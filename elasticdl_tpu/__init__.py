@@ -1,0 +1,20 @@
+"""elasticdl_tpu — a TPU-native elastic deep-learning framework.
+
+A ground-up rebuild of the capabilities of ElasticDL (reference:
+weblfe/elasticdl) designed for TPU hardware:
+
+- The control plane keeps ElasticDL's shape — a master that shards training
+  data into dynamically dispatched *tasks* and watches an elastic worker set
+  (reference: ``elasticdl/python/master/``) — because that design is
+  device-agnostic and is what makes worker death a non-event.
+- The data plane is brand new: the training step is a jit-compiled JAX/XLA
+  SPMD program over a ``jax.sharding.Mesh``; dense gradients ride ICI
+  collectives (psum/reduce-scatter) inside the compiled step instead of a
+  gRPC parameter-server round trip; parameters and optimizer state are
+  GSPMD-sharded (ZeRO-style) across the mesh.
+- Only the *sparse embedding* path keeps a host-side parameter server
+  (reference: ``elasticdl/go/pkg/ps/``), re-implemented as a C++ embedding
+  store served over gRPC from TPU-VM hosts.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,74 @@
+"""Shared constants.
+
+Reference parity: elasticdl/python/common/constants.py:15-96.
+"""
+
+
+class GRPC:
+    # Whole dense models can ride in single messages (reference raises the
+    # limit to 256 MB on both sides: common/constants.py:15-19).
+    MAX_SEND_MESSAGE_LENGTH = 256 * 1024 * 1024
+    MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
+
+
+class WorkerEnv:
+    WORKER_ID = "EDL_WORKER_ID"
+    MASTER_ADDR = "EDL_MASTER_ADDR"
+    WORKER_NUM = "EDL_WORKER_NUM"
+
+
+class JobType:
+    TRAINING_ONLY = "training"
+    EVALUATION_ONLY = "evaluation"
+    PREDICTION_ONLY = "prediction"
+    TRAINING_WITH_EVALUATION = "training_with_evaluation"
+
+
+class Mode:
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+
+
+class DistributionStrategy:
+    LOCAL = "Local"
+    # Dense gradients allreduced by XLA collectives inside the jitted step.
+    ALLREDUCE = "AllreduceStrategy"
+    # Sparse embeddings on a host-side PS; dense path still allreduce.
+    PARAMETER_SERVER = "ParameterServerStrategy"
+
+
+class PodStatus:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+    DELETED = "Deleted"
+
+
+class InstanceManagerStatus:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    FINISHED = "Finished"
+
+
+class TaskExecCounterKey:
+    FAIL_COUNT = "fail_count"
+
+
+class DefaultPort:
+    MASTER = 50001
+    PS = 50002
+    WORKER = 50003
+
+
+class SaveModelConfig:
+    SAVED_MODEL_PATH = "saved_model_path"
+
+
+# Per-task retry budget before the job is declared failed
+# (reference: master/task_dispatcher.py:27).
+MAX_TASK_RETRIES = 3
+# Per-minibatch retry budget against PS rejection (reference: worker/worker.py:49).
+MAX_MINIBATCH_RETRY_NUM = 64

@@ -1,0 +1,34 @@
+"""gRPC channel/server helpers.
+
+Reference parity: elasticdl/python/common/grpc_utils.py:22-40.
+"""
+
+import socket
+from concurrent import futures
+
+import grpc
+
+from elasticdl_tpu.common.constants import GRPC
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+]
+
+
+def build_channel(addr: str) -> grpc.Channel:
+    return grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+
+
+def build_server(max_workers: int = 64) -> grpc.Server:
+    return grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=_CHANNEL_OPTIONS,
+    )
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(("localhost", 0))
+        return sock.getsockname()[1]

@@ -1,0 +1,35 @@
+"""Stable hashing used for parameter/row placement.
+
+Reference parity: elasticdl/python/common/hash_utils.py:17-62 and the Go
+twins `StringToID`/`IntToID` (go/pkg/ps/checkpoint.go:31-44). Dense
+parameters route to a shard by sha256(name) mod N; embedding rows by
+id mod N. These functions must stay stable across processes and languages
+because checkpoint re-sharding on resume depends on them.
+"""
+
+import hashlib
+
+
+def string_to_id(name: str, bucket_num: int) -> int:
+    if bucket_num <= 0:
+        raise ValueError("bucket_num must be positive, got %s" % bucket_num)
+    digest = hashlib.sha256(name.encode("utf-8")).hexdigest()
+    return int(digest, 16) % bucket_num
+
+
+def int_to_id(value: int, bucket_num: int) -> int:
+    if bucket_num <= 0:
+        raise ValueError("bucket_num must be positive, got %s" % bucket_num)
+    return int(value) % bucket_num
+
+
+def scatter_ids(ids, bucket_num: int):
+    """Group embedding ids by destination shard.
+
+    Returns {shard_id: [positions...]} so callers can both route ids and
+    re-assemble the pulled rows in input order.
+    """
+    buckets = {}
+    for pos, i in enumerate(ids):
+        buckets.setdefault(int(i) % bucket_num, []).append(pos)
+    return buckets

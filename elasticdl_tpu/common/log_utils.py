@@ -1,0 +1,21 @@
+"""Logging factory (reference parity: elasticdl/python/common/log_utils.py)."""
+
+import logging
+
+_DEFAULT_FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+_initialized = False
+
+
+def default_logger(name: str = "elasticdl_tpu", level: int = logging.INFO):
+    global _initialized
+    if not _initialized:
+        logging.basicConfig(format=_DEFAULT_FMT)
+        _initialized = True
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    return logger
+
+
+def get_logger(name: str, level: int = logging.INFO):
+    return default_logger(name, level)

@@ -1,0 +1,66 @@
+"""numpy <-> TensorBlob conversion and IndexedSlices helpers.
+
+Reference parity: elasticdl/python/common/tensor_utils.py:31-122 (which
+converts to tensorflow.TensorProto). Here the wire type is our own
+TensorBlob (dtype string + dims + raw bytes), chosen so host code never
+needs TF and device code can go bytes -> numpy -> jax with one copy.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+def ndarray_to_blob(array, blob=None) -> pb.TensorBlob:
+    array = np.ascontiguousarray(array)
+    if blob is None:
+        blob = pb.TensorBlob()
+    blob.dtype = array.dtype.name
+    del blob.dims[:]
+    blob.dims.extend(array.shape)
+    blob.content = array.tobytes()
+    return blob
+
+
+def blob_to_ndarray(blob: pb.TensorBlob) -> np.ndarray:
+    dtype = np.dtype(blob.dtype)
+    array = np.frombuffer(blob.content, dtype=dtype)
+    return array.reshape(tuple(blob.dims))
+
+
+def serialize_indexed_slices(values, ids, slices=None) -> pb.IndexedSlicesProto:
+    """values: (n, dim) ndarray of rows; ids: iterable of int64 row ids."""
+    if slices is None:
+        slices = pb.IndexedSlicesProto()
+    ndarray_to_blob(values, slices.concat_tensors)
+    del slices.ids[:]
+    slices.ids.extend(int(i) for i in ids)
+    return slices
+
+
+def deserialize_indexed_slices(slices: pb.IndexedSlicesProto):
+    values = blob_to_ndarray(slices.concat_tensors)
+    ids = np.asarray(slices.ids, dtype=np.int64)
+    return values, ids
+
+
+def merge_indexed_slices(values_a, ids_a, values_b, ids_b):
+    """Concatenate two IndexedSlices (no dedup)."""
+    return (
+        np.concatenate([values_a, values_b], axis=0),
+        np.concatenate([ids_a, ids_b], axis=0),
+    )
+
+
+def deduplicate_indexed_slices(values, ids):
+    """Sum rows with duplicate ids.
+
+    Returns (summed_values, unique_ids). Mirrors the client-side dedup the
+    reference does before pushing embedding gradients
+    (worker/ps_client.py:135-232).
+    """
+    ids = np.asarray(ids, dtype=np.int64)
+    unique_ids, index = np.unique(ids, return_inverse=True)
+    summed = np.zeros((unique_ids.size, values.shape[1]), dtype=values.dtype)
+    np.add.at(summed, index, values)
+    return summed, unique_ids

@@ -1,0 +1,86 @@
+"""Mesh-epoch rendezvous for the elastic SPMD worker set.
+
+The reference used a Horovod HTTP rendezvous server whose ``rendezvous_id``
+bumped whenever the alive-worker set changed
+(master/rendezvous_server.py:29-81). On TPU, ICI topology within a slice is
+fixed, so "rendezvous" is reborn as a **mesh epoch**: a counter the master
+bumps whenever the elastic *slice/host set* changes. Workers poll
+``get_comm_info``; on seeing a new epoch they tear down and re-initialize
+``jax.distributed`` with the new coordinator/world-size and resume from the
+latest checkpoint.
+"""
+
+import threading
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.master.rendezvous")
+
+
+class MeshRendezvous:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mesh_epoch = 0
+        # host string -> rank; ranks assigned by join order (the reference
+        # sorts by pod start time: k8s_instance_manager.py:367-385)
+        self._hosts = []
+
+    def set_worker_hosts(self, hosts):
+        """Replace the alive-host list; bump the epoch if it changed."""
+        hosts = list(hosts)
+        with self._lock:
+            if hosts == self._hosts:
+                return self._mesh_epoch
+            self._hosts = hosts
+            self._mesh_epoch += 1
+            logger.info(
+                "Mesh epoch -> %d with %d hosts", self._mesh_epoch, len(hosts)
+            )
+            return self._mesh_epoch
+
+    def add_worker_host(self, host):
+        with self._lock:
+            if host in self._hosts:
+                return self._mesh_epoch
+            self._hosts.append(host)
+            self._mesh_epoch += 1
+            logger.info(
+                "Mesh epoch -> %d (+%s, %d hosts)",
+                self._mesh_epoch,
+                host,
+                len(self._hosts),
+            )
+            return self._mesh_epoch
+
+    def remove_worker_host(self, host):
+        with self._lock:
+            if host not in self._hosts:
+                return self._mesh_epoch
+            self._hosts.remove(host)
+            self._mesh_epoch += 1
+            logger.info(
+                "Mesh epoch -> %d (-%s, %d hosts)",
+                self._mesh_epoch,
+                host,
+                len(self._hosts),
+            )
+            return self._mesh_epoch
+
+    def get_comm_info(self, host):
+        """Returns (rank, world_size, mesh_epoch, coordinator_addr).
+
+        rank is -1 when the host is not (yet) part of the mesh.
+        """
+        with self._lock:
+            rank = self._hosts.index(host) if host in self._hosts else -1
+            coordinator = self._hosts[0] if self._hosts else ""
+            return rank, len(self._hosts), self._mesh_epoch, coordinator
+
+    @property
+    def mesh_epoch(self):
+        with self._lock:
+            return self._mesh_epoch
+
+    def hosts(self):
+        with self._lock:
+            return list(self._hosts)

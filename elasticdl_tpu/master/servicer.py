@@ -1,0 +1,107 @@
+"""gRPC Master service implementation.
+
+Reference parity: elasticdl/python/master/servicer.py:57-161 — get_task
+(WAIT when the queue is temporarily empty), report_task_result (feeds task
+timing stats + failure counters), report_evaluation_metrics,
+report_version (triggers step-based eval), and the comm-info RPC (the
+reference's get_comm_rank against the Horovod rendezvous; here the mesh
+epoch, see master/rendezvous.py).
+"""
+
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = _logger_factory("elasticdl_tpu.master.servicer")
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        task_dispatcher,
+        evaluation_service=None,
+        rendezvous=None,
+        instance_manager=None,
+    ):
+        self._task_dispatcher = task_dispatcher
+        self._evaluation_service = evaluation_service
+        self._rendezvous = rendezvous
+        self._instance_manager = instance_manager
+        self._lock = threading.Lock()
+        # worker_id -> last RPC timestamp; the liveness signal for the
+        # timeout scanner (reference: servicer.py:93-94,104-105)
+        self._worker_liveness = {}
+
+    # ------------------------------------------------------------------
+    def _touch(self, worker_id):
+        with self._lock:
+            self._worker_liveness[worker_id] = time.time()
+
+    def worker_liveness(self):
+        with self._lock:
+            return dict(self._worker_liveness)
+
+    def forget_worker(self, worker_id):
+        with self._lock:
+            self._worker_liveness.pop(worker_id, None)
+
+    # ------------------------------------------------------------------
+    # RPC handlers (also callable in-process without gRPC)
+
+    def get_task(self, request, context=None):
+        self._touch(request.worker_id)
+        task_type = request.task_type if request.task_type else None
+        task = self._task_dispatcher.get(request.worker_id, task_type)
+        if task is not None:
+            return task
+        if (
+            self._task_dispatcher.finished()
+            or self._task_dispatcher.job_failed()
+        ):
+            # Default Task (task_id=0, type=TRAINING): the job is over
+            # (success or terminal failure) and the worker should exit.
+            # The master distinguishes the two via job_failed().
+            return pb.Task()
+        # Queue temporarily empty (e.g. between epochs or during an eval
+        # pass): tell the worker to wait and re-poll.
+        return pb.Task(type=pb.WAIT)
+
+    def report_task_result(self, request, context=None):
+        success = not request.err_message
+        if not success:
+            logger.warning(
+                "Task %s failed: %s", request.task_id, request.err_message
+            )
+        self._task_dispatcher.report(request.task_id, success)
+        return pb.Empty()
+
+    def report_evaluation_metrics(self, request, context=None):
+        self._touch(request.worker_id)
+        if self._evaluation_service is not None:
+            self._evaluation_service.report_evaluation_metrics(
+                request.model_outputs, request.labels
+            )
+        return pb.Empty()
+
+    def report_version(self, request, context=None):
+        if self._evaluation_service is not None:
+            self._evaluation_service.add_evaluation_task_if_needed(
+                request.model_version
+            )
+        return pb.Empty()
+
+    def get_comm_info(self, request, context=None):
+        self._touch(request.worker_id)
+        if self._rendezvous is None:
+            return pb.CommInfo(rank=0, world_size=1, mesh_epoch=0)
+        rank, size, epoch, coordinator = self._rendezvous.get_comm_info(
+            request.worker_host
+        )
+        return pb.CommInfo(
+            rank=rank,
+            world_size=size,
+            mesh_epoch=epoch,
+            coordinator_addr=coordinator,
+        )

@@ -1,0 +1,1 @@
+from elasticdl_tpu.proto import elasticdl_tpu_pb2  # noqa: F401

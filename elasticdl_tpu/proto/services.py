@@ -1,0 +1,83 @@
+"""gRPC service bindings for the Master and Pserver services.
+
+The reference generates these with the protoc gRPC plugin
+(elasticdl/proto/elasticdl.proto:108-157); this environment has no
+`grpc_tools`, so the stubs/servicers are written by hand against grpc's
+generic-handler API. The wire format is identical to what generated code
+would produce (unary-unary methods, protobuf (de)serializers), so clients
+and servers here interoperate with any standard gRPC toolchain.
+"""
+
+import grpc
+
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+_MASTER_SERVICE = "elasticdl_tpu.Master"
+_PSERVER_SERVICE = "elasticdl_tpu.Pserver"
+
+# method name -> (request class, response class)
+_MASTER_METHODS = {
+    "get_task": (pb.GetTaskRequest, pb.Task),
+    "report_task_result": (pb.ReportTaskResultRequest, pb.Empty),
+    "report_evaluation_metrics": (pb.ReportEvaluationMetricsRequest, pb.Empty),
+    "report_version": (pb.ReportVersionRequest, pb.Empty),
+    "get_comm_info": (pb.GetCommInfoRequest, pb.CommInfo),
+}
+
+_PSERVER_METHODS = {
+    "push_model": (pb.Model, pb.Empty),
+    "push_embedding_table_infos": (pb.Model, pb.Empty),
+    "pull_dense_parameters": (
+        pb.PullDenseParametersRequest,
+        pb.PullDenseParametersResponse,
+    ),
+    "pull_embedding_vectors": (pb.PullEmbeddingVectorsRequest, pb.TensorBlob),
+    "push_gradients": (pb.PushGradientsRequest, pb.PushGradientsResponse),
+}
+
+
+class _Stub:
+    """Builds unary-unary callables for each method of a service."""
+
+    def __init__(self, channel, service_name, methods):
+        for name, (req_cls, resp_cls) in methods.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    "/%s/%s" % (service_name, name),
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                ),
+            )
+
+
+class MasterStub(_Stub):
+    def __init__(self, channel):
+        super().__init__(channel, _MASTER_SERVICE, _MASTER_METHODS)
+
+
+class PserverStub(_Stub):
+    def __init__(self, channel):
+        super().__init__(channel, _PSERVER_SERVICE, _PSERVER_METHODS)
+
+
+def _add_service(server, servicer, service_name, methods):
+    handlers = {}
+    for name, (req_cls, resp_cls) in methods.items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(service_name, handlers),)
+    )
+
+
+def add_master_servicer_to_server(servicer, server):
+    _add_service(server, servicer, _MASTER_SERVICE, _MASTER_METHODS)
+
+
+def add_pserver_servicer_to_server(servicer, server):
+    _add_service(server, servicer, _PSERVER_SERVICE, _PSERVER_METHODS)

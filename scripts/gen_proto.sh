@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate protobuf Python code. The gRPC stubs are hand-written in
+# elasticdl_tpu/proto/services.py (no grpc_tools in this environment), so
+# only message codegen is needed.
+set -e
+cd "$(dirname "$0")/.."
+protoc --python_out=. elasticdl_tpu/proto/elasticdl_tpu.proto
+echo "Regenerated elasticdl_tpu/proto/elasticdl_tpu_pb2.py"

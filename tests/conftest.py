@@ -1,0 +1,21 @@
+"""Test configuration.
+
+Tests run on the JAX CPU backend with 8 virtual devices standing in for a
+TPU slice, mirroring the reference's strategy of exercising distributed
+behavior without a real cluster (SURVEY.md §4: in-process gRPC
+multi-servicer tests + fake devices).
+
+Environment must be set before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,46 @@
+import numpy as np
+
+from elasticdl_tpu.common.tensor_utils import ndarray_to_blob
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.train.metrics import Accuracy
+
+
+def _metrics_fn():
+    return {"accuracy": Accuracy()}
+
+
+def test_step_based_eval_trigger_and_summary():
+    dispatcher = TaskDispatcher(
+        training_shards={"t": (0, 4)},
+        evaluation_shards={"e": (0, 4)},
+        records_per_task=2,
+        num_epochs=1,
+    )
+    service = EvaluationService(
+        dispatcher, _metrics_fn, eval_steps=10
+    )
+    assert not service.add_evaluation_task_if_needed(5)
+    assert service.add_evaluation_task_if_needed(10)
+    # a second trigger while a job is running is dropped
+    assert not service.add_evaluation_task_if_needed(20)
+
+    # worker processes the two eval tasks
+    outputs = {"output": ndarray_to_blob(np.eye(2)[[0, 1]])}
+    labels = ndarray_to_blob(np.array([0, 1]))
+    eval_tasks = []
+    while True:
+        task = dispatcher.get(0)
+        if task is None:
+            break
+        from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+        if task.type == pb.EVALUATION:
+            service.report_evaluation_metrics(outputs, labels)
+            eval_tasks.append(task)
+        dispatcher.report(task.task_id, True)
+    assert len(eval_tasks) == 2
+    assert len(service.completed_summaries) == 1
+    version, summary = service.completed_summaries[0]
+    assert version == 10
+    assert summary["accuracy"] == 1.0

@@ -1,0 +1,59 @@
+import numpy as np
+
+from elasticdl_tpu.train import metrics as M
+
+
+def test_accuracy_sparse_categorical():
+    m = M.Accuracy()
+    labels = np.array([0, 1, 2, 1])
+    outputs = np.eye(3)[[0, 1, 0, 1]]
+    m.update_state(labels, outputs)
+    assert m.result() == 0.75
+
+
+def test_binary_accuracy_logits():
+    m = M.BinaryAccuracy(from_logits=True)
+    m.update_state(np.array([1, 0, 1]), np.array([2.0, -2.0, -2.0]))
+    assert abs(m.result() - 2 / 3) < 1e-9
+
+
+def test_auc_matches_sklearn_style_rank():
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, size=200)
+    scores = rng.rand(200) + labels * 0.5
+    m = M.AUC()
+    # streaming in chunks must equal one-shot
+    m.update_state(labels[:100], scores[:100])
+    m.update_state(labels[100:], scores[100:])
+    # brute-force pairwise AUC
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    pairs = (pos[:, None] > neg[None, :]).sum() + 0.5 * (
+        pos[:, None] == neg[None, :]
+    ).sum()
+    expected = pairs / (pos.size * neg.size)
+    assert abs(m.result() - expected) < 1e-9
+
+
+def test_mse_mae():
+    mse = M.MeanSquaredError()
+    mae = M.MeanAbsoluteError()
+    labels = np.array([1.0, 2.0, 3.0])
+    outputs = np.array([1.0, 1.0, 5.0])
+    mse.update_state(labels, outputs)
+    mae.update_state(labels, outputs)
+    assert abs(mse.result() - (0 + 1 + 4) / 3) < 1e-9
+    assert abs(mae.result() - (0 + 1 + 2) / 3) < 1e-9
+
+
+def test_evaluation_metrics_multi_output():
+    books = M.EvaluationMetrics(
+        {"probs": {"acc": M.Accuracy()}, "aux": {"mse": M.MeanSquaredError()}}
+    )
+    books.update_evaluation_metrics(
+        {"probs": np.eye(2)[[0, 1]], "aux": np.array([1.0, 1.0])},
+        np.array([0, 1]),
+    )
+    summary = books.get_evaluation_summary()
+    assert summary["probs"]["acc"] == 1.0
+    assert summary["aux"]["mse"] == 0.5

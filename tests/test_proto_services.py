@@ -1,0 +1,67 @@
+"""Wire-protocol tests: tensor round trips and a live in-process gRPC
+master (mirrors the reference's mock_service.py pattern)."""
+
+import numpy as np
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.common.grpc_utils import (
+    build_channel,
+    build_server,
+    find_free_port,
+)
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.services import MasterStub, add_master_servicer_to_server
+
+
+def test_tensor_blob_roundtrip():
+    for dtype in ("float32", "int64", "bfloat16_fallback"):
+        if dtype == "bfloat16_fallback":
+            import ml_dtypes
+
+            arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+            arr = arr.astype(ml_dtypes.bfloat16)
+        else:
+            arr = np.arange(12, dtype=dtype).reshape(3, 4)
+        blob = tensor_utils.ndarray_to_blob(arr)
+        out = tensor_utils.blob_to_ndarray(blob)
+        assert out.dtype == arr.dtype
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_indexed_slices_dedup():
+    values = np.ones((4, 2), dtype=np.float32)
+    ids = np.array([3, 1, 3, 1], dtype=np.int64)
+    summed, unique = tensor_utils.deduplicate_indexed_slices(values, ids)
+    np.testing.assert_array_equal(unique, [1, 3])
+    np.testing.assert_allclose(summed, 2 * np.ones((2, 2)))
+
+
+def test_master_service_over_grpc():
+    dispatcher = TaskDispatcher(
+        training_shards={"f": (0, 6)}, records_per_task=3, num_epochs=1
+    )
+    servicer = MasterServicer(dispatcher)
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    try:
+        stub = MasterStub(build_channel("localhost:%d" % port))
+        t1 = stub.get_task(pb.GetTaskRequest(worker_id=1))
+        assert t1.task_id > 0 and t1.type == pb.TRAINING
+        t2 = stub.get_task(pb.GetTaskRequest(worker_id=1))
+        assert t2.task_id > 0
+        # queue empty but t1/t2 in-flight -> WAIT
+        t3 = stub.get_task(pb.GetTaskRequest(worker_id=2))
+        assert t3.task_id == 0 and t3.type == pb.WAIT
+        stub.report_task_result(pb.ReportTaskResultRequest(task_id=t1.task_id))
+        stub.report_task_result(pb.ReportTaskResultRequest(task_id=t2.task_id))
+        # all work done -> default Task means "exit"
+        t4 = stub.get_task(pb.GetTaskRequest(worker_id=1))
+        assert t4.task_id == 0 and t4.type == pb.TRAINING
+        assert dispatcher.finished()
+    finally:
+        server.stop(None)

@@ -1,0 +1,127 @@
+"""TaskDispatcher semantics tests.
+
+Models the reference's task_dispatcher_test.py coverage: slicing, epochs,
+re-queue on failure, retry cap, recover_tasks, train-end callback task.
+"""
+
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+def make_dispatcher(**kwargs):
+    defaults = dict(
+        training_shards={"f1": (0, 10), "f2": (0, 10)},
+        evaluation_shards={"e1": (0, 10)},
+        records_per_task=3,
+        num_epochs=2,
+        shuffle=False,
+    )
+    defaults.update(kwargs)
+    return TaskDispatcher(**defaults)
+
+
+def drain(dispatcher, worker_id=0):
+    tasks = []
+    while True:
+        task = dispatcher.get(worker_id)
+        if task is None:
+            break
+        tasks.append(task)
+    return tasks
+
+
+def test_task_slicing_covers_all_records():
+    d = make_dispatcher(num_epochs=1)
+    tasks = drain(d)
+    # 10 records / 3 per task = 4 tasks per shard, 2 shards
+    assert len(tasks) == 8
+    covered = {}
+    for t in tasks:
+        covered.setdefault(t.shard_name, []).append((t.start, t.end))
+    for name in ("f1", "f2"):
+        ranges = sorted(covered[name])
+        assert ranges[0][0] == 0
+        assert ranges[-1][1] == 10
+        # contiguity
+        for (s0, e0), (s1, e1) in zip(ranges, ranges[1:]):
+            assert e0 == s1
+
+
+def test_lazy_epoch_creation():
+    # get() creates the next epoch's tasks lazily when the queue drains,
+    # so a persistent worker sees all epochs as one continuous stream.
+    d = make_dispatcher(num_epochs=3)
+    tasks = drain(d)
+    assert len(tasks) == 24  # 8 tasks/epoch x 3 epochs
+    for t in tasks:
+        d.report(t.task_id, True)
+    assert d.get(0) is None
+    assert d.finished()
+
+
+def test_failed_task_requeued_then_capped():
+    d = make_dispatcher(num_epochs=1, max_task_retries=3)
+    task = d.get(0)
+    for _ in range(3):
+        d.report(task.task_id, False)
+        again = None
+        # the failed task goes to the back of the queue
+        while True:
+            t = d.get(0)
+            if t is None:
+                break
+            if t.task_id == task.task_id:
+                again = t
+                break
+            d.report(t.task_id, True)
+        assert again is not None
+    d.report(task.task_id, False)  # 4th failure exceeds cap
+    assert d.job_failed()
+
+
+def test_recover_tasks_requeues_worker_inflight():
+    d = make_dispatcher(num_epochs=1)
+    t1 = d.get(worker_id=1)
+    t2 = d.get(worker_id=1)
+    t3 = d.get(worker_id=2)
+    d.recover_tasks(1)
+    remaining = drain(d, worker_id=3)
+    ids = {t.task_id for t in remaining}
+    assert t1.task_id in ids and t2.task_id in ids
+    assert t3.task_id not in ids  # still held by worker 2
+
+
+def test_train_end_callback_task_created_after_last_epoch():
+    d = make_dispatcher(num_epochs=1)
+    d.add_deferred_callback_create_train_end_task({"saved_model_path": "/tmp/m"})
+    tasks = drain(d)
+    for t in tasks:
+        d.report(t.task_id, True)
+    end_task = d.get(0)
+    assert end_task is not None
+    assert end_task.type == pb.TRAIN_END_CALLBACK
+    assert end_task.extended_config["saved_model_path"] == "/tmp/m"
+    assert not d.finished()
+    d.report(end_task.task_id, True)
+    assert d.finished()
+
+
+def test_evaluation_tasks_take_priority():
+    d = make_dispatcher(num_epochs=1)
+    n = d.create_evaluation_tasks(model_version=5)
+    assert n == 4  # 10 records / 3 per task, 1 eval shard
+    t = d.get(0)
+    assert t.type == pb.EVALUATION
+    assert t.model_version == 5
+
+
+def test_prediction_only_job():
+    d = TaskDispatcher(
+        training_shards={},
+        prediction_shards={"p": (0, 7)},
+        records_per_task=3,
+        num_epochs=1,
+    )
+    tasks = drain(d)
+    assert len(tasks) == 3
+    assert all(t.type == pb.PREDICTION for t in tasks)
