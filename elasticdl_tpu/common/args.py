@@ -1,0 +1,90 @@
+"""Argument parsing for master and worker processes.
+
+Reference parity: elasticdl/python/common/args.py:110-228 (the master/
+worker argparse surface) — trimmed to the flags that exist in the TPU
+design; the client CLI (client/) re-serializes these for pod commands the
+same way the reference does (args.py:543-565).
+"""
+
+import argparse
+
+
+def _add_common(parser):
+    parser.add_argument(
+        "--model_zoo",
+        required=True,
+        help="Model module: dotted import path or file path",
+    )
+    parser.add_argument("--training_data", default="")
+    parser.add_argument("--validation_data", default="")
+    parser.add_argument("--prediction_data", default="")
+    parser.add_argument("--minibatch_size", type=int, default=32)
+    parser.add_argument(
+        "--data_reader_params",
+        default="",
+        help="k=v;k=v parameters for the data reader",
+    )
+    parser.add_argument(
+        "--compute_dtype",
+        default="",
+        help="Computation dtype for the jitted step (e.g. bfloat16); "
+        "params stay float32",
+    )
+
+
+def parse_master_args(argv=None):
+    parser = argparse.ArgumentParser("elasticdl_tpu master")
+    _add_common(parser)
+    parser.add_argument("--port", type=int, default=50001)
+    parser.add_argument("--records_per_task", type=int, default=1024)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--evaluation_steps", type=int, default=0)
+    parser.add_argument("--evaluation_throttle_secs", type=int, default=0)
+    parser.add_argument("--evaluation_start_delay_secs", type=int, default=0)
+    parser.add_argument("--task_timeout_secs", type=float, default=30.0)
+    parser.add_argument(
+        "--output", default="", help="saved-model export path"
+    )
+    parser.add_argument("--num_workers", type=int, default=1)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=int, default=0)
+    parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    parser.add_argument("--checkpoint_dir_for_init", default="")
+    return parser.parse_args(argv)
+
+
+def parse_worker_args(argv=None):
+    parser = argparse.ArgumentParser("elasticdl_tpu worker")
+    _add_common(parser)
+    parser.add_argument("--master_addr", required=True)
+    parser.add_argument("--worker_id", type=int, required=True)
+    parser.add_argument(
+        "--mode",
+        default="training",
+        choices=["training", "evaluation", "prediction"],
+    )
+    parser.add_argument("--report_version_steps", type=int, default=10)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=int, default=0)
+    parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    return parser.parse_args(argv)
+
+
+def parse_params_string(params: str) -> dict:
+    """Parse 'k=v;k=v' strings (reference: model_utils.py:79-94). Values
+    are eval'd as Python literals when possible."""
+    import ast
+
+    result = {}
+    for part in (params or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError("Bad params segment %r" % part)
+        key, value = part.split("=", 1)
+        try:
+            result[key.strip()] = ast.literal_eval(value.strip())
+        except (ValueError, SyntaxError):
+            result[key.strip()] = value.strip()
+    return result

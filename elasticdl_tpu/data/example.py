@@ -1,0 +1,25 @@
+"""Example (de)serialization for record files.
+
+Plays the role tf.train.Example plays for the reference's RecordIO
+datasets (data/recordio_gen/ converts datasets to Example records). An
+example is a dict of named numpy tensors, serialized as the Record proto.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common.tensor_utils import blob_to_ndarray, ndarray_to_blob
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+def encode_example(features: dict) -> bytes:
+    record = pb.Record()
+    for name, value in features.items():
+        ndarray_to_blob(np.asarray(value), record.features[name])
+    return record.SerializeToString()
+
+
+def decode_example(payload: bytes) -> dict:
+    record = pb.Record.FromString(payload)
+    return {
+        name: blob_to_ndarray(blob) for name, blob in record.features.items()
+    }

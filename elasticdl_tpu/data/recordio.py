@@ -1,0 +1,109 @@
+"""Indexed record file format ("edlrec").
+
+The task system needs exactly one property from its storage format: O(1)
+seek to record #k so a worker can read an arbitrary ``[start, end)`` task
+range (reference: RecordIO via recordio.Scanner,
+data/reader/recordio_reader.py:33-54). The recordio library isn't in this
+environment, so this is a minimal self-contained format with that
+property:
+
+    [u32 len][payload] ... [u32 len][payload]   # records
+    [u64 offset]*n                              # index: offset of each record
+    [u64 index_offset][u64 num_records][8-byte magic "EDLREC01"]
+
+All integers little-endian. The trailer is fixed-size, so a reader finds
+the index with one seek from EOF.
+"""
+
+import os
+import struct
+
+_MAGIC = b"EDLREC01"
+_TRAILER = struct.Struct("<QQ8s")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+class RecordWriter:
+    def __init__(self, path):
+        self._file = open(path, "wb")
+        self._offsets = []
+
+    def write(self, payload: bytes):
+        self._offsets.append(self._file.tell())
+        self._file.write(_U32.pack(len(payload)))
+        self._file.write(payload)
+
+    def close(self):
+        index_offset = self._file.tell()
+        for off in self._offsets:
+            self._file.write(_U64.pack(off))
+        self._file.write(_TRAILER.pack(index_offset, len(self._offsets), _MAGIC))
+        self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordReader:
+    """Random-access reader over an edlrec file."""
+
+    def __init__(self, path):
+        self._file = open(path, "rb")
+        self._file.seek(-_TRAILER.size, os.SEEK_END)
+        index_offset, num, magic = _TRAILER.unpack(self._file.read(_TRAILER.size))
+        if magic != _MAGIC:
+            raise ValueError("%s is not an edlrec file" % path)
+        self._num_records = num
+        self._file.seek(index_offset)
+        raw = self._file.read(num * _U64.size)
+        self._offsets = [
+            _U64.unpack_from(raw, i * _U64.size)[0] for i in range(num)
+        ]
+
+    def __len__(self):
+        return self._num_records
+
+    def read(self, index: int) -> bytes:
+        if not 0 <= index < self._num_records:
+            raise IndexError(index)
+        self._file.seek(self._offsets[index])
+        (length,) = _U32.unpack(self._file.read(_U32.size))
+        return self._file.read(length)
+
+    def read_range(self, start: int, end: int):
+        """Yield records [start, end); sequential reads avoid re-seeking."""
+        end = min(end, self._num_records)
+        if start >= end:
+            return
+        self._file.seek(self._offsets[start])
+        for _ in range(start, end):
+            (length,) = _U32.unpack(self._file.read(_U32.size))
+            yield self._file.read(length)
+
+    def close(self):
+        self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_records(path, payloads):
+    with RecordWriter(path) as w:
+        for p in payloads:
+            w.write(p)
+
+
+def count_records(path) -> int:
+    with open(path, "rb") as f:
+        f.seek(-_TRAILER.size, os.SEEK_END)
+        _, num, magic = _TRAILER.unpack(f.read(_TRAILER.size))
+        if magic != _MAGIC:
+            raise ValueError("%s is not an edlrec file" % path)
+        return num

@@ -1,0 +1,34 @@
+"""Master process entry point.
+
+Reference parity: elasticdl/python/master/main.py:20-24.
+Usage: python -m elasticdl_tpu.master.main --model_zoo=... --training_data=...
+"""
+
+import sys
+
+from elasticdl_tpu.common.args import parse_master_args
+from elasticdl_tpu.master.master import Master
+
+
+def main(argv=None):
+    args = parse_master_args(argv)
+    master = Master(
+        model_zoo_module=args.model_zoo,
+        training_data=args.training_data,
+        validation_data=args.validation_data,
+        prediction_data=args.prediction_data,
+        records_per_task=args.records_per_task,
+        num_epochs=args.num_epochs,
+        port=args.port,
+        eval_steps=args.evaluation_steps,
+        eval_throttle_secs=args.evaluation_throttle_secs,
+        eval_start_delay_secs=args.evaluation_start_delay_secs,
+        saved_model_path=args.output,
+        task_timeout_secs=args.task_timeout_secs,
+    )
+    master.prepare()
+    return master.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

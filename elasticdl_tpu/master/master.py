@@ -1,0 +1,161 @@
+"""Master composition root: owns the whole job.
+
+Reference parity: elasticdl/python/master/master.py:97-572 — loads the
+model module, builds the task dispatcher over the reader's shards, starts
+the evaluation service / gRPC server / instance manager, then polls for
+completion. The TPU version composes the same pieces minus the PS fleet
+(dense parameters live on workers' devices) and plus the mesh-epoch
+rendezvous and task monitor.
+"""
+
+import time
+
+from elasticdl_tpu.common.constants import JobType
+from elasticdl_tpu.common.grpc_utils import build_server
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.data.readers import create_data_reader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.rendezvous import MeshRendezvous
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.master.task_monitor import TaskMonitor
+from elasticdl_tpu.models.registry import get_model_spec
+from elasticdl_tpu.proto.services import add_master_servicer_to_server
+
+logger = _logger_factory("elasticdl_tpu.master.master")
+
+
+class Master:
+    def __init__(
+        self,
+        model_zoo_module,
+        training_data=None,
+        validation_data=None,
+        prediction_data=None,
+        records_per_task=1024,
+        num_epochs=1,
+        port=50001,
+        eval_steps=0,
+        eval_throttle_secs=0,
+        eval_start_delay_secs=0,
+        saved_model_path=None,
+        data_reader_params=None,
+        pod_manager=None,
+        task_timeout_secs=30.0,
+        seed=None,
+    ):
+        self.spec = get_model_spec(model_zoo_module)
+        reader_params = data_reader_params or {}
+
+        def shards_of(origin):
+            if not origin:
+                return {}
+            reader = create_data_reader(origin, **reader_params)
+            return reader.create_shards()
+
+        self.job_type = self._infer_job_type(
+            training_data, validation_data, prediction_data
+        )
+        self.task_dispatcher = TaskDispatcher(
+            training_shards=shards_of(training_data),
+            evaluation_shards=shards_of(validation_data),
+            prediction_shards=shards_of(prediction_data),
+            records_per_task=records_per_task,
+            num_epochs=num_epochs,
+            seed=seed,
+        )
+        if saved_model_path and self.job_type != JobType.PREDICTION_ONLY:
+            self.task_dispatcher.add_deferred_callback_create_train_end_task(
+                {"saved_model_path": saved_model_path}
+            )
+        self.evaluation_service = None
+        if validation_data and self.job_type != JobType.PREDICTION_ONLY:
+            self.evaluation_service = EvaluationService(
+                self.task_dispatcher,
+                self.spec.eval_metrics_fn,
+                eval_start_delay_secs=eval_start_delay_secs,
+                eval_throttle_secs=eval_throttle_secs,
+                eval_steps=eval_steps,
+            )
+        self.rendezvous = MeshRendezvous()
+        self.servicer = MasterServicer(
+            self.task_dispatcher,
+            self.evaluation_service,
+            self.rendezvous,
+        )
+        self.pod_manager = pod_manager
+        self.task_monitor = TaskMonitor(
+            self.task_dispatcher,
+            self.servicer,
+            self.rendezvous,
+            on_worker_dead=self._on_worker_dead,
+            liveness_timeout_secs=task_timeout_secs,
+        )
+        self._port = port
+        self._server = None
+
+    @staticmethod
+    def _infer_job_type(training_data, validation_data, prediction_data):
+        if prediction_data:
+            return JobType.PREDICTION_ONLY
+        if training_data and validation_data:
+            return JobType.TRAINING_WITH_EVALUATION
+        if validation_data:
+            return JobType.EVALUATION_ONLY
+        return JobType.TRAINING_ONLY
+
+    def _on_worker_dead(self, worker_id):
+        if self.pod_manager is not None:
+            self.pod_manager.on_worker_presumed_dead(worker_id)
+
+    # ------------------------------------------------------------------
+    def prepare(self):
+        if self.evaluation_service is not None:
+            self.evaluation_service.start()
+        if self.job_type == JobType.EVALUATION_ONLY:
+            n = self.task_dispatcher.create_evaluation_tasks(-1)
+            if self.evaluation_service is not None:
+                self.evaluation_service.init_eval_only_job(n)
+        self._server = build_server()
+        add_master_servicer_to_server(self.servicer, self._server)
+        self._server.add_insecure_port("[::]:%d" % self._port)
+        self._server.start()
+        self.task_monitor.start()
+        if self.pod_manager is not None:
+            self.pod_manager.start()
+        logger.info("Master serving on :%d", self._port)
+        return self
+
+    def run(self, poll_secs=1.0, timeout_secs=None):
+        """Block until the job finishes; returns 0 on success, 1 on
+        failure (reference: master.py:240-265 polls every 30 s)."""
+        start = time.time()
+        try:
+            while True:
+                if self.task_dispatcher.finished():
+                    logger.info("Job finished")
+                    return 0
+                if self.task_dispatcher.job_failed():
+                    logger.error("Job failed (task retries exhausted)")
+                    return 1
+                if (
+                    self.pod_manager is not None
+                    and self.pod_manager.all_workers_failed()
+                ):
+                    logger.error("All workers failed; aborting job")
+                    return 1
+                if timeout_secs and time.time() - start > timeout_secs:
+                    logger.error("Job timed out")
+                    return 1
+                time.sleep(poll_secs)
+        finally:
+            self.stop()
+
+    def stop(self):
+        self.task_monitor.stop()
+        if self.evaluation_service is not None:
+            self.evaluation_service.stop()
+        if self.pod_manager is not None:
+            self.pod_manager.stop()
+        if self._server is not None:
+            self._server.stop(grace=1.0)

@@ -24,15 +24,23 @@ class MasterServicer:
         evaluation_service=None,
         rendezvous=None,
         instance_manager=None,
+        auto_join_mesh=True,
     ):
         self._task_dispatcher = task_dispatcher
         self._evaluation_service = evaluation_service
         self._rendezvous = rendezvous
         self._instance_manager = instance_manager
+        # Membership = live workers: a worker's first get_comm_info joins
+        # its host to the mesh. A pod manager that owns membership
+        # explicitly (K8s pod events) sets auto_join_mesh=False.
+        self._auto_join_mesh = auto_join_mesh
         self._lock = threading.Lock()
         # worker_id -> last RPC timestamp; the liveness signal for the
         # timeout scanner (reference: servicer.py:93-94,104-105)
         self._worker_liveness = {}
+        # worker_id -> host (from get_comm_info); lets the task monitor
+        # evict a dead worker's host from the mesh rendezvous
+        self._worker_hosts = {}
 
     # ------------------------------------------------------------------
     def _touch(self, worker_id):
@@ -46,6 +54,11 @@ class MasterServicer:
     def forget_worker(self, worker_id):
         with self._lock:
             self._worker_liveness.pop(worker_id, None)
+            self._worker_hosts.pop(worker_id, None)
+
+    def worker_host(self, worker_id):
+        with self._lock:
+            return self._worker_hosts.get(worker_id)
 
     # ------------------------------------------------------------------
     # RPC handlers (also callable in-process without gRPC)
@@ -69,12 +82,15 @@ class MasterServicer:
         return pb.Task(type=pb.WAIT)
 
     def report_task_result(self, request, context=None):
+        self._touch(request.worker_id)
         success = not request.err_message
         if not success:
             logger.warning(
                 "Task %s failed: %s", request.task_id, request.err_message
             )
-        self._task_dispatcher.report(request.task_id, success)
+        self._task_dispatcher.report(
+            request.task_id, success, worker_id=request.worker_id
+        )
         return pb.Empty()
 
     def report_evaluation_metrics(self, request, context=None):
@@ -96,6 +112,11 @@ class MasterServicer:
         self._touch(request.worker_id)
         if self._rendezvous is None:
             return pb.CommInfo(rank=0, world_size=1, mesh_epoch=0)
+        if request.worker_host:
+            with self._lock:
+                self._worker_hosts[request.worker_id] = request.worker_host
+            if self._auto_join_mesh:
+                self._rendezvous.add_worker_host(request.worker_host)
         rank, size, epoch, coordinator = self._rendezvous.get_comm_info(
             request.worker_host
         )

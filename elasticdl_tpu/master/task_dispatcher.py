@@ -65,7 +65,9 @@ class TaskDispatcher:
         self._shuffle = shuffle
         self._rng = random.Random(seed)
 
-        self._epochs_left = num_epochs
+        # Epochs only apply to training; eval-/predict-only jobs must not
+        # leave a phantom epoch that keeps finished() False forever.
+        self._epochs_left = num_epochs if self._training_shards else 0
         self._next_task_id = 1
         # task_id -> _TaskRecord for every task ever handed out or queued
         self._records = {}
@@ -195,8 +197,13 @@ class TaskDispatcher:
             self._worker_doing.setdefault(worker_id, set()).add(task_id)
             return self._records[task_id].task
 
-    def report(self, task_id, success):
+    def report(self, task_id, success, worker_id=None):
         """Mark a task done or failed; failed tasks re-queue up to the cap.
+
+        ``worker_id``, when provided, must match the task's current
+        assignee — otherwise the report is stale (the task was recovered
+        from a presumed-dead worker and re-assigned) and is ignored so it
+        can't clobber the new assignee's run.
 
         Returns (evaluation_task_completed, task) so the caller can feed
         the evaluation service. When the last training task of the last
@@ -210,12 +217,23 @@ class TaskDispatcher:
             if record is None:
                 logger.warning("Unknown task id reported: %s", task_id)
                 return False, None
-            doing = self._doing.pop(task_id, None)
-            if doing is not None:
-                worker_id, start_time = doing
-                self._worker_doing.get(worker_id, set()).discard(task_id)
-            else:
-                start_time = None
+            doing = self._doing.get(task_id)
+            if doing is None or (
+                worker_id is not None and doing[0] != worker_id
+            ):
+                # Stale report: the task was already recovered (e.g. its
+                # worker was presumed dead mid-compile) and possibly
+                # re-assigned, or double-reported. Ignoring keeps the
+                # current assignment the single source of truth.
+                logger.warning(
+                    "Stale report for task %s from worker %s; ignored",
+                    task_id,
+                    worker_id,
+                )
+                return False, record.task
+            del self._doing[task_id]
+            assignee, start_time = doing
+            self._worker_doing.get(assignee, set()).discard(task_id)
 
             task = record.task
             if success:
@@ -274,9 +292,11 @@ class TaskDispatcher:
         death a non-event.
         """
         with self._lock:
-            task_ids = list(self._worker_doing.pop(worker_id, set()))
+            task_ids = list(self._worker_doing.get(worker_id, set()))
         for task_id in task_ids:
-            self.report(task_id, success=False)
+            self.report(task_id, success=False, worker_id=worker_id)
+        with self._lock:
+            self._worker_doing.pop(worker_id, None)
         if task_ids:
             logger.info(
                 "Recovered %d tasks from worker %s", len(task_ids), worker_id

@@ -1,0 +1,111 @@
+"""Failure detection: task timeouts and worker liveness.
+
+Reference parity: the master's _check_timeout_tasks thread — a task
+running 3x slower than the rolling average is recovered and its worker
+removed (master/master.py:550-572, servicer.py:131-145) — plus the
+RPC-liveness bookkeeping (servicer.py:93-94). On TPU this is the primary
+failure detector for the in-job path; pod-level detection (K8s events)
+layers on top via the pod manager.
+"""
+
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.master.task_monitor")
+
+
+class TaskMonitor:
+    def __init__(
+        self,
+        task_dispatcher,
+        servicer,
+        rendezvous=None,
+        on_worker_dead=None,
+        liveness_timeout_secs=30.0,
+        timeout_factor=3.0,
+        scan_interval_secs=1.0,
+    ):
+        self._dispatcher = task_dispatcher
+        self._servicer = servicer
+        self._rendezvous = rendezvous
+        self._on_worker_dead = on_worker_dead
+        self._liveness_timeout = liveness_timeout_secs
+        self._timeout_factor = timeout_factor
+        self._scan_interval = scan_interval_secs
+        self._stopping = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="task-monitor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stopping.set()
+
+    def _loop(self):
+        while not self._stopping.wait(self._scan_interval):
+            try:
+                self._scan()
+            except Exception:
+                logger.exception("task monitor scan failed")
+
+    def _scan(self):
+        now = time.time()
+        dead = set()
+
+        # Liveness: worker silent for too long while holding tasks.
+        liveness = self._servicer.worker_liveness()
+        doing = self._dispatcher.doing_tasks()
+        holders = {worker_id for worker_id, _ in doing.values()}
+        for worker_id in holders:
+            last = liveness.get(worker_id)
+            if last is not None and now - last > self._liveness_timeout:
+                logger.warning(
+                    "Worker %s silent for %.0fs; presumed dead",
+                    worker_id,
+                    now - last,
+                )
+                dead.add(worker_id)
+
+        # Task timeout: 3x slower than the rolling average.
+        threshold = self._timeout_factor * self._dispatcher.avg_task_duration()
+        for task_id, (worker_id, start_time) in doing.items():
+            if now - start_time > threshold:
+                logger.warning(
+                    "Task %s on worker %s exceeded %.0fs; recovering",
+                    task_id,
+                    worker_id,
+                    threshold,
+                )
+                dead.add(worker_id)
+
+        for worker_id in dead:
+            self.mark_worker_dead(worker_id)
+
+    def mark_worker_dead(self, worker_id):
+        """Recover a worker's tasks and drop it from liveness/rendezvous.
+
+        Idempotent and self-healing: forgetting the worker's liveness and
+        recovering its tasks removes both trigger conditions, so a worker
+        that was wrongly presumed dead simply re-registers on its next RPC
+        (and can be declared dead again later if it truly fails). Also the
+        entry point for pod-event-driven detection (the pod manager calls
+        this on pod failure/deletion).
+        """
+        self._dispatcher.recover_tasks(worker_id)
+        host = self._servicer.worker_host(worker_id)
+        self._servicer.forget_worker(worker_id)
+        if self._rendezvous is not None and host:
+            # Membership change: surviving workers see a new mesh epoch on
+            # their next get_comm_info and rebuild the SPMD mesh.
+            self._rendezvous.remove_worker_host(host)
+        if self._on_worker_dead is not None:
+            try:
+                self._on_worker_dead(worker_id)
+            except Exception:
+                logger.exception("on_worker_dead callback failed")

@@ -1,0 +1,60 @@
+"""MNIST CNN — the minimum end-to-end model (reference PR1 scope).
+
+Reference parity: model_zoo/mnist/mnist_functional_api.py:21-103
+(custom_model/loss/optimizer/dataset_fn/eval_metrics_fn contract). The
+network here is a fresh flax design, not a translation: NHWC convs with
+feature counts padded to MXU-friendly multiples, relu fused by XLA.
+"""
+
+import flax.linen as nn
+import numpy as np
+
+from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.train import metrics
+from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
+from elasticdl_tpu.train.optimizers import create_optimizer
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]  # NHW -> NHWC
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.25, deterministic=not training)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def custom_model():
+    return MnistCNN()
+
+
+def loss(labels, predictions):
+    return sparse_softmax_cross_entropy(labels, predictions)
+
+
+def optimizer():
+    return create_optimizer("Adam", learning_rate=0.002)
+
+
+def dataset_fn(dataset, mode=None, metadata=None):
+    def parse(payload):
+        example = decode_example(payload)
+        image = example["image"].astype(np.float32) / 255.0
+        label = example["label"].astype(np.int32).reshape(())
+        return image, label
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {"accuracy": metrics.Accuracy()}

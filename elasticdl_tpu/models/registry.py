@@ -1,0 +1,92 @@
+"""Model-zoo module contract loader.
+
+Reference parity: elasticdl/python/common/model_utils.py:139-198 — a
+model-zoo module exports up to 8 names; the loader resolves them with
+defaults. The TPU contract keeps the same names with JAX-shaped types:
+
+- ``custom_model()`` -> a flax Module whose ``__call__(features,
+  training)`` maps a batch to outputs (the reference returns a Keras
+  model)
+- ``loss(labels, predictions)`` -> per-sample loss vector (jnp)
+- ``optimizer()`` -> optax GradientTransformation
+- ``dataset_fn(dataset, mode, metadata)`` -> maps a pipeline.Dataset of
+  raw records to a Dataset of (features, label) examples
+- ``eval_metrics_fn()`` -> {name: train.metrics.Metric}
+- ``callbacks()`` -> list of callbacks (optional)
+- ``PredictionOutputsProcessor`` -> class with process(outputs, worker_id)
+  (optional)
+- ``sharding_rules()`` -> parallel/ partition rules (optional; TPU-only
+  addition, no reference counterpart)
+"""
+
+import importlib
+import importlib.util
+import os
+import sys
+
+
+class ModelSpec:
+    def __init__(
+        self,
+        custom_model,
+        loss,
+        optimizer,
+        dataset_fn,
+        eval_metrics_fn=None,
+        callbacks=None,
+        prediction_outputs_processor=None,
+        sharding_rules=None,
+        module=None,
+    ):
+        self.custom_model = custom_model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.dataset_fn = dataset_fn
+        self.eval_metrics_fn = eval_metrics_fn or (lambda: {})
+        self.callbacks = callbacks or (lambda: [])
+        self.prediction_outputs_processor = prediction_outputs_processor
+        self.sharding_rules = sharding_rules
+        self.module = module
+
+
+def load_module(module_path_or_name):
+    """Import a model-zoo module by file path or dotted module name."""
+    if os.path.exists(module_path_or_name):
+        name = os.path.splitext(os.path.basename(module_path_or_name))[0]
+        spec = importlib.util.spec_from_file_location(
+            name, module_path_or_name
+        )
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[name] = module
+        spec.loader.exec_module(module)
+        return module
+    return importlib.import_module(module_path_or_name)
+
+
+def _resolve(module, name, default_name=None, required=True):
+    target = getattr(module, name, None)
+    if target is None and default_name:
+        target = getattr(module, default_name, None)
+    if target is None and required:
+        raise ValueError(
+            "Model module %s does not define required %r"
+            % (module.__name__, name)
+        )
+    return target
+
+
+def get_model_spec(module_path_or_name) -> ModelSpec:
+    module = load_module(module_path_or_name)
+    return ModelSpec(
+        custom_model=_resolve(module, "custom_model", "model"),
+        loss=_resolve(module, "loss"),
+        optimizer=_resolve(module, "optimizer"),
+        dataset_fn=_resolve(module, "dataset_fn"),
+        eval_metrics_fn=_resolve(module, "eval_metrics_fn", required=False),
+        callbacks=_resolve(module, "callbacks", required=False),
+        prediction_outputs_processor=_resolve(
+            module, "PredictionOutputsProcessor", required=False
+        ),
+        sharding_rules=_resolve(module, "sharding_rules", required=False),
+        module=module,
+    )

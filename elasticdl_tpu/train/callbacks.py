@@ -1,0 +1,65 @@
+"""Training callbacks.
+
+Reference parity: elasticdl/python/elasticdl/callbacks.py —
+SavedModelExporter (:25-67), MaxStepsStopping (:70-111),
+LearningRateScheduler (:114-155). Here LR scheduling is expressed as an
+optax schedule at optimizer construction (idiomatic JAX: the schedule is
+part of the compiled step, not a per-batch host mutation), so the
+callback only covers the remaining host-side roles.
+"""
+
+
+class Callback:
+    def __init__(self):
+        self.worker = None  # set by the worker before training
+
+    def set_worker(self, worker):
+        self.worker = worker
+
+    def on_batch_end(self, step, loss):
+        pass
+
+    def on_task_end(self, task):
+        pass
+
+    def on_train_end(self, state, extended_config=None):
+        pass
+
+
+class MaxStepsStopping(Callback):
+    """Stop training once ``max_steps`` minibatches have run.
+
+    Reference: callbacks.py:70-111 (counts steps per finished task and
+    sets model.stop_training).
+    """
+
+    def __init__(self, max_steps):
+        super().__init__()
+        self._max_steps = max_steps
+
+    def on_batch_end(self, step, loss):
+        if step >= self._max_steps and self.worker is not None:
+            self.worker.stop_training = True
+
+
+class SavedModelExporter(Callback):
+    """Export the trained state on the TRAIN_END_CALLBACK task.
+
+    Reference: callbacks.py:25-67 (one worker receives the train-end task
+    and exports the SavedModel).
+    """
+
+    def __init__(self, export_fn=None):
+        super().__init__()
+        self._export_fn = export_fn
+
+    def on_train_end(self, state, extended_config=None):
+        path = (extended_config or {}).get("saved_model_path")
+        if not path:
+            return
+        if self._export_fn is not None:
+            self._export_fn(state, path)
+        else:
+            from elasticdl_tpu.train.export import export_train_state
+
+            export_train_state(state, path)

@@ -1,0 +1,70 @@
+"""Model export: persist trained state for serving/resume.
+
+Reference parity: the SavedModel export driven by the TRAIN_END_CALLBACK
+task (elasticdl/python/elasticdl/callbacks.py:25-67,
+common/model_handler.py:242-284). The TPU-native export format is an
+orbax/npz parameter bundle rather than a TF SavedModel graph: serving a
+JAX model means re-applying the module to restored params.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.train.export")
+
+
+def _flatten(tree, prefix=""):
+    flat = {}
+    if isinstance(tree, dict):
+        for key, value in tree.items():
+            flat.update(_flatten(value, prefix + key + "/"))
+    else:
+        flat[prefix.rstrip("/")] = np.asarray(tree)
+    return flat
+
+
+def _unflatten(flat):
+    tree = {}
+    for path, value in flat.items():
+        parts = path.split("/")
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def export_train_state(state, path):
+    """Write params (+ mutable model state) as an .npz bundle + manifest."""
+    os.makedirs(path, exist_ok=True)
+    params = jax.device_get(state.params)
+    model_state = jax.device_get(state.model_state)
+    flat = _flatten({"params": params, "model_state": model_state})
+    np.savez(os.path.join(path, "model.npz"), **flat)
+    manifest = {
+        "format": "elasticdl_tpu.export.v1",
+        "step": int(np.asarray(jax.device_get(state.step))),
+        "num_arrays": len(flat),
+    }
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    logger.info("Exported model (%d arrays) to %s", len(flat), path)
+    return path
+
+
+def load_exported(path):
+    """Returns (params, model_state, step)."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    data = np.load(os.path.join(path, "model.npz"))
+    tree = _unflatten({name: data[name] for name in data.files})
+    return (
+        tree.get("params", {}),
+        tree.get("model_state", {}),
+        manifest["step"],
+    )

@@ -1,0 +1,121 @@
+"""Run the model-zoo contract locally, no master/cluster.
+
+Reference parity: elasticdl/python/elasticdl/local_executor.py:36-208 —
+the "try the model on my laptop" path over the same module contract the
+distributed job uses.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.data.pipeline import (
+    Dataset,
+    batch_real_count,
+    normalize_outputs,
+)
+from elasticdl_tpu.data.readers import create_data_reader
+from elasticdl_tpu.models.registry import get_model_spec
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.train.metrics import EvaluationMetrics
+from elasticdl_tpu.worker.trainer import JaxTrainer
+
+logger = _logger_factory("elasticdl_tpu.train.local_executor")
+
+
+class LocalExecutor:
+    def __init__(
+        self,
+        model_zoo_module,
+        training_data=None,
+        validation_data=None,
+        minibatch_size=32,
+        num_epochs=1,
+        data_reader_params=None,
+        compute_dtype=None,
+        seed=0,
+    ):
+        self.spec = get_model_spec(model_zoo_module)
+        self._minibatch_size = minibatch_size
+        self._num_epochs = num_epochs
+        reader_params = data_reader_params or {}
+        self._train_reader = (
+            create_data_reader(training_data, **reader_params)
+            if training_data
+            else None
+        )
+        self._valid_reader = (
+            create_data_reader(validation_data, **reader_params)
+            if validation_data
+            else None
+        )
+        self.trainer = JaxTrainer(
+            model=self.spec.custom_model(),
+            loss_fn=self.spec.loss,
+            optimizer=self.spec.optimizer(),
+            compute_dtype=compute_dtype,
+            seed=seed,
+        )
+        self.state = None
+
+    # ------------------------------------------------------------------
+    def _records(self, reader):
+        def gen():
+            for shard_name, (start, count) in reader.create_shards().items():
+                task = pb.Task(
+                    task_id=0,
+                    shard_name=shard_name,
+                    start=start,
+                    end=start + count,
+                )
+                yield from reader.read_records(task)
+
+        return Dataset(gen)
+
+    def _batches(self, reader, mode):
+        dataset = self.spec.dataset_fn(
+            self._records(reader), mode, reader.metadata
+        )
+        return dataset.batch(self._minibatch_size).prefetch(2)
+
+    # ------------------------------------------------------------------
+    def train(self):
+        losses = []
+        for epoch in range(self._num_epochs):
+            for batch in self._batches(self._train_reader, "training"):
+                if self.state is None:
+                    self.state = self.trainer.create_state(batch["features"])
+                self.state, loss = self.trainer.train_step(self.state, batch)
+                losses.append(float(loss))
+            logger.info(
+                "Epoch %d done; last-batch loss %.4f", epoch, losses[-1]
+            )
+            if self._valid_reader is not None:
+                summary = self.evaluate()
+                logger.info("Epoch %d eval: %s", epoch, summary)
+        return losses
+
+    def evaluate(self):
+        books = EvaluationMetrics(self.spec.eval_metrics_fn())
+        for batch in self._batches(self._valid_reader, "evaluation"):
+            if self.state is None:
+                self.state = self.trainer.create_state(batch["features"])
+            outputs = self.trainer.eval_step(self.state, batch["features"])
+            real = batch_real_count(batch)
+            books.update_evaluation_metrics(
+                normalize_outputs(outputs, real),
+                np.asarray(batch["labels"])[:real],
+            )
+        return books.get_evaluation_summary()
+
+    def predict(self, data=None):
+        reader = (
+            create_data_reader(data) if data is not None else self._valid_reader
+        )
+        results = []
+        for batch in self._batches(reader, "prediction"):
+            if self.state is None:
+                self.state = self.trainer.create_state(batch["features"])
+            outputs = self.trainer.eval_step(self.state, batch["features"])
+            real = batch_real_count(batch)
+            results.append(normalize_outputs(outputs, real)["output"])
+        return results

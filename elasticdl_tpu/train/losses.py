@@ -1,0 +1,34 @@
+"""Per-sample loss functions.
+
+The model contract's ``loss(labels, predictions)`` must return a
+**per-sample** loss vector (shape [batch]); the trainer reduces it with
+the batch mask so padded tail batches never bias training (see
+data/pipeline.py). These helpers cover the losses the reference model zoo
+uses via Keras.
+"""
+
+import jax.numpy as jnp
+import optax
+
+
+def sparse_softmax_cross_entropy(labels, logits):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels.astype(jnp.int32)
+    )
+
+
+def sigmoid_binary_cross_entropy(labels, logits):
+    logits = logits.reshape(labels.shape)
+    return optax.sigmoid_binary_cross_entropy(logits, labels.astype(logits.dtype))
+
+
+def mean_squared_error(labels, predictions):
+    predictions = predictions.reshape(labels.shape)
+    return jnp.square(predictions - labels.astype(predictions.dtype))
+
+
+def masked_mean(per_sample, mask):
+    """Mean over real rows of a (possibly padded) batch."""
+    per_sample = per_sample.reshape(mask.shape[0], -1).mean(axis=1)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return (per_sample * mask).sum() / denom

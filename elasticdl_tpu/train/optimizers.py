@@ -1,0 +1,84 @@
+"""Optimizer factory over optax.
+
+The reference carries optimizer identity as (opt_type, opt_args) strings
+so the Go PS can reconstruct kernels (common/model_utils.py:234-261,
+go/pkg/ps/optimizer.go:297-390). Here the dense path is on-device optax,
+but the same string spec survives as the cross-process interchange format
+(CLI flags, sparse-PS optimizer config, checkpoints).
+"""
+
+import optax
+
+SUPPORTED = ("SGD", "Momentum", "Adam", "Adagrad", "AdamW", "RMSprop")
+
+
+def create_optimizer(opt_type: str, **opt_args) -> optax.GradientTransformation:
+    opt_type_lower = opt_type.lower()
+    lr = float(opt_args.pop("learning_rate", 0.01))
+    if opt_type_lower == "sgd":
+        momentum = float(opt_args.pop("momentum", 0.0))
+        nesterov = _parse_bool(opt_args.pop("nesterov", False))
+        _reject_extra(opt_type, opt_args)
+        return optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
+    if opt_type_lower == "momentum":
+        momentum = float(opt_args.pop("momentum", 0.9))
+        nesterov = _parse_bool(opt_args.pop("nesterov", False))
+        _reject_extra(opt_type, opt_args)
+        return optax.sgd(lr, momentum=momentum, nesterov=nesterov)
+    if opt_type_lower == "adam":
+        b1 = float(opt_args.pop("beta_1", 0.9))
+        b2 = float(opt_args.pop("beta_2", 0.999))
+        eps = float(opt_args.pop("epsilon", 1e-8))
+        _reject_extra(opt_type, opt_args)
+        return optax.adam(lr, b1=b1, b2=b2, eps=eps)
+    if opt_type_lower == "adamw":
+        b1 = float(opt_args.pop("beta_1", 0.9))
+        b2 = float(opt_args.pop("beta_2", 0.999))
+        eps = float(opt_args.pop("epsilon", 1e-8))
+        wd = float(opt_args.pop("weight_decay", 1e-4))
+        _reject_extra(opt_type, opt_args)
+        return optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=wd)
+    if opt_type_lower == "adagrad":
+        eps = float(opt_args.pop("epsilon", 1e-7))
+        init_acc = float(opt_args.pop("initial_accumulator_value", 0.1))
+        _reject_extra(opt_type, opt_args)
+        return optax.adagrad(
+            lr, initial_accumulator_value=init_acc, eps=eps
+        )
+    if opt_type_lower == "rmsprop":
+        decay = float(opt_args.pop("rho", 0.9))
+        eps = float(opt_args.pop("epsilon", 1e-7))
+        momentum = float(opt_args.pop("momentum", 0.0))
+        _reject_extra(opt_type, opt_args)
+        return optax.rmsprop(lr, decay=decay, eps=eps, momentum=momentum)
+    raise ValueError(
+        "Unsupported optimizer %r (supported: %s)" % (opt_type, SUPPORTED)
+    )
+
+
+def parse_opt_args(opt_args_str: str) -> dict:
+    """Parse 'k=v;k=v' optimizer arg strings (the reference's Go-PS flag
+    format, go/pkg/ps/optimizer.go parseOptArgs)."""
+    args = {}
+    for part in (opt_args_str or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError("Bad opt_args segment %r" % part)
+        key, value = part.split("=", 1)
+        args[key.strip()] = value.strip()
+    return args
+
+
+def _parse_bool(value):
+    if isinstance(value, bool):
+        return value
+    return str(value).lower() in ("1", "true", "yes")
+
+
+def _reject_extra(opt_type, extra):
+    if extra:
+        raise ValueError(
+            "Unknown args for optimizer %s: %s" % (opt_type, sorted(extra))
+        )

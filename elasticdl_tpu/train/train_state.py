@@ -1,0 +1,52 @@
+"""Train state: everything a training step owns, as one pytree.
+
+Unlike the reference — where model state lives in Keras variables on
+workers plus dense/embedding tables on PS pods, and optimizer slot state
+is PS-private and silently dropped from checkpoints
+(ps/parameters.py:194-199) — the TPU-native design keeps the *entire*
+training state (params, mutable model collections, optimizer state, step)
+in one pytree. That makes it shardable by GSPMD, checkpointable in full
+by orbax, and donatable through the jitted step.
+"""
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: dict = struct.field(pytree_node=True)
+    model_state: dict = struct.field(pytree_node=True)  # e.g. batch_stats
+    opt_state: tuple = struct.field(pytree_node=True)
+
+
+def create_train_state(model, tx, rng, sample_features):
+    """Initialize model + optimizer state from one sample batch."""
+    variables = model.init(rng, sample_features, training=False)
+    variables = dict(variables)
+    params = variables.pop("params")
+    model_state = variables  # whatever collections remain (batch_stats, ...)
+    opt_state = tx.init(params)
+    return TrainState(
+        step=jnp.zeros((), dtype=jnp.int32),
+        params=params,
+        model_state=model_state,
+        opt_state=opt_state,
+    )
+
+
+def cast_floating(tree, dtype):
+    """Cast floating leaves of a pytree (bf16 compute on MXU)."""
+
+    def _cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def num_params(params):
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

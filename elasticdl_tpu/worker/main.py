@@ -1,0 +1,37 @@
+"""Worker process entry point.
+
+Reference parity: elasticdl/python/worker/main.py:28-82.
+Usage: python -m elasticdl_tpu.worker.main --master_addr=... --worker_id=0 \
+    --model_zoo=... --training_data=...
+"""
+
+import sys
+
+from elasticdl_tpu.common.args import parse_params_string, parse_worker_args
+from elasticdl_tpu.data.readers import create_data_reader
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+
+def main(argv=None):
+    args = parse_worker_args(argv)
+    reader_params = parse_params_string(args.data_reader_params)
+    data_origin = (
+        args.training_data or args.validation_data or args.prediction_data
+    )
+    reader = create_data_reader(data_origin, **reader_params)
+    worker = Worker(
+        MasterClient(args.master_addr, worker_id=args.worker_id),
+        args.model_zoo,
+        reader,
+        minibatch_size=args.minibatch_size,
+        mode=args.mode,
+        compute_dtype=args.compute_dtype or None,
+        report_version_steps=args.report_version_steps,
+    )
+    worker.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

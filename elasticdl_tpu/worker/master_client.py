@@ -1,0 +1,86 @@
+"""Thin gRPC wrapper the worker uses to talk to the master.
+
+Reference parity: elasticdl/python/worker/master_client.py — get_task
+returns an empty Task on RPC error, which the worker reads as "job over"
+(:63-69), so a master that exits cleanly never strands its workers.
+"""
+
+import socket
+
+import grpc
+
+from elasticdl_tpu.common.grpc_utils import build_channel
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.common.tensor_utils import ndarray_to_blob
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.services import MasterStub
+
+logger = _logger_factory("elasticdl_tpu.worker.master_client")
+
+
+class MasterClient:
+    def __init__(self, master_addr, worker_id, worker_host=None):
+        self._channel = build_channel(master_addr)
+        self._stub = MasterStub(self._channel)
+        self._worker_id = worker_id
+        self._worker_host = worker_host or socket.gethostname()
+
+    @property
+    def worker_id(self):
+        return self._worker_id
+
+    def get_task(self, task_type=None):
+        request = pb.GetTaskRequest(worker_id=self._worker_id)
+        if task_type is not None:
+            request.task_type = task_type
+        try:
+            return self._stub.get_task(request)
+        except grpc.RpcError:
+            # Master gone: treat as job over (reference behavior).
+            return pb.Task()
+
+    def report_task_result(self, task_id, err_message="", exec_counters=None):
+        request = pb.ReportTaskResultRequest(
+            task_id=task_id,
+            err_message=err_message,
+            worker_id=self._worker_id,
+        )
+        for key, value in (exec_counters or {}).items():
+            request.exec_counters[key] = str(value)
+        try:
+            self._stub.report_task_result(request)
+        except grpc.RpcError:
+            logger.warning("report_task_result(%s) failed", task_id)
+
+    def report_evaluation_metrics(self, model_version, model_outputs, labels):
+        request = pb.ReportEvaluationMetricsRequest(
+            worker_id=self._worker_id, model_version=model_version
+        )
+        for name, array in model_outputs.items():
+            ndarray_to_blob(array, request.model_outputs[name])
+        ndarray_to_blob(labels, request.labels)
+        try:
+            self._stub.report_evaluation_metrics(request)
+        except grpc.RpcError:
+            logger.warning("report_evaluation_metrics failed")
+
+    def report_version(self, model_version):
+        try:
+            self._stub.report_version(
+                pb.ReportVersionRequest(model_version=model_version)
+            )
+        except grpc.RpcError:
+            logger.warning("report_version(%s) failed", model_version)
+
+    def get_comm_info(self):
+        try:
+            return self._stub.get_comm_info(
+                pb.GetCommInfoRequest(
+                    worker_id=self._worker_id, worker_host=self._worker_host
+                )
+            )
+        except grpc.RpcError:
+            return pb.CommInfo(rank=-1, world_size=0, mesh_epoch=-1)
+
+    def close(self):
+        self._channel.close()

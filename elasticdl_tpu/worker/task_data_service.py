@@ -1,0 +1,97 @@
+"""Turns the master's task stream into continuous record streams.
+
+Reference parity: elasticdl/python/worker/task_data_service.py — the
+training stream spans task boundaries so batches stay full (:206-238), a
+``_pending_tasks`` deque tracks how many records of each in-flight task
+have been consumed, and a task is reported done exactly when its range is
+covered (:95-130). TRAIN_END_CALLBACK tasks are intercepted and surfaced
+to the worker (:176-202 handles the same for warm-up/metadata).
+"""
+
+import collections
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = _logger_factory("elasticdl_tpu.worker.task_data_service")
+
+
+class TaskDataService:
+    def __init__(self, master_client, data_reader, wait_sleep_secs=2.0):
+        self._mc = master_client
+        self._reader = data_reader
+        self._wait_sleep_secs = wait_sleep_secs
+        self._lock = threading.Lock()
+        # deque of [task, records_total, records_reported]
+        self._pending_tasks = collections.deque()
+        self.train_end_task = None
+        self.job_over = False
+        # non-training tasks encountered while streaming training records;
+        # the worker drains these between minibatch loops
+        self.out_of_band_tasks = collections.deque()
+
+    # ------------------------------------------------------------------
+    def training_record_stream(self):
+        """Yield raw records across training tasks until the job ends.
+
+        Non-training tasks (evaluation/prediction) that the master hands
+        us are parked on ``out_of_band_tasks`` for the worker to process;
+        TRAIN_END_CALLBACK is remembered on ``train_end_task``.
+        """
+        while True:
+            task = self._mc.get_task()
+            if task.task_id == 0:
+                if task.type == pb.WAIT:
+                    time.sleep(self._wait_sleep_secs)
+                    continue
+                self.job_over = True
+                return
+            if task.type == pb.TRAIN_END_CALLBACK:
+                self.train_end_task = task
+                return
+            if task.type != pb.TRAINING:
+                # Park it and end the stream: the worker drains
+                # out_of_band_tasks (eval/predict interleave) and then
+                # opens a fresh training stream.
+                self.out_of_band_tasks.append(task)
+                return
+            total = task.end - task.start
+            with self._lock:
+                self._pending_tasks.append([task, total, 0])
+            yield from self._reader.read_records(task)
+
+    def report_record_done(self, count):
+        """Account ``count`` consumed records to the oldest pending tasks;
+        report each task whose full range is now covered."""
+        done = []
+        with self._lock:
+            while count > 0 and self._pending_tasks:
+                entry = self._pending_tasks[0]
+                task, total, reported = entry
+                take = min(count, total - reported)
+                entry[2] += take
+                count -= take
+                if entry[2] >= total:
+                    self._pending_tasks.popleft()
+                    done.append(task)
+        for task in done:
+            self._mc.report_task_result(task.task_id, "")
+
+    def report_pending_failed(self, err_message):
+        """Report every pending task as failed (training step blew up)."""
+        with self._lock:
+            pending = [entry[0] for entry in self._pending_tasks]
+            self._pending_tasks.clear()
+        for task in pending:
+            self._mc.report_task_result(task.task_id, err_message)
+
+    def has_pending(self):
+        with self._lock:
+            return bool(self._pending_tasks)
+
+    # ------------------------------------------------------------------
+    def task_record_stream(self, task):
+        """Records of a single (eval/predict) task."""
+        yield from self._reader.read_records(task)

@@ -1,0 +1,250 @@
+"""The elastic worker: pulls tasks, runs the jitted JAX step.
+
+Reference parity: elasticdl/python/worker/worker.py (the ~900-line TF2
+eager loop). The TPU redesign collapses most of it: there is no
+get_model()/report_gradient() PS round trip on the dense path (the
+optimizer update happens inside the compiled step, worker-side), so the
+hot loop is read records -> parse -> device step. What survives from the
+reference is the *protocol*: the continuous task stream with record-level
+accounting (task_data_service), eval/predict interleave, the train-end
+callback task, and reporting model versions so the master can trigger
+evaluations.
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.data.pipeline import (
+    Dataset,
+    batch_real_count,
+    normalize_outputs,
+)
+from elasticdl_tpu.models.registry import get_model_spec
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+from elasticdl_tpu.worker.trainer import JaxTrainer
+
+logger = _logger_factory("elasticdl_tpu.worker.worker")
+
+
+class Worker:
+    def __init__(
+        self,
+        master_client,
+        model_zoo_module,
+        data_reader,
+        minibatch_size=32,
+        mode=Mode.TRAINING,
+        compute_dtype=None,
+        report_version_steps=10,
+        wait_sleep_secs=2.0,
+        seed=0,
+        trainer_factory=None,
+    ):
+        self._mc = master_client
+        self.spec = get_model_spec(model_zoo_module)
+        self._reader = data_reader
+        self._minibatch_size = minibatch_size
+        self._mode = mode
+        self._report_version_steps = report_version_steps
+        self.tds = TaskDataService(
+            master_client, data_reader, wait_sleep_secs=wait_sleep_secs
+        )
+        factory = trainer_factory or JaxTrainer
+        self.trainer = factory(
+            model=self.spec.custom_model(),
+            loss_fn=self.spec.loss,
+            optimizer=self.spec.optimizer(),
+            compute_dtype=compute_dtype,
+            seed=seed,
+        )
+        self.state = None
+        self.stop_training = False
+        self._version = 0
+        self._callbacks = list(self.spec.callbacks() or [])
+        for cb in self._callbacks:
+            cb.set_worker(self)
+        # Heartbeat keeps master-side liveness fresh while the worker is
+        # silent for long stretches — on TPU the first train step compiles
+        # for 20-40 s, which must not read as worker death.
+        self._heartbeat_stop = threading.Event()
+        self._heartbeat_thread = None
+
+    def _start_heartbeat(self, interval_secs=3.0):
+        def beat():
+            while not self._heartbeat_stop.wait(interval_secs):
+                self._mc.get_comm_info()
+
+        self._heartbeat_thread = threading.Thread(
+            target=beat, name="worker-heartbeat", daemon=True
+        )
+        self._heartbeat_thread.start()
+
+    def _stop_heartbeat(self):
+        self._heartbeat_stop.set()
+
+    # ------------------------------------------------------------------
+    @property
+    def model_version(self):
+        return self._version
+
+    def _ensure_state(self, batch):
+        if self.state is None:
+            self.state = self.trainer.create_state(batch["features"])
+
+    def _batches(self, record_stream, mode):
+        dataset = self.spec.dataset_fn(
+            Dataset(lambda: record_stream), mode, self._reader.metadata
+        )
+        return dataset.batch(self._minibatch_size).prefetch(2)
+
+    # ------------------------------------------------------------------
+    def _run_training_stream(self):
+        """Consume one continuous training stream until it pauses."""
+        try:
+            for batch in self._batches(
+                self.tds.training_record_stream(), Mode.TRAINING
+            ):
+                self._ensure_state(batch)
+                self.state, loss = self.trainer.train_step(self.state, batch)
+                self._version += 1
+                self.tds.report_record_done(batch_real_count(batch))
+                if (
+                    self._report_version_steps
+                    and self._version % self._report_version_steps == 0
+                ):
+                    self._mc.report_version(self._version)
+                for cb in self._callbacks:
+                    cb.on_batch_end(self._version, loss)
+                if self.stop_training:
+                    break
+        except Exception as e:  # report so tasks get retried elsewhere
+            logger.exception("Training stream failed")
+            self.tds.report_pending_failed(str(e))
+
+    def _process_eval_task(self, task):
+        try:
+            for batch in self._batches(
+                self.tds.task_record_stream(task), Mode.EVALUATION
+            ):
+                self._ensure_state(batch)
+                outputs = self.trainer.eval_step(
+                    self.state, batch["features"]
+                )
+                real = batch_real_count(batch)
+                outputs = normalize_outputs(outputs, real)
+                labels = np.asarray(batch["labels"])[:real]
+                self._mc.report_evaluation_metrics(
+                    task.model_version, outputs, labels
+                )
+            self._mc.report_task_result(task.task_id)
+        except Exception as e:
+            logger.exception("Evaluation task %s failed", task.task_id)
+            self._mc.report_task_result(task.task_id, str(e))
+
+    def _process_prediction_task(self, task):
+        processor_cls = self.spec.prediction_outputs_processor
+        processor = processor_cls() if processor_cls else None
+        try:
+            for batch in self._batches(
+                self.tds.task_record_stream(task), Mode.PREDICTION
+            ):
+                self._ensure_state(batch)
+                outputs = self.trainer.eval_step(
+                    self.state, batch["features"]
+                )
+                real = batch_real_count(batch)
+                if processor is not None:
+                    processor.process(
+                        normalize_outputs(outputs, real),
+                        self._mc.worker_id,
+                    )
+            self._mc.report_task_result(task.task_id)
+        except Exception as e:
+            logger.exception("Prediction task %s failed", task.task_id)
+            self._mc.report_task_result(task.task_id, str(e))
+
+    def _process_train_end_task(self, task):
+        for cb in self._callbacks:
+            try:
+                cb.on_train_end(self.state, dict(task.extended_config))
+            except Exception:
+                logger.exception("train-end callback failed")
+        self._mc.report_task_result(task.task_id)
+
+    def _drain_out_of_band(self):
+        while self.tds.out_of_band_tasks:
+            task = self.tds.out_of_band_tasks.popleft()
+            if task.type == pb.EVALUATION:
+                self._process_eval_task(task)
+            elif task.type == pb.PREDICTION:
+                self._process_prediction_task(task)
+            else:
+                logger.warning("Unexpected out-of-band task type %s", task.type)
+                self._mc.report_task_result(task.task_id)
+
+    def _drain_fast(self):
+        """After MaxStepsStopping: consume remaining tasks without
+        training so the job can finish."""
+        import time
+
+        while True:
+            task = self._mc.get_task()
+            if task.task_id == 0:
+                if task.type == pb.WAIT:
+                    time.sleep(0.2)
+                    continue
+                return
+            if task.type == pb.TRAIN_END_CALLBACK:
+                self._process_train_end_task(task)
+            else:
+                self._mc.report_task_result(task.task_id)
+
+    # ------------------------------------------------------------------
+    def run(self):
+        self._start_heartbeat()
+        try:
+            self._run()
+        finally:
+            self._stop_heartbeat()
+
+    def _run(self):
+        if self._mode == Mode.EVALUATION:
+            self._run_task_mode(pb.EVALUATION, self._process_eval_task)
+            return
+        if self._mode == Mode.PREDICTION:
+            self._run_task_mode(pb.PREDICTION, self._process_prediction_task)
+            return
+        while True:
+            self._run_training_stream()
+            self._drain_out_of_band()
+            if self.tds.train_end_task is not None:
+                task = self.tds.train_end_task
+                self.tds.train_end_task = None
+                self._process_train_end_task(task)
+                continue
+            if self.stop_training:
+                self._drain_fast()
+                return
+            if self.tds.job_over:
+                logger.info(
+                    "Worker %s done at version %d",
+                    self._mc.worker_id,
+                    self._version,
+                )
+                return
+
+    def _run_task_mode(self, task_type, process_fn):
+        import time
+
+        while True:
+            task = self._mc.get_task(task_type)
+            if task.task_id == 0:
+                if task.type == pb.WAIT:
+                    time.sleep(0.2)
+                    continue
+                return
+            process_fn(task)

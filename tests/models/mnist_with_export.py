@@ -1,0 +1,14 @@
+"""Test model-zoo module: mnist + SavedModelExporter callback."""
+
+from elasticdl_tpu.models.mnist import (  # noqa: F401
+    custom_model,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+from elasticdl_tpu.train.callbacks import SavedModelExporter
+
+
+def callbacks():
+    return [SavedModelExporter()]

@@ -57,8 +57,17 @@ def test_master_service_over_grpc():
         # queue empty but t1/t2 in-flight -> WAIT
         t3 = stub.get_task(pb.GetTaskRequest(worker_id=2))
         assert t3.task_id == 0 and t3.type == pb.WAIT
-        stub.report_task_result(pb.ReportTaskResultRequest(task_id=t1.task_id))
-        stub.report_task_result(pb.ReportTaskResultRequest(task_id=t2.task_id))
+        # a report from the wrong worker is stale and must be ignored
+        stub.report_task_result(
+            pb.ReportTaskResultRequest(task_id=t1.task_id, worker_id=2)
+        )
+        assert not dispatcher.finished()
+        stub.report_task_result(
+            pb.ReportTaskResultRequest(task_id=t1.task_id, worker_id=1)
+        )
+        stub.report_task_result(
+            pb.ReportTaskResultRequest(task_id=t2.task_id, worker_id=1)
+        )
         # all work done -> default Task means "exit"
         t4 = stub.get_task(pb.GetTaskRequest(worker_id=1))
         assert t4.task_id == 0 and t4.type == pb.TRAINING

@@ -1,0 +1,60 @@
+import time
+
+from elasticdl_tpu.master.rendezvous import MeshRendezvous
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.master.task_monitor import TaskMonitor
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+def test_silent_worker_recovered_and_mesh_epoch_bumped():
+    dispatcher = TaskDispatcher(
+        training_shards={"f": (0, 10)}, records_per_task=5, num_epochs=1
+    )
+    rendezvous = MeshRendezvous()
+    servicer = MasterServicer(dispatcher, rendezvous=rendezvous)
+    monitor = TaskMonitor(
+        dispatcher,
+        servicer,
+        rendezvous,
+        liveness_timeout_secs=0.3,
+        scan_interval_secs=0.05,
+    )
+    # worker 1 joins the mesh and takes a task
+    info = servicer.get_comm_info(
+        pb.GetCommInfoRequest(worker_id=1, worker_host="h1:1")
+    )
+    assert info.rank == 0 and info.world_size == 1
+    epoch_before = rendezvous.mesh_epoch
+    task = servicer.get_task(pb.GetTaskRequest(worker_id=1))
+    assert task.task_id > 0
+
+    monitor.start()
+    try:
+        deadline = time.time() + 5
+        while dispatcher.doing_tasks() and time.time() < deadline:
+            time.sleep(0.05)
+        # task recovered, host evicted, epoch bumped
+        assert not dispatcher.doing_tasks()
+        assert rendezvous.mesh_epoch > epoch_before
+        assert rendezvous.hosts() == []
+        # the task is back in the queue (at the tail) for another worker
+        seen = set()
+        while True:
+            t2 = servicer.get_task(pb.GetTaskRequest(worker_id=2))
+            seen.add(t2.task_id)
+            if t2.task_id == task.task_id:
+                break
+        assert task.task_id in seen
+        # a stale report from the presumed-dead worker is ignored
+        servicer.report_task_result(
+            pb.ReportTaskResultRequest(task_id=t2.task_id, worker_id=1)
+        )
+        assert dispatcher.doing_tasks()  # still held by worker 2
+        # worker 1 heartbeats again -> rejoins the mesh cleanly
+        servicer.get_comm_info(
+            pb.GetCommInfoRequest(worker_id=1, worker_host="h1:1")
+        )
+        assert rendezvous.hosts() == ["h1:1"]
+    finally:
+        monitor.stop()

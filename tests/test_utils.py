@@ -1,0 +1,50 @@
+"""Shared test fixtures: synthetic dataset fabrication.
+
+Models the reference's tests/test_utils.py:103-225 (create_recordio_file
+fabricating mnist/frappe/census-shaped shards in temp files).
+"""
+
+import numpy as np
+
+from elasticdl_tpu.data.example import encode_example
+from elasticdl_tpu.data.recordio import write_records
+
+
+def create_mnist_recordio(path, num_records=128, seed=0, image_size=8):
+    """Small separable 'mnist-shaped' dataset: label = quadrant of the
+    bright patch, so a tiny CNN can actually learn it."""
+    rng = np.random.RandomState(seed)
+    payloads = []
+    half = image_size // 2
+    for _ in range(num_records):
+        label = rng.randint(0, 4)
+        image = rng.rand(image_size, image_size).astype(np.float32) * 40
+        row, col = divmod(label, 2)
+        image[
+            row * half : (row + 1) * half, col * half : (col + 1) * half
+        ] += 200
+        payloads.append(
+            encode_example(
+                {
+                    "image": image.astype(np.uint8),
+                    "label": np.int64(label),
+                }
+            )
+        )
+    write_records(path, payloads)
+    return path
+
+
+def create_ctr_recordio(path, num_records=256, num_features=10, vocab=1000, seed=0):
+    """Criteo-shaped CTR rows: sparse id features + a planted linear
+    signal in the label."""
+    rng = np.random.RandomState(seed)
+    weights = rng.randn(vocab) * 2
+    payloads = []
+    for _ in range(num_records):
+        ids = rng.randint(0, vocab, size=num_features).astype(np.int64)
+        score = weights[ids].sum() / np.sqrt(num_features)
+        label = np.int64(1 if score + rng.randn() * 0.1 > 0 else 0)
+        payloads.append(encode_example({"ids": ids, "label": label}))
+    write_records(path, payloads)
+    return path

@@ -1,0 +1,123 @@
+"""In-process distributed training: real gRPC master + real Worker.
+
+The workhorse test pattern of the reference
+(tests/test_utils.py:286-430 distributed_train_and_evaluate): full
+master<->worker protocol over localhost, no cluster.
+"""
+
+import os
+import threading
+
+from elasticdl_tpu.common.grpc_utils import (
+    build_channel,
+    build_server,
+    find_free_port,
+)
+from elasticdl_tpu.data.readers import RecordIODataReader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto.services import add_master_servicer_to_server
+from elasticdl_tpu.train.metrics import Accuracy
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+from tests.test_utils import create_mnist_recordio
+
+
+def start_master(train_dir, valid_dir, export_path, eval_steps=8):
+    train_reader = RecordIODataReader(data_dir=train_dir)
+    valid_reader = RecordIODataReader(data_dir=valid_dir)
+    dispatcher = TaskDispatcher(
+        training_shards=train_reader.create_shards(),
+        evaluation_shards=valid_reader.create_shards(),
+        records_per_task=64,
+        num_epochs=2,
+        seed=0,
+    )
+    dispatcher.add_deferred_callback_create_train_end_task(
+        {"saved_model_path": export_path}
+    )
+    evals = EvaluationService(
+        dispatcher, lambda: {"accuracy": Accuracy()}, eval_steps=eval_steps
+    )
+    servicer = MasterServicer(dispatcher, evals)
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    return server, dispatcher, evals, port
+
+
+def test_distributed_train_and_evaluate(tmp_path):
+    train_dir = tmp_path / "train"
+    valid_dir = tmp_path / "valid"
+    train_dir.mkdir()
+    valid_dir.mkdir()
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=256, seed=0)
+    create_mnist_recordio(str(valid_dir / "f0.rec"), num_records=64, seed=1)
+    export_path = str(tmp_path / "export")
+
+    server, dispatcher, evals, port = start_master(
+        str(train_dir), str(valid_dir), export_path
+    )
+    try:
+        worker = Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "tests.models.mnist_with_export",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=32,
+            report_version_steps=4,
+            wait_sleep_secs=0.1,
+        )
+        worker.run()
+        assert dispatcher.finished()
+        assert not dispatcher.job_failed()
+        # step-based eval fired and produced sane accuracy
+        assert evals.completed_summaries
+        version, summary = evals.completed_summaries[-1]
+        assert summary["accuracy"] > 0.8
+        # train-end callback exported the model
+        assert os.path.exists(os.path.join(export_path, "manifest.json"))
+    finally:
+        server.stop(None)
+
+
+def test_two_workers_share_the_queue(tmp_path):
+    train_dir = tmp_path / "train"
+    valid_dir = tmp_path / "valid"
+    train_dir.mkdir()
+    valid_dir.mkdir()
+    for i in range(2):
+        create_mnist_recordio(
+            str(train_dir / ("f%d.rec" % i)), num_records=128, seed=i
+        )
+    create_mnist_recordio(str(valid_dir / "f0.rec"), num_records=64, seed=9)
+
+    server, dispatcher, evals, port = start_master(
+        str(train_dir), str(valid_dir), str(tmp_path / "export"), eval_steps=0
+    )
+    try:
+        workers = [
+            Worker(
+                MasterClient("localhost:%d" % port, worker_id=i),
+                "elasticdl_tpu.models.mnist",
+                RecordIODataReader(data_dir=str(train_dir)),
+                minibatch_size=32,
+                wait_sleep_secs=0.1,
+            )
+            for i in range(2)
+        ]
+        threads = [
+            threading.Thread(target=w.run, daemon=True) for w in workers
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+        assert dispatcher.finished()
+        # both workers actually trained (queue was shared)
+        assert all(w.model_version > 0 for w in workers)
+    finally:
+        server.stop(None)
