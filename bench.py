@@ -1,0 +1,92 @@
+"""Benchmark: ResNet50 training throughput (images/sec) on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline context (BASELINE.md): the reference's best published ResNet50
+number is 364 images/s on a 4x P100 cluster via Horovod, 145 images/s on
+one P100 (ImageNet-shaped inputs, batch 64). vs_baseline is computed
+against the single-accelerator number (145 img/s) since this benchmark
+runs one chip.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    # Persistent compile cache: first ResNet50 compile is slow; repeat
+    # bench runs should time steps, not XLA.
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    sys.path.insert(0, ".")
+    from elasticdl_tpu.models import resnet
+    from elasticdl_tpu.train.optimizers import create_optimizer
+    from elasticdl_tpu.train.step_fns import make_train_step
+    from elasticdl_tpu.train.train_state import create_train_state
+
+    batch_size = 256
+    image_size = 224
+    warmup_steps = 3
+    bench_steps = 20
+
+    model = resnet.resnet50(num_classes=1000)
+    tx = create_optimizer(
+        "Momentum", learning_rate=0.1, momentum=0.9, nesterov=True
+    )
+    step = jax.jit(
+        make_train_step(model, resnet.loss, tx, compute_dtype=jnp.bfloat16),
+        donate_argnums=(0,),
+    )
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": jnp.asarray(
+            rng.rand(batch_size, image_size, image_size, 3), jnp.float32
+        ),
+        "labels": jnp.asarray(
+            rng.randint(0, 1000, size=batch_size), jnp.int32
+        ),
+        "_mask": jnp.ones((batch_size,), jnp.float32),
+    }
+    state = create_train_state(
+        model, tx, jax.random.PRNGKey(0), batch["features"]
+    )
+
+    # Block on the FULL state, not just the scalar loss: on async remote
+    # backends a scalar can resolve before the parameter updates have
+    # executed, which makes the timing meaningless.
+    for _ in range(warmup_steps):
+        state, loss = step(state, batch)
+    jax.block_until_ready((state, loss))
+
+    start = time.perf_counter()
+    for _ in range(bench_steps):
+        state, loss = step(state, batch)
+    jax.block_until_ready((state, loss))
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = batch_size * bench_steps / elapsed
+    # Reference single-accelerator ResNet50/ImageNet: 145 images/s (P100,
+    # ftlib_benchmark.md:115-123).
+    baseline = 145.0
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_imagenet_train_throughput_per_chip",
+                "value": round(images_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(images_per_sec / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Platform selection for framework processes.
+
+TPU containers in this environment register the accelerator backend from
+sitecustomize at interpreter start, which overrides JAX_PLATFORMS from
+the environment. EDL_PLATFORM provides a reliable escape hatch (used by
+tests and CPU-mesh dry runs): it is applied through jax.config after
+import, which wins over the sitecustomize registration.
+"""
+
+import os
+
+
+def apply_platform_overrides():
+    platform = os.environ.get("EDL_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)

@@ -11,6 +11,9 @@ from elasticdl_tpu.master.master import Master
 
 
 def main(argv=None):
+    from elasticdl_tpu.common.platform import apply_platform_overrides
+
+    apply_platform_overrides()
     args = parse_master_args(argv)
     master = Master(
         model_zoo_module=args.model_zoo,

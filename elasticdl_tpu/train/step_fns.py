@@ -1,0 +1,108 @@
+"""Pure train/eval step functions, shared by all trainers.
+
+One implementation serves the single-chip path (worker/trainer.py wraps
+with plain jit) and the SPMD path (parallel/spmd_trainer.py wraps with
+jit + shardings over a Mesh). The function is written so GSPMD can insert
+the gradient reductions: there is no explicit psum — sharding the batch
+while replicating (or fsdp-sharding) parameters makes XLA place the
+collectives on ICI automatically.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.data.pipeline import MASK_KEY
+from elasticdl_tpu.train.losses import masked_mean
+from elasticdl_tpu.train.train_state import TrainState, cast_floating
+
+
+def _apply_model(model, params, model_state, features, training, rngs):
+    variables = {"params": params, **model_state}
+    if model_state:
+        if training:
+            outputs, updates = model.apply(
+                variables,
+                features,
+                training=True,
+                rngs=rngs,
+                mutable=list(model_state.keys()),
+            )
+            return outputs, dict(updates)
+        outputs = model.apply(
+            variables, features, training=False, rngs=rngs
+        )
+        return outputs, model_state
+    outputs = model.apply(variables, features, training=training, rngs=rngs)
+    return outputs, model_state
+
+
+def make_train_step(model, loss_fn, tx, compute_dtype=None):
+    """Returns train_step(state, batch) -> (new_state, loss)."""
+
+    def train_step(state: TrainState, batch):
+        features, labels, mask = (
+            batch["features"],
+            batch["labels"],
+            batch[MASK_KEY],
+        )
+        rngs = {"dropout": jax.random.fold_in(jax.random.PRNGKey(0), state.step)}
+
+        def compute_loss(params):
+            compute_params = params
+            compute_features = features
+            if compute_dtype is not None:
+                compute_params = cast_floating(params, compute_dtype)
+                compute_features = cast_floating(features, compute_dtype)
+            outputs, new_model_state = _apply_model(
+                model,
+                compute_params,
+                state.model_state,
+                compute_features,
+                training=True,
+                rngs=rngs,
+            )
+            per_sample = loss_fn(labels, outputs)
+            return masked_mean(per_sample.astype(jnp.float32), mask), (
+                new_model_state
+            )
+
+        (loss, new_model_state), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+        grads = cast_floating(grads, jnp.float32)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), state.params, updates
+        )
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                model_state=new_model_state,
+                opt_state=new_opt_state,
+            ),
+            loss,
+        )
+
+    return train_step
+
+
+def make_eval_step(model, compute_dtype=None):
+    """Returns eval_step(state, features) -> outputs."""
+
+    def eval_step(state: TrainState, features):
+        compute_params = state.params
+        if compute_dtype is not None:
+            compute_params = cast_floating(state.params, compute_dtype)
+            features = cast_floating(features, compute_dtype)
+        outputs, _ = _apply_model(
+            model,
+            compute_params,
+            state.model_state,
+            features,
+            training=False,
+            rngs=None,
+        )
+        return outputs
+
+    return eval_step

@@ -48,5 +48,12 @@ def cast_floating(tree, dtype):
     return jax.tree_util.tree_map(_cast, tree)
 
 
+def resolve_dtype(dtype):
+    """Accept a dtype or its string name ('bfloat16', 'float32', ...)."""
+    if dtype is None or not isinstance(dtype, str):
+        return dtype
+    return jnp.dtype(dtype).type
+
+
 def num_params(params):
     return sum(x.size for x in jax.tree_util.tree_leaves(params))

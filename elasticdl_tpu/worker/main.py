@@ -14,12 +14,24 @@ from elasticdl_tpu.worker.worker import Worker
 
 
 def main(argv=None):
+    from elasticdl_tpu.common.platform import apply_platform_overrides
+
+    apply_platform_overrides()
+    import jax
+
     args = parse_worker_args(argv)
     reader_params = parse_params_string(args.data_reader_params)
     data_origin = (
         args.training_data or args.validation_data or args.prediction_data
     )
     reader = create_data_reader(data_origin, **reader_params)
+    # More than one local device: run the SPMD trainer over the chip mesh
+    # (gradients ride ICI inside the compiled step).
+    trainer_factory = None
+    if jax.device_count() > 1:
+        from elasticdl_tpu.parallel.spmd_trainer import SpmdTrainer
+
+        trainer_factory = SpmdTrainer
     worker = Worker(
         MasterClient(args.master_addr, worker_id=args.worker_id),
         args.model_zoo,
@@ -28,6 +40,7 @@ def main(argv=None):
         mode=args.mode,
         compute_dtype=args.compute_dtype or None,
         report_version_steps=args.report_version_steps,
+        trainer_factory=trainer_factory,
     )
     worker.run()
     return 0

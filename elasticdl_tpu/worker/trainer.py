@@ -1,34 +1,21 @@
-"""The jitted JAX training/eval step.
+"""Single-chip trainer: plain jit around the shared step functions.
 
 This replaces the reference's TF2-eager worker step + gRPC
 push_gradients/pull_variables round trip (worker/worker.py:517-649,
 ps_client.py) with a single XLA-compiled function: forward, backward,
-optimizer update, all on device. Under a sharded mesh (parallel/), the
-same step runs SPMD and XLA inserts the gradient psum over ICI — there is
-no separate "gradient communication" code path to maintain.
-
-Design notes (TPU-first):
-- Static shapes: padded tail batches + mask (data/pipeline.py) mean one
-  compilation per (batch_size, feature-shape) signature.
-- Mixed precision: params live in f32; compute runs in ``compute_dtype``
-  (bf16 on TPU) by casting inside the loss closure, so the MXU sees bf16
-  while the optimizer update stays f32.
-- Donation: the input state buffer is donated to the step, so parameters
-  are updated in place in HBM instead of being double-buffered.
+optimizer update, all on device. For the sharded multi-chip variant see
+parallel/spmd_trainer.py — both wrap the same step functions
+(train/step_fns.py).
 """
 
-import functools
-
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from elasticdl_tpu.data.pipeline import MASK_KEY
-from elasticdl_tpu.train.losses import masked_mean
+from elasticdl_tpu.train.step_fns import make_eval_step, make_train_step
 from elasticdl_tpu.train.train_state import (
     TrainState,
-    cast_floating,
     create_train_state,
+    resolve_dtype,
 )
 
 
@@ -42,12 +29,14 @@ class JaxTrainer:
         seed=0,
     ):
         self._model = model
-        self._loss = loss_fn
         self._tx = optimizer
-        self._compute_dtype = compute_dtype
         self._rng = jax.random.PRNGKey(seed)
-        self._train_step = jax.jit(self._train_step_impl, donate_argnums=(0,))
-        self._eval_step = jax.jit(self._eval_step_impl)
+        compute_dtype = resolve_dtype(compute_dtype)
+        self._train_step = jax.jit(
+            make_train_step(model, loss_fn, optimizer, compute_dtype),
+            donate_argnums=(0,),
+        )
+        self._eval_step = jax.jit(make_eval_step(model, compute_dtype))
 
     # ------------------------------------------------------------------
     def create_state(self, sample_features) -> TrainState:
@@ -56,86 +45,6 @@ class JaxTrainer:
             self._model, self._tx, init_rng, sample_features
         )
 
-    # ------------------------------------------------------------------
-    def _apply(self, params, model_state, features, training, rngs):
-        variables = {"params": params, **model_state}
-        if model_state:
-            outputs, updates = self._model.apply(
-                variables,
-                features,
-                training=training,
-                rngs=rngs,
-                mutable=list(model_state.keys()) if training else [],
-            )
-            if not training:
-                updates = model_state
-            return outputs, updates
-        outputs = self._model.apply(
-            variables, features, training=training, rngs=rngs
-        )
-        return outputs, model_state
-
-    def _train_step_impl(self, state: TrainState, batch):
-        features, labels, mask = (
-            batch["features"],
-            batch["labels"],
-            batch[MASK_KEY],
-        )
-        step_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
-        rngs = {"dropout": step_rng}
-
-        def loss_fn(params):
-            compute_params = params
-            compute_features = features
-            if self._compute_dtype is not None:
-                compute_params = cast_floating(params, self._compute_dtype)
-                compute_features = cast_floating(
-                    features, self._compute_dtype
-                )
-            outputs, new_model_state = self._apply(
-                compute_params,
-                state.model_state,
-                compute_features,
-                training=True,
-                rngs=rngs,
-            )
-            per_sample = self._loss(labels, outputs)
-            loss = masked_mean(per_sample.astype(jnp.float32), mask)
-            return loss, new_model_state
-
-        (loss, new_model_state), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
-        grads = cast_floating(grads, jnp.float32)
-        updates, new_opt_state = self._tx.update(
-            grads, state.opt_state, state.params
-        )
-        new_params = jax.tree_util.tree_map(
-            lambda p, u: (p + u).astype(p.dtype), state.params, updates
-        )
-        new_state = TrainState(
-            step=state.step + 1,
-            params=new_params,
-            model_state=new_model_state,
-            opt_state=new_opt_state,
-        )
-        return new_state, loss
-
-    def _eval_step_impl(self, state: TrainState, features):
-        compute_params = state.params
-        if self._compute_dtype is not None:
-            compute_params = cast_floating(state.params, self._compute_dtype)
-            features = cast_floating(features, self._compute_dtype)
-        outputs, _ = self._apply(
-            compute_params,
-            state.model_state,
-            features,
-            training=False,
-            rngs=None,
-        )
-        return outputs
-
-    # ------------------------------------------------------------------
     def train_step(self, state, batch):
         return self._train_step(state, batch)
 

@@ -43,6 +43,7 @@ class Worker:
         wait_sleep_secs=2.0,
         seed=0,
         trainer_factory=None,
+        mesh_config=None,
     ):
         self._mc = master_client
         self.spec = get_model_spec(model_zoo_module)
@@ -54,13 +55,23 @@ class Worker:
             master_client, data_reader, wait_sleep_secs=wait_sleep_secs
         )
         factory = trainer_factory or JaxTrainer
-        self.trainer = factory(
+        trainer_kwargs = dict(
             model=self.spec.custom_model(),
             loss_fn=self.spec.loss,
             optimizer=self.spec.optimizer(),
             compute_dtype=compute_dtype,
             seed=seed,
         )
+        # SPMD-capable factories take the model's sharding rules; the
+        # single-chip trainer does not.
+        import inspect
+
+        factory_params = inspect.signature(factory).parameters
+        if "sharding_rules" in factory_params and self.spec.sharding_rules:
+            trainer_kwargs["sharding_rules"] = self.spec.sharding_rules()
+        if "mesh_config" in factory_params and mesh_config is not None:
+            trainer_kwargs["mesh_config"] = mesh_config
+        self.trainer = factory(**trainer_kwargs)
         self.state = None
         self.stop_training = False
         self._version = 0

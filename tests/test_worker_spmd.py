@@ -1,0 +1,39 @@
+"""Worker driving the SPMD trainer over the virtual 8-device mesh,
+against a real gRPC master — the full distributed data plane."""
+
+from elasticdl_tpu.data.readers import RecordIODataReader
+from elasticdl_tpu.parallel.spmd_trainer import SpmdTrainer
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+from tests.test_utils import create_mnist_recordio
+from tests.test_worker_distributed import start_master
+
+
+def test_worker_with_spmd_trainer(tmp_path):
+    train_dir = tmp_path / "train"
+    valid_dir = tmp_path / "valid"
+    train_dir.mkdir()
+    valid_dir.mkdir()
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=256, seed=0)
+    create_mnist_recordio(str(valid_dir / "f0.rec"), num_records=64, seed=1)
+
+    server, dispatcher, evals, port = start_master(
+        str(train_dir), str(valid_dir), str(tmp_path / "export"), eval_steps=8
+    )
+    try:
+        worker = Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "elasticdl_tpu.models.mnist",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=32,  # 32 % 8 devices == 0
+            report_version_steps=4,
+            wait_sleep_secs=0.1,
+            trainer_factory=SpmdTrainer,
+        )
+        worker.run()
+        assert dispatcher.finished()
+        assert evals.completed_summaries
+        _, summary = evals.completed_summaries[-1]
+        assert summary["accuracy"] > 0.8
+    finally:
+        server.stop(None)
