@@ -59,6 +59,11 @@ def parse_worker_args(argv=None):
     parser.add_argument("--master_addr", required=True)
     parser.add_argument("--worker_id", type=int, required=True)
     parser.add_argument(
+        "--ps_addrs",
+        default="",
+        help="comma-separated PS addresses for sparse embedding models",
+    )
+    parser.add_argument(
         "--mode",
         default="training",
         choices=["training", "evaluation", "prediction"],

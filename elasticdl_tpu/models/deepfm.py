@@ -1,0 +1,93 @@
+"""DeepFM over host-PS embedding tables (CTR family).
+
+Reference parity: model_zoo/deepfm_edl_embedding/deepfm_edl_embedding.py
+(uses elasticdl.layers.Embedding against the PS) and the dac_ctr deepfm
+variant. TPU redesign: ids are swapped for (rows, indices) before the
+step (train/sparse.py), so the device-side model is pure dense math —
+gather, FM interaction, MLP — all fusable by XLA.
+
+Expected raw features: {"ids": int64 [B, F]} and labels {0,1}.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.train import metrics
+from elasticdl_tpu.train.losses import sigmoid_binary_cross_entropy
+from elasticdl_tpu.train.optimizers import create_optimizer
+from elasticdl_tpu.train.sparse import SparseEmbeddingSpec, embedding_lookup
+
+EMBEDDING_DIM = 8
+
+
+class DeepFM(nn.Module):
+    embedding_dim: int = EMBEDDING_DIM
+    hidden: tuple = (64, 32)
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        # [B, F, d] second-order embeddings + [B, F->sum, 1] first-order
+        emb = embedding_lookup(features, "deepfm_emb", combiner=None)
+        linear = embedding_lookup(features, "deepfm_linear", combiner="sum")
+        # FM second-order: 0.5 * ((sum v)^2 - sum v^2)
+        summed = emb.sum(axis=1)
+        fm = 0.5 * (jnp.square(summed) - jnp.square(emb).sum(axis=1))
+        fm_term = fm.sum(axis=-1, keepdims=True)
+        # deep tower over flattened field embeddings
+        deep = emb.reshape((emb.shape[0], -1))
+        for width in self.hidden:
+            deep = nn.relu(nn.Dense(width)(deep))
+        deep_term = nn.Dense(1)(deep)
+        logit = linear.reshape((-1, 1)) + fm_term + deep_term
+        return logit.squeeze(-1)
+
+
+def custom_model():
+    return DeepFM()
+
+
+def loss(labels, predictions):
+    return sigmoid_binary_cross_entropy(labels, predictions)
+
+
+def optimizer():
+    return create_optimizer("Adam", learning_rate=0.001)
+
+
+def sparse_embedding_specs(num_features=10, batch_size=64):
+    """Host-PS tables this model trains against (TPU-contract addition:
+    the reference discovers elasticdl.layers.Embedding instances via
+    model introspection, model_handler.py:98-102; here the module
+    declares them)."""
+    capacity = batch_size * num_features
+    return [
+        SparseEmbeddingSpec(
+            "deepfm_emb",
+            EMBEDDING_DIM,
+            feature_key="ids",
+            capacity=capacity,
+        ),
+        SparseEmbeddingSpec(
+            "deepfm_linear", 1, feature_key="ids", capacity=capacity
+        ),
+    ]
+
+
+def dataset_fn(dataset, mode=None, metadata=None):
+    def parse(payload):
+        example = decode_example(payload)
+        return (
+            {"ids": example["ids"].astype(np.int64)},
+            example["label"].astype(np.float32).reshape(()),
+        )
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {
+        "auc": metrics.AUC(from_logits=True),
+        "accuracy": metrics.BinaryAccuracy(from_logits=True),
+    }

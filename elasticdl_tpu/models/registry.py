@@ -36,6 +36,7 @@ class ModelSpec:
         callbacks=None,
         prediction_outputs_processor=None,
         sharding_rules=None,
+        sparse_embedding_specs=None,
         module=None,
     ):
         self.custom_model = custom_model
@@ -46,6 +47,10 @@ class ModelSpec:
         self.callbacks = callbacks or (lambda: [])
         self.prediction_outputs_processor = prediction_outputs_processor
         self.sharding_rules = sharding_rules
+        # () -> [SparseEmbeddingSpec]: host-PS tables the model trains
+        # against (TPU contract addition; the reference discovers these by
+        # introspecting for elasticdl.layers.Embedding instances)
+        self.sparse_embedding_specs = sparse_embedding_specs
         self.module = module
 
 
@@ -88,5 +93,8 @@ def get_model_spec(module_path_or_name) -> ModelSpec:
             module, "PredictionOutputsProcessor", required=False
         ),
         sharding_rules=_resolve(module, "sharding_rules", required=False),
+        sparse_embedding_specs=_resolve(
+            module, "sparse_embedding_specs", required=False
+        ),
         module=module,
     )

@@ -1,0 +1,279 @@
+// Host-side sparse embedding store with fused optimizer kernels.
+//
+// TPU-native equivalent of the reference's Go parameter server runtime:
+//   - lazy hash-map embedding tables (go/pkg/common/embedding_table.go)
+//   - sparse SGD/Momentum/Adagrad/Adam kernels (go/pkg/kernel/capi/
+//     kernel_api.cc) — here applied row-wise in-place, slots stored
+//     inline with the row so one cache line serves weight+slots
+//   - id-sharded binary checkpoints (go/pkg/ps/checkpoint.go)
+//
+// The dense path of the reference PS is intentionally absent: dense
+// parameters live on device, GSPMD-sharded. Only the embedding-id axis
+// — unbounded and hash-addressed — stays host-side.
+//
+// Exposed as a C API for ctypes (no pybind11 in this environment).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum class OptType { kSGD = 0, kMomentum = 1, kAdagrad = 2, kAdam = 3 };
+
+struct OptConfig {
+  OptType type = OptType::kSGD;
+  float lr = 0.01f;
+  float momentum = 0.9f;
+  float beta1 = 0.9f;
+  float beta2 = 0.999f;
+  float epsilon = 1e-8f;
+  int slots() const {
+    switch (type) {
+      case OptType::kSGD: return 0;
+      case OptType::kMomentum: return 1;
+      case OptType::kAdagrad: return 1;
+      case OptType::kAdam: return 2;
+    }
+    return 0;
+  }
+};
+
+struct Table {
+  std::string name;
+  int64_t dim = 0;
+  float init_scale = 0.05f;
+  int slots = 0;
+  // row layout: [weight(dim) | slot0(dim) | slot1(dim)]
+  std::unordered_map<int64_t, std::unique_ptr<float[]>> rows;
+  // Adam per-row step counts for bias correction.
+  std::unordered_map<int64_t, int64_t> row_steps;
+  // Per-table RNG: only touched under this table's unique lock, so
+  // concurrent lookups on different tables never race on RNG state.
+  std::mt19937 rng;
+  mutable std::shared_mutex mu;
+
+  float* get_or_init(int64_t id) {
+    std::mt19937* rng = &this->rng;
+    auto it = rows.find(id);
+    if (it != rows.end()) return it->second.get();
+    auto row = std::make_unique<float[]>(dim * (1 + slots));
+    std::uniform_real_distribution<float> dist(-init_scale, init_scale);
+    for (int64_t d = 0; d < dim; ++d) row[d] = dist(*rng);
+    std::memset(row.get() + dim, 0, sizeof(float) * dim * slots);
+    float* ptr = row.get();
+    rows.emplace(id, std::move(row));
+    return ptr;
+  }
+};
+
+struct Store {
+  OptConfig opt;
+  std::unordered_map<std::string, std::unique_ptr<Table>> tables;
+  uint64_t seed = 0;
+  std::mutex tables_mu;
+  std::atomic<int64_t> version{0};
+
+  Table* find(const char* name) {
+    std::lock_guard<std::mutex> lock(tables_mu);
+    auto it = tables.find(name);
+    return it == tables.end() ? nullptr : it->second.get();
+  }
+};
+
+void apply_row(const OptConfig& opt, float* row, const float* grad,
+               int64_t dim, float lr, int64_t step) {
+  float* w = row;
+  switch (opt.type) {
+    case OptType::kSGD: {
+      for (int64_t d = 0; d < dim; ++d) w[d] -= lr * grad[d];
+      break;
+    }
+    case OptType::kMomentum: {
+      float* vel = row + dim;
+      for (int64_t d = 0; d < dim; ++d) {
+        vel[d] = opt.momentum * vel[d] + grad[d];
+        w[d] -= lr * vel[d];
+      }
+      break;
+    }
+    case OptType::kAdagrad: {
+      float* acc = row + dim;
+      for (int64_t d = 0; d < dim; ++d) {
+        acc[d] += grad[d] * grad[d];
+        w[d] -= lr * grad[d] / (std::sqrt(acc[d]) + opt.epsilon);
+      }
+      break;
+    }
+    case OptType::kAdam: {
+      float* m = row + dim;
+      float* v = row + 2 * dim;
+      const float bc1 = 1.0f - std::pow(opt.beta1, (float)step);
+      const float bc2 = 1.0f - std::pow(opt.beta2, (float)step);
+      for (int64_t d = 0; d < dim; ++d) {
+        m[d] = opt.beta1 * m[d] + (1.0f - opt.beta1) * grad[d];
+        v[d] = opt.beta2 * v[d] + (1.0f - opt.beta2) * grad[d] * grad[d];
+        const float mhat = m[d] / bc1;
+        const float vhat = v[d] / bc2;
+        w[d] -= lr * mhat / (std::sqrt(vhat) + opt.epsilon);
+      }
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* edl_store_create(uint64_t seed) {
+  auto* store = new Store();
+  store->seed = seed;
+  return store;
+}
+
+void edl_store_destroy(void* handle) { delete static_cast<Store*>(handle); }
+
+int edl_store_set_optimizer(void* handle, const char* type, float lr,
+                            float momentum, float beta1, float beta2,
+                            float epsilon) {
+  auto* store = static_cast<Store*>(handle);
+  OptConfig cfg;
+  std::string t(type);
+  if (t == "sgd") cfg.type = OptType::kSGD;
+  else if (t == "momentum") cfg.type = OptType::kMomentum;
+  else if (t == "adagrad") cfg.type = OptType::kAdagrad;
+  else if (t == "adam") cfg.type = OptType::kAdam;
+  else return -1;
+  cfg.lr = lr;
+  cfg.momentum = momentum;
+  cfg.beta1 = beta1;
+  cfg.beta2 = beta2;
+  cfg.epsilon = epsilon;
+  store->opt = cfg;
+  return 0;
+}
+
+int edl_store_create_table(void* handle, const char* name, int64_t dim,
+                           float init_scale) {
+  auto* store = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(store->tables_mu);
+  auto it = store->tables.find(name);
+  if (it != store->tables.end()) {
+    if (it->second->dim != dim) return -1;
+    // Existing table: adopt the (possibly updated) init scale so a
+    // restore-then-register sequence keeps the model's configured scale.
+    it->second->init_scale = init_scale;
+    return 0;
+  }
+  auto table = std::make_unique<Table>();
+  table->name = name;
+  table->dim = dim;
+  table->init_scale = init_scale;
+  table->slots = store->opt.slots();
+  table->rng.seed(store->seed * 1000003u + std::hash<std::string>{}(name));
+  store->tables.emplace(name, std::move(table));
+  return 0;
+}
+
+// Batch lookup; missing rows are lazily initialized (the reference's
+// GetEmbeddingVector semantics, embedding_table.go:41-58).
+int edl_store_lookup(void* handle, const char* name, const int64_t* ids,
+                     int64_t n, float* out) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    const float* row = table->get_or_init(ids[i]);
+    std::memcpy(out + i * table->dim, row, sizeof(float) * table->dim);
+  }
+  return 0;
+}
+
+// Sparse apply: grads is [n, dim] row-major, one row per id. lr_scale
+// multiplies the configured LR (staleness modulation hook).
+int edl_store_push_gradients(void* handle, const char* name,
+                             const int64_t* ids, const float* grads,
+                             int64_t n, float lr_scale) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  const float lr = store->opt.lr * lr_scale;
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    float* row = table->get_or_init(ids[i]);
+    int64_t step = ++table->row_steps[ids[i]];
+    apply_row(store->opt, row, grads + i * table->dim, table->dim, lr, step);
+  }
+  return 0;
+}
+
+int64_t edl_store_table_size(void* handle, const char* name) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  std::shared_lock<std::shared_mutex> lock(table->mu);
+  return (int64_t)table->rows.size();
+}
+
+int64_t edl_store_version(void* handle) {
+  return static_cast<Store*>(handle)->version.load();
+}
+
+void edl_store_bump_version(void* handle) {
+  static_cast<Store*>(handle)->version.fetch_add(1);
+}
+
+// Export all (id, weight-row) pairs of a table into caller buffers.
+// Call with out_ids == nullptr to get the count. Weights only — slots
+// are excluded from checkpoints, matching the reference
+// (ps/parameters.py:194-199); unlike the reference this is a documented
+// choice, not an accident: sparse slots rebuild quickly and halve
+// checkpoint size.
+int64_t edl_store_export(void* handle, const char* name, int64_t* out_ids,
+                         float* out_values, int64_t capacity) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  std::shared_lock<std::shared_mutex> lock(table->mu);
+  if (out_ids == nullptr) return (int64_t)table->rows.size();
+  int64_t i = 0;
+  for (const auto& kv : table->rows) {
+    if (i >= capacity) break;
+    out_ids[i] = kv.first;
+    std::memcpy(out_values + i * table->dim, kv.second.get(),
+                sizeof(float) * table->dim);
+    ++i;
+  }
+  return i;
+}
+
+// Bulk import rows (checkpoint restore / re-shard). Only ids with
+// id % shard_num == shard_id are kept when shard_num > 0.
+int edl_store_import(void* handle, const char* name, const int64_t* ids,
+                     const float* values, int64_t n, int shard_id,
+                     int shard_num) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    if (shard_num > 0 && (ids[i] % shard_num + shard_num) % shard_num != shard_id)
+      continue;
+    float* row = table->get_or_init(ids[i]);
+    std::memcpy(row, values + i * table->dim, sizeof(float) * table->dim);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -109,9 +109,18 @@ class SpmdTrainer:
             )
         return jax.device_put(batch, self._batch_sharding)
 
+    def ensure_state(self, state, batch):
+        if state is None:
+            return self.create_state(batch["features"])
+        return state
+
     def train_step(self, state, batch):
+        state = self.ensure_state(state, batch)
         return self._train_step(state, self.shard_batch(batch))
 
-    def eval_step(self, state, features):
-        outputs = self._eval_step(state, jax.device_put(features, self._batch_sharding))
+    def eval_step(self, state, batch):
+        features = jax.device_put(
+            batch["features"], self._batch_sharding
+        )
+        outputs = self._eval_step(state, features)
         return jax.tree_util.tree_map(np.asarray, outputs)

@@ -1,0 +1,121 @@
+"""Sparse embedding checkpoints: versioned, id-shardable files.
+
+Reference parity: go/pkg/ps/checkpoint.go + common/save_utils.py —
+``<dir>/version-<v>/embeddings-<i>-of-<N>.npz`` with rows routed to
+shards by id mod N, keep-max GC, and restore that re-shards any
+checkpoint onto the current PS count (save_utils.py:229-282).
+"""
+
+import os
+import re
+import shutil
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.ps.checkpoint")
+
+_FILE_RE = re.compile(r"embeddings-(\d+)-of-(\d+)\.npz$")
+
+
+class SparseCheckpointSaver:
+    def __init__(self, checkpoint_dir, shard_id=0, shard_num=1, keep_max=3):
+        self._dir = checkpoint_dir
+        self._shard_id = shard_id
+        self._shard_num = shard_num
+        self._keep_max = keep_max
+
+    def _version_dir(self, version):
+        return os.path.join(self._dir, "version-%d" % version)
+
+    def save(self, version, store):
+        vdir = self._version_dir(version)
+        os.makedirs(vdir, exist_ok=True)
+        arrays = {}
+        for name in store.table_names():
+            ids, values = store.export_table(name)
+            arrays["ids/" + name] = ids
+            arrays["values/" + name] = values
+            arrays["dim/" + name] = np.int64(store.table_dim(name))
+        path = os.path.join(
+            vdir,
+            "embeddings-%d-of-%d.npz" % (self._shard_id, self._shard_num),
+        )
+        np.savez(path, **arrays)
+        logger.info("Saved sparse checkpoint %s", path)
+        self._gc()
+        return path
+
+    def _complete(self, vdir):
+        """A version dir is valid when all N shard files exist
+        (reference validity check: save_utils.py:211-227)."""
+        files = [f for f in os.listdir(vdir) if _FILE_RE.search(f)]
+        if not files:
+            return False
+        total = int(_FILE_RE.search(files[0]).group(2))
+        return len(files) >= total
+
+    def _gc(self):
+        if self._keep_max <= 0 or not os.path.isdir(self._dir):
+            return
+        versions = sorted(
+            int(d.split("-")[1])
+            for d in os.listdir(self._dir)
+            if d.startswith("version-")
+        )
+        complete = [
+            v for v in versions if self._complete(self._version_dir(v))
+        ]
+        for v in complete[: -self._keep_max]:
+            shutil.rmtree(self._version_dir(v), ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def latest_version(checkpoint_dir):
+        if not os.path.isdir(checkpoint_dir):
+            return None
+        versions = sorted(
+            int(d.split("-")[1])
+            for d in os.listdir(checkpoint_dir)
+            if d.startswith("version-")
+        )
+        return versions[-1] if versions else None
+
+    def restore(self, store, version=None):
+        """Load all shard files of a version, keeping only rows belonging
+        to this shard — re-sharding is implicit (any old N -> new N)."""
+        version = (
+            version
+            if version is not None
+            else self.latest_version(self._dir)
+        )
+        if version is None:
+            return None
+        vdir = self._version_dir(version)
+        for fname in sorted(os.listdir(vdir)):
+            if not _FILE_RE.search(fname):
+                continue
+            data = np.load(os.path.join(vdir, fname))
+            tables = {
+                key.split("/", 1)[1]
+                for key in data.files
+                if key.startswith("ids/")
+            }
+            for name in tables:
+                dim = int(data["dim/" + name])
+                store.create_table(name, dim)
+                store.import_table(
+                    name,
+                    data["ids/" + name],
+                    data["values/" + name],
+                    shard_id=self._shard_id,
+                    shard_num=self._shard_num,
+                )
+        logger.info(
+            "Restored sparse checkpoint version %d into shard %d/%d",
+            version,
+            self._shard_id,
+            self._shard_num,
+        )
+        return version

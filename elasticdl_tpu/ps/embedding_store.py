@@ -1,0 +1,359 @@
+"""Embedding store bindings: native C++ store with a numpy fallback.
+
+The native library (native/embedding_store.cc) is the TPU-host
+equivalent of the reference's Go PS runtime (lazy hash-map tables +
+sparse optimizer kernels, §2.2 of SURVEY.md). The numpy implementation
+mirrors it exactly and serves as both a fallback when no C++ toolchain
+exists and the reference semantics for tests.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.ps.embedding_store")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libedl_embedding.so"))
+
+OPTIMIZER_DEFAULTS = dict(
+    lr=0.01, momentum=0.9, beta1=0.9, beta2=0.999, epsilon=1e-8
+)
+
+
+def _load_native():
+    if not os.path.exists(_SO_PATH):
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+            )
+        except Exception as e:
+            logger.warning("Native embedding store build failed: %s", e)
+            return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError as e:
+        logger.warning("Native embedding store load failed: %s", e)
+        return None
+    lib.edl_store_create.restype = ctypes.c_void_p
+    lib.edl_store_create.argtypes = [ctypes.c_uint64]
+    lib.edl_store_destroy.argtypes = [ctypes.c_void_p]
+    lib.edl_store_set_optimizer.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_float,
+        ctypes.c_float,
+        ctypes.c_float,
+        ctypes.c_float,
+        ctypes.c_float,
+    ]
+    lib.edl_store_create_table.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_float,
+    ]
+    lib.edl_store_lookup.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.edl_store_push_gradients.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_float,
+    ]
+    lib.edl_store_table_size.restype = ctypes.c_int64
+    lib.edl_store_table_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.edl_store_version.restype = ctypes.c_int64
+    lib.edl_store_version.argtypes = [ctypes.c_void_p]
+    lib.edl_store_bump_version.argtypes = [ctypes.c_void_p]
+    lib.edl_store_export.restype = ctypes.c_int64
+    lib.edl_store_export.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+    ]
+    lib.edl_store_import.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    return lib
+
+
+_native_lib = None
+_native_lock = threading.Lock()
+
+
+def native_lib():
+    global _native_lib
+    with _native_lock:
+        if _native_lib is None:
+            _native_lib = _load_native() or False
+    return _native_lib or None
+
+
+class NativeEmbeddingStore:
+    """ctypes wrapper over the C++ store."""
+
+    def __init__(self, seed=0, lib=None):
+        self._lib = lib or native_lib()
+        if self._lib is None:
+            raise RuntimeError("native embedding store unavailable")
+        self._handle = ctypes.c_void_p(self._lib.edl_store_create(seed))
+        self._dims = {}
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.edl_store_destroy(handle)
+            self._handle = None
+
+    def set_optimizer(self, opt_type, **kwargs):
+        args = dict(OPTIMIZER_DEFAULTS)
+        args.update(kwargs)
+        rc = self._lib.edl_store_set_optimizer(
+            self._handle,
+            opt_type.lower().encode(),
+            args["lr"],
+            args["momentum"],
+            args["beta1"],
+            args["beta2"],
+            args["epsilon"],
+        )
+        if rc != 0:
+            raise ValueError("unsupported sparse optimizer %r" % opt_type)
+
+    def create_table(self, name, dim, init_scale=0.05):
+        rc = self._lib.edl_store_create_table(
+            self._handle, name.encode(), dim, init_scale
+        )
+        if rc != 0:
+            raise ValueError(
+                "table %r exists with a different dim" % name
+            )
+        self._dims[name] = dim
+
+    def lookup(self, name, ids):
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        dim = self._dims[name]
+        out = np.empty((ids.size, dim), dtype=np.float32)
+        rc = self._lib.edl_store_lookup(
+            self._handle,
+            name.encode(),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ids.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        if rc != 0:
+            raise KeyError(name)
+        return out
+
+    def push_gradients(self, name, ids, grads, lr_scale=1.0):
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        rc = self._lib.edl_store_push_gradients(
+            self._handle,
+            name.encode(),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ids.size,
+            lr_scale,
+        )
+        if rc != 0:
+            raise KeyError(name)
+
+    def table_size(self, name):
+        return int(self._lib.edl_store_table_size(self._handle, name.encode()))
+
+    @property
+    def version(self):
+        return int(self._lib.edl_store_version(self._handle))
+
+    def bump_version(self):
+        self._lib.edl_store_bump_version(self._handle)
+
+    def table_names(self):
+        return list(self._dims)
+
+    def table_dim(self, name):
+        return self._dims[name]
+
+    def export_table(self, name):
+        count = self._lib.edl_store_export(
+            self._handle, name.encode(), None, None, 0
+        )
+        dim = self._dims[name]
+        ids = np.empty((count,), dtype=np.int64)
+        values = np.empty((count, dim), dtype=np.float32)
+        got = self._lib.edl_store_export(
+            self._handle,
+            name.encode(),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            count,
+        )
+        return ids[:got], values[:got]
+
+    def import_table(self, name, ids, values, shard_id=0, shard_num=0):
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        rc = self._lib.edl_store_import(
+            self._handle,
+            name.encode(),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ids.size,
+            shard_id,
+            shard_num,
+        )
+        if rc != 0:
+            raise KeyError(name)
+
+
+class NumpyEmbeddingStore:
+    """Pure-python twin of the native store (same semantics)."""
+
+    def __init__(self, seed=0):
+        self._rng = np.random.RandomState(seed)
+        self._tables = {}  # name -> {id: weight row}
+        self._slots = {}  # name -> {id: slot array [slots, dim]}
+        self._steps = {}  # name -> {id: step count}
+        self._meta = {}  # name -> (dim, init_scale)
+        self._opt = ("sgd", dict(OPTIMIZER_DEFAULTS))
+        self._lock = threading.Lock()
+        self.version = 0
+
+    def set_optimizer(self, opt_type, **kwargs):
+        opt_type = opt_type.lower()
+        if opt_type not in ("sgd", "momentum", "adagrad", "adam"):
+            raise ValueError("unsupported sparse optimizer %r" % opt_type)
+        args = dict(OPTIMIZER_DEFAULTS)
+        args.update(kwargs)
+        self._opt = (opt_type, args)
+
+    def create_table(self, name, dim, init_scale=0.05):
+        with self._lock:
+            if name in self._meta:
+                if self._meta[name][0] != dim:
+                    raise ValueError(
+                        "table %r exists with a different dim" % name
+                    )
+                # adopt the (possibly updated) scale so restore-then-
+                # register keeps the model's configured init
+                self._meta[name] = (dim, init_scale)
+                return
+            self._meta[name] = (dim, init_scale)
+            self._tables[name] = {}
+            self._slots[name] = {}
+            self._steps[name] = {}
+
+    def _row(self, name, id_):
+        table = self._tables[name]
+        if id_ not in table:
+            dim, scale = self._meta[name]
+            table[id_] = self._rng.uniform(-scale, scale, size=dim).astype(
+                np.float32
+            )
+            n_slots = {"sgd": 0, "momentum": 1, "adagrad": 1, "adam": 2}[
+                self._opt[0]
+            ]
+            self._slots[name][id_] = np.zeros(
+                (n_slots, dim), dtype=np.float32
+            )
+            self._steps[name][id_] = 0
+        return table[id_]
+
+    def lookup(self, name, ids):
+        if name not in self._meta:
+            raise KeyError(name)
+        with self._lock:
+            return np.stack([self._row(name, int(i)).copy() for i in ids])
+
+    def push_gradients(self, name, ids, grads, lr_scale=1.0):
+        if name not in self._meta:
+            raise KeyError(name)
+        opt_type, args = self._opt
+        lr = args["lr"] * lr_scale
+        with self._lock:
+            for i, grad in zip(ids, np.asarray(grads, dtype=np.float32)):
+                i = int(i)
+                w = self._row(name, i)
+                slots = self._slots[name][i]
+                self._steps[name][i] += 1
+                step = self._steps[name][i]
+                if opt_type == "sgd":
+                    w -= lr * grad
+                elif opt_type == "momentum":
+                    slots[0] = args["momentum"] * slots[0] + grad
+                    w -= lr * slots[0]
+                elif opt_type == "adagrad":
+                    slots[0] += grad * grad
+                    w -= lr * grad / (np.sqrt(slots[0]) + args["epsilon"])
+                elif opt_type == "adam":
+                    slots[0] = args["beta1"] * slots[0] + (1 - args["beta1"]) * grad
+                    slots[1] = (
+                        args["beta2"] * slots[1]
+                        + (1 - args["beta2"]) * grad * grad
+                    )
+                    mhat = slots[0] / (1 - args["beta1"] ** step)
+                    vhat = slots[1] / (1 - args["beta2"] ** step)
+                    w -= lr * mhat / (np.sqrt(vhat) + args["epsilon"])
+
+    def table_size(self, name):
+        return len(self._tables.get(name, {}))
+
+    def bump_version(self):
+        self.version += 1
+
+    def table_names(self):
+        return list(self._meta)
+
+    def table_dim(self, name):
+        return self._meta[name][0]
+
+    def export_table(self, name):
+        with self._lock:
+            table = self._tables[name]
+            if not table:
+                dim = self._meta[name][0]
+                return (
+                    np.empty((0,), np.int64),
+                    np.empty((0, dim), np.float32),
+                )
+            ids = np.fromiter(table.keys(), dtype=np.int64, count=len(table))
+            values = np.stack([table[int(i)] for i in ids])
+            return ids, values
+
+    def import_table(self, name, ids, values, shard_id=0, shard_num=0):
+        with self._lock:
+            for i, row in zip(ids, values):
+                i = int(i)
+                if shard_num > 0 and i % shard_num != shard_id:
+                    continue
+                self._row(name, i)[:] = row
+
+
+def create_store(seed=0, prefer_native=True):
+    if prefer_native and native_lib() is not None:
+        return NativeEmbeddingStore(seed=seed)
+    return NumpyEmbeddingStore(seed=seed)

@@ -1,0 +1,46 @@
+"""In-process PS client: an embedding store behind the PSClient surface.
+
+Lets LocalExecutor (and tests) run sparse models with no gRPC or PS
+processes — the reference's LocalExecutor had no sparse story at all
+(local_executor.py trains only non-EDL-embedding models); this closes
+that gap.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
+from elasticdl_tpu.ps.embedding_store import create_store
+
+
+class LocalPSClient:
+    def __init__(self, store=None, seed=0, opt_type="adam", **opt_args):
+        self.store = store or create_store(seed=seed)
+        if store is None:
+            self.store.set_optimizer(opt_type, **opt_args)
+
+    @property
+    def ps_num(self):
+        return 1
+
+    def push_embedding_table_infos(self, infos):
+        for name, dim, init_scale in infos:
+            self.store.create_table(name, dim, init_scale)
+
+    def push_dense_init(self, params, version=0):
+        pass  # single process: dense init is local by definition
+
+    def pull_dense_init(self, version=-1):
+        return False, 0, {}
+
+    def pull_embedding_vectors(self, name, ids):
+        return self.store.lookup(name, np.asarray(ids, dtype=np.int64))
+
+    def push_gradients(self, grads_by_table, model_version=0, learning_rate=0.0):
+        lr_scale = learning_rate if learning_rate > 0 else 1.0
+        for name, (values, ids) in grads_by_table.items():
+            values, ids = deduplicate_indexed_slices(
+                np.asarray(values), np.asarray(ids, dtype=np.int64)
+            )
+            self.store.push_gradients(name, ids, values, lr_scale=lr_scale)
+        self.store.bump_version()
+        return self.store.version

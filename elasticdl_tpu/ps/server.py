@@ -1,0 +1,133 @@
+"""Parameter server process.
+
+Reference parity: elasticdl/python/ps/parameter_server.py and
+go/cmd/elasticdl_ps/main.go — serves the Pserver gRPC service until the
+master goes away (the reference polls the master pod's K8s status every
+30 s; here the master channel's health plays that role).
+"""
+
+import argparse
+import sys
+import time
+
+import grpc
+
+from elasticdl_tpu.common.grpc_utils import build_server
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
+from elasticdl_tpu.ps.embedding_store import create_store
+from elasticdl_tpu.ps.servicer import PserverServicer
+from elasticdl_tpu.proto.services import add_pserver_servicer_to_server
+from elasticdl_tpu.train.optimizers import parse_opt_args
+
+logger = _logger_factory("elasticdl_tpu.ps.server")
+
+
+def parse_ps_args(argv=None):
+    parser = argparse.ArgumentParser("elasticdl_tpu ps")
+    parser.add_argument("--ps_id", type=int, default=0)
+    parser.add_argument("--num_ps_pods", type=int, default=1)
+    parser.add_argument("--port", type=int, default=50002)
+    parser.add_argument("--master_addr", default="")
+    parser.add_argument("--opt_type", default="sgd")
+    parser.add_argument(
+        "--opt_args", default="", help="k=v;k=v (e.g. lr=0.01;momentum=0.9)"
+    )
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=int, default=0)
+    parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    parser.add_argument("--checkpoint_dir_for_init", default="")
+    parser.add_argument("--use_native_store", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=0)
+    return parser.parse_args(argv)
+
+
+class ParameterServer:
+    def __init__(self, args):
+        self.args = args
+        self.store = create_store(
+            seed=args.seed + args.ps_id,
+            prefer_native=bool(args.use_native_store),
+        )
+        opt_args = {
+            k: float(v) for k, v in parse_opt_args(args.opt_args).items()
+        }
+        self.store.set_optimizer(args.opt_type, **opt_args)
+        saver = None
+        if args.checkpoint_dir:
+            saver = SparseCheckpointSaver(
+                args.checkpoint_dir,
+                shard_id=args.ps_id,
+                shard_num=args.num_ps_pods,
+                keep_max=args.keep_checkpoint_max,
+            )
+        master_client = None
+        if args.master_addr:
+            from elasticdl_tpu.worker.master_client import MasterClient
+
+            # worker_host="": a PS is not a mesh member (its liveness
+            # polls must not auto-join it into the SPMD rendezvous).
+            master_client = MasterClient(
+                args.master_addr,
+                worker_id=-(args.ps_id + 1),
+                worker_host="",
+            )
+        self._master_client = master_client
+        self.servicer = PserverServicer(
+            self.store,
+            ps_id=args.ps_id,
+            checkpoint_saver=saver,
+            checkpoint_steps=args.checkpoint_steps,
+            master_client=master_client,
+        )
+        if args.checkpoint_dir_for_init:
+            SparseCheckpointSaver(
+                args.checkpoint_dir_for_init,
+                shard_id=args.ps_id,
+                shard_num=args.num_ps_pods,
+            ).restore(self.store)
+        self.server = None
+
+    def prepare(self):
+        self.server = build_server()
+        add_pserver_servicer_to_server(self.servicer, self.server)
+        self.server.add_insecure_port("[::]:%d" % self.args.port)
+        self.server.start()
+        logger.info(
+            "PS %d/%d serving on :%d",
+            self.args.ps_id,
+            self.args.num_ps_pods,
+            self.args.port,
+        )
+        return self
+
+    def run(self, poll_secs=5.0):
+        """Serve until the master stops answering (reference: PS pods poll
+        the master pod's status, parameter_server.py:129-153)."""
+        if self._master_client is None:
+            self.server.wait_for_termination()
+            return 0
+        misses = 0
+        while True:
+            time.sleep(poll_secs)
+            info = self._master_client.get_comm_info()
+            if info.mesh_epoch < 0:  # RPC failure marker
+                misses += 1
+                if misses >= 3:
+                    logger.info("Master gone; PS exiting")
+                    self.server.stop(grace=1.0)
+                    return 0
+            else:
+                misses = 0
+
+
+def main(argv=None):
+    from elasticdl_tpu.common.platform import apply_platform_overrides
+
+    apply_platform_overrides()
+    args = parse_ps_args(argv)
+    return ParameterServer(args).prepare().run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

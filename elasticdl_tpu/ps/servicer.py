@@ -1,0 +1,136 @@
+"""Pserver gRPC service over the embedding store.
+
+Reference parity: elasticdl/python/ps/servicer.py and go/pkg/ps/server.go
+— with the dense hot path removed. What remains host-side:
+
+- sparse embedding pull/push with lazy table creation
+  (pull_embedding_vectors / push_gradients)
+- async-SGD semantics on the sparse path only: immediate apply,
+  version++, staleness-modulated LR ``lr /= max(1, version_diff)``
+  (reference: ps/servicer.py:120-165). Lockstep SPMD makes these
+  semantics meaningless for dense params, so they survive only here.
+- cold-start dense init: the first worker pushes its initialized dense
+  params; late joiners pull them instead of re-initializing (reference
+  worker.py:297-336 get_model protocol).
+- periodic sparse checkpoints + report_version to the master for
+  step-based evaluation triggering.
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.common.tensor_utils import (
+    blob_to_ndarray,
+    deserialize_indexed_slices,
+    ndarray_to_blob,
+)
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = _logger_factory("elasticdl_tpu.ps.servicer")
+
+
+class PserverServicer:
+    def __init__(
+        self,
+        store,
+        ps_id=0,
+        staleness_modulation=True,
+        checkpoint_saver=None,
+        checkpoint_steps=0,
+        master_client=None,
+    ):
+        self._store = store
+        self._ps_id = ps_id
+        self._staleness_modulation = staleness_modulation
+        self._checkpoint_saver = checkpoint_saver
+        self._checkpoint_steps = checkpoint_steps
+        self._master_client = master_client
+        self._lock = threading.Lock()
+        self._dense = {}
+        self._dense_version = 0
+        self._dense_initialized = False
+
+    # ------------------------------------------------------------------
+    def push_model(self, request, context=None):
+        """First writer wins: later pushes are ignored (reference:
+        ps/parameters.py:129-159 init_from_model_pb only once)."""
+        with self._lock:
+            if not self._dense_initialized:
+                self._dense = {
+                    name: blob_to_ndarray(blob).copy()
+                    for name, blob in request.dense_parameters.items()
+                }
+                self._dense_version = request.version
+                self._dense_initialized = True
+                logger.info(
+                    "Initialized %d dense parameters at version %d",
+                    len(self._dense),
+                    request.version,
+                )
+        self._create_tables(request.embedding_table_infos)
+        return pb.Empty()
+
+    def push_embedding_table_infos(self, request, context=None):
+        self._create_tables(request.embedding_table_infos)
+        return pb.Empty()
+
+    def _create_tables(self, infos):
+        for info in infos:
+            init_scale = 0.05
+            if info.initializer:
+                try:
+                    init_scale = float(info.initializer)
+                except ValueError:
+                    pass
+            self._store.create_table(info.name, info.dim, init_scale)
+
+    # ------------------------------------------------------------------
+    def pull_dense_parameters(self, request, context=None):
+        response = pb.PullDenseParametersResponse()
+        with self._lock:
+            response.initialized = self._dense_initialized
+            response.version = self._dense_version
+            if self._dense_initialized and request.version < self._dense_version:
+                for name, array in self._dense.items():
+                    ndarray_to_blob(array, response.dense_parameters[name])
+        return response
+
+    def pull_embedding_vectors(self, request, context=None):
+        ids = np.asarray(request.ids, dtype=np.int64)
+        values = self._store.lookup(request.name, ids)
+        return ndarray_to_blob(values)
+
+    # ------------------------------------------------------------------
+    def push_gradients(self, request, context=None):
+        grad_version = request.gradients.version
+        lr_scale = 1.0
+        if self._staleness_modulation:
+            diff = self._store.version - grad_version
+            lr_scale = 1.0 / max(1, diff) if diff > 0 else 1.0
+        if request.learning_rate > 0:
+            lr_scale *= request.learning_rate
+        for name, slices in request.gradients.embedding_tables.items():
+            values, ids = deserialize_indexed_slices(slices)
+            self._store.push_gradients(name, ids, values, lr_scale=lr_scale)
+        self._store.bump_version()
+        version = self._store.version
+        self._maybe_checkpoint(version)
+        self._maybe_report_version(version)
+        return pb.PushGradientsResponse(accepted=True, version=version)
+
+    def _maybe_checkpoint(self, version):
+        if (
+            self._checkpoint_saver is not None
+            and self._checkpoint_steps > 0
+            and version % self._checkpoint_steps == 0
+        ):
+            try:
+                self._checkpoint_saver.save(version, self._store)
+            except Exception:
+                logger.exception("sparse checkpoint failed")
+
+    def _maybe_report_version(self, version):
+        if self._master_client is not None:
+            self._master_client.report_version(version)

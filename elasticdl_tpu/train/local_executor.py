@@ -48,13 +48,30 @@ class LocalExecutor:
             if validation_data
             else None
         )
-        self.trainer = JaxTrainer(
-            model=self.spec.custom_model(),
-            loss_fn=self.spec.loss,
-            optimizer=self.spec.optimizer(),
-            compute_dtype=compute_dtype,
-            seed=seed,
-        )
+        if self.spec.sparse_embedding_specs:
+            # Sparse model locally: in-process embedding store, no gRPC.
+            from elasticdl_tpu.ps.local_client import LocalPSClient
+            from elasticdl_tpu.train.sparse import SparseTrainer
+
+            self.trainer = SparseTrainer(
+                model=self.spec.custom_model(),
+                loss_fn=self.spec.loss,
+                optimizer=self.spec.optimizer(),
+                specs=self.spec.sparse_embedding_specs(
+                    batch_size=minibatch_size
+                ),
+                ps_client=LocalPSClient(seed=seed),
+                compute_dtype=compute_dtype,
+                seed=seed,
+            )
+        else:
+            self.trainer = JaxTrainer(
+                model=self.spec.custom_model(),
+                loss_fn=self.spec.loss,
+                optimizer=self.spec.optimizer(),
+                compute_dtype=compute_dtype,
+                seed=seed,
+            )
         self.state = None
 
     # ------------------------------------------------------------------
@@ -82,8 +99,6 @@ class LocalExecutor:
         losses = []
         for epoch in range(self._num_epochs):
             for batch in self._batches(self._train_reader, "training"):
-                if self.state is None:
-                    self.state = self.trainer.create_state(batch["features"])
                 self.state, loss = self.trainer.train_step(self.state, batch)
                 losses.append(float(loss))
             logger.info(
@@ -97,9 +112,8 @@ class LocalExecutor:
     def evaluate(self):
         books = EvaluationMetrics(self.spec.eval_metrics_fn())
         for batch in self._batches(self._valid_reader, "evaluation"):
-            if self.state is None:
-                self.state = self.trainer.create_state(batch["features"])
-            outputs = self.trainer.eval_step(self.state, batch["features"])
+            self.state = self.trainer.ensure_state(self.state, batch)
+            outputs = self.trainer.eval_step(self.state, batch)
             real = batch_real_count(batch)
             books.update_evaluation_metrics(
                 normalize_outputs(outputs, real),
@@ -113,9 +127,8 @@ class LocalExecutor:
         )
         results = []
         for batch in self._batches(reader, "prediction"):
-            if self.state is None:
-                self.state = self.trainer.create_state(batch["features"])
-            outputs = self.trainer.eval_step(self.state, batch["features"])
+            self.state = self.trainer.ensure_state(self.state, batch)
+            outputs = self.trainer.eval_step(self.state, batch)
             real = batch_real_count(batch)
             results.append(normalize_outputs(outputs, real)["output"])
         return results

@@ -1,0 +1,278 @@
+"""Sparse embedding training: host PS tables + on-device combine.
+
+This is the TPU answer to the reference's EmbeddingDelegate
+(elasticdl/python/elasticdl/embedding_delegate.py), which escaped the TF
+graph mid-forward via tf.py_function to pull rows. Escaping a jitted XLA
+step mid-forward would stall the TPU pipe, so the lookup moves *before*
+the step (SURVEY.md §7 "pre-step gather"):
+
+  host:   ids -> unique -> pull rows from PS (PSClient, id-mod sharded)
+  device: jitted step takes rows as an INPUT, gathers + combines on the
+          MXU-friendly dense side, and returns d(loss)/d(rows)
+  host:   push row gradients back to the PS as IndexedSlices
+
+Static shapes: the unique-id buffer is padded to a fixed per-spec
+capacity so XLA compiles the step once.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.data.pipeline import MASK_KEY
+from elasticdl_tpu.train.losses import masked_mean
+from elasticdl_tpu.train.train_state import (
+    TrainState,
+    cast_floating,
+    create_train_state,
+    resolve_dtype,
+)
+
+ROWS_SUFFIX = "__rows"
+INDICES_SUFFIX = "__indices"
+
+
+class SparseEmbeddingSpec:
+    """One host-side embedding table used by a model.
+
+    feature_key: the feature holding int ids, shape [B] or [B, F].
+    capacity: padded unique-ids buffer size (static shape); defaults to
+    batch_size * F at prepare time if 0.
+    """
+
+    def __init__(self, name, dim, feature_key=None, combiner="sum",
+                 capacity=0, init_scale=0.05):
+        self.name = name
+        self.dim = dim
+        self.feature_key = feature_key or name
+        self.combiner = combiner
+        self.capacity = capacity
+        self.init_scale = init_scale
+
+
+def embedding_lookup(features, name, combiner=None):
+    """Model-side: gather pulled rows and combine over the feature axis.
+
+    rows: [capacity, dim]; indices: [B] or [B, F] positions into rows.
+    Returns [B, dim] (combined) or [B, F, dim] when combiner is None.
+    """
+    rows = features[name + ROWS_SUFFIX]
+    indices = features[name + INDICES_SUFFIX]
+    gathered = rows[indices]  # [B, dim] or [B, F, dim]
+    if gathered.ndim == 2 or combiner is None:
+        return gathered
+    if combiner == "sum":
+        return gathered.sum(axis=1)
+    if combiner == "mean":
+        return gathered.mean(axis=1)
+    if combiner == "sqrtn":
+        return gathered.sum(axis=1) / jnp.sqrt(
+            jnp.asarray(gathered.shape[1], gathered.dtype)
+        )
+    raise ValueError("unknown combiner %r" % combiner)
+
+
+class SparseBatchPreparer:
+    """Host-side: swap raw id features for (rows, indices) pairs."""
+
+    def __init__(self, specs, ps_client):
+        self._specs = list(specs)
+        self._ps = ps_client
+        self._registered = False
+
+    def register_tables(self):
+        if not self._registered:
+            self._ps.push_embedding_table_infos(
+                [(s.name, s.dim, s.init_scale) for s in self._specs]
+            )
+            self._registered = True
+
+    def prepare(self, batch):
+        """Returns (batch with rows/indices features, pull_info) where
+        pull_info = {name: (unique_ids, n_unique)} for the grad push."""
+        self.register_tables()
+        features = dict(batch["features"])
+        pull_info = {}
+        consumed = set()
+        for spec in self._specs:
+            # multiple tables may read the same id feature (e.g. DeepFM's
+            # second-order and linear tables), so consume keys at the end
+            ids = np.asarray(features[spec.feature_key])
+            consumed.add(spec.feature_key)
+            capacity = spec.capacity or int(np.prod(ids.shape))
+            unique, inverse = np.unique(ids, return_inverse=True)
+            if unique.size > capacity:
+                raise ValueError(
+                    "Batch has %d unique ids for table %s (capacity %d); "
+                    "raise SparseEmbeddingSpec.capacity"
+                    % (unique.size, spec.name, capacity)
+                )
+            rows = self._ps.pull_embedding_vectors(spec.name, unique)
+            padded = np.zeros((capacity, spec.dim), dtype=np.float32)
+            padded[: unique.size] = rows
+            features[spec.name + ROWS_SUFFIX] = padded
+            features[spec.name + INDICES_SUFFIX] = inverse.reshape(
+                ids.shape
+            ).astype(np.int32)
+            pull_info[spec.name] = (unique, unique.size)
+        for key in consumed:
+            features.pop(key, None)
+        out = dict(batch)
+        out["features"] = features
+        return out, pull_info
+
+    def push_gradients(self, row_grads, pull_info, model_version=0):
+        grads_by_table = {}
+        for name, (unique, n) in pull_info.items():
+            grads_by_table[name] = (
+                np.asarray(row_grads[name])[:n],
+                unique,
+            )
+        return self._ps.push_gradients(
+            grads_by_table, model_version=model_version
+        )
+
+
+def make_sparse_train_step(model, loss_fn, tx, specs, compute_dtype=None):
+    """Train step that also returns d(loss)/d(embedding rows)."""
+    row_keys = [spec.name + ROWS_SUFFIX for spec in specs]
+
+    def train_step(state: TrainState, batch):
+        features = dict(batch["features"])
+        labels, mask = batch["labels"], batch[MASK_KEY]
+        rows = {key: features.pop(key) for key in row_keys}
+        rngs = {
+            "dropout": jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+        }
+
+        def compute_loss(params, rows):
+            compute_params = params
+            compute_rows = rows
+            compute_features = features
+            if compute_dtype is not None:
+                compute_params = cast_floating(params, compute_dtype)
+                compute_rows = cast_floating(rows, compute_dtype)
+                compute_features = cast_floating(features, compute_dtype)
+            merged = {**compute_features, **compute_rows}
+            variables = {"params": compute_params, **state.model_state}
+            if state.model_state:
+                outputs, new_model_state = model.apply(
+                    variables,
+                    merged,
+                    training=True,
+                    rngs=rngs,
+                    mutable=list(state.model_state.keys()),
+                )
+                new_model_state = dict(new_model_state)
+            else:
+                outputs = model.apply(
+                    variables, merged, training=True, rngs=rngs
+                )
+                new_model_state = state.model_state
+            per_sample = loss_fn(labels, outputs)
+            return masked_mean(per_sample.astype(jnp.float32), mask), (
+                new_model_state
+            )
+
+        (loss, new_model_state), (param_grads, row_grads) = (
+            jax.value_and_grad(compute_loss, argnums=(0, 1), has_aux=True)(
+                state.params, rows
+            )
+        )
+        param_grads = cast_floating(param_grads, jnp.float32)
+        row_grads = cast_floating(row_grads, jnp.float32)
+        updates, new_opt_state = tx.update(
+            param_grads, state.opt_state, state.params
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), state.params, updates
+        )
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            model_state=new_model_state,
+            opt_state=new_opt_state,
+        )
+        # strip the suffix for the caller: {table_name: grad rows}
+        named = {
+            key[: -len(ROWS_SUFFIX)]: value
+            for key, value in row_grads.items()
+        }
+        return new_state, loss, named
+
+    return train_step
+
+
+class SparseTrainer:
+    """Trainer surface (create_state/train_step/eval_step) over dense
+    on-device params + host-PS sparse tables."""
+
+    def __init__(
+        self,
+        model,
+        loss_fn,
+        optimizer,
+        specs,
+        ps_client,
+        compute_dtype=None,
+        seed=0,
+    ):
+        self._model = model
+        self._tx = optimizer
+        self._rng = jax.random.PRNGKey(seed)
+        self._specs = list(specs)
+        self.preparer = SparseBatchPreparer(self._specs, ps_client)
+        compute_dtype = resolve_dtype(compute_dtype)
+        self._train_step = jax.jit(
+            make_sparse_train_step(
+                model, loss_fn, optimizer, self._specs, compute_dtype
+            ),
+            donate_argnums=(0,),
+        )
+        from elasticdl_tpu.train.step_fns import make_eval_step
+
+        self._eval_step = jax.jit(make_eval_step(model, compute_dtype))
+        self._version = 0
+        # memo of the last prepared batch, so ensure_state followed by
+        # eval_step/train_step on the same batch pulls rows once
+        self._prep_memo = None
+
+    def create_state(self, sample_features):
+        init_rng, self._rng = jax.random.split(self._rng)
+        return create_train_state(
+            self._model, self._tx, init_rng, sample_features
+        )
+
+    def _prepare_once(self, batch):
+        if self._prep_memo is not None and self._prep_memo[0] is batch:
+            return self._prep_memo[1], self._prep_memo[2]
+        prepared, pull_info = self.preparer.prepare(batch)
+        self._prep_memo = (batch, prepared, pull_info)
+        return prepared, pull_info
+
+    def ensure_state(self, state, batch):
+        if state is None:
+            prepared, _ = self._prepare_once(batch)
+            return self.create_state(prepared["features"])
+        return state
+
+    def prepare_batch(self, batch):
+        return self._prepare_once(batch)
+
+    def train_step(self, state, batch):
+        """batch: raw (un-prepared) batch with id features."""
+        prepared, pull_info = self._prepare_once(batch)
+        if state is None:
+            state = self.create_state(prepared["features"])
+        self._prep_memo = None
+        state, loss, row_grads = self._train_step(state, prepared)
+        self._version = self.preparer.push_gradients(
+            row_grads, pull_info, model_version=self._version
+        )
+        return state, loss
+
+    def eval_step(self, state, batch):
+        prepared, _ = self._prepare_once(batch)
+        self._prep_memo = None
+        outputs = self._eval_step(state, prepared["features"])
+        return jax.tree_util.tree_map(np.asarray, outputs)

@@ -41,6 +41,7 @@ def main(argv=None):
         compute_dtype=args.compute_dtype or None,
         report_version_steps=args.report_version_steps,
         trainer_factory=trainer_factory,
+        ps_addrs=args.ps_addrs or None,
     )
     worker.run()
     return 0

@@ -23,7 +23,12 @@ class MasterClient:
         self._channel = build_channel(master_addr)
         self._stub = MasterStub(self._channel)
         self._worker_id = worker_id
-        self._worker_host = worker_host or socket.gethostname()
+        # worker_host="" is an explicit opt-out of mesh membership (used
+        # by PS processes, which poll the master for liveness but must
+        # never join the SPMD device mesh).
+        self._worker_host = (
+            socket.gethostname() if worker_host is None else worker_host
+        )
 
     @property
     def worker_id(self):

@@ -1,0 +1,137 @@
+"""Worker-side PS client: id-mod sharding and parallel fan-out.
+
+Reference parity: elasticdl/python/worker/ps_client.py — embedding rows
+route to PS shard ``id % ps_num`` (:41-75), pulls fan out as concurrent
+futures and reassemble in input order, and gradient pushes are deduped
+client-side before scattering (:135-232). Dense parameters here exist
+only for the cold-start init protocol (first worker pushes, late joiners
+pull); there is no per-step dense traffic.
+"""
+
+import concurrent.futures
+
+import numpy as np
+
+from elasticdl_tpu.common.grpc_utils import build_channel
+from elasticdl_tpu.common.tensor_utils import (
+    blob_to_ndarray,
+    deduplicate_indexed_slices,
+    ndarray_to_blob,
+    serialize_indexed_slices,
+)
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.services import PserverStub
+
+
+class PSClient:
+    def __init__(self, ps_addrs):
+        if isinstance(ps_addrs, str):
+            ps_addrs = [a for a in ps_addrs.split(",") if a]
+        self._stubs = [PserverStub(build_channel(a)) for a in ps_addrs]
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(4, len(self._stubs))
+        )
+
+    @property
+    def ps_num(self):
+        return len(self._stubs)
+
+    # ------------------------------------------------------------------
+    def push_embedding_table_infos(self, infos):
+        """infos: [(name, dim, init_scale)] broadcast to every PS."""
+        request = pb.Model()
+        for name, dim, init_scale in infos:
+            request.embedding_table_infos.add(
+                name=name, dim=dim, initializer=str(init_scale)
+            )
+        list(
+            self._pool.map(
+                lambda stub: stub.push_embedding_table_infos(request),
+                self._stubs,
+            )
+        )
+
+    def push_dense_init(self, params, version=0):
+        request = pb.Model(version=version)
+        for name, array in params.items():
+            ndarray_to_blob(np.asarray(array), request.dense_parameters[name])
+        list(self._pool.map(lambda s: s.push_model(request), self._stubs))
+
+    def pull_dense_init(self, version=-1):
+        """Returns (initialized, version, params) from PS 0."""
+        response = self._stubs[0].pull_dense_parameters(
+            pb.PullDenseParametersRequest(version=version)
+        )
+        params = {
+            name: blob_to_ndarray(blob)
+            for name, blob in response.dense_parameters.items()
+        }
+        return response.initialized, response.version, params
+
+    # ------------------------------------------------------------------
+    def pull_embedding_vectors(self, name, ids):
+        """ids: int64 array; returns rows aligned with input order."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if ids.size == 0:
+            return np.empty((0, 0), dtype=np.float32)
+        if self.ps_num == 1:
+            blob = self._stubs[0].pull_embedding_vectors(
+                pb.PullEmbeddingVectorsRequest(name=name, ids=ids.tolist())
+            )
+            return blob_to_ndarray(blob)
+        shard_of = ids % self.ps_num
+        futures = {}
+        positions = {}
+        for shard in np.unique(shard_of):
+            pos = np.nonzero(shard_of == shard)[0]
+            positions[int(shard)] = pos
+            request = pb.PullEmbeddingVectorsRequest(
+                name=name, ids=ids[pos].tolist()
+            )
+            futures[int(shard)] = self._pool.submit(
+                self._stubs[int(shard)].pull_embedding_vectors, request
+            )
+        dim = None
+        rows = None
+        for shard, future in futures.items():
+            values = blob_to_ndarray(future.result())
+            if rows is None:
+                dim = values.shape[1]
+                rows = np.empty((ids.size, dim), dtype=values.dtype)
+            rows[positions[shard]] = values
+        return rows
+
+    def push_gradients(self, grads_by_table, model_version=0, learning_rate=0.0):
+        """grads_by_table: {name: (values [n,dim], ids [n])}; dedups then
+        scatters per-PS. Returns the max PS version seen."""
+        per_ps = [pb.PushGradientsRequest() for _ in self._stubs]
+        for request in per_ps:
+            request.gradients.version = model_version
+            request.learning_rate = learning_rate
+        for name, (values, ids) in grads_by_table.items():
+            values, ids = deduplicate_indexed_slices(
+                np.asarray(values), np.asarray(ids, dtype=np.int64)
+            )
+            if self.ps_num == 1:
+                serialize_indexed_slices(
+                    values, ids, per_ps[0].gradients.embedding_tables[name]
+                )
+                continue
+            shard_of = ids % self.ps_num
+            for shard in np.unique(shard_of):
+                pos = np.nonzero(shard_of == shard)[0]
+                serialize_indexed_slices(
+                    values[pos],
+                    ids[pos],
+                    per_ps[int(shard)].gradients.embedding_tables[name],
+                )
+        futures = []
+        for stub, request in zip(self._stubs, per_ps):
+            if not request.gradients.embedding_tables:
+                continue
+            futures.append(self._pool.submit(stub.push_gradients, request))
+        version = 0
+        for future in futures:
+            response = future.result()
+            version = max(version, response.version)
+        return version

@@ -45,9 +45,15 @@ class JaxTrainer:
             self._model, self._tx, init_rng, sample_features
         )
 
+    def ensure_state(self, state, batch):
+        if state is None:
+            return self.create_state(batch["features"])
+        return state
+
     def train_step(self, state, batch):
+        state = self.ensure_state(state, batch)
         return self._train_step(state, batch)
 
-    def eval_step(self, state, features):
-        outputs = self._eval_step(state, features)
+    def eval_step(self, state, batch):
+        outputs = self._eval_step(state, batch["features"])
         return jax.tree_util.tree_map(np.asarray, outputs)

@@ -44,6 +44,7 @@ class Worker:
         seed=0,
         trainer_factory=None,
         mesh_config=None,
+        ps_addrs=None,
     ):
         self._mc = master_client
         self.spec = get_model_spec(model_zoo_module)
@@ -54,7 +55,6 @@ class Worker:
         self.tds = TaskDataService(
             master_client, data_reader, wait_sleep_secs=wait_sleep_secs
         )
-        factory = trainer_factory or JaxTrainer
         trainer_kwargs = dict(
             model=self.spec.custom_model(),
             loss_fn=self.spec.loss,
@@ -62,6 +62,24 @@ class Worker:
             compute_dtype=compute_dtype,
             seed=seed,
         )
+        if self.spec.sparse_embedding_specs:
+            # Sparse model: host-PS embedding tables + dense on device.
+            if not ps_addrs:
+                raise ValueError(
+                    "Model %s declares sparse_embedding_specs; the worker "
+                    "needs --ps_addrs pointing at parameter servers"
+                    % model_zoo_module
+                )
+            from elasticdl_tpu.train.sparse import SparseTrainer
+            from elasticdl_tpu.worker.ps_client import PSClient
+
+            factory = trainer_factory or SparseTrainer
+            trainer_kwargs["specs"] = self.spec.sparse_embedding_specs(
+                batch_size=minibatch_size
+            )
+            trainer_kwargs["ps_client"] = PSClient(ps_addrs)
+        else:
+            factory = trainer_factory or JaxTrainer
         # SPMD-capable factories take the model's sharding rules; the
         # single-chip trainer does not.
         import inspect
@@ -102,10 +120,6 @@ class Worker:
     def model_version(self):
         return self._version
 
-    def _ensure_state(self, batch):
-        if self.state is None:
-            self.state = self.trainer.create_state(batch["features"])
-
     def _batches(self, record_stream, mode):
         dataset = self.spec.dataset_fn(
             Dataset(lambda: record_stream), mode, self._reader.metadata
@@ -119,8 +133,9 @@ class Worker:
             for batch in self._batches(
                 self.tds.training_record_stream(), Mode.TRAINING
             ):
-                self._ensure_state(batch)
-                self.state, loss = self.trainer.train_step(self.state, batch)
+                self.state, loss = self.trainer.train_step(
+                    self.state, batch
+                )
                 self._version += 1
                 self.tds.report_record_done(batch_real_count(batch))
                 if (
@@ -141,10 +156,8 @@ class Worker:
             for batch in self._batches(
                 self.tds.task_record_stream(task), Mode.EVALUATION
             ):
-                self._ensure_state(batch)
-                outputs = self.trainer.eval_step(
-                    self.state, batch["features"]
-                )
+                self.state = self.trainer.ensure_state(self.state, batch)
+                outputs = self.trainer.eval_step(self.state, batch)
                 real = batch_real_count(batch)
                 outputs = normalize_outputs(outputs, real)
                 labels = np.asarray(batch["labels"])[:real]
@@ -163,10 +176,8 @@ class Worker:
             for batch in self._batches(
                 self.tds.task_record_stream(task), Mode.PREDICTION
             ):
-                self._ensure_state(batch)
-                outputs = self.trainer.eval_step(
-                    self.state, batch["features"]
-                )
+                self.state = self.trainer.ensure_state(self.state, batch)
+                outputs = self.trainer.eval_step(self.state, batch)
                 real = batch_real_count(batch)
                 if processor is not None:
                     processor.process(

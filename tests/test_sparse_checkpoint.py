@@ -1,0 +1,42 @@
+import os
+
+import numpy as np
+
+from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
+from elasticdl_tpu.ps.embedding_store import NumpyEmbeddingStore
+
+
+def make_store(seed=0):
+    store = NumpyEmbeddingStore(seed=seed)
+    store.set_optimizer("sgd", lr=0.1)
+    store.create_table("t", 4, init_scale=0.5)
+    return store
+
+
+def test_save_restore_and_gc(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    store = make_store()
+    ids = np.arange(20, dtype=np.int64)
+    values = np.random.RandomState(0).rand(20, 4).astype(np.float32)
+    store.import_table("t", ids, values)
+    saver = SparseCheckpointSaver(ckpt_dir, shard_id=0, shard_num=1, keep_max=2)
+    for version in (5, 10, 15):
+        saver.save(version, store)
+    # GC keeps only the last two complete versions
+    remaining = sorted(os.listdir(ckpt_dir))
+    assert remaining == ["version-10", "version-15"]
+
+    # restore latest into a 4-shard store: shard 2 keeps ids 2,6,10,14,18
+    shard_store = make_store(seed=1)
+    shard = SparseCheckpointSaver(ckpt_dir, shard_id=2, shard_num=4)
+    version = shard.restore(shard_store)
+    assert version == 15
+    assert shard_store.table_size("t") == 5
+    np.testing.assert_array_equal(
+        shard_store.lookup("t", np.array([6], np.int64))[0], values[6]
+    )
+    # init_scale survives re-registration after restore (tables adopt
+    # the registered scale)
+    shard_store.create_table("t", 4, init_scale=0.3)
+    row = shard_store.lookup("t", np.array([999], np.int64))[0]
+    assert (np.abs(row) <= 0.3).all()

@@ -1,0 +1,128 @@
+"""Sparse embedding path end-to-end: JAX model + live PS over gRPC.
+
+Models the reference's worker_ps_interaction_test.py: a real Pserver
+service on localhost, the worker-side PSClient, and training that
+converges through the host tables.
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.grpc_utils import (
+    build_channel,
+    build_server,
+    find_free_port,
+)
+from elasticdl_tpu.models import deepfm
+from elasticdl_tpu.proto.services import add_pserver_servicer_to_server
+from elasticdl_tpu.ps.embedding_store import NumpyEmbeddingStore, create_store
+from elasticdl_tpu.ps.servicer import PserverServicer
+from elasticdl_tpu.train.metrics import AUC
+from elasticdl_tpu.train.sparse import SparseTrainer
+from elasticdl_tpu.worker.ps_client import PSClient
+
+
+@pytest.fixture
+def ps_cluster():
+    """Two real PS servers on localhost."""
+    servers = []
+    addrs = []
+    for ps_id in range(2):
+        store = create_store(seed=ps_id)
+        store.set_optimizer("adam", lr=0.01)
+        servicer = PserverServicer(store, ps_id=ps_id)
+        server = build_server()
+        add_pserver_servicer_to_server(servicer, server)
+        port = find_free_port()
+        server.add_insecure_port("localhost:%d" % port)
+        server.start()
+        servers.append((server, store))
+        addrs.append("localhost:%d" % port)
+    yield addrs, [s for _, s in servers]
+    for server, _ in servers:
+        server.stop(None)
+
+
+def _ctr_batch(rng, batch_size=64, num_features=10, vocab=500, weights=None):
+    ids = rng.randint(0, vocab, size=(batch_size, num_features)).astype(
+        np.int64
+    )
+    score = weights[ids].sum(axis=1) / np.sqrt(num_features)
+    labels = (score + rng.randn(batch_size) * 0.1 > 0).astype(np.float32)
+    return {
+        "features": {"ids": ids},
+        "labels": labels,
+        "_mask": np.ones(batch_size, np.float32),
+    }
+
+
+def test_deepfm_trains_through_live_ps(ps_cluster):
+    addrs, stores = ps_cluster
+    client = PSClient(addrs)
+    trainer = SparseTrainer(
+        model=deepfm.custom_model(),
+        loss_fn=deepfm.loss,
+        optimizer=deepfm.optimizer(),
+        specs=deepfm.sparse_embedding_specs(num_features=10, batch_size=64),
+        ps_client=client,
+        seed=0,
+    )
+    rng = np.random.RandomState(0)
+    weights = np.random.RandomState(42).randn(500) * 2
+
+    state = None
+    losses = []
+    for _ in range(30):
+        batch = _ctr_batch(rng, weights=weights)
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    # rows are sharded across both PS stores by id % 2
+    sizes = [store.table_size("deepfm_emb") for store in stores]
+    assert all(size > 0 for size in sizes)
+
+    # AUC on held-out data clearly better than chance
+    auc = AUC(from_logits=True)
+    eval_rng = np.random.RandomState(7)
+    for _ in range(4):
+        batch = _ctr_batch(eval_rng, weights=weights)
+        outputs = trainer.eval_step(state, batch)
+        auc.update_state(batch["labels"], outputs)
+    assert auc.result() > 0.8
+
+
+def test_ps_client_routing_and_dedup(ps_cluster):
+    addrs, stores = ps_cluster
+    client = PSClient(addrs)
+    client.push_embedding_table_infos([("t", 4, 0.05)])
+    ids = np.array([0, 1, 2, 3, 10, 11], dtype=np.int64)
+    rows = client.pull_embedding_vectors("t", ids)
+    assert rows.shape == (6, 4)
+    # same id pulled via different shards stays consistent
+    again = client.pull_embedding_vectors("t", ids[::-1])
+    np.testing.assert_array_equal(again, rows[::-1])
+    # push deduped gradients: id 2 appears twice -> summed once
+    values = np.ones((3, 4), np.float32)
+    version = client.push_gradients(
+        {"t": (values, np.array([2, 2, 3], dtype=np.int64))}
+    )
+    assert version >= 1
+    after = client.pull_embedding_vectors("t", np.array([2, 3], np.int64))
+    # sgd default lr=0.01: id2 got grad 2.0, id3 got 1.0... but stores
+    # use adam here, so just check rows moved and differ
+    assert not np.allclose(after[0], rows[2])
+    assert not np.allclose(after[1], rows[3])
+
+
+def test_dense_cold_start_protocol(ps_cluster):
+    addrs, _ = ps_cluster
+    client = PSClient(addrs)
+    initialized, version, params = client.pull_dense_init()
+    assert not initialized
+    client.push_dense_init({"w": np.ones((2, 2), np.float32)}, version=5)
+    # second push is ignored (first writer wins)
+    client.push_dense_init({"w": np.zeros((2, 2), np.float32)}, version=9)
+    initialized, version, params = client.pull_dense_init()
+    assert initialized and version == 5
+    np.testing.assert_array_equal(params["w"], np.ones((2, 2)))

@@ -37,9 +37,11 @@ def create_mnist_recordio(path, num_records=128, seed=0, image_size=8):
 
 def create_ctr_recordio(path, num_records=256, num_features=10, vocab=1000, seed=0):
     """Criteo-shaped CTR rows: sparse id features + a planted linear
-    signal in the label."""
+    signal in the label. The planted weights are fixed (independent of
+    ``seed``) so files with different seeds share one underlying
+    distribution — train/valid must be related for eval to mean anything."""
     rng = np.random.RandomState(seed)
-    weights = rng.randn(vocab) * 2
+    weights = np.random.RandomState(12345).randn(vocab) * 2
     payloads = []
     for _ in range(num_records):
         ids = rng.randint(0, vocab, size=num_features).astype(np.int64)
