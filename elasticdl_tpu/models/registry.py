@@ -37,6 +37,8 @@ class ModelSpec:
         prediction_outputs_processor=None,
         sharding_rules=None,
         sparse_embedding_specs=None,
+        batch_spec=None,
+        mesh_config=None,
         module=None,
     ):
         self.custom_model = custom_model
@@ -51,6 +53,12 @@ class ModelSpec:
         # against (TPU contract addition; the reference discovers these by
         # introspecting for elasticdl.layers.Embedding instances)
         self.sparse_embedding_specs = sparse_embedding_specs
+        # () -> PartitionSpec for batch leaves (TPU addition: models with
+        # sequence parallelism shard dim 1 over sp)
+        self.batch_spec = batch_spec
+        # (num_devices) -> MeshConfig: the model's preferred mesh
+        # topology (TPU addition: a tp/sp model picks its axis split)
+        self.mesh_config = mesh_config
         self.module = module
 
 
@@ -96,5 +104,7 @@ def get_model_spec(module_path_or_name) -> ModelSpec:
         sparse_embedding_specs=_resolve(
             module, "sparse_embedding_specs", required=False
         ),
+        batch_spec=_resolve(module, "batch_spec", required=False),
+        mesh_config=_resolve(module, "mesh_config", required=False),
         module=module,
     )

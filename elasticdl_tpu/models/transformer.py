@@ -1,0 +1,225 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+No reference counterpart: the reference zoo is CTR/vision Keras models
+(SURVEY.md §2.11) with no attention; this family exists to exercise the
+TPU-first capabilities the rebuild adds — flash attention (Pallas),
+tensor parallelism (GSPMD rules below), and sequence/context parallelism
+(ring / all-to-all schedules over the ``sp`` mesh axis).
+
+Design notes (TPU-first):
+- pre-LayerNorm blocks, GELU MLP, rotary position embeddings — all
+  position-wise ops GSPMD shards trivially over dp/sp.
+- attention dispatches by config: single-device flash/XLA, or ring /
+  ulysses shard_map schedules when the mesh has sp > 1.
+- tensor parallelism is pure annotation: qkv/mlp-up kernels split their
+  output dim over ``tp``, out-proj/mlp-down split their input dim, so
+  XLA inserts one psum per block (Megatron layout, expressed as GSPMD
+  rules instead of hand-written collectives).
+"""
+
+from dataclasses import field
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.ops.attention import dot_product_attention
+from elasticdl_tpu.ops.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+)
+from elasticdl_tpu.parallel.mesh import DATA_AXES
+from elasticdl_tpu.parallel.sharding import ShardingRules
+from elasticdl_tpu.train import metrics
+from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
+from elasticdl_tpu.train.optimizers import create_optimizer
+
+
+def rotary_embedding(x, base=10000.0):
+    """Apply RoPE over (batch, heads, seq, head_dim)."""
+    _, _, seq, dim = x.shape
+    half = dim // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, None]
+    sin = jnp.sin(angles)[None, None]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    num_heads: int
+    attention_impl: str = "auto"  # auto | xla | pallas | ring | ulysses
+    mesh: Optional[Any] = None
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        dim = x.shape[-1]
+        head_dim = dim // self.num_heads
+        dense = lambda name: nn.DenseGeneral(
+            (self.num_heads, head_dim),
+            axis=-1,
+            use_bias=False,
+            name=name,
+        )
+        # (B, S, H, d) -> (B, H, S, d)
+        to_bhsd = lambda t: t.transpose(0, 2, 1, 3)
+        q = to_bhsd(dense("query")(x))
+        k = to_bhsd(dense("key")(x))
+        v = to_bhsd(dense("value")(x))
+        q = rotary_embedding(q)
+        k = rotary_embedding(k)
+
+        if self.attention_impl == "ring":
+            out = ring_attention(q, k, v, self.mesh, causal=True)
+        elif self.attention_impl == "ulysses":
+            out = ulysses_attention(q, k, v, self.mesh, causal=True)
+        else:
+            out = dot_product_attention(
+                q, k, v, causal=True, impl=self.attention_impl
+            )
+        out = out.transpose(0, 2, 1, 3)  # back to (B, S, H, d)
+        out = nn.DenseGeneral(
+            dim, axis=(-2, -1), use_bias=False, name="out_proj"
+        )(out)
+        if self.dropout:
+            out = nn.Dropout(
+                self.dropout, deterministic=not training
+            )(out)
+        return out
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    attention_impl: str = "auto"
+    mesh: Optional[Any] = None
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, training=False):
+        dim = x.shape[-1]
+        h = nn.LayerNorm(name="ln_attn")(x)
+        x = x + Attention(
+            self.num_heads,
+            attention_impl=self.attention_impl,
+            mesh=self.mesh,
+            dropout=self.dropout,
+            name="attn",
+        )(h, training)
+        h = nn.LayerNorm(name="ln_mlp")(x)
+        h = nn.Dense(dim * self.mlp_ratio, use_bias=False, name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(dim, use_bias=False, name="mlp_down")(h)
+        if self.dropout:
+            h = nn.Dropout(self.dropout, deterministic=not training)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    embed_dim: int = 512
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    attention_impl: str = "auto"
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, tokens, training: bool = False):
+        x = nn.Embed(
+            self.vocab_size, self.embed_dim, name="wte"
+        )(tokens.astype(jnp.int32))
+        for i in range(self.num_layers):
+            x = Block(
+                self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                attention_impl=self.attention_impl,
+                mesh=self.mesh,
+                dropout=self.dropout,
+                name="block_%d" % i,
+            )(x, training)
+        x = nn.LayerNorm(name="ln_f")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, name="lm_head")(x)
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules (tensor parallelism as pure annotation)
+# ---------------------------------------------------------------------------
+
+
+def transformer_sharding_rules():
+    """Megatron-style TP layout + fsdp on everything big.
+
+    qkv and mlp-up split output features over tp (their matmuls become
+    local); out-proj and mlp-down split input features, after which XLA
+    inserts a single psum per block. Embedding and lm_head split vocab.
+    """
+    return ShardingRules(
+        rules=[
+            (r"(query|key|value)/kernel$", P("fsdp", "tp", None)),
+            (r"out_proj/kernel$", P("tp", None, "fsdp")),
+            (r"mlp_up/kernel$", P("fsdp", "tp")),
+            (r"mlp_down/kernel$", P("tp", "fsdp")),
+            (r"wte/embedding$", P("tp", "fsdp")),
+            (r"lm_head/kernel$", P("fsdp", "tp")),
+            (r".*", P()),
+        ],
+        default_spec=P(),
+    )
+
+
+def batch_spec():
+    """Tokens/labels (B, S): batch over data axes, sequence over sp."""
+    return P(DATA_AXES, "sp")
+
+
+# ---------------------------------------------------------------------------
+# Model-zoo contract
+# ---------------------------------------------------------------------------
+
+
+def custom_model():
+    return TransformerLM(
+        vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768
+    )
+
+
+def loss(labels, predictions):
+    # Next-token prediction: logits at t predict token at t+1. Returns a
+    # per-sample vector (contract: trainer applies the batch mask).
+    logits = predictions[:, :-1]
+    targets = labels[:, 1:]
+    per_token = sparse_softmax_cross_entropy(targets, logits)
+    return per_token.mean(axis=-1)
+
+
+def optimizer():
+    return create_optimizer(
+        "AdamW", learning_rate=3e-4, weight_decay=0.01
+    )
+
+
+def sharding_rules():
+    return transformer_sharding_rules()
+
+
+def dataset_fn(dataset, mode=None, metadata=None):
+    def parse(payload):
+        example = decode_example(payload)
+        tokens = example["tokens"].astype(np.int32)
+        # LM: the sequence is both input and label (shift happens in loss)
+        return tokens, tokens
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {"accuracy": metrics.Accuracy()}

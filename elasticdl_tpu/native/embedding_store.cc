@@ -147,6 +147,13 @@ int edl_store_set_optimizer(void* handle, const char* type, float lr,
                             float momentum, float beta1, float beta2,
                             float epsilon) {
   auto* store = static_cast<Store*>(handle);
+  {
+    // Rows size their slot memory from the optimizer at table-creation
+    // time; swapping the optimizer afterwards would make apply_row write
+    // past the allocation.
+    std::lock_guard<std::mutex> lock(store->tables_mu);
+    if (!store->tables.empty()) return -2;
+  }
   OptConfig cfg;
   std::string t(type);
   if (t == "sgd") cfg.type = OptType::kSGD;

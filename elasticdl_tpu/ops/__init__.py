@@ -1,0 +1,9 @@
+"""TPU-native compute ops (Pallas kernels + SPMD attention).
+
+The reference has no custom device kernels beyond Eigen CPU loops
+(go/pkg/kernel/capi/kernel_api.cc); on TPU the hot ops are expressed as
+Pallas kernels (flash attention) and shard_map collectives (ring /
+all-to-all sequence parallelism).
+"""
+
+from elasticdl_tpu.ops.attention import dot_product_attention  # noqa: F401

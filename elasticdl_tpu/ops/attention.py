@@ -1,0 +1,77 @@
+"""Attention dispatch: Pallas flash kernel on TPU, XLA math elsewhere.
+
+``dot_product_attention`` is the op model code calls; the implementation
+is picked by backend (or forced via ``impl=``):
+
+- ``"pallas"``  — ops/flash_attention.py blockwise kernel (TPU)
+- ``"xla"``     — plain jnp softmax attention (any backend; also the
+                  correctness oracle the kernel is tested against)
+- ``"auto"``    — pallas on TPU when shapes allow, else xla
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.ops import flash_attention as _flash
+
+
+def xla_attention(q, k, v, causal=False, sm_scale=None):
+    """Reference O(S^2) attention over (batch, heads, seq, dim)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * sm_scale
+    if causal:
+        seq_q, seq_k = s.shape[-2], s.shape[-1]
+        q_pos = jnp.arange(seq_q)[:, None]
+        k_pos = jnp.arange(seq_k)[None, :]
+        s = jnp.where(q_pos >= k_pos, s, _flash.NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _pallas_ok(q, k, block_q, block_k):
+    seq_q, seq_k = q.shape[2], k.shape[2]
+    return (
+        seq_q % min(block_q, seq_q) == 0
+        and seq_k % min(block_k, seq_k) == 0
+        and seq_q >= 8
+        and seq_k >= 128  # below one lane tile the kernel buys nothing
+    )
+
+
+def dot_product_attention(
+    q,
+    k,
+    v,
+    causal=False,
+    sm_scale=None,
+    impl="auto",
+    block_q=128,
+    block_k=128,
+    interpret=False,
+):
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        impl = (
+            "pallas"
+            if on_tpu and _pallas_ok(q, k, block_q, block_k)
+            else "xla"
+        )
+    if impl == "pallas":
+        return _flash.flash_attention(
+            q,
+            k,
+            v,
+            causal=causal,
+            sm_scale=sm_scale,
+            block_q=block_q,
+            block_k=block_k,
+            interpret=interpret,
+        )
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    raise ValueError("unknown attention impl %r" % (impl,))

@@ -1,0 +1,451 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+Blockwise online-softmax attention: O(S) memory instead of the O(S^2)
+score matrix, scores kept in VMEM, matmuls on the MXU. This is the
+single-chip building block; sequence parallelism composes it with ring /
+all-to-all collectives (ops/ring_attention.py).
+
+No reference counterpart — the reference's models are CTR/vision Keras
+nets with no attention anywhere (SURVEY.md §5 "long-context: absent");
+this is a new TPU-first capability.
+
+Layout: (batch, heads, seq, head_dim) — "BHSD". Kernels flatten
+batch*heads into one parallel grid axis.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+# Lane width of the m/l scratch rows (min f32 tile is (8, 128)).
+_STATS_LANES = 128
+
+
+def _causal_mask(s, q_block, k_block, block_q, block_k):
+    q_pos = q_block * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 0
+    )
+    k_pos = k_block * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1
+    )
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    sm_scale,
+    causal,
+    block_q,
+    block_k,
+):
+    q_block = pl.program_id(1)
+    k_block = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(k_block == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: blocks strictly above the diagonal contribute nothing.
+    diag_ok = (
+        (q_block + 1) * block_q - 1 >= k_block * block_k
+        if causal
+        else True
+    )
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )
+        if causal:
+            s = _causal_mask(s, q_block, k_block, block_q, block_k)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        correction = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(k_block == num_k - 1)
+    def _finalize():
+        l_final = l_ref[:, :1]
+        # Fully-masked rows (can't happen causally, but keep the kernel
+        # total): emit zeros, lse = -inf.
+        safe_l = jnp.where(l_final > 0.0, l_final, 1.0)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    bh, seq_q, head_dim = q.shape
+    seq_k = k.shape[1]
+    num_q = seq_q // block_q
+    num_k = seq_k // block_k
+    grid = (bh, num_q, num_k)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    out_shape = (
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
+        ],
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_acc_ref,
+    *,
+    sm_scale,
+    causal,
+    block_q,
+    block_k,
+):
+    q_block = pl.program_id(1)
+    k_block = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(k_block == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    diag_ok = (
+        (q_block + 1) * block_q - 1 >= k_block * block_k
+        if causal
+        else True
+    )
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )
+        if causal:
+            s = _causal_mask(s, q_block, k_block, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do,
+            v,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_acc_ref[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(k_block == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_acc_ref,
+    dv_acc_ref,
+    *,
+    sm_scale,
+    causal,
+    block_q,
+    block_k,
+):
+    k_block = pl.program_id(1)
+    q_block = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(q_block == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    diag_ok = (
+        (q_block + 1) * block_q - 1 >= k_block * block_k
+        if causal
+        else True
+    )
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * sm_scale
+        )
+        if causal:
+            s = _causal_mask(s, q_block, k_block, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p,
+            do,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do,
+            v,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds,
+            q,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(q_block == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _bwd(
+    q, k, v, o, lse, do, sm_scale, causal, block_q, block_k, interpret
+):
+    bh, seq_q, head_dim = q.shape
+    seq_k = k.shape[1]
+    num_q = seq_q // block_q
+    num_k = seq_k // block_k
+
+    delta = jnp.sum(
+        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel,
+            sm_scale=sm_scale,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+        ),
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, head_dim), lambda b, i, j: (b, i, 0)
+        ),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel,
+            sm_scale=sm_scale,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+        ),
+        grid=(bh, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(
+        q, k, v, o, lse, do, sm_scale, causal, block_q, block_k, interpret
+    )
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal=False,
+    sm_scale=None,
+    block_q=128,
+    block_k=128,
+    interpret=False,
+):
+    """Blockwise attention over (batch, heads, seq, head_dim) inputs.
+
+    Sequence lengths must be divisible by the block sizes (the public
+    dispatcher in ops/attention.py pads); head_dim should be a multiple
+    of 128 lanes for best MXU utilisation but any size compiles.
+    """
+    if q.ndim != 4:
+        raise ValueError("expected (batch, heads, seq, head_dim)")
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[2]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k:
+        raise ValueError(
+            "seq lengths (%d, %d) must divide block sizes (%d, %d)"
+            % (seq_q, seq_k, block_q, block_k)
+        )
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    merge = lambda t: t.reshape(batch * heads, t.shape[2], head_dim)
+    o = _flash(
+        merge(q),
+        merge(k),
+        merge(v),
+        sm_scale,
+        causal,
+        block_q,
+        block_k,
+        interpret,
+    )
+    return o.reshape(batch, heads, seq_q, head_dim)

@@ -1,0 +1,225 @@
+"""Sequence/context parallelism: ring attention and all-to-all (Ulysses).
+
+The reference has no long-context support at all (SURVEY.md §5); the only
+axis it ever shards is the embedding-id axis across PS pods. These ops
+are the new TPU-first capability: attention over a sequence sharded
+across the ``sp`` mesh axis, communicating over ICI.
+
+Two schedules, both differentiable (autodiff through scan/ppermute —
+``ppermute``/``all_to_all`` have transpose rules, so the backward pass is
+the reverse ring):
+
+- ``ring_attention``: KV blocks rotate around the sp ring via
+  ``ppermute`` while each device folds them into a flash-style online
+  softmax. Memory O(S_local), comm overlaps compute under XLA latency
+  hiding. Blockwise/RingAttention schedule (Liu et al.) — re-derived,
+  not ported.
+- ``ulysses_attention``: ``all_to_all`` re-shards seq <-> heads so each
+  device holds the full sequence for H/sp heads, runs ordinary (flash)
+  attention locally, and all-to-alls back. Cheaper comm for moderate S,
+  requires heads % sp == 0.
+
+Both are called *inside* jit on global arrays; they open a shard_map
+manual region over the mesh.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.parallel.mesh import DATA_AXES
+
+NEG_INF = -1e30
+
+
+def _default_spec():
+    # (batch, heads, seq, head_dim): batch over data axes, heads over tp,
+    # seq over sp.
+    return P(DATA_AXES, "tp", "sp", None)
+
+
+def _block_update(carry, k_blk, v_blk, q, mask):
+    """Fold one KV block into the running (m, l, acc) softmax state."""
+    m_prev, l_prev, acc = carry
+    s = (
+        jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+        )
+    )
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    correction = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l_prev * correction + jnp.sum(p, axis=-1)
+    acc_new = acc * correction[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd",
+        p,
+        v_blk.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh,
+    axis_name="sp",
+    causal=False,
+    sm_scale=None,
+    spec=None,
+    remat=True,
+):
+    """Attention with q/k/v sequence-sharded over ``axis_name``.
+
+    Shapes are the global (batch, heads, seq, head_dim); sharding of the
+    operands must match ``spec`` (default: batch over dp/fsdp, heads over
+    tp, seq over sp).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = spec if spec is not None else _default_spec()
+    sp_size = mesh.shape[axis_name]
+    if sp_size == 1:
+        from elasticdl_tpu.ops.attention import xla_attention
+
+        return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    def local_fn(q_loc, k_loc, v_loc):
+        my_idx = jax.lax.axis_index(axis_name)
+        seq_loc = q_loc.shape[2]
+        q32 = q_loc.astype(jnp.float32) * sm_scale
+
+        def step(carry, t):
+            m, l, acc, k_blk, v_blk = carry
+            # After t hops the block on this device originated at shard
+            # (my_idx - t) mod sp.
+            src = (my_idx - t) % sp_size
+
+            def masked_update(operands):
+                m, l, acc, k_blk, v_blk = operands
+                if causal:
+                    q_pos = my_idx * seq_loc + jnp.arange(seq_loc)
+                    k_pos = src * seq_loc + jnp.arange(seq_loc)
+                    mask = q_pos[:, None] >= k_pos[None, :]
+                    mask = mask[None, None]
+                else:
+                    mask = None
+                return _block_update((m, l, acc), k_blk, v_blk, q32, mask)
+
+            if causal:
+                # Blocks strictly in the future contribute nothing: skip
+                # the matmuls entirely (branch selected at runtime).
+                m, l, acc = jax.lax.cond(
+                    src > my_idx,
+                    lambda operands: operands[:3],
+                    masked_update,
+                    (m, l, acc, k_blk, v_blk),
+                )
+            else:
+                m, l, acc = masked_update((m, l, acc, k_blk, v_blk))
+            # Rotate KV one hop around the ring (device j -> j+1).
+            perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            return (m, l, acc, k_blk, v_blk), None
+
+        step_fn = jax.checkpoint(step) if remat else step
+        batch, heads = q_loc.shape[0], q_loc.shape[1]
+        # Literal-zero inits are "unvarying" in shard_map's VMA typing
+        # while the scan outputs vary per device; pvary reconciles them.
+        vary = lambda x: jax.lax.pcast(
+            x, tuple(mesh.axis_names), to="varying"
+        )
+        init = (
+            vary(jnp.full((batch, heads, seq_loc), NEG_INF, jnp.float32)),
+            vary(jnp.zeros((batch, heads, seq_loc), jnp.float32)),
+            vary(
+                jnp.zeros(
+                    (batch, heads, seq_loc, q_loc.shape[3]), jnp.float32
+                )
+            ),
+            k_loc,
+            v_loc,
+        )
+        (m, l, acc, _, _), _ = jax.lax.scan(
+            step_fn, init, jnp.arange(sp_size)
+        )
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        return (acc / safe_l[..., None]).astype(q_loc.dtype)
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    mesh,
+    axis_name="sp",
+    causal=False,
+    sm_scale=None,
+    spec=None,
+    attention_fn=None,
+):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses schedule).
+
+    Re-shards (heads sharded <- seq sharded), runs full-sequence local
+    attention per head group, re-shards back. ``attention_fn(q, k, v,
+    causal, sm_scale)`` defaults to the flash/XLA dispatcher.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = spec if spec is not None else _default_spec()
+    sp_size = mesh.shape[axis_name]
+    if attention_fn is None:
+        from elasticdl_tpu.ops.attention import dot_product_attention
+
+        attention_fn = functools.partial(dot_product_attention)
+    if sp_size == 1:
+        return attention_fn(q, k, v, causal=causal, sm_scale=sm_scale)
+    if q.shape[1] % sp_size:
+        raise ValueError(
+            "ulysses needs heads (%d) divisible by sp (%d)"
+            % (q.shape[1], sp_size)
+        )
+
+    def local_fn(q_loc, k_loc, v_loc):
+        # (B, H_loc*sp, S/sp, D) -> (B, H_loc, S, D): scatter heads,
+        # gather sequence.
+        def seq_to_heads(x):
+            return jax.lax.all_to_all(
+                x, axis_name, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(
+                x, axis_name, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        out = attention_fn(
+            seq_to_heads(q_loc),
+            seq_to_heads(k_loc),
+            seq_to_heads(v_loc),
+            causal=causal,
+            sm_scale=sm_scale,
+        )
+        return heads_to_seq(out)
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)

@@ -47,6 +47,7 @@ class SpmdTrainer:
         mesh=None,
         mesh_config: MeshConfig = None,
         sharding_rules: ShardingRules = None,
+        batch_spec=None,
     ):
         self._model = model
         self._tx = optimizer
@@ -58,6 +59,11 @@ class SpmdTrainer:
             model, loss_fn, optimizer, compute_dtype
         )
         self._eval_step_fn = make_eval_step(model, compute_dtype)
+        # batch_spec overrides the default dim-0-over-data-axes layout
+        # (e.g. transformers with sequence parallelism shard dim 1 over
+        # sp: P(("dp","fsdp"), "sp")). Applied per leaf, truncated to the
+        # leaf's rank (the scalar-per-row _mask ignores the seq axis).
+        self._batch_spec = batch_spec
         self._batch_sharding = batch_sharding(self.mesh)
         self._state_shardings = None
         self._train_step = None
@@ -81,21 +87,38 @@ class SpmdTrainer:
             state, self.mesh, self._rules
         )
         state = jax.device_put(state, self._state_shardings)
+        self._train_step = None
+        self._eval_step = None
+        return state
+
+    def _leaf_sharding(self, leaf):
+        if self._batch_spec is None:
+            return self._batch_sharding
+        spec = P(*tuple(self._batch_spec)[: np.ndim(leaf)])
+        return NamedSharding(self.mesh, spec)
+
+    def _shard_tree(self, tree):
+        return jax.tree_util.tree_map(self._leaf_sharding, tree)
+
+    def _build_steps(self, batch):
+        # jit wrapping is deferred to the first batch because the batch
+        # shardings are per-leaf (rank-dependent) when a batch_spec is
+        # set.
         replicated = NamedSharding(self.mesh, P())
-        # A single sharding as a pytree prefix shards every batch leaf's
-        # dim 0 over the data axes.
         self._train_step = jax.jit(
             self._train_step_fn,
-            in_shardings=(self._state_shardings, self._batch_sharding),
+            in_shardings=(self._state_shardings, self._shard_tree(batch)),
             out_shardings=(self._state_shardings, replicated),
             donate_argnums=(0,),
         )
         self._eval_step = jax.jit(
             self._eval_step_fn,
-            in_shardings=(self._state_shardings, self._batch_sharding),
+            in_shardings=(
+                self._state_shardings,
+                self._shard_tree(batch["features"]),
+            ),
             out_shardings=replicated,
         )
-        return state
 
     # ------------------------------------------------------------------
     def shard_batch(self, batch):
@@ -107,7 +130,10 @@ class SpmdTrainer:
                 "Global batch %d not divisible by data-parallel size %d"
                 % (leaves[0].shape[0], dp)
             )
-        return jax.device_put(batch, self._batch_sharding)
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, self._leaf_sharding(leaf)),
+            batch,
+        )
 
     def ensure_state(self, state, batch):
         if state is None:
@@ -116,11 +142,16 @@ class SpmdTrainer:
 
     def train_step(self, state, batch):
         state = self.ensure_state(state, batch)
+        if self._train_step is None:
+            self._build_steps(batch)
         return self._train_step(state, self.shard_batch(batch))
 
     def eval_step(self, state, batch):
-        features = jax.device_put(
-            batch["features"], self._batch_sharding
+        if self._eval_step is None:
+            self._build_steps(batch)
+        features = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, self._leaf_sharding(leaf)),
+            batch["features"],
         )
         outputs = self._eval_step(state, features)
         return jax.tree_util.tree_map(np.asarray, outputs)

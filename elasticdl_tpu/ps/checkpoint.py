@@ -73,6 +73,8 @@ class SparseCheckpointSaver:
     # ------------------------------------------------------------------
     @staticmethod
     def latest_version(checkpoint_dir):
+        """Newest *complete* version (all N shard files present): a crash
+        between shard saves must not lead to a silent partial restore."""
         if not os.path.isdir(checkpoint_dir):
             return None
         versions = sorted(
@@ -80,7 +82,11 @@ class SparseCheckpointSaver:
             for d in os.listdir(checkpoint_dir)
             if d.startswith("version-")
         )
-        return versions[-1] if versions else None
+        saver = SparseCheckpointSaver(checkpoint_dir)
+        for v in reversed(versions):
+            if saver._complete(saver._version_dir(v)):
+                return v
+        return None
 
     def restore(self, store, version=None):
         """Load all shard files of a version, keeping only rows belonging

@@ -140,6 +140,11 @@ class NativeEmbeddingStore:
             args["beta2"],
             args["epsilon"],
         )
+        if rc == -2:
+            raise RuntimeError(
+                "cannot change the optimizer after tables exist (slot "
+                "memory is sized at table creation)"
+            )
         if rc != 0:
             raise ValueError("unsupported sparse optimizer %r" % opt_type)
 
@@ -247,6 +252,13 @@ class NumpyEmbeddingStore:
         opt_type = opt_type.lower()
         if opt_type not in ("sgd", "momentum", "adagrad", "adam"):
             raise ValueError("unsupported sparse optimizer %r" % opt_type)
+        if self._meta:
+            # Parity with the native store: slot layout is fixed at
+            # table creation.
+            raise RuntimeError(
+                "cannot change the optimizer after tables exist (slot "
+                "memory is sized at table creation)"
+            )
         args = dict(OPTIMIZER_DEFAULTS)
         args.update(kwargs)
         self._opt = (opt_type, args)

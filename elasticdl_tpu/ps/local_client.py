@@ -35,8 +35,10 @@ class LocalPSClient:
     def pull_embedding_vectors(self, name, ids):
         return self.store.lookup(name, np.asarray(ids, dtype=np.int64))
 
-    def push_gradients(self, grads_by_table, model_version=0, learning_rate=0.0):
-        lr_scale = learning_rate if learning_rate > 0 else 1.0
+    def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0):
+        # lr_scale multiplies the store optimizer's configured LR; 0
+        # means "no scaling" (mirrors PSClient/the wire field).
+        lr_scale = lr_scale if lr_scale > 0 else 1.0
         for name, (values, ids) in grads_by_table.items():
             values, ids = deduplicate_indexed_slices(
                 np.asarray(values), np.asarray(ids, dtype=np.int64)

@@ -109,8 +109,8 @@ class PserverServicer:
         if self._staleness_modulation:
             diff = self._store.version - grad_version
             lr_scale = 1.0 / max(1, diff) if diff > 0 else 1.0
-        if request.learning_rate > 0:
-            lr_scale *= request.learning_rate
+        if request.lr_scale > 0:
+            lr_scale *= request.lr_scale
         for name, slices in request.gradients.embedding_tables.items():
             values, ids = deserialize_indexed_slices(slices)
             self._store.push_gradients(name, ids, values, lr_scale=lr_scale)

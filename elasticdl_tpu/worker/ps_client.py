@@ -101,13 +101,17 @@ class PSClient:
             rows[positions[shard]] = values
         return rows
 
-    def push_gradients(self, grads_by_table, model_version=0, learning_rate=0.0):
+    def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0):
         """grads_by_table: {name: (values [n,dim], ids [n])}; dedups then
-        scatters per-PS. Returns the max PS version seen."""
+        scatters per-PS. Returns the max PS version seen.
+
+        ``lr_scale`` multiplies the PS optimizer's configured learning
+        rate (e.g. a worker-side schedule); 0 means "no scaling".
+        """
         per_ps = [pb.PushGradientsRequest() for _ in self._stubs]
         for request in per_ps:
             request.gradients.version = model_version
-            request.learning_rate = learning_rate
+            request.lr_scale = lr_scale
         for name, (values, ids) in grads_by_table.items():
             values, ids = deduplicate_indexed_slices(
                 np.asarray(values), np.asarray(ids, dtype=np.int64)

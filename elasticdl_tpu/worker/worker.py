@@ -73,7 +73,14 @@ class Worker:
             from elasticdl_tpu.train.sparse import SparseTrainer
             from elasticdl_tpu.worker.ps_client import PSClient
 
+            import inspect
+
+            # An injected factory (e.g. SpmdTrainer on a multi-device
+            # host) that can't drive the host-PS embedding path must not
+            # shadow the sparse trainer.
             factory = trainer_factory or SparseTrainer
+            if "specs" not in inspect.signature(factory).parameters:
+                factory = SparseTrainer
             trainer_kwargs["specs"] = self.spec.sparse_embedding_specs(
                 batch_size=minibatch_size
             )
@@ -87,8 +94,15 @@ class Worker:
         factory_params = inspect.signature(factory).parameters
         if "sharding_rules" in factory_params and self.spec.sharding_rules:
             trainer_kwargs["sharding_rules"] = self.spec.sharding_rules()
-        if "mesh_config" in factory_params and mesh_config is not None:
-            trainer_kwargs["mesh_config"] = mesh_config
+        if "batch_spec" in factory_params and self.spec.batch_spec:
+            trainer_kwargs["batch_spec"] = self.spec.batch_spec()
+        if "mesh_config" in factory_params:
+            if mesh_config is None and self.spec.mesh_config:
+                import jax
+
+                mesh_config = self.spec.mesh_config(jax.device_count())
+            if mesh_config is not None:
+                trainer_kwargs["mesh_config"] = mesh_config
         self.trainer = factory(**trainer_kwargs)
         self.state = None
         self.stop_training = False
