@@ -72,6 +72,7 @@ def parse_worker_args(argv=None):
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    parser.add_argument("--checkpoint_dir_for_init", default="")
     return parser.parse_args(argv)
 
 

@@ -17,7 +17,6 @@ Design notes (TPU-first):
   rules instead of hand-written collectives).
 """
 
-from dataclasses import field
 from typing import Any, Optional
 
 import flax.linen as nn

@@ -420,9 +420,10 @@ def flash_attention(
 ):
     """Blockwise attention over (batch, heads, seq, head_dim) inputs.
 
-    Sequence lengths must be divisible by the block sizes (the public
-    dispatcher in ops/attention.py pads); head_dim should be a multiple
-    of 128 lanes for best MXU utilisation but any size compiles.
+    Sequence lengths must be multiples of the block sizes (the auto
+    dispatcher in ops/attention.py falls back to the XLA impl when they
+    are not); head_dim should be a multiple of 128 lanes for best MXU
+    utilisation but any size compiles.
     """
     if q.ndim != 4:
         raise ValueError("expected (batch, heads, seq, head_dim)")
@@ -432,8 +433,8 @@ def flash_attention(
     block_k = min(block_k, seq_k)
     if seq_q % block_q or seq_k % block_k:
         raise ValueError(
-            "seq lengths (%d, %d) must divide block sizes (%d, %d)"
-            % (seq_q, seq_k, block_q, block_k)
+            "seq lengths (%d, %d) must be multiples of the block sizes "
+            "(%d, %d)" % (seq_q, seq_k, block_q, block_k)
         )
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)

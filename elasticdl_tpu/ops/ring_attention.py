@@ -23,7 +23,6 @@ Both are called *inside* jit on global arrays; they open a shard_map
 manual region over the mesh.
 """
 
-import functools
 import math
 
 import jax
@@ -186,13 +185,24 @@ def ulysses_attention(
     if attention_fn is None:
         from elasticdl_tpu.ops.attention import dot_product_attention
 
-        attention_fn = functools.partial(dot_product_attention)
+        attention_fn = dot_product_attention
     if sp_size == 1:
         return attention_fn(q, k, v, causal=causal, sm_scale=sm_scale)
-    if q.shape[1] % sp_size:
+    # The all_to_all splits the *per-device* head count (global heads
+    # already divided by whatever axes spec shards dim 1 over).
+    head_axes = tuple(spec)[1] if len(tuple(spec)) > 1 else None
+    if head_axes is None:
+        head_shard = 1
+    elif isinstance(head_axes, (tuple, list)):
+        head_shard = math.prod(mesh.shape[a] for a in head_axes)
+    else:
+        head_shard = mesh.shape[head_axes]
+    local_heads = q.shape[1] // head_shard
+    if local_heads % sp_size:
         raise ValueError(
-            "ulysses needs heads (%d) divisible by sp (%d)"
-            % (q.shape[1], sp_size)
+            "ulysses needs per-device heads (%d global / %d sharded = %d)"
+            " divisible by sp (%d)"
+            % (q.shape[1], head_shard, local_heads, sp_size)
         )
 
     def local_fn(q_loc, k_loc, v_loc):

@@ -120,6 +120,12 @@ class SpmdTrainer:
             out_shardings=replicated,
         )
 
+    @property
+    def state_shardings(self):
+        """TrainState-shaped tree of NamedShardings (None before
+        create_state); checkpoint restore re-lays state out with these."""
+        return self._state_shardings
+
     # ------------------------------------------------------------------
     def shard_batch(self, batch):
         """Host numpy batch -> sharded device arrays (one transfer)."""

@@ -42,6 +42,10 @@ def main(argv=None):
         report_version_steps=args.report_version_steps,
         trainer_factory=trainer_factory,
         ps_addrs=args.ps_addrs or None,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_steps=args.checkpoint_steps,
+        keep_checkpoint_max=args.keep_checkpoint_max,
+        checkpoint_dir_for_init=args.checkpoint_dir_for_init,
     )
     worker.run()
     return 0

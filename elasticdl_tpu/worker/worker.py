@@ -45,6 +45,10 @@ class Worker:
         trainer_factory=None,
         mesh_config=None,
         ps_addrs=None,
+        checkpoint_dir="",
+        checkpoint_steps=0,
+        keep_checkpoint_max=3,
+        checkpoint_dir_for_init="",
     ):
         self._mc = master_client
         self.spec = get_model_spec(model_zoo_module)
@@ -62,6 +66,8 @@ class Worker:
             compute_dtype=compute_dtype,
             seed=seed,
         )
+        import inspect
+
         if self.spec.sparse_embedding_specs:
             # Sparse model: host-PS embedding tables + dense on device.
             if not ps_addrs:
@@ -72,8 +78,6 @@ class Worker:
                 )
             from elasticdl_tpu.train.sparse import SparseTrainer
             from elasticdl_tpu.worker.ps_client import PSClient
-
-            import inspect
 
             # An injected factory (e.g. SpmdTrainer on a multi-device
             # host) that can't drive the host-PS embedding path must not
@@ -89,8 +93,6 @@ class Worker:
             factory = trainer_factory or JaxTrainer
         # SPMD-capable factories take the model's sharding rules; the
         # single-chip trainer does not.
-        import inspect
-
         factory_params = inspect.signature(factory).parameters
         if "sharding_rules" in factory_params and self.spec.sharding_rules:
             trainer_kwargs["sharding_rules"] = self.spec.sharding_rules()
@@ -107,6 +109,22 @@ class Worker:
         self.state = None
         self.stop_training = False
         self._version = 0
+        # Dense full-state checkpoints (params + model_state + optimizer
+        # slots + step; the reference drops slot state,
+        # ps/parameters.py:194-199). Restore happens lazily on the first
+        # batch, when the state template/shardings exist.
+        self._checkpoint_steps = checkpoint_steps
+        self._checkpoint_mgr = None
+        self._init_checkpoint_dir = checkpoint_dir_for_init
+        self._restore_attempted = not checkpoint_dir_for_init
+        if checkpoint_dir and checkpoint_steps:
+            from elasticdl_tpu.train.checkpoint import (
+                DenseCheckpointManager,
+            )
+
+            self._checkpoint_mgr = DenseCheckpointManager(
+                checkpoint_dir, keep_max=keep_checkpoint_max
+            )
         self._callbacks = list(self.spec.callbacks() or [])
         for cb in self._callbacks:
             cb.set_worker(self)
@@ -147,10 +165,17 @@ class Worker:
             for batch in self._batches(
                 self.tds.training_record_stream(), Mode.TRAINING
             ):
+                if not self._restore_attempted:
+                    self._restore_from_checkpoint(batch)
                 self.state, loss = self.trainer.train_step(
                     self.state, batch
                 )
                 self._version += 1
+                if (
+                    self._checkpoint_mgr is not None
+                    and self._version % self._checkpoint_steps == 0
+                ):
+                    self._checkpoint_mgr.save(self._version, self.state)
                 self.tds.report_record_done(batch_real_count(batch))
                 if (
                     self._report_version_steps
@@ -165,12 +190,55 @@ class Worker:
             logger.exception("Training stream failed")
             self.tds.report_pending_failed(str(e))
 
+    def _restore_from_checkpoint(self, batch):
+        """Resume from --checkpoint_dir_for_init on the first batch.
+
+        The freshly-initialized state is the restore template; restoring
+        into the trainer's current shardings re-lays the checkpoint out
+        over whatever mesh this worker runs (elastic resume onto a
+        different topology). A missing/empty checkpoint dir is an error:
+        silently training (or evaluating) from random init after the
+        operator asked for a resume would discard real progress.
+        """
+        self._restore_attempted = True
+        from elasticdl_tpu.train.checkpoint import DenseCheckpointManager
+
+        self.state = self.trainer.ensure_state(self.state, batch)
+        mgr = DenseCheckpointManager(
+            self._init_checkpoint_dir, keep_max=0, create=False
+        )
+        try:
+            restored = mgr.restore(
+                template=self.state,
+                shardings=getattr(self.trainer, "state_shardings", None),
+            )
+        finally:
+            mgr.close()
+        if restored is None:
+            raise RuntimeError(
+                "--checkpoint_dir_for_init=%r holds no restorable "
+                "checkpoint" % self._init_checkpoint_dir
+            )
+        self.state = restored
+        self._version = int(restored.step)
+        logger.info(
+            "Resumed from checkpoint at version %d", self._version
+        )
+
+    def _ensure_state_restored(self, batch):
+        """ensure_state + one-time checkpoint_dir_for_init restore; used
+        by eval/prediction paths so they never score random weights."""
+        if not self._restore_attempted:
+            self._restore_from_checkpoint(batch)
+        else:
+            self.state = self.trainer.ensure_state(self.state, batch)
+
     def _process_eval_task(self, task):
         try:
             for batch in self._batches(
                 self.tds.task_record_stream(task), Mode.EVALUATION
             ):
-                self.state = self.trainer.ensure_state(self.state, batch)
+                self._ensure_state_restored(batch)
                 outputs = self.trainer.eval_step(self.state, batch)
                 real = batch_real_count(batch)
                 outputs = normalize_outputs(outputs, real)
@@ -190,7 +258,7 @@ class Worker:
             for batch in self._batches(
                 self.tds.task_record_stream(task), Mode.PREDICTION
             ):
-                self.state = self.trainer.ensure_state(self.state, batch)
+                self._ensure_state_restored(batch)
                 outputs = self.trainer.eval_step(self.state, batch)
                 real = batch_real_count(batch)
                 if processor is not None:
