@@ -33,17 +33,29 @@ def main():
 
     batch_size = 256
     image_size = 224
-    warmup_steps = 3
     bench_steps = 20
 
     model = resnet.resnet50(num_classes=1000)
     tx = create_optimizer(
         "Momentum", learning_rate=0.1, momentum=0.9, nesterov=True
     )
-    step = jax.jit(
-        make_train_step(model, resnet.loss, tx, compute_dtype=jnp.bfloat16),
-        donate_argnums=(0,),
+    train_step = make_train_step(
+        model, resnet.loss, tx, compute_dtype=jnp.bfloat16
     )
+
+    # The whole bench loop is one lax.scan under one jit: a single device
+    # execution covers all steps, so the wall-clock between dispatch and
+    # the fetched loss is pure device time — immune to async-dispatch
+    # artifacts where per-step block_until_ready fences host handles
+    # without fencing remote execution, and to per-call host latency on
+    # tunneled backends.
+    def run_steps(state, batch, n):
+        def body(state, _):
+            state, loss = train_step(state, batch)
+            return state, loss
+        return jax.lax.scan(body, state, None, length=n)
+
+    run = jax.jit(run_steps, static_argnums=(2,), donate_argnums=(0,))
 
     rng = np.random.RandomState(0)
     batch = {
@@ -59,18 +71,17 @@ def main():
         model, tx, jax.random.PRNGKey(0), batch["features"]
     )
 
-    # Block on the FULL state, not just the scalar loss: on async remote
-    # backends a scalar can resolve before the parameter updates have
-    # executed, which makes the timing meaningless.
-    for _ in range(warmup_steps):
-        state, loss = step(state, batch)
-    jax.block_until_ready((state, loss))
+    # Warmup at the SAME scan length as the timed run: scan length is a
+    # static shape, so a different length would recompile inside the
+    # timed region.
+    state, losses = run(state, batch, bench_steps)
+    float(losses[-1])
 
     start = time.perf_counter()
-    for _ in range(bench_steps):
-        state, loss = step(state, batch)
-    jax.block_until_ready((state, loss))
+    state, losses = run(state, batch, bench_steps)
+    final_loss = float(losses[-1])  # device->host fetch fences execution
     elapsed = time.perf_counter() - start
+    assert np.isfinite(final_loss)
 
     images_per_sec = batch_size * bench_steps / elapsed
     # Reference single-accelerator ResNet50/ImageNet: 145 images/s (P100,

@@ -29,6 +29,7 @@ from elasticdl_tpu.parallel.sharding import (
 )
 from elasticdl_tpu.train.step_fns import make_eval_step, make_train_step
 from elasticdl_tpu.train.train_state import (
+    abstract_train_state,
     create_train_state,
     resolve_dtype,
 )
@@ -90,6 +91,23 @@ class SpmdTrainer:
         self._train_step = None
         self._eval_step = None
         return state
+
+    def abstract_state(self, sample_features):
+        """Shape/dtype skeleton of create_state without materializing any
+        buffers — the restore template for checkpoint resume. Also
+        computes state_shardings over the current mesh (restore re-lays
+        the checkpoint out with them, so resume onto a different
+        topology never touches the save-time layout)."""
+        init_rng, _ = jax.random.split(self._rng)
+        abstract = abstract_train_state(
+            self._model, self._tx, init_rng, sample_features
+        )
+        self._state_shardings = infer_state_shardings(
+            abstract, self.mesh, self._rules
+        )
+        self._train_step = None
+        self._eval_step = None
+        return abstract
 
     def _leaf_sharding(self, leaf):
         if self._batch_spec is None:

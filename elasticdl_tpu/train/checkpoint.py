@@ -82,12 +82,22 @@ class DenseCheckpointManager:
             ),
             template,
         )
-        if shardings is not None:
-            abstract = jax.tree_util.tree_map(
-                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        if shardings is None:
+            # Pin every leaf to this process's default device rather than
+            # letting orbax read the sharding file written at save time:
+            # a checkpoint saved on an N-device mesh must restore on a
+            # single-chip worker (cross-topology resume).
+            shardings = jax.tree_util.tree_map(
+                lambda _: jax.sharding.SingleDeviceSharding(
+                    jax.devices()[0]
+                ),
                 abstract,
-                shardings,
             )
+        abstract = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract,
+            shardings,
+        )
         state = self._mgr.restore(
             int(version), args=ocp.args.StandardRestore(abstract)
         )

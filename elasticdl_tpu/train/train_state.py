@@ -37,6 +37,19 @@ def create_train_state(model, tx, rng, sample_features):
     )
 
 
+def abstract_train_state(model, tx, rng, sample_features):
+    """Shape/dtype skeleton of create_train_state without materializing
+    any buffers (checkpoint-restore template; a model near HBM capacity
+    must not hold init + restored copies at once)."""
+    import jax
+
+    return jax.eval_shape(
+        lambda r, feats: create_train_state(model, tx, r, feats),
+        rng,
+        sample_features,
+    )
+
+
 def cast_floating(tree, dtype):
     """Cast floating leaves of a pytree (bf16 compute on MXU)."""
 

@@ -14,6 +14,7 @@ import numpy as np
 from elasticdl_tpu.train.step_fns import make_eval_step, make_train_step
 from elasticdl_tpu.train.train_state import (
     TrainState,
+    abstract_train_state,
     create_train_state,
     resolve_dtype,
 )
@@ -42,6 +43,13 @@ class JaxTrainer:
     def create_state(self, sample_features) -> TrainState:
         init_rng, self._rng = jax.random.split(self._rng)
         return create_train_state(
+            self._model, self._tx, init_rng, sample_features
+        )
+
+    def abstract_state(self, sample_features):
+        """Restore template: create_state's shapes without the buffers."""
+        init_rng, _ = jax.random.split(self._rng)
+        return abstract_train_state(
             self._model, self._tx, init_rng, sample_features
         )
 

@@ -30,6 +30,10 @@ from elasticdl_tpu.worker.trainer import JaxTrainer
 logger = _logger_factory("elasticdl_tpu.worker.worker")
 
 
+class CheckpointRestoreError(RuntimeError):
+    """Fatal: --checkpoint_dir_for_init was given but restore failed."""
+
+
 class Worker:
     def __init__(
         self,
@@ -125,6 +129,20 @@ class Worker:
             self._checkpoint_mgr = DenseCheckpointManager(
                 checkpoint_dir, keep_max=keep_checkpoint_max
             )
+        if self.spec.sparse_embedding_specs and (
+            checkpoint_dir or checkpoint_dir_for_init
+        ):
+            # Checkpoint responsibility is split: the worker snapshots the
+            # dense TrainState; embedding tables are checkpointed by the
+            # parameter servers themselves (--checkpoint_dir on the PS,
+            # ps/server.py), as in the reference. Worker flags alone do
+            # NOT cover the embeddings.
+            logger.warning(
+                "Sparse model: worker checkpoint flags cover only the "
+                "dense state; pass --checkpoint_dir/--checkpoint_dir_for_"
+                "init to the parameter servers to snapshot/restore "
+                "embedding tables"
+            )
         self._callbacks = list(self.spec.callbacks() or [])
         for cb in self._callbacks:
             cb.set_worker(self)
@@ -186,6 +204,8 @@ class Worker:
                     cb.on_batch_end(self._version, loss)
                 if self.stop_training:
                     break
+        except CheckpointRestoreError:
+            raise  # fatal: never train from random init after a resume ask
         except Exception as e:  # report so tasks get retried elsewhere
             logger.exception("Training stream failed")
             self.tds.report_pending_failed(str(e))
@@ -196,29 +216,42 @@ class Worker:
         The freshly-initialized state is the restore template; restoring
         into the trainer's current shardings re-lays the checkpoint out
         over whatever mesh this worker runs (elastic resume onto a
-        different topology). A missing/empty checkpoint dir is an error:
+        different topology). Any restore failure is FATAL to the worker
+        (CheckpointRestoreError propagates out of every task handler):
         silently training (or evaluating) from random init after the
-        operator asked for a resume would discard real progress.
+        operator asked for a resume would discard real progress. The
+        retry path for transient storage errors is pod relaunch.
         """
-        self._restore_attempted = True
         from elasticdl_tpu.train.checkpoint import DenseCheckpointManager
 
-        self.state = self.trainer.ensure_state(self.state, batch)
+        if hasattr(self.trainer, "abstract_state"):
+            # Shape-only template: never hold init + restored state at
+            # once (a ZeRO-sharded model near HBM capacity would OOM).
+            template = self.trainer.abstract_state(batch["features"])
+        else:
+            self.state = self.trainer.ensure_state(self.state, batch)
+            template = self.state
         mgr = DenseCheckpointManager(
             self._init_checkpoint_dir, keep_max=0, create=False
         )
         try:
             restored = mgr.restore(
-                template=self.state,
+                template=template,
                 shardings=getattr(self.trainer, "state_shardings", None),
             )
+        except Exception as e:
+            raise CheckpointRestoreError(
+                "restore from --checkpoint_dir_for_init=%r failed: %s"
+                % (self._init_checkpoint_dir, e)
+            ) from e
         finally:
             mgr.close()
         if restored is None:
-            raise RuntimeError(
+            raise CheckpointRestoreError(
                 "--checkpoint_dir_for_init=%r holds no restorable "
                 "checkpoint" % self._init_checkpoint_dir
             )
+        self._restore_attempted = True
         self.state = restored
         self._version = int(restored.step)
         logger.info(
@@ -247,6 +280,9 @@ class Worker:
                     task.model_version, outputs, labels
                 )
             self._mc.report_task_result(task.task_id)
+        except CheckpointRestoreError:
+            self._mc.report_task_result(task.task_id, "restore failed")
+            raise
         except Exception as e:
             logger.exception("Evaluation task %s failed", task.task_id)
             self._mc.report_task_result(task.task_id, str(e))
@@ -267,6 +303,9 @@ class Worker:
                         self._mc.worker_id,
                     )
             self._mc.report_task_result(task.task_id)
+        except CheckpointRestoreError:
+            self._mc.report_task_result(task.task_id, "restore failed")
+            raise
         except Exception as e:
             logger.exception("Prediction task %s failed", task.task_id)
             self._mc.report_task_result(task.task_id, str(e))
@@ -314,6 +353,10 @@ class Worker:
             self._run()
         finally:
             self._stop_heartbeat()
+            if self._checkpoint_mgr is not None:
+                # Flush any in-flight orbax commit before process exit.
+                self._checkpoint_mgr.close()
+                self._checkpoint_mgr = None
 
     def _run(self):
         if self._mode == Mode.EVALUATION:

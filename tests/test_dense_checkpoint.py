@@ -130,3 +130,39 @@ def test_resume_onto_different_mesh(tmp_path):
     np.testing.assert_allclose(
         resumed_losses, oracle_losses[2:], atol=1e-5, rtol=1e-5
     )
+
+
+def test_spmd_checkpoint_restores_on_single_chip(tmp_path):
+    """A checkpoint saved from an 8-device SPMD mesh must restore on a
+    plain single-chip JaxTrainer (shardings=None): restore pins leaves to
+    the local default device instead of replaying the save-time layout."""
+    batch = _batch(16)
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=4))
+    spmd = SpmdTrainer(
+        model=mnist.custom_model(),
+        loss_fn=mnist.loss,
+        optimizer=mnist.optimizer(),
+        mesh=mesh,
+        seed=0,
+    )
+    state = spmd.create_state(batch["features"])
+    state, _ = spmd.train_step(state, batch)
+    mgr = DenseCheckpointManager(str(tmp_path / "ckpt"), keep_max=1)
+    mgr.save(1, state)
+    mgr.close()
+
+    single = JaxTrainer(
+        model=mnist.custom_model(),
+        loss_fn=mnist.loss,
+        optimizer=mnist.optimizer(),
+        seed=1,
+    )
+    template = single.abstract_state(batch["features"])
+    mgr = DenseCheckpointManager(str(tmp_path / "ckpt"), keep_max=1)
+    restored = mgr.restore(template=template, shardings=None)
+    mgr.close()
+    assert int(restored.step) == 1
+    # restored state drives the single-chip step
+    new_state, loss = single.train_step(restored, batch)
+    assert np.isfinite(float(loss))
+    assert int(new_state.step) == 2

@@ -121,3 +121,91 @@ def test_two_workers_share_the_queue(tmp_path):
         assert all(w.model_version > 0 for w in workers)
     finally:
         server.stop(None)
+
+
+def test_worker_checkpoint_resume_and_fatal_restore(tmp_path):
+    """Worker-level restore wiring: save during a training run, resume a
+    fresh worker from --checkpoint_dir_for_init (version fast-forwards),
+    and die fatally (CheckpointRestoreError) on an unrestorable dir
+    rather than silently training from random init."""
+    from elasticdl_tpu.worker.worker import CheckpointRestoreError
+
+    train_dir = tmp_path / "train"
+    valid_dir = tmp_path / "valid"
+    train_dir.mkdir()
+    valid_dir.mkdir()
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=256, seed=0)
+    create_mnist_recordio(str(valid_dir / "f0.rec"), num_records=64, seed=1)
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    # Run 1: train to completion, checkpointing every 2 versions.
+    server, dispatcher, evals, port = start_master(
+        str(train_dir), str(valid_dir), str(tmp_path / "export"), eval_steps=0
+    )
+    try:
+        w1 = Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "elasticdl_tpu.models.mnist",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=32,
+            wait_sleep_secs=0.1,
+            checkpoint_dir=ckpt_dir,
+            checkpoint_steps=2,
+        )
+        w1.run()
+        assert dispatcher.finished()
+        saved_version = w1.model_version
+        assert saved_version > 0
+    finally:
+        server.stop(None)
+
+    # Run 2: resume from the checkpoint; version fast-forwards past the
+    # last saved snapshot and eval tasks never see random weights.
+    server, dispatcher, evals, port = start_master(
+        str(train_dir), str(valid_dir), str(tmp_path / "export2"),
+        eval_steps=4,
+    )
+    try:
+        w2 = Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "elasticdl_tpu.models.mnist",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=32,
+            wait_sleep_secs=0.1,
+            checkpoint_dir_for_init=ckpt_dir,
+        )
+        w2.run()
+        assert dispatcher.finished()
+        # version fast-forwarded to the restored snapshot, then kept
+        # counting through run 2's batches
+        assert w2.model_version >= saved_version + 1
+        assert evals.completed_summaries
+        _, summary = evals.completed_summaries[-1]
+        assert summary["accuracy"] > 0.8  # resumed weights, not random
+    finally:
+        server.stop(None)
+
+    # Run 3: empty restore dir is fatal, and the job does NOT finish.
+    empty = tmp_path / "empty_ckpt"
+    empty.mkdir()
+    server, dispatcher, evals, port = start_master(
+        str(train_dir), str(valid_dir), str(tmp_path / "export3"),
+        eval_steps=0,
+    )
+    try:
+        w3 = Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "elasticdl_tpu.models.mnist",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=32,
+            wait_sleep_secs=0.1,
+            checkpoint_dir_for_init=str(empty),
+        )
+        try:
+            w3.run()
+            raise AssertionError("worker trained from random init")
+        except CheckpointRestoreError:
+            pass
+        assert not dispatcher.finished()
+    finally:
+        server.stop(None)
