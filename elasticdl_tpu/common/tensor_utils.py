@@ -13,9 +13,19 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 def ndarray_to_blob(array, blob=None) -> pb.TensorBlob:
     array = np.ascontiguousarray(array)
+    if array.dtype == object:
+        # object arrays of python strings (categorical features):
+        # materialize as fixed-width unicode so they have a raw layout
+        array = array.astype(str)
     if blob is None:
         blob = pb.TensorBlob()
-    blob.dtype = array.dtype.name
+    # unicode/bytes need dtype.str ("<U7"/"|S7"; dtype.name is the
+    # unparseable "str224"), while extension types like bfloat16 need
+    # dtype.name (their dtype.str is an opaque "<V2")
+    if array.dtype.kind in ("U", "S"):
+        blob.dtype = array.dtype.str
+    else:
+        blob.dtype = array.dtype.name
     del blob.dims[:]
     blob.dims.extend(array.shape)
     blob.content = array.tobytes()

@@ -1,0 +1,179 @@
+"""Census-income Wide&Deep over the feature-column stack.
+
+Reference parity: model_zoo/census_wide_deep_model/
+wide_deep_functional_api.py + feature_config.py (vocab lookups for
+work-class/marital-status, hash buckets for education/occupation,
+age/hours bucketization, one concatenated id group feeding a wide
+indicator + deep embedding, staged LR schedule :75-84).
+
+TPU redesign: string->id resolution (IndexLookup/Hashing — host-only
+ops, XLA has no strings) happens per record in dataset_fn; the flax
+model sees only numeric arrays and identity categorical columns, so the
+whole forward is one jit-fused program. The LR schedule runs through
+LearningRateScheduler over an inject_hyperparams optimizer — host-set
+like the reference, no recompile.
+"""
+
+import flax.linen as nn
+import numpy as np
+
+from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.preprocessing import Hashing, IndexLookup
+from elasticdl_tpu.preprocessing import feature_column as fc
+from elasticdl_tpu.train import metrics
+from elasticdl_tpu.train.callbacks import LearningRateScheduler
+from elasticdl_tpu.train.losses import sigmoid_binary_cross_entropy
+from elasticdl_tpu.train.optimizers import (
+    create_host_schedulable_optimizer,
+)
+
+WORK_CLASS_VOCABULARY = [
+    "Private",
+    "Self-emp-not-inc",
+    "Self-emp-inc",
+    "Federal-gov",
+    "Local-gov",
+    "State-gov",
+    "Without-pay",
+    "Never-worked",
+]
+
+MARITAL_STATUS_VOCABULARY = [
+    "Married-civ-spouse",
+    "Divorced",
+    "Never-married",
+    "Separated",
+    "Widowed",
+    "Married-spouse-absent",
+    "Married-AF-spouse",
+]
+
+AGE_BOUNDARIES = [18.0, 25.0, 30.0, 35.0, 40.0, 45.0, 50.0, 60.0, 70.0]
+HOURS_BOUNDARIES = [20.0, 35.0, 40.0, 45.0, 55.0]
+EDUCATION_BUCKETS = 30
+OCCUPATION_BUCKETS = 50
+
+_work_lookup = IndexLookup(WORK_CLASS_VOCABULARY, num_oov_tokens=1)
+_marital_lookup = IndexLookup(MARITAL_STATUS_VOCABULARY, num_oov_tokens=1)
+_education_hash = Hashing(EDUCATION_BUCKETS)
+_occupation_hash = Hashing(OCCUPATION_BUCKETS)
+
+
+def build_columns():
+    age = fc.numeric_column("age")
+    hours = fc.numeric_column("hours_per_week")
+    age_buckets = fc.bucketized_column(age, AGE_BOUNDARIES)
+    hours_buckets = fc.bucketized_column(hours, HOURS_BOUNDARIES)
+    # ids were resolved in dataset_fn; identity columns bound them
+    work_class = fc.categorical_column_with_identity(
+        "work_class_id", _work_lookup.vocab_size()
+    )
+    marital = fc.categorical_column_with_identity(
+        "marital_status_id", _marital_lookup.vocab_size()
+    )
+    education = fc.categorical_column_with_identity(
+        "education_id", EDUCATION_BUCKETS
+    )
+    occupation = fc.categorical_column_with_identity(
+        "occupation_id", OCCUPATION_BUCKETS
+    )
+    group = fc.concatenated_categorical_column(
+        [
+            age_buckets,
+            hours_buckets,
+            work_class,
+            marital,
+            education,
+            occupation,
+        ]
+    )
+    wide_columns = (fc.indicator_column(group),)
+    deep_columns = (
+        age,
+        hours,
+        fc.embedding_column(group, dimension=8, combiner="sum"),
+    )
+    return wide_columns, deep_columns
+
+
+class CensusWideDeep(nn.Module):
+    hidden: tuple = (64, 32)
+
+    def setup(self):
+        wide_cols, deep_cols = build_columns()
+        self.wide_features = fc.DenseFeatures(columns=wide_cols)
+        self.deep_features = fc.DenseFeatures(columns=deep_cols)
+        self.deep_layers = [nn.Dense(w) for w in self.hidden]
+        self.wide_logit = nn.Dense(1)
+        self.deep_logit = nn.Dense(1)
+
+    def __call__(self, features, training: bool = False):
+        wide = self.wide_features(features)
+        deep = self.deep_features(features)
+        for layer in self.deep_layers:
+            deep = nn.relu(layer(deep))
+        logit = self.wide_logit(wide) + self.deep_logit(deep)
+        return logit.squeeze(-1)
+
+
+def custom_model():
+    return CensusWideDeep()
+
+
+def loss(labels, predictions):
+    return sigmoid_binary_cross_entropy(labels, predictions)
+
+
+def optimizer():
+    return create_host_schedulable_optimizer("Adam", learning_rate=0.0003)
+
+
+def dataset_fn(dataset, mode=None, metadata=None):
+    def parse(payload):
+        example = decode_example(payload)
+
+        def s(key):
+            value = example[key]
+            return value if isinstance(value, str) else str(value)
+
+        features = {
+            "age": np.float32(example["age"]).reshape(()),
+            "hours_per_week": np.float32(
+                example["hours_per_week"]
+            ).reshape(()),
+            "work_class_id": _work_lookup(
+                np.array([s("work_class")])
+            ).reshape((1,)),
+            "marital_status_id": _marital_lookup(
+                np.array([s("marital_status")])
+            ).reshape((1,)),
+            "education_id": _education_hash(
+                np.array([s("education")])
+            ).reshape((1,)),
+            "occupation_id": _occupation_hash(
+                np.array([s("occupation")])
+            ).reshape((1,)),
+        }
+        return features, np.float32(example["label"]).reshape(())
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {
+        "auc": metrics.AUC(from_logits=True),
+        "accuracy": metrics.BinaryAccuracy(from_logits=True),
+    }
+
+
+def callbacks():
+    # wide_deep_functional_api.py:75-84 staged LR schedule, applied
+    # host-side between steps (no recompile).
+    def _schedule(model_version):
+        if model_version < 5000:
+            return 0.0003
+        elif model_version < 12000:
+            return 0.0002
+        return 0.0001
+
+    return [LearningRateScheduler(_schedule)]

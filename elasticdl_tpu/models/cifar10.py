@@ -1,0 +1,66 @@
+"""CIFAR-10 CNN (the reference's "functional API" baseline).
+
+Reference parity: model_zoo/cifar10/cifar10_functional_api.py (VGG-style
+conv stack with BN + dropout over 32x32x3) and cifar10/data_parser.py
+(uint8 image / int label records). The resnet/mobilenet CIFAR variants
+live in models/resnet.py (small_inputs=True) and models/mobilenet.py.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.train import metrics
+from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
+from elasticdl_tpu.train.optimizers import create_optimizer
+
+
+class Cifar10CNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        norm = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not training,
+            momentum=0.9,
+            dtype=jnp.float32,
+        )
+        for filters in (32, 64, 128):
+            x = nn.Conv(filters, (3, 3), padding="SAME", use_bias=False)(x)
+            x = nn.relu(norm()(x))
+            x = nn.Conv(filters, (3, 3), padding="SAME", use_bias=False)(x)
+            x = nn.relu(norm()(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = nn.Dropout(0.25, deterministic=not training)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        x = nn.Dropout(0.5, deterministic=not training)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def custom_model():
+    return Cifar10CNN()
+
+
+def loss(labels, predictions):
+    return sparse_softmax_cross_entropy(labels, predictions)
+
+
+def optimizer():
+    return create_optimizer("Adam", learning_rate=0.001)
+
+
+def dataset_fn(dataset, mode=None, metadata=None):
+    def parse(payload):
+        example = decode_example(payload)
+        image = example["image"].astype(np.float32) / 255.0
+        if image.ndim == 2:  # grayscale fixtures -> 3 channels
+            image = np.stack([image] * 3, axis=-1)
+        return image, example["label"].astype(np.int32).reshape(())
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {"accuracy": metrics.Accuracy()}

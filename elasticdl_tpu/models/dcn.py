@@ -1,0 +1,17 @@
+"""DCN CTR model-zoo module (model_zoo/dac_ctr/dcn_model.py parity).
+
+Thin wrapper over models/ctr.py pinning the variant; see that module for
+the architecture and citations.
+"""
+
+from elasticdl_tpu.models.ctr import (  # noqa: F401
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+from elasticdl_tpu.models import ctr as _ctr
+
+
+def custom_model():
+    return _ctr._VARIANTS["dcn"]()

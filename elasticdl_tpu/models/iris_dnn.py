@@ -1,0 +1,56 @@
+"""Iris DNN over CSV records.
+
+Reference parity: model_zoo/odps_iris_dnn_model/odps_iris_dnn_model.py
+(4-feature DNN, the canonical table-reader example). The reader side is
+CSVDataReader (data/readers.py) standing in for the ODPS table reader;
+records arrive as delimited text rows.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.train import metrics
+from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
+from elasticdl_tpu.train.optimizers import create_optimizer
+
+
+class IrisDNN(nn.Module):
+    num_classes: int = 3
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        x = nn.relu(nn.Dense(16)(x))
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def custom_model():
+    return IrisDNN()
+
+
+def loss(labels, predictions):
+    return sparse_softmax_cross_entropy(labels, predictions)
+
+
+def optimizer():
+    return create_optimizer("Adam", learning_rate=0.01)
+
+
+def dataset_fn(dataset, mode=None, metadata=None):
+    def parse(record):
+        if isinstance(record, bytes):
+            record = record.decode("utf-8")
+        if isinstance(record, str):
+            parts = record.strip().split(",")
+        else:  # already a sequence of fields
+            parts = list(record)
+        features = np.array([float(v) for v in parts[:4]], np.float32)
+        label = np.int32(float(parts[4])) if len(parts) > 4 else np.int32(0)
+        return features, label.reshape(())
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {"accuracy": metrics.Accuracy()}

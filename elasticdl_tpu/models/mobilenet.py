@@ -1,0 +1,144 @@
+"""MobileNetV2 in flax (inverted residual bottlenecks).
+
+Reference parity: model_zoo/cifar10/cifar10_mobilenetv2.py and the
+ImageNet MobileNetV2 benchmarks (docs/benchmark/ftlib_benchmark.md:79-86,
+139-156 — the reference's second headline model). Fresh TPU-first
+implementation: NHWC, depthwise convs via feature_group_count (XLA's
+native depthwise form), ReLU6, width multiples of 8, BatchNorm in f32.
+
+``small_inputs=True`` keeps the CIFAR stem at stride 1 (32x32 inputs
+would otherwise collapse before the deep stages).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.train import metrics
+from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
+from elasticdl_tpu.train.optimizers import create_optimizer
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class InvertedResidual(nn.Module):
+    filters: int
+    strides: int = 1
+    expand_ratio: int = 6
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        norm = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not training,
+            momentum=0.9,
+            dtype=jnp.float32,
+        )
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand_ratio
+        residual = x
+        if self.expand_ratio != 1:
+            x = nn.Conv(hidden, (1, 1), use_bias=False)(x)
+            x = nn.relu6(norm()(x))
+        # depthwise: one group per channel — XLA lowers this to the
+        # native depthwise conv on TPU
+        x = nn.Conv(
+            hidden,
+            (3, 3),
+            strides=(self.strides, self.strides),
+            padding="SAME",
+            feature_group_count=hidden,
+            use_bias=False,
+        )(x)
+        x = nn.relu6(norm()(x))
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        x = norm()(x)
+        if self.strides == 1 and in_ch == self.filters:
+            x = x + residual
+        return x
+
+
+# (expand_ratio, filters, repeats, first_stride)
+_V2_CONFIG = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1000
+    width_multiplier: float = 1.0
+    small_inputs: bool = False
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        norm = lambda: nn.BatchNorm(  # noqa: E731
+            use_running_average=not training,
+            momentum=0.9,
+            dtype=jnp.float32,
+        )
+        stem = _make_divisible(32 * self.width_multiplier)
+        stem_strides = (1, 1) if self.small_inputs else (2, 2)
+        x = nn.Conv(
+            stem, (3, 3), strides=stem_strides, padding="SAME",
+            use_bias=False,
+        )(x)
+        x = nn.relu6(norm()(x))
+        for i, (expand, filters, repeats, stride) in enumerate(_V2_CONFIG):
+            filters = _make_divisible(filters * self.width_multiplier)
+            for r in range(repeats):
+                if self.small_inputs and i == 1 and r == 0:
+                    stride_r = 1  # keep 32x32 resolution one stage longer
+                else:
+                    stride_r = stride if r == 0 else 1
+                x = InvertedResidual(
+                    filters, strides=stride_r, expand_ratio=expand
+                )(x, training=training)
+        head = _make_divisible(max(1280 * self.width_multiplier, 1280))
+        x = nn.Conv(head, (1, 1), use_bias=False)(x)
+        x = nn.relu6(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def mobilenetv2(num_classes=1000, **kwargs):
+    return MobileNetV2(num_classes=num_classes, **kwargs)
+
+
+def custom_model():
+    return MobileNetV2(num_classes=10, small_inputs=True)
+
+
+def loss(labels, predictions):
+    return sparse_softmax_cross_entropy(labels, predictions)
+
+
+def optimizer():
+    return create_optimizer(
+        "Momentum", learning_rate=0.02, momentum=0.9, nesterov=True
+    )
+
+
+def dataset_fn(dataset, mode=None, metadata=None):
+    def parse(payload):
+        example = decode_example(payload)
+        image = example["image"].astype(np.float32) / 255.0
+        if image.ndim == 2:
+            image = np.stack([image] * 3, axis=-1)
+        return image, example["label"].astype(np.int32).reshape(())
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {"accuracy": metrics.Accuracy()}

@@ -1,0 +1,26 @@
+"""Preprocessing library: feature transforms + feature columns.
+
+Reference parity: elasticdl_preprocessing/ (layers, feature_column,
+analyzer_utils). See layers.py for the host/device split rationale.
+"""
+
+from elasticdl_tpu.preprocessing.layers import (  # noqa: F401
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    LogRound,
+    Normalizer,
+    RoundIdentity,
+    SparseEmbedding,
+    ToNumber,
+    ToRagged,
+    ToSparse,
+)
+from elasticdl_tpu.preprocessing.sparse import (  # noqa: F401
+    PAD_ID,
+    PaddedSparse,
+    dense_rows,
+    from_row_lists,
+    to_padded_sparse,
+)

@@ -1,0 +1,370 @@
+"""Feature columns: declarative feature -> dense-input mapping.
+
+Reference parity:
+- elasticdl_preprocessing/feature_column/feature_column.py
+  (concatenated_categorical_column merging many categorical id spaces by
+  offsetting into one, :22-230)
+- elasticdl/python/elasticdl/feature_column/feature_column.py
+  (embedding_column with sum/mean/sqrtn combiner, :25-221)
+- the stock TF columns the model zoo uses (numeric, bucketized,
+  identity/vocab/hash categorical, indicator).
+
+TPU redesign: a column is a small object with ``output_dim`` and
+``__call__(features) -> [batch, output_dim] array`` (dense) or a
+PaddedSparse (categorical). String-consuming columns run host-side;
+numeric ones are jit-safe. ``DenseFeatures`` is the flax module that
+owns embedding weights and concatenates all column outputs — the
+replacement for tf.keras.layers.DenseFeatures.
+
+Embedding tables bigger than the PS routing threshold are rewritten to
+the host-PS path by train/model_handler.py, not here: the column layer
+stays storage-agnostic.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+from elasticdl_tpu.preprocessing import layers as pp
+from elasticdl_tpu.preprocessing.sparse import (
+    PaddedSparse,
+    to_padded_sparse,
+)
+
+
+class NumericColumn:
+    def __init__(self, key, shape=(1,), normalizer_fn=None):
+        self.key = key
+        self.shape = tuple(shape)
+        self.normalizer_fn = normalizer_fn
+        self.output_dim = int(np.prod(self.shape))
+
+    @property
+    def name(self):
+        return self.key
+
+    def __call__(self, features):
+        x = jnp.asarray(features[self.key], jnp.float32)
+        if x.ndim == 1:
+            x = x[:, None]
+        x = x.reshape((x.shape[0], self.output_dim))
+        if self.normalizer_fn is not None:
+            x = self.normalizer_fn(x)
+        return x
+
+
+class BucketizedColumn:
+    """numeric -> bucket ids (categorical with len(boundaries)+1 buckets)."""
+
+    def __init__(self, source: NumericColumn, boundaries):
+        self.source = source
+        self.boundaries = list(boundaries)
+        self._disc = pp.Discretization(self.boundaries)
+        self.num_buckets = len(self.boundaries) + 1
+
+    @property
+    def name(self):
+        return self.source.name + "_bucketized"
+
+    def ids(self, features):
+        ids = self._disc(self.source(features)).astype(jnp.int64)
+        return PaddedSparse(ids, jnp.ones_like(ids, dtype=bool))
+
+
+class IdentityCategoricalColumn:
+    def __init__(self, key, num_buckets, default_value=None):
+        self.key = key
+        self.num_buckets = num_buckets
+        self.default_value = default_value
+
+    @property
+    def name(self):
+        return self.key
+
+    def ids(self, features):
+        raw = features[self.key]
+        if isinstance(raw, PaddedSparse):
+            sp = raw
+        else:
+            # entries outside [0, num_buckets) drop out of the mask
+            # unless a default_value re-routes them (TF identity column
+            # semantics).
+            sp = to_padded_sparse(jnp.asarray(raw), ignore_value=-1)
+        values = jnp.asarray(sp.values)
+        in_range = (values >= 0) & (values < self.num_buckets)
+        if self.default_value is not None:
+            values = jnp.where(
+                in_range, values, jnp.int64(self.default_value)
+            )
+            mask = jnp.asarray(sp.mask)
+        else:
+            mask = jnp.asarray(sp.mask) & in_range
+            values = jnp.where(in_range, values, 0)
+        return PaddedSparse(values, mask, sp.weights)
+
+
+class VocabularyCategoricalColumn:
+    def __init__(self, key, vocabulary_list, num_oov_buckets=0):
+        self.key = key
+        self.vocabulary_list = list(vocabulary_list)
+        self._lookup = pp.IndexLookup(
+            self.vocabulary_list, num_oov_tokens=max(1, num_oov_buckets)
+        )
+        self._keep_oov = num_oov_buckets > 0
+        self.num_buckets = len(self.vocabulary_list) + max(
+            0, num_oov_buckets
+        )
+
+    @property
+    def name(self):
+        return self.key
+
+    def ids(self, features):
+        raw = features[self.key]
+        sp = raw if isinstance(raw, PaddedSparse) else to_padded_sparse(
+            np.asarray(raw)
+        )
+        ids = self._lookup(np.asarray(sp.values))
+        mask = np.asarray(sp.mask)
+        if not self._keep_oov:
+            mask = mask & (ids < len(self.vocabulary_list))
+            ids = np.where(mask, ids, 0)
+        return PaddedSparse(ids, mask, sp.weights)
+
+
+class HashCategoricalColumn:
+    def __init__(self, key, hash_bucket_size):
+        self.key = key
+        self.num_buckets = hash_bucket_size
+        self._hashing = pp.Hashing(hash_bucket_size)
+
+    @property
+    def name(self):
+        return self.key
+
+    def ids(self, features):
+        raw = features[self.key]
+        sp = raw if isinstance(raw, PaddedSparse) else to_padded_sparse(
+            np.asarray(raw) if _host_array(raw) else jnp.asarray(raw)
+        )
+        return sp.with_values(self._hashing(sp.values))
+
+
+def _host_array(x):
+    return isinstance(x, np.ndarray) or isinstance(x, (list, tuple))
+
+
+class ConcatenatedCategoricalColumn:
+    """Merge N categorical columns into one id space by offsetting —
+    one big embedding table instead of N small ones.
+
+    Reference: elasticdl_preprocessing/feature_column/feature_column.py:
+    22-178 (offsets are exclusive prefix sums of num_buckets).
+    """
+
+    def __init__(self, categorical_columns):
+        self.columns = list(categorical_columns)
+        self.offsets = list(
+            np.cumsum([0] + [c.num_buckets for c in self.columns])[:-1]
+        )
+        self.num_buckets = int(
+            sum(c.num_buckets for c in self.columns)
+        )
+
+    @property
+    def name(self):
+        return "_C_".join(c.name for c in self.columns)
+
+    def ids(self, features):
+        parts = [c.ids(features) for c in self.columns]
+        return pp.ConcatenateWithOffset(self.offsets, axis=1)(parts)
+
+
+class EmbeddingColumn:
+    """categorical ids -> combined embedding vector.
+
+    Reference: elasticdl/python/elasticdl/feature_column/feature_column.py
+    :25-221. The weight lives in DenseFeatures (flax); this object only
+    describes the mapping.
+    """
+
+    def __init__(self, categorical, dimension, combiner="mean"):
+        self.categorical = categorical
+        self.dimension = dimension
+        self.combiner = combiner
+        self.output_dim = dimension
+
+    @property
+    def name(self):
+        return self.categorical.name + "_embedding"
+
+    @property
+    def table_shape(self):
+        return (self.categorical.num_buckets, self.dimension)
+
+
+class IndicatorColumn:
+    """categorical ids -> multi-hot counts (the wide half of wide&deep)."""
+
+    def __init__(self, categorical):
+        self.categorical = categorical
+        self.output_dim = categorical.num_buckets
+
+    @property
+    def name(self):
+        return self.categorical.name + "_indicator"
+
+    def __call__(self, features):
+        sp = self.categorical.ids(features)
+        return _multi_hot(sp, self.output_dim)
+
+
+def _multi_hot(sp: PaddedSparse, num_buckets):
+    """Scatter-add of the mask: multi-hot with counts."""
+    ids = jnp.asarray(sp.values).astype(jnp.int32)
+    mask = jnp.asarray(sp.mask)
+    safe = jnp.where(mask, ids, 0)
+    return jnp.zeros((ids.shape[0], num_buckets), jnp.float32).at[
+        jnp.arange(ids.shape[0])[:, None], safe
+    ].add(mask.astype(jnp.float32))
+
+
+# Factory functions mirroring the tf.feature_column API names used by the
+# reference model zoo (model_zoo/census_wide_deep_model/...).
+def numeric_column(key, shape=(1,), normalizer_fn=None):
+    return NumericColumn(key, shape, normalizer_fn)
+
+
+def bucketized_column(source, boundaries):
+    return BucketizedColumn(source, boundaries)
+
+
+def categorical_column_with_identity(key, num_buckets, default_value=None):
+    return IdentityCategoricalColumn(key, num_buckets, default_value)
+
+
+def categorical_column_with_vocabulary_list(
+    key, vocabulary_list, num_oov_buckets=0
+):
+    return VocabularyCategoricalColumn(key, vocabulary_list, num_oov_buckets)
+
+
+def categorical_column_with_hash_bucket(key, hash_bucket_size):
+    return HashCategoricalColumn(key, hash_bucket_size)
+
+
+def concatenated_categorical_column(categorical_columns):
+    return ConcatenatedCategoricalColumn(categorical_columns)
+
+
+def embedding_column(categorical, dimension, combiner="mean"):
+    return EmbeddingColumn(categorical, dimension, combiner)
+
+
+def indicator_column(categorical):
+    return IndicatorColumn(categorical)
+
+
+class DenseFeatures(nn.Module):
+    """Apply a list of columns to a features dict and concatenate —
+    the flax replacement for tf.keras.layers.DenseFeatures. Owns one
+    embedding table per EmbeddingColumn.
+
+    String-consuming columns (vocab/hash over numpy arrays) run on host
+    BEFORE jit; call ``preprocess(features)`` from the dataset_fn to
+    materialize their ids, then the module's __call__ is fully jit-safe.
+    """
+
+    columns: tuple
+
+    def preprocess(self, features):
+        """Host-side stage: resolve string-consuming categorical columns
+        to PaddedSparse ids and DROP the raw string keys, so the jitted
+        step sees only numeric arrays."""
+        out = dict(features)
+        consumed = set()
+        for col in self.columns:
+            cat = getattr(col, "categorical", None)
+            if cat is not None and _consumes_strings(cat):
+                out[_ids_key(cat)] = cat.ids(features)
+                consumed.update(_feature_keys(cat))
+        for key in consumed:
+            out.pop(key, None)
+        return out
+
+    @nn.compact
+    def __call__(self, features):
+        outputs = []
+        for col in self.columns:
+            if isinstance(col, EmbeddingColumn):
+                table = self.param(
+                    col.name,
+                    nn.initializers.variance_scaling(
+                        1.0, "fan_out", "uniform"
+                    ),
+                    col.table_shape,
+                )
+                sp = _resolve_ids(col.categorical, features)
+                outputs.append(
+                    _combine(table, sp, col.combiner)
+                )
+            elif isinstance(col, IndicatorColumn):
+                sp = _resolve_ids(col.categorical, features)
+                outputs.append(_multi_hot(sp, col.output_dim))
+            else:
+                outputs.append(col(features))
+        return jnp.concatenate(outputs, axis=-1)
+
+
+def _ids_key(categorical):
+    return "__ids__" + categorical.name
+
+
+def _consumes_strings(categorical):
+    return isinstance(
+        categorical,
+        (VocabularyCategoricalColumn, HashCategoricalColumn),
+    ) or (
+        isinstance(categorical, ConcatenatedCategoricalColumn)
+        and any(_consumes_strings(c) for c in categorical.columns)
+    )
+
+
+def _feature_keys(categorical):
+    """Raw feature keys consumed by STRING-consuming leaves only — a
+    numeric key (e.g. a bucketized column's source) may be shared with
+    dense columns and must survive preprocess()."""
+    if isinstance(categorical, ConcatenatedCategoricalColumn):
+        keys = set()
+        for c in categorical.columns:
+            keys.update(_feature_keys(c))
+        return keys
+    if isinstance(
+        categorical, (VocabularyCategoricalColumn, HashCategoricalColumn)
+    ):
+        return {categorical.key}
+    return set()
+
+
+def _resolve_ids(categorical, features):
+    key = _ids_key(categorical)
+    if key in features:
+        return features[key]
+    return categorical.ids(features)
+
+
+def _combine(table, sp: PaddedSparse, combiner):
+    ids = jnp.asarray(sp.values)
+    mask = jnp.asarray(sp.mask)
+    safe = jnp.where(mask, ids, 0).astype(jnp.int32)
+    rows = jnp.take(table, safe, axis=0)
+    w = mask.astype(rows.dtype)
+    if sp.weights is not None:
+        w = w * jnp.asarray(sp.weights, rows.dtype)
+    summed = jnp.einsum("blh,bl->bh", rows, w)
+    if combiner == "sum":
+        return summed
+    denom = jnp.sum(w, axis=1, keepdims=True)
+    if combiner == "sqrtn":
+        denom = jnp.sqrt(jnp.sum(w * w, axis=1, keepdims=True))
+    return summed / jnp.maximum(denom, 1e-12)

@@ -1,0 +1,360 @@
+"""Feature-preprocessing transforms.
+
+Reference parity: elasticdl_preprocessing/layers/* (Hashing, IndexLookup,
+Discretization, LogRound, RoundIdentity, Normalizer, ToNumber, ToSparse/
+ToRagged, ConcatenateWithOffset, SparseEmbedding). The TPU redesign
+splits each transform by where it must run:
+
+- **String handling is host-side** (numpy object arrays): XLA has no
+  string type. Hashing/IndexLookup/ToNumber accept numpy string arrays
+  and return integer/float numpy arrays the jitted step consumes.
+- **Numeric transforms are jit-safe** (pure jnp): Discretization,
+  LogRound, RoundIdentity, Normalizer, ConcatenateWithOffset and integer
+  Hashing/IndexLookup trace into the compiled step, so XLA fuses them
+  into the surrounding program instead of running per-batch python.
+- **Ragged/sparse inputs** ride the fixed-shape PaddedSparse (see
+  sparse.py); every layer maps over ``values`` and preserves the mask,
+  the moral equivalent of the reference's ``tf.ragged.map_flat_values``.
+
+Every layer is a plain callable; SparseEmbedding (the only one with
+trainable weight) is a flax Module.
+"""
+
+import hashlib
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.preprocessing.sparse import (
+    PaddedSparse,
+    to_padded_sparse,
+)
+
+
+def _is_string_array(x):
+    return isinstance(x, np.ndarray) and x.dtype.kind in ("U", "S", "O")
+
+
+def _map_values(inputs, fn):
+    """Apply fn to the value tensor, preserving PaddedSparse structure."""
+    if isinstance(inputs, PaddedSparse):
+        return inputs.with_values(fn(inputs.values))
+    return fn(inputs)
+
+
+def _string_hash(s, num_bins):
+    digest = hashlib.md5(str(s).encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "little") % num_bins
+
+
+def _int_mix_hash(x, num_bins):
+    """splitmix64-style mixer, jit-safe (device path for int features)."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return (x % jnp.uint32(num_bins)).astype(jnp.int32)
+
+
+class Hashing:
+    """value -> hash(value) % num_bins.
+
+    Reference: elasticdl_preprocessing/layers/hashing.py:19-100. Strings
+    (and host numpy ints, for cross-path consistency) use a stable md5
+    bucket; traced integer arrays use a jit-safe integer mixer. Both are
+    deterministic across processes — the property the reference needs
+    when many elastic workers must agree on feature buckets.
+    """
+
+    def __init__(self, num_bins):
+        if not num_bins or num_bins <= 0:
+            raise ValueError("num_bins must be a positive integer")
+        self.num_bins = num_bins
+
+    def __call__(self, inputs):
+        return _map_values(inputs, self._hash)
+
+    def _hash(self, values):
+        if _is_string_array(values):
+            flat = [
+                _string_hash(v, self.num_bins) for v in values.reshape(-1)
+            ]
+            return np.array(flat, dtype=np.int64).reshape(values.shape)
+        if isinstance(values, np.ndarray):
+            flat = [
+                _string_hash(int(v), self.num_bins)
+                for v in values.reshape(-1)
+            ]
+            return np.array(flat, dtype=np.int64).reshape(values.shape)
+        return _int_mix_hash(values, self.num_bins)
+
+
+class IndexLookup:
+    """vocabulary term -> zero-based index; OOV -> len(vocab) +
+    hash(term) % num_oov_tokens.
+
+    Reference: elasticdl_preprocessing/layers/index_lookup.py:22-120
+    (vocabulary list or one-token-per-line file; OOV bucketing).
+    Host-side (strings live here); emits int64 numpy arrays.
+    """
+
+    def __init__(self, vocabulary=None, num_oov_tokens=1):
+        if isinstance(vocabulary, str):
+            with open(vocabulary) as f:
+                vocabulary = [line.rstrip("\n") for line in f if line.strip()]
+        vocabulary = list(vocabulary or [])
+        if len(set(vocabulary)) != len(vocabulary):
+            raise ValueError("vocabulary contains repeated terms")
+        self.vocabulary = vocabulary
+        self.num_oov_tokens = max(1, num_oov_tokens)
+        self._table = {term: i for i, term in enumerate(vocabulary)}
+
+    def vocab_size(self):
+        return len(self.vocabulary) + self.num_oov_tokens
+
+    def __call__(self, inputs):
+        return _map_values(inputs, self._lookup)
+
+    def _lookup(self, values):
+        values = np.asarray(values)
+        flat = []
+        for v in values.reshape(-1):
+            key = v if isinstance(v, str) else str(v)
+            idx = self._table.get(key)
+            if idx is None:
+                idx = len(self.vocabulary) + _string_hash(
+                    key, self.num_oov_tokens
+                )
+            flat.append(idx)
+        return np.array(flat, dtype=np.int64).reshape(values.shape)
+
+
+class Discretization:
+    """x -> bucket index over sorted boundaries; bins include the left
+    boundary and exclude the right (bins=[0,1,2] -> 4 buckets).
+
+    Reference: elasticdl_preprocessing/layers/discretization.py:20-77.
+    jit-safe (jnp.searchsorted compiles to a vectorized compare tree).
+    """
+
+    def __init__(self, bins):
+        self.bins = jnp.asarray(list(bins), jnp.float32)
+
+    def num_bins(self):
+        return len(self.bins) + 1
+
+    def __call__(self, inputs):
+        return _map_values(
+            inputs,
+            lambda v: jnp.searchsorted(
+                self.bins, jnp.asarray(v, jnp.float32), side="right"
+            ).astype(jnp.int32),
+        )
+
+
+class LogRound:
+    """x -> round(log_base(x)), clipped to [0, num_bins); non-positive
+    inputs map to default_value.
+
+    Reference: elasticdl_preprocessing/layers/log_round.py:20-90.
+    """
+
+    def __init__(self, num_bins, default_value=0, base=None):
+        self.num_bins = num_bins
+        self.default_value = default_value
+        self.base = base
+
+    def __call__(self, inputs):
+        return _map_values(inputs, self._log_round)
+
+    def _log_round(self, values):
+        x = jnp.asarray(values, jnp.float32)
+        logs = jnp.log(jnp.maximum(x, 1e-30))
+        if self.base is not None:
+            logs = logs / jnp.log(jnp.float32(self.base))
+        out = jnp.round(logs).astype(jnp.int32)
+        out = jnp.where(x <= 0, jnp.int32(self.default_value), out)
+        return jnp.clip(out, 0, self.num_bins - 1)
+
+
+class RoundIdentity:
+    """x -> round(x) clipped to [0, num_buckets]; a degenerate bucketize
+    where the value is its own bucket.
+
+    Reference: elasticdl_preprocessing/layers/round_identity.py:20-80.
+    """
+
+    def __init__(self, num_buckets, default_value=0):
+        self.num_buckets = num_buckets
+        self.default_value = default_value
+
+    def __call__(self, inputs):
+        return _map_values(
+            inputs,
+            lambda v: jnp.clip(
+                jnp.round(jnp.asarray(v, jnp.float32)), 0, self.num_buckets
+            ).astype(jnp.int64),
+        )
+
+
+class Normalizer:
+    """x -> (x - subtractor) / divisor.
+
+    Reference: elasticdl_preprocessing/layers/normalizer.py:17-80.
+    """
+
+    def __init__(self, subtractor, divisor):
+        if divisor == 0:
+            raise ValueError("The divisor cannot be 0")
+        self.subtractor = subtractor
+        self.divisor = divisor
+
+    def __call__(self, inputs):
+        return _map_values(
+            inputs,
+            lambda v: (jnp.asarray(v, jnp.float32) - self.subtractor)
+            / self.divisor,
+        )
+
+
+class ToNumber:
+    """Parse strings to numbers ("" -> default_value); numeric inputs are
+    cast. Host-side for strings, jit-safe for numerics.
+
+    Reference: elasticdl_preprocessing/layers/to_number.py:33-90.
+    """
+
+    def __init__(self, out_dtype, default_value=0):
+        self.out_dtype = np.dtype(out_dtype)
+        self.default_value = default_value
+
+    def __call__(self, inputs):
+        return _map_values(inputs, self._convert)
+
+    def _convert(self, values):
+        if _is_string_array(values):
+            flat = []
+            for v in np.asarray(values).reshape(-1):
+                if v == "":
+                    flat.append(self.default_value)
+                elif np.issubdtype(self.out_dtype, np.integer):
+                    flat.append(int(float(v)))
+                else:
+                    flat.append(float(v))
+            return np.array(flat, dtype=self.out_dtype).reshape(
+                np.asarray(values).shape
+            )
+        return jnp.asarray(values).astype(self.out_dtype)
+
+
+class ToSparse:
+    """Dense matrix -> PaddedSparse, dropping ignore_value entries.
+
+    Reference: to_sparse.py / to_ragged.py both produce a
+    variable-length view of a dense batch; PaddedSparse is the
+    fixed-shape equivalent of either (the mask carries the raggedness).
+    """
+
+    def __init__(self, ignore_value=None):
+        self.ignore_value = ignore_value
+
+    def __call__(self, inputs):
+        if isinstance(inputs, PaddedSparse):
+            return inputs
+        return to_padded_sparse(inputs, self.ignore_value)
+
+
+ToRagged = ToSparse  # one fixed-shape representation serves both
+
+
+class ConcatenateWithOffset:
+    """Add offsets[i] to inputs[i], then concatenate along axis.
+
+    Reference: concatenate_with_offset.py:17-90 — the id-space merging
+    primitive behind concatenated_categorical_column. PaddedSparse
+    inputs concatenate values AND masks (axis=1).
+    """
+
+    def __init__(self, offsets, axis=-1):
+        self.offsets = offsets
+        self.axis = axis
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return inputs
+        if self.offsets is not None and len(self.offsets) != len(inputs):
+            raise ValueError(
+                "offsets length %d != inputs length %d"
+                % (len(self.offsets), len(inputs))
+            )
+        offsets = self.offsets or [0] * len(inputs)
+        if isinstance(inputs[0], PaddedSparse):
+            values = jnp.concatenate(
+                [
+                    jnp.asarray(sp.values) + off
+                    for sp, off in zip(inputs, offsets)
+                ],
+                axis=1,
+            )
+            mask = jnp.concatenate(
+                [jnp.asarray(sp.mask) for sp in inputs], axis=1
+            )
+            weights = None
+            if all(sp.weights is not None for sp in inputs):
+                weights = jnp.concatenate(
+                    [jnp.asarray(sp.weights) for sp in inputs], axis=1
+                )
+            return PaddedSparse(values, mask, weights)
+        return jnp.concatenate(
+            [
+                jnp.asarray(x) + off
+                for x, off in zip(inputs, offsets)
+            ],
+            axis=self.axis,
+        )
+
+
+class SparseEmbedding(nn.Module):
+    """Embedding with a combiner over variable-length ids — the
+    device-resident counterpart of the host-PS sparse path.
+
+    Reference: elasticdl_preprocessing/layers/sparse_embedding.py:20-88
+    (safe_embedding_lookup_sparse with sum/mean/sqrtn). The TPU-native
+    lookup is a masked gather + segment combine, fully jit-fused; rows
+    for pad slots are zeroed by the mask so they never contribute.
+    """
+
+    input_dim: int
+    output_dim: int
+    combiner: str = "mean"
+    embeddings_initializer: object = nn.initializers.uniform(scale=0.05)
+
+    @nn.compact
+    def __call__(self, inputs):
+        if self.combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError("combiner must be sum, mean or sqrtn")
+        table = self.param(
+            "embeddings",
+            self.embeddings_initializer,
+            (self.input_dim, self.output_dim),
+        )
+        if not isinstance(inputs, PaddedSparse):
+            inputs = to_padded_sparse(inputs, ignore_value=0)
+        ids = jnp.asarray(inputs.values)
+        mask = jnp.asarray(inputs.mask)
+        safe_ids = jnp.where(mask, ids, 0).astype(jnp.int32)
+        if self.input_dim:
+            safe_ids = jnp.clip(safe_ids, 0, self.input_dim - 1)
+        rows = jnp.take(table, safe_ids, axis=0)  # [b, L, dim]
+        w = mask.astype(rows.dtype)
+        if inputs.weights is not None:
+            w = w * jnp.asarray(inputs.weights, rows.dtype)
+        summed = jnp.einsum("blh,bl->bh", rows, w)
+        if self.combiner == "sum":
+            return summed
+        denom = jnp.sum(w, axis=1, keepdims=True)
+        if self.combiner == "sqrtn":
+            denom = jnp.sqrt(jnp.sum(w * w, axis=1, keepdims=True))
+        return summed / jnp.maximum(denom, 1e-12)

@@ -1,0 +1,92 @@
+"""PaddedSparse: the fixed-shape stand-in for tf.SparseTensor/RaggedTensor.
+
+The reference's preprocessing layers pass tf.SparseTensor / tf.RaggedTensor
+between layers (elasticdl_preprocessing/layers/to_sparse.py, to_ragged.py).
+XLA requires static shapes, so the TPU-native representation is a dense
+``[batch, max_len]`` id matrix plus a boolean validity mask — every op on
+it is jit-compatible and maps onto vectorized TPU compute instead of
+per-row dynamic shapes.
+
+Conversions at the pipeline boundary (python lists of variable length ->
+padded matrices) happen host-side in numpy; everything downstream
+(combiners, embedding lookups, offsets) runs on device.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+PAD_ID = -1
+
+
+class PaddedSparse(NamedTuple):
+    """``values``: [batch, max_len] ids (or numerics), pad slots hold
+    ``PAD_ID`` (ids) / 0 (numerics); ``mask``: [batch, max_len] bool,
+    True on real entries; ``weights``: optional [batch, max_len] float."""
+
+    values: object
+    mask: object
+    weights: Optional[object] = None
+
+    @property
+    def batch_size(self):
+        return self.values.shape[0]
+
+    @property
+    def max_len(self):
+        return self.values.shape[1]
+
+    def with_values(self, values):
+        """Same sparsity pattern, new values (the map_flat_values of the
+        ragged/sparse world: layers transform values, keep the mask)."""
+        return PaddedSparse(values, self.mask, self.weights)
+
+    def row_lengths(self):
+        return jnp.sum(self.mask.astype(jnp.int32), axis=1)
+
+
+def from_row_lists(rows, max_len=None, dtype=np.int64, weights=None):
+    """Python lists of variable length -> PaddedSparse (host-side)."""
+    max_len = max_len or max((len(r) for r in rows), default=1) or 1
+    n = len(rows)
+    values = np.zeros((n, max_len), dtype=dtype)
+    mask = np.zeros((n, max_len), dtype=bool)
+    w = None
+    if weights is not None:
+        w = np.zeros((n, max_len), dtype=np.float32)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        values[:] = PAD_ID
+    for i, row in enumerate(rows):
+        row = list(row)[:max_len]
+        values[i, : len(row)] = row
+        mask[i, : len(row)] = True
+        if w is not None:
+            wr = list(weights[i])[:max_len]
+            w[i, : len(wr)] = wr
+    return PaddedSparse(values, mask, w)
+
+
+def to_padded_sparse(dense, ignore_value=None):
+    """Dense [batch, len] -> PaddedSparse, dropping ``ignore_value``
+    entries from the mask. The reference's ToSparse/ToRagged layers
+    (to_sparse.py:34-63) do this with default ignore "" for strings and
+    -1 for numerics; same defaults here."""
+    dense = np.asarray(dense) if not hasattr(dense, "dtype") else dense
+    if ignore_value is None:
+        if hasattr(dense, "dtype") and dense.dtype.kind in ("U", "S", "O"):
+            ignore_value = ""
+        else:
+            ignore_value = -1
+    if hasattr(dense, "dtype") and dense.dtype.kind in ("U", "S", "O"):
+        mask = np.asarray(dense) != ignore_value
+        return PaddedSparse(np.asarray(dense), mask)
+    mask = dense != ignore_value
+    return PaddedSparse(dense, mask)
+
+
+def dense_rows(sp: PaddedSparse):
+    """PaddedSparse -> list of python lists (host-side, for tests/IO)."""
+    values = np.asarray(sp.values)
+    mask = np.asarray(sp.mask)
+    return [list(values[i][mask[i]]) for i in range(values.shape[0])]

@@ -42,6 +42,47 @@ class MaxStepsStopping(Callback):
             self.worker.stop_training = True
 
 
+class LearningRateScheduler(Callback):
+    """Set the learning rate from the model version each batch.
+
+    Reference: elasticdl/callbacks.py:114-155 (replaces
+    ``optimizer.learning_rate`` with a version-derived value). On TPU
+    prefer an optax schedule at optimizer construction — it compiles
+    into the step. This callback serves schedules that must stay in
+    python: it rewrites the learning_rate hyperparameter of an opt state
+    built by create_host_schedulable_optimizer between steps (no
+    recompile). With a plain optimizer it is a no-op (warned once).
+    """
+
+    def __init__(self, schedule):
+        super().__init__()
+        self.schedule = schedule
+        self._warned = False
+
+    def on_batch_end(self, step, loss):
+        from elasticdl_tpu.train.optimizers import set_learning_rate
+
+        worker = self.worker
+        state = getattr(worker, "state", None)
+        if state is None:
+            return
+        new_opt_state = set_learning_rate(
+            state.opt_state, self.schedule(step)
+        )
+        if new_opt_state is None:
+            if not self._warned:
+                self._warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "LearningRateScheduler: optimizer has no injected "
+                    "hyperparams (build it with "
+                    "create_host_schedulable_optimizer); schedule ignored"
+                )
+            return
+        worker.state = state.replace(opt_state=new_opt_state)
+
+
 class SavedModelExporter(Callback):
     """Export the trained state on the TRAIN_END_CALLBACK task.
 

@@ -14,7 +14,12 @@ SUPPORTED = ("SGD", "Momentum", "Adam", "Adagrad", "AdamW", "RMSprop")
 
 def create_optimizer(opt_type: str, **opt_args) -> optax.GradientTransformation:
     opt_type_lower = opt_type.lower()
-    lr = float(opt_args.pop("learning_rate", 0.01))
+    # learning_rate may be a float, an optax schedule (callable of step —
+    # compiles into the step, the idiomatic TPU form of the reference's
+    # LearningRateScheduler), or a traced scalar (inject_hyperparams).
+    lr = opt_args.pop("learning_rate", 0.01)
+    if isinstance(lr, (str, int)):
+        lr = float(lr)
     if opt_type_lower == "sgd":
         momentum = float(opt_args.pop("momentum", 0.0))
         nesterov = _parse_bool(opt_args.pop("nesterov", False))
@@ -54,6 +59,54 @@ def create_optimizer(opt_type: str, **opt_args) -> optax.GradientTransformation:
     raise ValueError(
         "Unsupported optimizer %r (supported: %s)" % (opt_type, SUPPORTED)
     )
+
+
+def create_host_schedulable_optimizer(
+    opt_type: str, **opt_args
+) -> optax.GradientTransformation:
+    """Like create_optimizer, but the learning rate lives in
+    ``opt_state.hyperparams`` (optax.inject_hyperparams) so the
+    LearningRateScheduler callback can rewrite it between steps with NO
+    recompile — the TPU equivalent of the reference mutating
+    ``optimizer.learning_rate`` per batch (elasticdl/callbacks.py:114-155,
+    ps/learning_rate_modulator.py)."""
+    lr = opt_args.pop("learning_rate", 0.01)
+
+    def factory(learning_rate):
+        return create_optimizer(
+            opt_type, learning_rate=learning_rate, **opt_args
+        )
+
+    return optax.inject_hyperparams(factory)(learning_rate=lr)
+
+
+def set_learning_rate(opt_state, learning_rate):
+    """Rewrite the learning_rate hyperparameter inside an opt_state built
+    by create_host_schedulable_optimizer. Returns the new opt_state, or
+    None if this opt_state has no injected hyperparams."""
+    inject_types = (
+        optax.InjectHyperparamsState,
+        optax.InjectStatefulHyperparamsState,
+    )
+
+    def rewrite(s):
+        # the inject states are themselves NamedTuples, so test for them
+        # BEFORE treating tuples as containers
+        if isinstance(s, inject_types) and "learning_rate" in s.hyperparams:
+            import jax.numpy as jnp
+
+            hp = dict(s.hyperparams)
+            hp["learning_rate"] = jnp.asarray(
+                learning_rate, jnp.asarray(hp["learning_rate"]).dtype
+            )
+            return s._replace(hyperparams=hp), True
+        if type(s) is tuple:
+            parts = [rewrite(p) for p in s]
+            return tuple(p for p, _ in parts), any(f for _, f in parts)
+        return s, False
+
+    new_state, found = rewrite(opt_state)
+    return new_state if found else None
 
 
 def parse_opt_args(opt_args_str: str) -> dict:

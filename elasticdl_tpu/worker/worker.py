@@ -129,8 +129,14 @@ class Worker:
             self._checkpoint_mgr = DenseCheckpointManager(
                 checkpoint_dir, keep_max=keep_checkpoint_max
             )
+        if checkpoint_dir and not checkpoint_steps:
+            logger.warning(
+                "--checkpoint_dir=%r given without --checkpoint_steps; "
+                "NO checkpoints will be written",
+                checkpoint_dir,
+            )
         if self.spec.sparse_embedding_specs and (
-            checkpoint_dir or checkpoint_dir_for_init
+            self._checkpoint_mgr is not None or checkpoint_dir_for_init
         ):
             # Checkpoint responsibility is split: the worker snapshots the
             # dense TrainState; embedding tables are checkpointed by the
@@ -231,10 +237,13 @@ class Worker:
         else:
             self.state = self.trainer.ensure_state(self.state, batch)
             template = self.state
-        mgr = DenseCheckpointManager(
-            self._init_checkpoint_dir, keep_max=0, create=False
-        )
+        mgr = None
         try:
+            # constructor included: a nonexistent dir (create=False)
+            # must also be fatal, not a retryable task failure
+            mgr = DenseCheckpointManager(
+                self._init_checkpoint_dir, keep_max=0, create=False
+            )
             restored = mgr.restore(
                 template=template,
                 shardings=getattr(self.trainer, "state_shardings", None),
@@ -245,7 +254,8 @@ class Worker:
                 % (self._init_checkpoint_dir, e)
             ) from e
         finally:
-            mgr.close()
+            if mgr is not None:
+                mgr.close()
         if restored is None:
             raise CheckpointRestoreError(
                 "--checkpoint_dir_for_init=%r holds no restorable "

@@ -185,27 +185,29 @@ def test_worker_checkpoint_resume_and_fatal_restore(tmp_path):
     finally:
         server.stop(None)
 
-    # Run 3: empty restore dir is fatal, and the job does NOT finish.
+    # Run 3: empty and nonexistent restore dirs are both fatal, and the
+    # job does NOT finish.
     empty = tmp_path / "empty_ckpt"
     empty.mkdir()
-    server, dispatcher, evals, port = start_master(
-        str(train_dir), str(valid_dir), str(tmp_path / "export3"),
-        eval_steps=0,
-    )
-    try:
-        w3 = Worker(
-            MasterClient("localhost:%d" % port, worker_id=0),
-            "elasticdl_tpu.models.mnist",
-            RecordIODataReader(data_dir=str(train_dir)),
-            minibatch_size=32,
-            wait_sleep_secs=0.1,
-            checkpoint_dir_for_init=str(empty),
+    for bad_dir in (str(empty), str(tmp_path / "typo_ckpt")):
+        server, dispatcher, evals, port = start_master(
+            str(train_dir), str(valid_dir), str(tmp_path / "export3"),
+            eval_steps=0,
         )
         try:
-            w3.run()
-            raise AssertionError("worker trained from random init")
-        except CheckpointRestoreError:
-            pass
-        assert not dispatcher.finished()
-    finally:
-        server.stop(None)
+            w3 = Worker(
+                MasterClient("localhost:%d" % port, worker_id=0),
+                "elasticdl_tpu.models.mnist",
+                RecordIODataReader(data_dir=str(train_dir)),
+                minibatch_size=32,
+                wait_sleep_secs=0.1,
+                checkpoint_dir_for_init=bad_dir,
+            )
+            try:
+                w3.run()
+                raise AssertionError("worker trained from random init")
+            except CheckpointRestoreError:
+                pass
+            assert not dispatcher.finished()
+        finally:
+            server.stop(None)
