@@ -1,0 +1,4 @@
+"""Client CLI (`edl`): zoo image workflow + train/evaluate/predict.
+
+Reference parity: elasticdl_client/ (SURVEY.md §2.9).
+"""

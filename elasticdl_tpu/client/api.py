@@ -1,0 +1,136 @@
+"""Client API: zoo image workflow + job submission.
+
+Reference parity: elasticdl_client/api.py — init_zoo renders a
+Dockerfile embedding the model zoo (:52-90), build_zoo/push_zoo drive
+docker (:93-113), train/evaluate/predict re-serialize args into a master
+pod command line and create the master pod or dump YAML (:116-248).
+
+Docker here goes through the `docker` CLI via subprocess (the docker
+python SDK is not in this image); clusterless workflows use --dry_run /
+--yaml, which never touch a cluster or daemon.
+"""
+
+import os
+import shlex
+import subprocess
+
+import yaml
+
+from elasticdl_tpu.client import args as client_args
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.client.api")
+
+_DOCKERFILE_TEMPLATE = """\
+FROM {base_image}
+
+RUN pip install elasticdl_tpu {extra_packages}
+COPY . /model_zoo
+ENV PYTHONPATH=/model_zoo:$PYTHONPATH
+"""
+
+
+def init_zoo(parsed):
+    """Render a Dockerfile into the current directory (api.py:52-90)."""
+    extra = " ".join(parsed.extra_pypi_package)
+    content = _DOCKERFILE_TEMPLATE.format(
+        base_image=parsed.base_image, extra_packages=extra
+    )
+    if parsed.cluster_spec:
+        content += "COPY %s /cluster_spec/\n" % parsed.cluster_spec
+    with open("Dockerfile", "w") as f:
+        f.write(content)
+    logger.info("Wrote Dockerfile (base image %s)", parsed.base_image)
+
+
+def build_zoo(parsed):
+    _docker("build", "-t", parsed.image, parsed.path)
+
+
+def push_zoo(parsed):
+    _docker("push", parsed.image)
+
+
+def _docker(*args):
+    command = ["docker", *args]
+    logger.info("Running: %s", " ".join(shlex.quote(a) for a in command))
+    subprocess.run(command, check=True)
+
+
+# ----------------------------------------------------------------------
+def train(parsed):
+    return _submit_job(parsed, "train")
+
+
+def evaluate(parsed):
+    return _submit_job(parsed, "evaluate")
+
+
+def predict(parsed):
+    return _submit_job(parsed, "predict")
+
+
+def _submit_job(parsed, job_kind):
+    """Build the master pod manifest; submit it or dump YAML
+    (api.py:193-248)."""
+    master_args = client_args.build_master_arguments(parsed)
+    command = [
+        "python",
+        "-m",
+        "elasticdl_tpu.master.main",
+    ] + master_args
+
+    from elasticdl_tpu.k8s.client import Client
+
+    api = _make_api(parsed)
+    client = Client(api, parsed.job_name, image_name=parsed.image_name)
+    manifest = client.build_pod_manifest(
+        client.get_master_pod_name(),
+        "master",
+        0,
+        command,
+        resource_requests=client_args.parse_resource_string(
+            parsed.master_resource_request
+        ),
+        resource_limits=client_args.parse_resource_string(
+            parsed.master_resource_limit
+        )
+        or None,
+        env=dict(
+            client_args.parse_envs_string(parsed.envs),
+            EDL_JOB_KIND=job_kind,
+        ),
+        restart_policy=parsed.restart_policy,
+        priority_class=parsed.master_pod_priority or None,
+        volumes=client_args.parse_volume_string(parsed.volume),
+    )
+    if parsed.dry_run or parsed.yaml:
+        text = yaml.safe_dump(manifest, sort_keys=False)
+        if parsed.yaml:
+            with open(parsed.yaml, "w") as f:
+                f.write(text)
+            logger.info("Wrote master pod manifest to %s", parsed.yaml)
+        else:
+            print(text)
+        return manifest
+    api_obj = client._api  # real submission path
+    api_obj.create_pod(manifest)
+    logger.info(
+        "Submitted %s job %s (master pod %s)",
+        job_kind,
+        parsed.job_name,
+        client.get_master_pod_name(),
+    )
+    return manifest
+
+
+def _make_api(parsed):
+    """In-cluster/kubeconfig-less API, or an inert stub for dry runs."""
+    if parsed.dry_run or parsed.yaml:
+        class _DryRunApi:
+            namespace = parsed.namespace
+
+        return _DryRunApi()
+    from elasticdl_tpu.k8s.api import K8sApi
+
+    return K8sApi(namespace=parsed.namespace)

@@ -1,0 +1,241 @@
+"""Client CLI argument surface.
+
+Reference parity: elasticdl_client/common/args.py:78-503 (the canonical
+~60-flag surface: resources, priorities, volumes, distribution_strategy,
+checkpoint/eval/prediction groups, envs) and
+build_arguments_from_parsed_result (:543-565), which re-serializes parsed
+args into the master pod's command line.
+
+TPU additions: --tpu_resource (chips per worker pod), --mesh (dp,fsdp,
+tp,sp axis sizes), --num_ps meaning *sparse host-PS* count (the dense
+path has no PS).
+"""
+
+import argparse
+
+
+def add_zoo_init_arguments(parser):
+    parser.add_argument(
+        "--base_image", default="python:3.12", help="Docker base image"
+    )
+    parser.add_argument(
+        "--extra_pypi_package",
+        action="append",
+        default=[],
+        help="extra pip packages baked into the image",
+    )
+    parser.add_argument(
+        "--cluster_spec",
+        default="",
+        help="python file customizing pod specs for your cluster",
+    )
+
+
+def add_zoo_build_arguments(parser):
+    parser.add_argument("path", help="model zoo directory")
+    parser.add_argument(
+        "--image", required=True, help="tag for the built image"
+    )
+    parser.add_argument("--docker_base_url", default="")
+    parser.add_argument("--docker_tlscert", default="")
+    parser.add_argument("--docker_tlskey", default="")
+
+
+def add_zoo_push_arguments(parser):
+    parser.add_argument("image", help="image tag to push")
+
+
+def add_common_arguments(parser):
+    parser.add_argument("--job_name", required=True)
+    parser.add_argument("--image_name", default="")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument(
+        "--distribution_strategy",
+        default="AllreduceStrategy",
+        choices=[
+            "Local",
+            "AllreduceStrategy",  # dense SPMD over ICI (the default)
+            "ParameterServerStrategy",  # + sparse host-PS
+        ],
+    )
+    parser.add_argument("--num_workers", type=int, default=1)
+    parser.add_argument(
+        "--num_ps_pods",
+        type=int,
+        default=0,
+        help="sparse host-PS pod count (dense gradients never touch a PS)",
+    )
+    parser.add_argument("--worker_resource_request", default="cpu=1,memory=4096Mi")
+    parser.add_argument("--worker_resource_limit", default="")
+    parser.add_argument("--ps_resource_request", default="cpu=1,memory=4096Mi")
+    parser.add_argument("--ps_resource_limit", default="")
+    parser.add_argument("--master_resource_request", default="cpu=0.5,memory=1024Mi")
+    parser.add_argument("--master_resource_limit", default="")
+    parser.add_argument(
+        "--tpu_resource",
+        default="",
+        help='TPU chips per worker pod, e.g. "google.com/tpu=8"',
+    )
+    parser.add_argument(
+        "--mesh",
+        default="",
+        help='mesh axis sizes, e.g. "dp=4,fsdp=2" (defaults to all-dp)',
+    )
+    parser.add_argument("--master_pod_priority", default="")
+    parser.add_argument("--worker_pod_priority", default="")
+    parser.add_argument("--ps_pod_priority", default="")
+    parser.add_argument(
+        "--volume",
+        default="",
+        help='e.g. "claim_name=mypvc,mount_path=/data"',
+    )
+    parser.add_argument(
+        "--envs", default="", help="k1=v1,k2=v2 env vars for all pods"
+    )
+    parser.add_argument("--restart_policy", default="Never")
+    parser.add_argument("--image_pull_policy", default="Always")
+    parser.add_argument(
+        "--dry_run",
+        action="store_true",
+        help="print the master pod manifest as YAML instead of submitting",
+    )
+    parser.add_argument(
+        "--yaml",
+        default="",
+        help="dump the master pod manifest to this file",
+    )
+
+
+def add_train_arguments(parser):
+    parser.add_argument("--model_zoo", required=True)
+    parser.add_argument("--model_def", default="")
+    parser.add_argument("--model_params", default="")
+    parser.add_argument("--training_data", default="")
+    parser.add_argument("--validation_data", default="")
+    parser.add_argument("--data_reader_params", default="")
+    parser.add_argument("--minibatch_size", type=int, default=64)
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--records_per_task", type=int, default=1024)
+    parser.add_argument("--evaluation_steps", type=int, default=0)
+    parser.add_argument("--evaluation_throttle_secs", type=int, default=0)
+    parser.add_argument("--evaluation_start_delay_secs", type=int, default=0)
+    parser.add_argument("--task_timeout_secs", type=float, default=300.0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=int, default=0)
+    parser.add_argument("--keep_checkpoint_max", type=int, default=3)
+    parser.add_argument("--checkpoint_dir_for_init", default="")
+    parser.add_argument("--output", default="")
+    parser.add_argument("--compute_dtype", default="bfloat16")
+
+
+def add_evaluate_arguments(parser):
+    parser.add_argument("--model_zoo", required=True)
+    parser.add_argument("--model_def", default="")
+    parser.add_argument("--validation_data", required=True)
+    parser.add_argument("--data_reader_params", default="")
+    parser.add_argument("--minibatch_size", type=int, default=64)
+    parser.add_argument("--records_per_task", type=int, default=1024)
+    parser.add_argument("--checkpoint_dir_for_init", required=True)
+    parser.add_argument("--compute_dtype", default="bfloat16")
+
+
+def add_predict_arguments(parser):
+    parser.add_argument("--model_zoo", required=True)
+    parser.add_argument("--model_def", default="")
+    parser.add_argument("--prediction_data", required=True)
+    parser.add_argument("--data_reader_params", default="")
+    parser.add_argument("--minibatch_size", type=int, default=64)
+    parser.add_argument("--records_per_task", type=int, default=1024)
+    parser.add_argument("--checkpoint_dir_for_init", required=True)
+    parser.add_argument("--compute_dtype", default="bfloat16")
+
+
+# flags that belong to the client only and must NOT be forwarded to the
+# master process command line
+_CLIENT_ONLY = {
+    "image_name",
+    "namespace",
+    "dry_run",
+    "yaml",
+    "docker_base_url",
+    "docker_tlscert",
+    "docker_tlskey",
+    "worker_resource_request",
+    "worker_resource_limit",
+    "ps_resource_request",
+    "ps_resource_limit",
+    "master_resource_request",
+    "master_resource_limit",
+    "master_pod_priority",
+    "worker_pod_priority",
+    "ps_pod_priority",
+    "volume",
+    "image_pull_policy",
+    "restart_policy",
+    "tpu_resource",
+}
+
+
+def build_master_arguments(parsed):
+    """Re-serialize parsed args into the master command line
+    (reference args.py:543-565 build_arguments_from_parsed_result)."""
+    parts = []
+    for key, value in sorted(vars(parsed).items()):
+        if key in _CLIENT_ONLY or key in ("command", "zoo_command", "func"):
+            continue
+        if value in ("", None, False) or value == []:
+            continue
+        if value is True:
+            parts.append("--%s" % key)
+        else:
+            parts.append("--%s=%s" % (key, value))
+    return parts
+
+
+def parse_resource_string(spec):
+    """'cpu=1,memory=4096Mi' -> {'cpu': '1', 'memory': '4096Mi'}
+    (reference elasticdl_client/common/k8s_resource.py)."""
+    resources = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError("Bad resource segment %r" % part)
+        key, value = part.split("=", 1)
+        resources[key.strip()] = value.strip()
+    return resources
+
+
+def parse_envs_string(spec):
+    envs = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, value = part.split("=", 1)
+        envs[key.strip()] = value.strip()
+    return envs
+
+
+def parse_volume_string(spec):
+    """'claim_name=x,mount_path=/data' -> pod volume + mount dicts
+    (reference elasticdl_client/common/k8s_volume.py). Also supports
+    'host_path=/p,mount_path=/data'."""
+    if not spec:
+        return None
+    fields = parse_resource_string(spec)
+    mount_path = fields.get("mount_path")
+    if not mount_path:
+        raise ValueError("volume spec needs mount_path")
+    name = "edl-volume-0"
+    if "claim_name" in fields:
+        volume = {
+            "name": name,
+            "persistentVolumeClaim": {"claimName": fields["claim_name"]},
+        }
+    elif "host_path" in fields:
+        volume = {"name": name, "hostPath": {"path": fields["host_path"]}}
+    else:
+        raise ValueError("volume spec needs claim_name or host_path")
+    return [{"volume": volume, "mount": {"name": name, "mountPath": mount_path}}]

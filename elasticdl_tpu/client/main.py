@@ -1,0 +1,56 @@
+"""`edl` CLI entry point.
+
+Reference parity: elasticdl_client/main.py:28-88 — the command tree
+`zoo init|build|push` and `train|evaluate|predict`.
+"""
+
+import argparse
+import sys
+
+from elasticdl_tpu.client import api
+from elasticdl_tpu.client import args as client_args
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        "edl", description="elasticdl_tpu client"
+    )
+    subparsers = parser.add_subparsers(dest="command", required=True)
+
+    zoo = subparsers.add_parser("zoo", help="model zoo image workflow")
+    zoo_sub = zoo.add_subparsers(dest="zoo_command", required=True)
+    p = zoo_sub.add_parser("init")
+    client_args.add_zoo_init_arguments(p)
+    p.set_defaults(func=api.init_zoo)
+    p = zoo_sub.add_parser("build")
+    client_args.add_zoo_build_arguments(p)
+    p.set_defaults(func=api.build_zoo)
+    p = zoo_sub.add_parser("push")
+    client_args.add_zoo_push_arguments(p)
+    p.set_defaults(func=api.push_zoo)
+
+    p = subparsers.add_parser("train")
+    client_args.add_common_arguments(p)
+    client_args.add_train_arguments(p)
+    p.set_defaults(func=api.train)
+
+    p = subparsers.add_parser("evaluate")
+    client_args.add_common_arguments(p)
+    client_args.add_evaluate_arguments(p)
+    p.set_defaults(func=api.evaluate)
+
+    p = subparsers.add_parser("predict")
+    client_args.add_common_arguments(p)
+    client_args.add_predict_arguments(p)
+    p.set_defaults(func=api.predict)
+
+    return parser
+
+
+def main(argv=None):
+    parsed = build_parser().parse_args(argv)
+    return parsed.func(parsed)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

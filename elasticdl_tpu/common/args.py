@@ -50,6 +50,19 @@ def parse_master_args(argv=None):
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--checkpoint_dir_for_init", default="")
+    # flags the client CLI forwards (client/args.py); consumed when the
+    # master provisions pods via the instance manager
+    parser.add_argument("--job_name", default="")
+    parser.add_argument(
+        "--distribution_strategy", default="AllreduceStrategy"
+    )
+    parser.add_argument("--num_ps_pods", type=int, default=0)
+    parser.add_argument(
+        "--mesh", default="", help='axis sizes, e.g. "dp=4,fsdp=2"'
+    )
+    parser.add_argument("--model_def", default="")
+    parser.add_argument("--model_params", default="")
+    parser.add_argument("--envs", default="")
     return parser.parse_args(argv)
 
 

@@ -1,0 +1,6 @@
+"""Kubernetes integration: REST api, job client, instance manager.
+
+Reference parity: elasticdl/python/common/k8s_client.py,
+master/k8s_instance_manager.py, common/k8s_job_monitor.py (L6 of the
+layer map, SURVEY.md §1).
+"""

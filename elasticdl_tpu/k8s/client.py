@@ -1,0 +1,258 @@
+"""K8s job client: pods, per-pod services, event watch.
+
+Reference parity: elasticdl/python/common/k8s_client.py (create_worker/
+create_ps/create_master + per-pod Services on worker:3333 / PS:2222
+:29-31,239-257; label-patch job status :203-207; watch thread :82-96)
+and elasticdl_client/common/k8s_client.py (master pod with owner
+references so deleting the master garbage-collects the job).
+
+TPU redesign: a "worker" pod is a TPU-VM host pod — the pod spec takes a
+``tpu_resource`` (e.g. {"google.com/tpu": "8"}) plus the usual cpu/mem,
+and workers get the env the JAX runtime needs for multi-host meshes
+(coordinator address = master service DNS). The watch loop is a daemon
+thread feeding InstanceManager._event_cb, exactly the reference's shape.
+"""
+
+import threading
+import traceback
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.k8s.client")
+
+ELASTICDL_APP_NAME = "elasticdl-tpu"
+ELASTICDL_JOB_KEY = "elasticdl-tpu-job-name"
+ELASTICDL_REPLICA_TYPE_KEY = "elasticdl-tpu-replica-type"
+ELASTICDL_REPLICA_INDEX_KEY = "elasticdl-tpu-replica-index"
+
+WORKER_PORT = 3333
+PS_PORT = 2222
+MASTER_PORT = 50001
+
+
+class Client:
+    def __init__(self, api, job_name, image_name="", event_callback=None):
+        self._api = api
+        self.job_name = job_name
+        self._image = image_name
+        self._event_cb = event_callback
+        self._watch_thread = None
+        self._stopped = threading.Event()
+        if event_callback:
+            self.start_watch()
+
+    # ------------------------------------------------------------------
+    def start_watch(self):
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop,
+            name="k8s_pod_watch",
+            daemon=True,
+        )
+        self._watch_thread.start()
+
+    def stop_watch(self):
+        self._stopped.set()
+
+    def _watch_loop(self):
+        selector = "%s=%s" % (ELASTICDL_JOB_KEY, self.job_name)
+        while not self._stopped.is_set():
+            try:
+                for event_type, pod in self._api.watch_pods(
+                    label_selector=selector, timeout_seconds=60
+                ):
+                    if self._stopped.is_set():
+                        return
+                    try:
+                        self._event_cb(event_type, pod)
+                    except Exception:
+                        logger.error(
+                            "event callback failed:\n%s",
+                            traceback.format_exc(),
+                        )
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                logger.warning(
+                    "pod watch disconnected; re-establishing:\n%s",
+                    traceback.format_exc(),
+                )
+
+    # ------------------------------------------------------------------
+    def get_master_pod_name(self):
+        return "elasticdl-%s-master" % self.job_name
+
+    def get_worker_pod_name(self, worker_id):
+        return "elasticdl-%s-worker-%s" % (self.job_name, worker_id)
+
+    def get_ps_pod_name(self, ps_id):
+        return "elasticdl-%s-ps-%s" % (self.job_name, ps_id)
+
+    def get_worker_service_address(self, worker_id):
+        return "%s.%s.svc:%d" % (
+            self.get_worker_pod_name(worker_id),
+            self._api.namespace,
+            WORKER_PORT,
+        )
+
+    def get_ps_service_address(self, ps_id):
+        return "%s.%s.svc:%d" % (
+            self.get_ps_pod_name(ps_id),
+            self._api.namespace,
+            PS_PORT,
+        )
+
+    def get_master_service_address(self):
+        return "%s.%s.svc:%d" % (
+            self.get_master_pod_name(),
+            self._api.namespace,
+            MASTER_PORT,
+        )
+
+    # ------------------------------------------------------------------
+    def _labels(self, replica_type, replica_index):
+        return {
+            "app": ELASTICDL_APP_NAME,
+            ELASTICDL_JOB_KEY: self.job_name,
+            ELASTICDL_REPLICA_TYPE_KEY: replica_type,
+            ELASTICDL_REPLICA_INDEX_KEY: str(replica_index),
+        }
+
+    def build_pod_manifest(
+        self,
+        name,
+        replica_type,
+        replica_index,
+        command,
+        resource_requests=None,
+        resource_limits=None,
+        tpu_resource=None,
+        env=None,
+        image=None,
+        restart_policy="Never",
+        priority_class=None,
+        volumes=None,
+        owner=None,
+    ):
+        resources = {
+            "requests": dict(resource_requests or {}),
+            "limits": dict(resource_limits or resource_requests or {}),
+        }
+        if tpu_resource:
+            # TPU chips are limits-only resources on GKE
+            resources["limits"].update(tpu_resource)
+        container = {
+            "name": "main",
+            "image": image or self._image,
+            "command": command,
+            "resources": resources,
+            "env": [
+                {"name": k, "value": str(v)}
+                for k, v in (env or {}).items()
+            ],
+        }
+        spec = {
+            "containers": [container],
+            "restartPolicy": restart_policy,
+        }
+        if priority_class:
+            spec["priorityClassName"] = priority_class
+        if volumes:
+            spec["volumes"] = [v["volume"] for v in volumes]
+            container["volumeMounts"] = [v["mount"] for v in volumes]
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "labels": self._labels(replica_type, replica_index),
+            },
+            "spec": spec,
+        }
+        if owner:
+            # deleting the master garbage-collects every job pod
+            # (elasticdl_client/common/k8s_client.py owner references)
+            manifest["metadata"]["ownerReferences"] = [
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "name": owner["name"],
+                    "uid": owner["uid"],
+                    "controller": True,
+                    "blockOwnerDeletion": True,
+                }
+            ]
+        return manifest
+
+    def _service_manifest(self, name, port, replica_type, replica_index):
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name},
+            "spec": {
+                "selector": self._labels(replica_type, replica_index),
+                "ports": [{"port": port, "targetPort": port}],
+                "clusterIP": "None",  # headless: DNS -> pod IP
+            },
+        }
+
+    # ------------------------------------------------------------------
+    def create_worker(self, worker_id, command, **kwargs):
+        name = self.get_worker_pod_name(worker_id)
+        pod = self._api.create_pod(
+            self.build_pod_manifest(
+                name, "worker", worker_id, command, **kwargs
+            )
+        )
+        self._api.create_service(
+            self._service_manifest(name, WORKER_PORT, "worker", worker_id)
+        )
+        return pod
+
+    def create_ps(self, ps_id, command, **kwargs):
+        name = self.get_ps_pod_name(ps_id)
+        pod = self._api.create_pod(
+            self.build_pod_manifest(name, "ps", ps_id, command, **kwargs)
+        )
+        self._api.create_service(
+            self._service_manifest(name, PS_PORT, "ps", ps_id)
+        )
+        return pod
+
+    def create_master(self, command, **kwargs):
+        name = self.get_master_pod_name()
+        pod = self._api.create_pod(
+            self.build_pod_manifest(name, "master", 0, command, **kwargs)
+        )
+        self._api.create_service(
+            self._service_manifest(name, MASTER_PORT, "master", 0)
+        )
+        return pod
+
+    def delete_worker(self, worker_id):
+        self._delete_pod_and_service(self.get_worker_pod_name(worker_id))
+
+    def delete_ps(self, ps_id):
+        self._delete_pod_and_service(self.get_ps_pod_name(ps_id))
+
+    def delete_master(self):
+        self._delete_pod_and_service(self.get_master_pod_name())
+
+    def _delete_pod_and_service(self, name):
+        try:
+            self._api.delete_pod(name)
+        finally:
+            try:
+                self._api.delete_service(name)
+            except Exception:
+                logger.warning("service %s not deleted", name)
+
+    def get_master_pod(self):
+        return self._api.get_pod(self.get_master_pod_name())
+
+    def update_master_status_label(self, status):
+        """The reference surfaces job status by patching master pod
+        labels, which PS pods poll to know when to exit
+        (k8s_instance_manager.py:203-207, ps/parameter_server.py:129-153)."""
+        self._api.patch_pod_labels(
+            self.get_master_pod_name(), {"status": status}
+        )

@@ -1,0 +1,285 @@
+"""Instance manager: launches and heals the elastic worker/PS pod set.
+
+Reference parity: elasticdl/python/master/k8s_instance_manager.py —
+start_workers/start_parameter_servers (:137-195), the pod event callback
+(:256-358): MODIFIED+Failed -> task recovery, DELETED or exit-137-not-OOM
+-> relaunch (workers get a NEW id, PS keeps the SAME id and service
+address :341-354), OOM-killed pods are NOT relaunched (:289-301),
+`all_workers_failed` aborts the job, and every membership change
+recomputes the alive-host list sorted by pod start time for the
+rendezvous (:356-385).
+
+TPU redesign: the "worker" is a TPU-VM host pod; membership changes feed
+MeshRendezvous (master/rendezvous.py), whose epoch bump is what tells
+surviving workers to rebuild their jax.distributed mesh — the reference's
+Horovod rendezvous re-init reborn at slice granularity.
+"""
+
+import itertools
+import threading
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.k8s.instance_manager")
+
+_MAX_MEMORY_EXIT_CODE = 137
+
+
+class InstanceManager:
+    def __init__(
+        self,
+        client,
+        num_workers=1,
+        num_ps=0,
+        worker_command=None,
+        ps_command=None,
+        worker_resources=None,
+        ps_resources=None,
+        tpu_resource=None,
+        restart_policy="Never",
+        task_dispatcher=None,
+        rendezvous=None,
+        envs=None,
+    ):
+        self._client = client
+        self._num_workers = num_workers
+        self._num_ps = num_ps
+        self._worker_command = worker_command or ["true"]
+        self._ps_command = ps_command or ["true"]
+        self._worker_resources = worker_resources or {}
+        self._ps_resources = ps_resources or {}
+        self._tpu_resource = tpu_resource
+        self._restart_policy = restart_policy
+        self._task_d = task_dispatcher
+        self._rendezvous = rendezvous
+        self._envs = envs or {}
+
+        self._lock = threading.Lock()
+        self._next_worker_id = itertools.count().__next__
+        # pod name -> phase; wiped on DELETED
+        self._worker_pods_phase = {}
+        self._ps_pods_phase = {}
+        # pod name -> (worker_id, start_time)
+        self._worker_pod_info = {}
+        self._relaunch_deleted_live_worker = True
+        self._relaunch_deleted_live_ps = True
+        self.all_workers_failed = False
+
+    # ------------------------------------------------------------------
+    def start_workers(self):
+        for _ in range(self._num_workers):
+            self._start_worker(self._next_worker_id())
+
+    def _start_worker(self, worker_id):
+        logger.info("Starting worker %d", worker_id)
+        command = [
+            str(c).replace("{worker_id}", str(worker_id))
+            for c in self._worker_command
+        ]
+        pod = self._client.create_worker(
+            worker_id,
+            command,
+            resource_requests=self._worker_resources,
+            tpu_resource=self._tpu_resource,
+            restart_policy=self._restart_policy,
+            env=dict(self._envs, WORKER_ID=str(worker_id)),
+        )
+        name = self._client.get_worker_pod_name(worker_id)
+        with self._lock:
+            self._worker_pods_phase[name] = "Pending"
+            self._worker_pod_info[name] = (
+                worker_id,
+                _start_time_of(pod),
+            )
+
+    def start_parameter_servers(self):
+        for ps_id in range(self._num_ps):
+            self._start_ps(ps_id)
+
+    def _start_ps(self, ps_id):
+        logger.info("Starting PS %d", ps_id)
+        command = [
+            str(c).replace("{ps_id}", str(ps_id))
+            for c in self._ps_command
+        ]
+        self._client.create_ps(
+            ps_id,
+            command,
+            resource_requests=self._ps_resources,
+            restart_policy=self._restart_policy,
+            env=dict(self._envs, PS_ID=str(ps_id)),
+        )
+        name = self._client.get_ps_pod_name(ps_id)
+        with self._lock:
+            self._ps_pods_phase[name] = "Pending"
+
+    # ------------------------------------------------------------------
+    def _event_cb(self, event_type, pod):
+        meta = pod.get("metadata", {})
+        name = meta.get("name", "")
+        labels = meta.get("labels", {})
+        replica_type = labels.get(
+            "elasticdl-tpu-replica-type", _infer_type(name)
+        )
+        if replica_type == "worker":
+            self._worker_event(event_type, name, pod)
+        elif replica_type == "ps":
+            self._ps_event(event_type, name, pod)
+
+    # -- workers -------------------------------------------------------
+    def _worker_event(self, event_type, name, pod):
+        phase = pod.get("status", {}).get("phase", "")
+        with self._lock:
+            info = self._worker_pod_info.get(name)
+        if info is None:
+            return
+        worker_id, _ = info
+        relaunch = False
+        if event_type == "MODIFIED":
+            with self._lock:
+                self._worker_pods_phase[name] = phase
+                if phase == "Running":
+                    self._worker_pod_info[name] = (
+                        worker_id,
+                        _start_time_of(pod),
+                    )
+            if phase == "Failed":
+                logger.warning("Worker pod %s failed", name)
+                self._recover(worker_id)
+                relaunch = not _was_oom_killed(pod)
+                if not relaunch:
+                    logger.warning(
+                        "Worker pod %s was OOM-killed; NOT relaunching "
+                        "(a bigger pod is an operator decision)",
+                        name,
+                    )
+                self._forget_worker(name)
+        elif event_type == "DELETED":
+            logger.warning("Worker pod %s deleted", name)
+            self._recover(worker_id)
+            relaunch = self._relaunch_deleted_live_worker and (
+                phase not in ("Succeeded",)
+            )
+            self._forget_worker(name)
+        self._update_membership()
+        if relaunch:
+            # a replacement worker gets a NEW id: the dead worker's tasks
+            # were already re-queued under the old id
+            self._start_worker(self._next_worker_id())
+            self._update_membership()
+
+    def _forget_worker(self, name):
+        with self._lock:
+            self._worker_pods_phase.pop(name, None)
+            self._worker_pod_info.pop(name, None)
+            if not self._worker_pods_phase:
+                self.all_workers_failed = True
+
+    def _recover(self, worker_id):
+        if self._task_d is not None:
+            self._task_d.recover_tasks(worker_id)
+
+    def _update_membership(self):
+        """Alive workers sorted by pod start time -> rendezvous. Rank
+        stability across scale-out is what keeps re-init cheap
+        (k8s_instance_manager.py:367-385)."""
+        if self._rendezvous is None:
+            return
+        with self._lock:
+            alive = [
+                (start, self._client.get_worker_service_address(wid))
+                for name, (wid, start) in self._worker_pod_info.items()
+                if self._worker_pods_phase.get(name) == "Running"
+            ]
+        hosts = [addr for _, addr in sorted(alive)]
+        self._rendezvous.set_worker_hosts(hosts)
+
+    # -- parameter servers ---------------------------------------------
+    def _ps_event(self, event_type, name, pod):
+        phase = pod.get("status", {}).get("phase", "")
+        ps_id = _replica_index(pod, name)
+        relaunch = False
+        if event_type == "MODIFIED":
+            with self._lock:
+                self._ps_pods_phase[name] = phase
+            if phase == "Failed":
+                relaunch = not _was_oom_killed(pod)
+        elif event_type == "DELETED":
+            with self._lock:
+                self._ps_pods_phase.pop(name, None)
+            relaunch = self._relaunch_deleted_live_ps and phase not in (
+                "Succeeded",
+            )
+        if relaunch and ps_id is not None:
+            # SAME id and service address: workers keep their partition
+            # map; parameters come back from the PS checkpoint
+            # (k8s_instance_manager.py:349-354)
+            logger.warning("Relaunching PS %d", ps_id)
+            try:
+                self._client.delete_ps(ps_id)
+            except Exception:
+                pass
+            self._start_ps(ps_id)
+
+    # ------------------------------------------------------------------
+    def worker_phases(self):
+        with self._lock:
+            return dict(self._worker_pods_phase)
+
+    def ps_phases(self):
+        with self._lock:
+            return dict(self._ps_pods_phase)
+
+    def stop_all(self):
+        with self._lock:
+            worker_ids = [
+                wid for wid, _ in self._worker_pod_info.values()
+            ]
+        for wid in worker_ids:
+            try:
+                self._client.delete_worker(wid)
+            except Exception:
+                pass
+        for ps_id in range(self._num_ps):
+            try:
+                self._client.delete_ps(ps_id)
+            except Exception:
+                pass
+
+
+def _start_time_of(pod):
+    return pod.get("status", {}).get("startTime") or ""
+
+
+def _was_oom_killed(pod):
+    """exit 137 with reason OOMKilled (k8s_instance_manager.py:289-301)."""
+    statuses = pod.get("status", {}).get("containerStatuses", []) or []
+    for cs in statuses:
+        terminated = cs.get("state", {}).get("terminated") or {}
+        if terminated.get("reason") == "OOMKilled":
+            return True
+        if (
+            terminated.get("exitCode") == _MAX_MEMORY_EXIT_CODE
+            and terminated.get("reason") is None
+        ):
+            return True
+    return False
+
+
+def _replica_index(pod, name):
+    labels = pod.get("metadata", {}).get("labels", {})
+    index = labels.get("elasticdl-tpu-replica-index")
+    if index is not None:
+        return int(index)
+    try:
+        return int(name.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return None
+
+
+def _infer_type(name):
+    if "-worker-" in name:
+        return "worker"
+    if "-ps-" in name:
+        return "ps"
+    return ""

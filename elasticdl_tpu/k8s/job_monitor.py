@@ -1,0 +1,54 @@
+"""Pod job monitor: poll one pod's phase to completion.
+
+Reference parity: elasticdl/python/common/k8s_job_monitor.py:32-80 (used
+by data-transform jobs) and the PS's exit condition — PS pods poll the
+master pod phase/label to know when to shut down
+(ps/parameter_server.py:129-153, go/pkg/common/k8s_client.go:43-59).
+"""
+
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.k8s.job_monitor")
+
+FINISHED_PHASES = ("Succeeded", "Failed")
+
+
+class PodMonitor:
+    def __init__(self, api, pod_name, poll_secs=30):
+        self._api = api
+        self._pod_name = pod_name
+        self._poll_secs = poll_secs
+
+    def pod_phase(self):
+        try:
+            pod = self._api.get_pod(self._pod_name)
+        except Exception:
+            return None  # gone counts as finished for exit purposes
+        return pod.get("status", {}).get("phase")
+
+    def pod_finished(self):
+        """True when the pod reached a terminal phase, disappeared, or —
+        matching the Go PS's check — carries a `status: Finished` label."""
+        try:
+            pod = self._api.get_pod(self._pod_name)
+        except Exception:
+            return True
+        phase = pod.get("status", {}).get("phase")
+        if phase in FINISHED_PHASES:
+            return True
+        labels = pod.get("metadata", {}).get("labels", {})
+        return labels.get("status") == "Finished"
+
+    def wait(self, timeout_secs=None):
+        """Block until finished; returns the final phase (or None)."""
+        deadline = (
+            time.time() + timeout_secs if timeout_secs else None
+        )
+        while True:
+            if self.pod_finished():
+                return self.pod_phase()
+            if deadline and time.time() > deadline:
+                return self.pod_phase()
+            time.sleep(self._poll_secs)

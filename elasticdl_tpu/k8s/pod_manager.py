@@ -1,0 +1,156 @@
+"""K8sPodManager: the Master's pod-provisioning seam, backed by K8s.
+
+Reference parity: the master building the full PS & worker container
+command lines (master/master.py:392-539) and driving InstanceManager.
+The Master object stays cluster-agnostic (tests inject fakes); this
+adapter owns the real wiring: K8sApi -> Client -> InstanceManager,
+worker/PS command marshalling from the parsed master args, and the
+status label patch PS pods poll for exit.
+"""
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.k8s.client import Client
+from elasticdl_tpu.k8s.instance_manager import InstanceManager
+
+logger = _logger_factory("elasticdl_tpu.k8s.pod_manager")
+
+_FORWARDED_WORKER_FLAGS = (
+    "model_zoo",
+    "training_data",
+    "validation_data",
+    "prediction_data",
+    "minibatch_size",
+    "data_reader_params",
+    "compute_dtype",
+    "checkpoint_dir",
+    "checkpoint_steps",
+    "keep_checkpoint_max",
+    "checkpoint_dir_for_init",
+)
+
+
+def build_worker_command(args, master_addr, ps_addrs=()):
+    """Marshal master args into the worker command line
+    (reference master.py:392-501 re-emits worker args)."""
+    command = [
+        "python",
+        "-m",
+        "elasticdl_tpu.worker.main",
+        "--master_addr=%s" % master_addr,
+        "--worker_id={worker_id}",
+    ]
+    for flag in _FORWARDED_WORKER_FLAGS:
+        value = getattr(args, flag, "")
+        if value not in ("", None, 0):  # 0 = disabled for *_steps/max
+            command.append("--%s=%s" % (flag, value))
+    if ps_addrs:
+        command.append("--ps_addrs=%s" % ",".join(ps_addrs))
+    return command
+
+
+def build_ps_command(args, master_addr, num_ps, ps_optimizer=None):
+    """Sparse host-PS command (reference marshals Go-PS -flag=value
+    style, common/args.py:231-246 and the optimizer into opt_type/
+    opt_args via model introspection, model_utils.py:234-261; ours is
+    the C++-backed python PS and the model declares ps_optimizer())."""
+    command = [
+        "python",
+        "-m",
+        "elasticdl_tpu.ps.server",
+        "--ps_id={ps_id}",
+        "--num_ps_pods=%d" % num_ps,
+        "--master_addr=%s" % master_addr,
+    ]
+    if ps_optimizer is not None:
+        opt_type, opt_args = ps_optimizer
+        command.append("--opt_type=%s" % opt_type)
+        if opt_args:
+            command.append("--opt_args=%s" % opt_args)
+    for flag in (
+        "checkpoint_dir",
+        "checkpoint_steps",
+        "keep_checkpoint_max",
+        "checkpoint_dir_for_init",
+    ):
+        value = getattr(args, flag, "")
+        if value not in ("", None, 0):
+            command.append("--%s=%s" % (flag, value))
+    return command
+
+
+class K8sPodManager:
+    """Implements the Master's pod_manager protocol: start/stop,
+    all_workers_failed, on_worker_presumed_dead."""
+
+    def __init__(
+        self,
+        args,
+        task_dispatcher,
+        rendezvous,
+        api=None,
+        worker_resources=None,
+        ps_resources=None,
+        tpu_resource=None,
+        envs=None,
+    ):
+        if api is None:
+            from elasticdl_tpu.k8s.api import K8sApi
+
+            api = K8sApi()
+        self._client = Client(
+            api,
+            args.job_name,
+            image_name=getattr(args, "image_name", ""),
+            event_callback=self._event_cb,
+        )
+        master_addr = self._client.get_master_service_address()
+        num_ps = getattr(args, "num_ps_pods", 0)
+        ps_addrs = [
+            self._client.get_ps_service_address(i) for i in range(num_ps)
+        ]
+        self._manager = InstanceManager(
+            self._client,
+            num_workers=getattr(args, "num_workers", 1),
+            num_ps=num_ps,
+            worker_command=build_worker_command(
+                args, master_addr, ps_addrs
+            ),
+            ps_command=build_ps_command(args, master_addr, num_ps),
+            worker_resources=worker_resources,
+            ps_resources=ps_resources,
+            tpu_resource=tpu_resource,
+            task_dispatcher=task_dispatcher,
+            rendezvous=rendezvous,
+            envs=envs,
+        )
+
+    def _event_cb(self, event_type, pod):
+        self._manager._event_cb(event_type, pod)
+
+    # -- Master protocol ----------------------------------------------
+    def start(self):
+        self._manager.start_parameter_servers()
+        self._manager.start_workers()
+
+    def stop(self):
+        self._client.stop_watch()
+        try:
+            # PS pods poll this label to know the job is over
+            # (ps/parameter_server.py:129-153)
+            self._client.update_master_status_label("Finished")
+        except Exception:
+            logger.warning("could not patch master status label")
+
+    def all_workers_failed(self):
+        return self._manager.all_workers_failed
+
+    def on_worker_presumed_dead(self, worker_id):
+        """Liveness-timeout kill: reclaim the pod so K8s emits the
+        DELETED event that relaunches a replacement (the reference's
+        timeout scanner removes the worker, master.py:550-572)."""
+        try:
+            self._client.delete_worker(worker_id)
+        except Exception:
+            logger.warning(
+                "presumed-dead worker %s already gone", worker_id
+            )

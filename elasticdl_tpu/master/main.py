@@ -11,6 +11,8 @@ from elasticdl_tpu.master.master import Master
 
 
 def main(argv=None):
+    import os
+
     from elasticdl_tpu.common.platform import apply_platform_overrides
 
     apply_platform_overrides()
@@ -29,6 +31,17 @@ def main(argv=None):
         saved_model_path=args.output,
         task_timeout_secs=args.task_timeout_secs,
     )
+    if args.job_name and os.environ.get("KUBERNETES_SERVICE_HOST"):
+        # in-cluster: provision and heal worker/PS pods
+        from elasticdl_tpu.client.args import parse_envs_string
+        from elasticdl_tpu.k8s.pod_manager import K8sPodManager
+
+        master.pod_manager = K8sPodManager(
+            args,
+            master.task_dispatcher,
+            master.rendezvous,
+            envs=parse_envs_string(args.envs),
+        )
     master.prepare()
     return master.run()
 

@@ -39,6 +39,7 @@ class ModelSpec:
         sparse_embedding_specs=None,
         batch_spec=None,
         mesh_config=None,
+        ps_optimizer=None,
         module=None,
     ):
         self.custom_model = custom_model
@@ -59,6 +60,10 @@ class ModelSpec:
         # (num_devices) -> MeshConfig: the model's preferred mesh
         # topology (TPU addition: a tp/sp model picks its axis split)
         self.mesh_config = mesh_config
+        # () -> (opt_type, "k=v;k=v") for the sparse host-PS optimizer
+        # (the reference introspects the Keras optimizer instead,
+        # common/model_utils.py:234-261 get_optimizer_info)
+        self.ps_optimizer = ps_optimizer
         self.module = module
 
 
@@ -106,5 +111,6 @@ def get_model_spec(module_path_or_name) -> ModelSpec:
         ),
         batch_spec=_resolve(module, "batch_spec", required=False),
         mesh_config=_resolve(module, "mesh_config", required=False),
+        ps_optimizer=_resolve(module, "ps_optimizer", required=False),
         module=module,
     )

@@ -1,0 +1,266 @@
+"""K8s layer tests over a fake API (no cluster), mirroring the
+reference's minikube-gated tier (SURVEY.md §4 tier 3) as in-process
+fakes: instance-manager recovery semantics, pod/service manifests,
+job-monitor phases, CLI dry-run round-trip into the master parser.
+"""
+
+import queue
+
+import pytest
+import yaml
+
+from elasticdl_tpu.client import main as client_main
+from elasticdl_tpu.common.args import parse_master_args
+from elasticdl_tpu.k8s.client import Client
+from elasticdl_tpu.k8s.instance_manager import InstanceManager
+from elasticdl_tpu.k8s.job_monitor import PodMonitor
+from elasticdl_tpu.master.rendezvous import MeshRendezvous
+
+
+class FakeApi:
+    """In-memory stand-in implementing the K8sApi surface."""
+
+    def __init__(self, namespace="default"):
+        self.namespace = namespace
+        self.pods = {}
+        self.services = {}
+        self.events = queue.Queue()
+
+    def create_pod(self, manifest):
+        name = manifest["metadata"]["name"]
+        if name in self.pods:
+            raise RuntimeError("pod %s exists" % name)
+        manifest.setdefault("status", {"phase": "Pending"})
+        self.pods[name] = manifest
+        return manifest
+
+    def delete_pod(self, name, grace_period_seconds=0):
+        return self.pods.pop(name, {})
+
+    def get_pod(self, name):
+        if name not in self.pods:
+            raise RuntimeError("pod %s not found" % name)
+        return self.pods[name]
+
+    def patch_pod_labels(self, name, labels):
+        self.pods[name]["metadata"].setdefault("labels", {}).update(labels)
+        return self.pods[name]
+
+    def create_service(self, manifest):
+        self.services[manifest["metadata"]["name"]] = manifest
+        return manifest
+
+    def delete_service(self, name):
+        return self.services.pop(name, {})
+
+    def watch_pods(self, label_selector=None, timeout_seconds=None):
+        while True:
+            item = self.events.get()
+            if item is None:
+                return
+            yield item
+
+
+class FakeDispatcher:
+    def __init__(self):
+        self.recovered = []
+
+    def recover_tasks(self, worker_id):
+        self.recovered.append(worker_id)
+
+
+def _manager(api, num_workers=2, num_ps=1, rendezvous=None):
+    client = Client(api, "job1", image_name="img:latest")
+    dispatcher = FakeDispatcher()
+    manager = InstanceManager(
+        client,
+        num_workers=num_workers,
+        num_ps=num_ps,
+        worker_command=["python", "-m", "worker", "--worker_id={worker_id}"],
+        ps_command=["python", "-m", "ps", "--ps_id={ps_id}"],
+        task_dispatcher=dispatcher,
+        rendezvous=rendezvous,
+    )
+    return client, dispatcher, manager
+
+
+def _running(pod, start_time="t0"):
+    pod["status"] = {"phase": "Running", "startTime": start_time}
+    return pod
+
+
+def test_manifests_and_services():
+    api = FakeApi()
+    client, dispatcher, manager = _manager(api)
+    manager.start_workers()
+    manager.start_parameter_servers()
+    assert set(api.pods) == {
+        "elasticdl-job1-worker-0",
+        "elasticdl-job1-worker-1",
+        "elasticdl-job1-ps-0",
+    }
+    # per-pod services with stable DNS names (reference k8s_client.py:29-31)
+    assert set(api.services) == set(api.pods)
+    pod = api.pods["elasticdl-job1-worker-0"]
+    assert pod["spec"]["containers"][0]["command"][-1] == "--worker_id=0"
+    labels = pod["metadata"]["labels"]
+    assert labels["elasticdl-tpu-job-name"] == "job1"
+    assert labels["elasticdl-tpu-replica-type"] == "worker"
+    assert client.get_ps_service_address(0).startswith(
+        "elasticdl-job1-ps-0.default.svc:"
+    )
+
+
+def test_worker_failure_recovers_tasks_and_relaunches():
+    api = FakeApi()
+    client, dispatcher, manager = _manager(api, num_workers=2, num_ps=0)
+    manager.start_workers()
+    pod = api.pods["elasticdl-job1-worker-0"]
+    pod["status"] = {
+        "phase": "Failed",
+        "containerStatuses": [
+            {"state": {"terminated": {"exitCode": 1, "reason": "Error"}}}
+        ],
+    }
+    manager._event_cb("MODIFIED", pod)
+    # dead worker's tasks re-queued under its id; replacement has NEW id
+    assert dispatcher.recovered == [0]
+    assert "elasticdl-job1-worker-2" in api.pods
+    assert "elasticdl-job1-worker-0" not in manager.worker_phases()
+
+
+def test_oom_killed_worker_not_relaunched():
+    api = FakeApi()
+    client, dispatcher, manager = _manager(api, num_workers=1, num_ps=0)
+    manager.start_workers()
+    pod = api.pods["elasticdl-job1-worker-0"]
+    pod["status"] = {
+        "phase": "Failed",
+        "containerStatuses": [
+            {
+                "state": {
+                    "terminated": {"exitCode": 137, "reason": "OOMKilled"}
+                }
+            }
+        ],
+    }
+    manager._event_cb("MODIFIED", pod)
+    assert dispatcher.recovered == [0]  # tasks still recovered
+    assert len(api.pods) == 1  # no replacement pod
+    assert manager.all_workers_failed
+
+
+def test_ps_relaunch_keeps_id_and_address():
+    api = FakeApi()
+    client, dispatcher, manager = _manager(api, num_workers=0, num_ps=2)
+    manager.start_parameter_servers()
+    pod = api.pods["elasticdl-job1-ps-1"]
+    pod["status"] = {
+        "phase": "Failed",
+        "containerStatuses": [
+            {"state": {"terminated": {"exitCode": 1, "reason": "Error"}}}
+        ],
+    }
+    manager._event_cb("MODIFIED", pod)
+    # same pod name = same service address (k8s_instance_manager.py:349-354)
+    assert "elasticdl-job1-ps-1" in api.pods
+    assert api.pods["elasticdl-job1-ps-1"]["status"]["phase"] == "Pending"
+
+
+def test_membership_feeds_rendezvous_sorted_by_start_time():
+    api = FakeApi()
+    rendezvous = MeshRendezvous()
+    client, dispatcher, manager = _manager(
+        api, num_workers=2, num_ps=0, rendezvous=rendezvous
+    )
+    manager.start_workers()
+    # worker 1 started earlier than worker 0
+    manager._event_cb(
+        "MODIFIED", _running(api.pods["elasticdl-job1-worker-1"], "t1")
+    )
+    manager._event_cb(
+        "MODIFIED", _running(api.pods["elasticdl-job1-worker-0"], "t2")
+    )
+    epoch_before = rendezvous.mesh_epoch
+    assert rendezvous.hosts() == [
+        client.get_worker_service_address(1),
+        client.get_worker_service_address(0),
+    ]
+    # a death bumps the mesh epoch and shrinks the host list
+    pod = api.pods["elasticdl-job1-worker-1"]
+    pod["status"]["phase"] = "Failed"
+    pod["status"]["containerStatuses"] = [
+        {"state": {"terminated": {"exitCode": 1, "reason": "Error"}}}
+    ]
+    manager._event_cb("MODIFIED", pod)
+    assert rendezvous.mesh_epoch > epoch_before
+    assert client.get_worker_service_address(0) in rendezvous.hosts()
+
+
+def test_job_monitor_phases():
+    api = FakeApi()
+    api.create_pod(
+        {"metadata": {"name": "m", "labels": {}}, "status": {"phase": "Running"}}
+    )
+    monitor = PodMonitor(api, "m", poll_secs=0)
+    assert not monitor.pod_finished()
+    api.pods["m"]["status"]["phase"] = "Succeeded"
+    assert monitor.pod_finished()
+    # Finished label counts too (Go PS exit check)
+    api.pods["m"]["status"]["phase"] = "Running"
+    api.patch_pod_labels("m", {"status": "Finished"})
+    assert monitor.pod_finished()
+    # vanished pod counts as finished
+    api.delete_pod("m")
+    assert monitor.pod_finished()
+
+
+def test_cli_dry_run_roundtrips_master_args(tmp_path, capsys):
+    out_yaml = tmp_path / "master.yaml"
+    client_main.main(
+        [
+            "train",
+            "--job_name=census1",
+            "--image_name=registry/edl:latest",
+            "--model_zoo=elasticdl_tpu.models.census_wide_deep",
+            "--training_data=/data/train",
+            "--validation_data=/data/valid",
+            "--num_workers=4",
+            "--num_ps_pods=2",
+            "--minibatch_size=128",
+            "--num_epochs=3",
+            "--evaluation_steps=100",
+            "--checkpoint_dir=/ckpt",
+            "--checkpoint_steps=50",
+            "--tpu_resource=google.com/tpu=8",
+            "--mesh=dp=4,fsdp=2",
+            "--volume=claim_name=data-pvc,mount_path=/data",
+            "--envs=A=1,B=x",
+            "--yaml=%s" % out_yaml,
+        ]
+    )
+    manifest = yaml.safe_load(out_yaml.read_text())
+    assert manifest["kind"] == "Pod"
+    assert manifest["metadata"]["name"] == "elasticdl-census1-master"
+    command = manifest["spec"]["containers"][0]["command"]
+    assert command[:3] == ["python", "-m", "elasticdl_tpu.master.main"]
+    # the forwarded command line must parse cleanly master-side with the
+    # values intact (reference args.py:543-565 round trip)
+    master_parsed = parse_master_args(command[3:])
+    assert master_parsed.model_zoo == "elasticdl_tpu.models.census_wide_deep"
+    assert master_parsed.num_workers == 4
+    assert master_parsed.num_ps_pods == 2
+    assert master_parsed.minibatch_size == 128
+    assert master_parsed.checkpoint_steps == 50
+    assert master_parsed.mesh == "dp=4,fsdp=2"
+    # volume landed in the pod spec
+    mounts = manifest["spec"]["containers"][0]["volumeMounts"]
+    assert mounts[0]["mountPath"] == "/data"
+
+
+def test_cli_zoo_init(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    client_main.main(["zoo", "init", "--base_image=python:3.12-slim"])
+    dockerfile = (tmp_path / "Dockerfile").read_text()
+    assert "FROM python:3.12-slim" in dockerfile
+    assert "COPY . /model_zoo" in dockerfile
