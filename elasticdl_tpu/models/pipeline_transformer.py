@@ -1,0 +1,191 @@
+"""Pipeline-parallel transformer LM.
+
+No reference counterpart (the reference has no layer pipelining —
+SURVEY.md §2.12 lists PP as absent); this family exercises the ``pp``
+mesh axis: transformer blocks are pipeline *stages* whose stacked
+parameters shard ``P("pp")`` over the mesh, and the forward runs the
+GPipe microbatch schedule in :mod:`elasticdl_tpu.parallel.pipeline`.
+
+The model is a plain (non-flax) class implementing the framework's model
+contract — ``init(rng, features) -> variables`` / ``apply(variables,
+features, training=, rngs=)`` — because the stage loop lives in a
+``shard_map`` that flax's module system has no idiom for; the embed /
+final-norm / head pieces and the per-stage Block remain ordinary flax
+modules so their params initialize identically to TransformerLM's.
+
+Dropout is intentionally unsupported here (stage rng plumbing through the
+pipeline schedule isn't worth the complexity; the reference's models
+don't regularize via dropout either).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.models import transformer
+from elasticdl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from elasticdl_tpu.parallel.sharding import ShardingRules
+from jax.sharding import PartitionSpec as P
+
+
+class PipelinedTransformerLM:
+    """Decoder-only LM with blocks partitioned into pipeline stages.
+
+    ``layers_per_stage`` blocks run sequentially inside each stage;
+    ``num_stages`` must equal the mesh's ``pp`` extent (or 1 when no mesh
+    is given — pure sequential fallback for single-chip runs).
+    """
+
+    def __init__(
+        self,
+        vocab_size=32000,
+        num_stages=4,
+        layers_per_stage=1,
+        num_heads=8,
+        embed_dim=512,
+        mlp_ratio=4,
+        num_microbatches=4,
+        attention_impl="auto",
+        mesh=None,
+    ):
+        self.vocab_size = vocab_size
+        self.num_stages = num_stages
+        self.layers_per_stage = layers_per_stage
+        self.num_microbatches = num_microbatches
+        self.mesh = mesh
+        self.embed_dim = embed_dim
+        self._wte = nn.Embed(vocab_size, embed_dim, name="wte")
+        self._ln_f = nn.LayerNorm(name="ln_f")
+        self._head = nn.Dense(vocab_size, use_bias=False, name="lm_head")
+        self._block = transformer.Block(
+            num_heads,
+            mlp_ratio=mlp_ratio,
+            attention_impl=attention_impl,
+            mesh=mesh,
+        )
+
+    # -- model contract ------------------------------------------------
+    def init(self, rng, tokens, training=False, rngs=None):
+        del training, rngs
+        n_blocks = self.num_stages * self.layers_per_stage
+        keys = jax.random.split(rng, n_blocks + 3)
+        wte = self._wte.init(keys[0], jnp.asarray(tokens, jnp.int32))
+        x = self._wte.apply(wte, jnp.asarray(tokens, jnp.int32))
+        block_params = []
+        for i in range(n_blocks):
+            variables = self._block.init(keys[1 + i], x, training=False)
+            block_params.append(variables["params"])
+        # Stage axis (num_stages) outermost, per-stage layer axis second:
+        # leaves are (S, L, ...).
+        stages = [
+            stack_stage_params(
+                block_params[
+                    s * self.layers_per_stage : (s + 1)
+                    * self.layers_per_stage
+                ]
+            )
+            for s in range(self.num_stages)
+        ]
+        stacked = stack_stage_params(stages)
+        ln_f = self._ln_f.init(keys[-2], x)
+        head = self._head.init(keys[-1], x)
+        return {
+            "params": {
+                "wte": wte["params"],
+                "blocks": stacked,
+                "ln_f": ln_f["params"],
+                "lm_head": head["params"],
+            }
+        }
+
+    def apply(self, variables, tokens, training=False, rngs=None):
+        del rngs
+        params = variables["params"]
+        x = self._wte.apply(
+            {"params": params["wte"]}, jnp.asarray(tokens, jnp.int32)
+        )
+
+        def stage_fn(stage_params, h):
+            def layer(carry, layer_params):
+                out = self._block.apply(
+                    {"params": layer_params}, carry, training=training
+                )
+                return out, None
+
+            h, _ = jax.lax.scan(layer, h, stage_params)
+            return h
+
+        if self.mesh is None:
+            # Single-chip sequential fallback.
+            def all_stages(carry, stage_params):
+                return stage_fn(stage_params, carry), None
+
+            x, _ = jax.lax.scan(all_stages, x, params["blocks"])
+        else:
+            # pipeline_apply validates num_stages against the mesh's pp
+            # extent and runs every stage sequentially when pp == 1.
+            x = pipeline_apply(
+                stage_fn,
+                params["blocks"],
+                x,
+                num_microbatches=self.num_microbatches,
+                mesh=self.mesh,
+            )
+        x = self._ln_f.apply({"params": params["ln_f"]}, x)
+        return self._head.apply({"params": params["lm_head"]}, x)
+
+
+def pipeline_sharding_rules():
+    """Stage axis over pp; within-stage tensor parallelism composes by
+    prepending (pp, layer) to the TransformerLM TP specs. Blocks leaves
+    are (S, L, *param_shape)."""
+    return ShardingRules(
+        rules=[
+            (
+                r"blocks/.*(query|key|value)/kernel$",
+                P("pp", None, "fsdp", "tp", None),
+            ),
+            (r"blocks/.*out_proj/kernel$", P("pp", None, "tp", None, "fsdp")),
+            (r"blocks/.*mlp_up/kernel$", P("pp", None, "fsdp", "tp")),
+            (r"blocks/.*mlp_down/kernel$", P("pp", None, "tp", "fsdp")),
+            (r"^blocks/", P("pp")),
+            (r"wte/embedding$", P(None, "fsdp")),
+            (r"lm_head/kernel$", P("fsdp", None)),
+            (r".*", P()),
+        ],
+        default_spec=P(),
+    )
+
+
+# -- model-zoo contract -----------------------------------------------------
+
+def mesh_config(num_devices):
+    from elasticdl_tpu.parallel.mesh import MeshConfig
+
+    pp = 4 if num_devices % 4 == 0 else (2 if num_devices % 2 == 0 else 1)
+    return MeshConfig(dp=num_devices // pp, pp=pp)
+
+
+def custom_model(mesh=None):
+    total_layers = 12
+    num_stages = 1
+    if mesh is not None:
+        num_stages = mesh.shape.get("pp", 1)
+    return PipelinedTransformerLM(
+        vocab_size=32000,
+        num_stages=max(num_stages, 1),
+        layers_per_stage=max(1, total_layers // max(num_stages, 1)),
+        num_heads=12,
+        embed_dim=768,
+        mesh=mesh,
+    )
+
+
+loss = transformer.loss
+optimizer = transformer.optimizer
+dataset_fn = transformer.dataset_fn
+eval_metrics_fn = transformer.eval_metrics_fn
+
+
+def sharding_rules():
+    return pipeline_sharding_rules()

@@ -185,9 +185,13 @@ def batch_spec():
 # ---------------------------------------------------------------------------
 
 
-def custom_model():
+def custom_model(mesh=None):
     return TransformerLM(
-        vocab_size=32000, num_layers=12, num_heads=12, embed_dim=768
+        vocab_size=32000,
+        num_layers=12,
+        num_heads=12,
+        embed_dim=768,
+        mesh=mesh,
     )
 
 

@@ -133,8 +133,19 @@ def ring_attention(
         batch, heads = q_loc.shape[0], q_loc.shape[1]
         # Literal-zero inits are "unvarying" in shard_map's VMA typing
         # while the scan outputs vary per device; pvary reconciles them.
+        # Vary only over the axes the in/out spec mentions: axes absent
+        # from the spec (e.g. pp/ep) must stay unvarying or the out-spec
+        # check rejects the result.
+        spec_axes = []
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                spec_axes.extend(entry)
+            else:
+                spec_axes.append(entry)
         vary = lambda x: jax.lax.pcast(
-            x, tuple(mesh.axis_names), to="varying"
+            x, tuple(spec_axes), to="varying"
         )
         init = (
             vary(jnp.full((batch, heads, seq_loc), NEG_INF, jnp.float32)),

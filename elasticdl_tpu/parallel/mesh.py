@@ -6,8 +6,10 @@ by gRPC; its only "mesh" is the Horovod ring. On TPU the topology is a
 
 - ``dp``   — pure data parallelism (params replicated)
 - ``fsdp`` — data parallelism with parameter/optimizer sharding (ZeRO)
+- ``pp``   — pipeline parallelism (stage-sharded layer stacks)
 - ``tp``   — tensor parallelism (within-layer sharding)
 - ``sp``   — sequence/context parallelism (ring attention)
+- ``ep``   — expert parallelism (MoE expert-sharded FFNs)
 
 Axis sizes multiply to the device count. Defaults put every device on
 ``dp`` (the reference's data-parallel-only world); model code opts into
@@ -21,7 +23,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
 # Batch is sharded over both flavors of data parallelism.
 DATA_AXES = ("dp", "fsdp")
 
@@ -30,8 +32,10 @@ DATA_AXES = ("dp", "fsdp")
 class MeshConfig:
     dp: int = -1  # -1: absorb remaining devices
     fsdp: int = 1
+    pp: int = 1
     tp: int = 1
     sp: int = 1
+    ep: int = 1
     devices: list = field(default_factory=list)
 
     def resolve(self, num_devices=None):
@@ -39,25 +43,27 @@ class MeshConfig:
         if num_devices is not None:
             devices = devices[:num_devices]
         n = len(devices)
-        fixed = self.fsdp * self.tp * self.sp
+        fixed = self.fsdp * self.pp * self.tp * self.sp * self.ep
         dp = self.dp
         if dp == -1:
             if n % fixed != 0:
                 raise ValueError(
-                    "%d devices not divisible by fsdp*tp*sp=%d" % (n, fixed)
+                    "%d devices not divisible by fsdp*pp*tp*sp*ep=%d"
+                    % (n, fixed)
                 )
             dp = n // fixed
         if dp * fixed != n:
             raise ValueError(
-                "Mesh %dx%dx%dx%d != %d devices"
-                % (dp, self.fsdp, self.tp, self.sp, n)
+                "Mesh %dx%dx%dx%dx%dx%d != %d devices"
+                % (dp, self.fsdp, self.pp, self.tp, self.sp, self.ep, n)
             )
-        return dp, self.fsdp, self.tp, self.sp, devices
+        return (dp, self.fsdp, self.pp, self.tp, self.sp, self.ep, devices)
 
 
 def build_mesh(config: MeshConfig = None, num_devices=None) -> Mesh:
     config = config or MeshConfig()
-    dp, fsdp, tp, sp, devices = config.resolve(num_devices)
+    *shape, devices = config.resolve(num_devices)
+    shape = tuple(shape)
     try:
         # Topology-aware placement: on a real TPU slice this assigns mesh
         # neighbors to ICI torus neighbors so GSPMD collectives ride
@@ -65,12 +71,12 @@ def build_mesh(config: MeshConfig = None, num_devices=None) -> Mesh:
         from jax.experimental import mesh_utils
 
         device_array = mesh_utils.create_device_mesh(
-            (dp, fsdp, tp, sp), devices=devices
+            shape, devices=devices
         )
     except Exception:
         # Fallback (virtual CPU devices, unusual shapes): enumeration
         # order — correct, just not topology-optimal.
-        device_array = np.array(devices).reshape(dp, fsdp, tp, sp)
+        device_array = np.array(devices).reshape(shape)
     return Mesh(device_array, AXES)
 
 

@@ -1,0 +1,177 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+No reference counterpart: the reference's only model-parallel axis is the
+embedding-id axis across PS pods (SURVEY.md §2.12, worker/ps_client.py
+id-mod routing); layer pipelining is a new TPU-first capability, designed
+the XLA way rather than as a port of any NCCL send/recv schedule.
+
+Design (GPipe schedule, expressed as shard_map + scan + ppermute):
+
+- Stage parameters are *stacked* on a leading stage axis and sharded
+  ``P("pp")`` over the mesh, so each device holds exactly its stage's
+  weights — the pipeline analogue of ZeRO's "shard the layer stack".
+- The global batch is microbatched locally on each data-parallel shard.
+  One ``lax.scan`` runs ``M + S - 1`` ticks; every tick each device
+  applies its stage to whatever activation it holds and ``ppermute``s the
+  result one hop toward the next stage. Stage 0 feeds fresh microbatches
+  in; the last stage masks finished microbatches into an output buffer.
+- Everything is differentiable (``ppermute`` has a transpose rule and the
+  schedule is data-independent), so the same function serves forward and
+  backward — XLA schedules the reverse pipeline automatically.
+
+Composability: the ``pp`` loop is agnostic to what the stage computes, so
+stages may internally use tensor-parallel kernels (``tp``) or sequence-
+parallel attention (``sp``); the batch stays sharded over dp/fsdp
+throughout because the schedule below is per-data-shard.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.parallel.mesh import DATA_AXES
+
+
+def stack_stage_params(stage_params_list):
+    """Stack per-stage parameter pytrees on a new leading stage axis."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *stage_params_list
+    )
+
+
+def unstack_stage_params(stacked, num_stages):
+    """Inverse of :func:`stack_stage_params` (host-side, for export)."""
+    return [
+        jax.tree_util.tree_map(lambda leaf: leaf[i], stacked)
+        for i in range(num_stages)
+    ]
+
+
+def pipeline_spec(leaf=None):
+    """PartitionSpec for stacked stage params: stage axis over ``pp``."""
+    return P("pp")
+
+
+def pipeline_apply(
+    stage_fn,
+    stacked_params,
+    x,
+    num_microbatches,
+    mesh,
+    axis="pp",
+    batch_spec=None,
+    remat=True,
+):
+    """Run ``x`` through a stack of pipeline stages.
+
+    Args:
+      stage_fn: ``(stage_params, activations) -> activations`` — one
+        stage's computation on a (microbatch, ...) activation block. Must
+        preserve the activation shape (homogeneous stages).
+      stacked_params: pytree whose leaves carry a leading stage axis of
+        size ``mesh.shape[axis]``, laid out ``P(axis)``.
+      x: global batch ``(batch, ...)``, batch dim sharded over dp/fsdp
+        and replicated over ``axis``.
+      num_microbatches: pipeline depth M; each data shard's rows are
+        split into M microbatches (local batch must divide evenly).
+      batch_spec: PartitionSpec of ``x`` (default: dim 0 over dp/fsdp).
+
+    Returns the stacked stages' output with the same shape/sharding as
+    ``x`` would have after ``S`` sequential stage applications.
+    """
+    num_stages = mesh.shape[axis]
+    stage_axis_sizes = {
+        leaf.shape[0] for leaf in jax.tree_util.tree_leaves(stacked_params)
+    }
+    if len(stage_axis_sizes) != 1:
+        raise ValueError(
+            "Inconsistent stage-axis sizes in stacked params: %s"
+            % sorted(stage_axis_sizes)
+        )
+    (stacked_size,) = stage_axis_sizes
+    if num_stages == 1:
+        # Degenerate pipeline: sequential application of every stacked
+        # stage, no collectives.
+        def body(carry, stage_params):
+            return stage_fn(stage_params, carry), None
+
+        out, _ = jax.lax.scan(body, x, stacked_params)
+        return out
+    if stacked_size != num_stages:
+        raise ValueError(
+            "Stacked stage axis (%d) must equal the mesh's %s extent (%d)"
+            % (stacked_size, axis, num_stages)
+        )
+
+    spec = batch_spec if batch_spec is not None else P(DATA_AXES)
+    param_specs = jax.tree_util.tree_map(
+        lambda _: pipeline_spec(), stacked_params
+    )
+    M = num_microbatches
+
+
+    def local_fn(params_loc, x_loc):
+        # Local stage params: shard_map leaves a unit stage axis.
+        params = jax.tree_util.tree_map(
+            lambda leaf: jax.lax.squeeze(leaf, (0,)), params_loc
+        )
+        idx = jax.lax.axis_index(axis)
+        batch_loc = x_loc.shape[0]
+        if batch_loc % M != 0:
+            raise ValueError(
+                "Local batch %d not divisible by %d microbatches"
+                % (batch_loc, M)
+            )
+        x_mb = x_loc.reshape((M, batch_loc // M) + x_loc.shape[1:])
+
+        # Activation buffers derived from x_loc already vary over the
+        # batch axes; each stage additionally computes different values,
+        # so add ``pp`` to the varying set (shard_map VMA typing).
+        vary = lambda v: jax.lax.pcast(v, (axis,), to="varying")
+        # Forward one hop toward the next stage; stage 0 receives zeros
+        # (it reads fresh microbatches instead).
+        perm = [(j, j + 1) for j in range(num_stages - 1)]
+
+        def tick(carry, t):
+            recv, outputs = carry
+            inp = jnp.where(
+                idx == 0,
+                jax.lax.dynamic_index_in_dim(
+                    x_mb, jnp.minimum(t, M - 1), 0, keepdims=False
+                ),
+                recv,
+            )
+            out = stage_fn(params, inp)
+            # The microbatch leaving the last stage at tick t entered the
+            # pipeline at tick t - (S - 1).
+            m = t - (num_stages - 1)
+            write = jnp.logical_and(idx == num_stages - 1, m >= 0)
+            slot = jnp.clip(m, 0, M - 1)
+            current = jax.lax.dynamic_index_in_dim(
+                outputs, slot, 0, keepdims=False
+            )
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(write, out, current), slot, 0
+            )
+            recv = jax.lax.ppermute(out, axis, perm)
+            return (recv, outputs), None
+
+        tick_fn = jax.checkpoint(tick) if remat else tick
+        init = (
+            vary(jnp.zeros_like(x_mb[0])),
+            vary(jnp.zeros_like(x_mb)),
+        )
+        (_, outputs), _ = jax.lax.scan(
+            tick_fn, init, jnp.arange(M + num_stages - 1)
+        )
+        # Only the last stage holds real outputs (others are zeros);
+        # psum over pp replicates the result onto every stage.
+        outputs = jax.lax.psum(outputs, axis)
+        return outputs.reshape((batch_loc,) + x_loc.shape[1:])
+
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(param_specs, spec),
+        out_specs=spec,
+    )(stacked_params, x)

@@ -64,7 +64,6 @@ class Worker:
             master_client, data_reader, wait_sleep_secs=wait_sleep_secs
         )
         trainer_kwargs = dict(
-            model=self.spec.custom_model(),
             loss_fn=self.spec.loss,
             optimizer=self.spec.optimizer(),
             compute_dtype=compute_dtype,
@@ -102,13 +101,28 @@ class Worker:
             trainer_kwargs["sharding_rules"] = self.spec.sharding_rules()
         if "batch_spec" in factory_params and self.spec.batch_spec:
             trainer_kwargs["batch_spec"] = self.spec.batch_spec()
-        if "mesh_config" in factory_params:
+        mesh = None
+        if "mesh_config" in factory_params or "mesh" in factory_params:
             if mesh_config is None and self.spec.mesh_config:
                 import jax
 
                 mesh_config = self.spec.mesh_config(jax.device_count())
             if mesh_config is not None:
-                trainer_kwargs["mesh_config"] = mesh_config
+                if "mesh" in factory_params:
+                    from elasticdl_tpu.parallel.mesh import build_mesh
+
+                    mesh = build_mesh(mesh_config)
+                    trainer_kwargs["mesh"] = mesh
+                else:
+                    trainer_kwargs["mesh_config"] = mesh_config
+        # Mesh-aware models (pipeline stages over pp, ring attention over
+        # sp) take the mesh at construction so their internal shard_map
+        # schedules target the same mesh the trainer shards over.
+        model_params = inspect.signature(self.spec.custom_model).parameters
+        if "mesh" in model_params:
+            trainer_kwargs["model"] = self.spec.custom_model(mesh=mesh)
+        else:
+            trainer_kwargs["model"] = self.spec.custom_model()
         self.trainer = factory(**trainer_kwargs)
         self.state = None
         self.stop_training = False
