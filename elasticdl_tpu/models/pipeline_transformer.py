@@ -6,6 +6,13 @@ mesh axis: transformer blocks are pipeline *stages* whose stacked
 parameters shard ``P("pp")`` over the mesh, and the forward runs the
 GPipe microbatch schedule in :mod:`elasticdl_tpu.parallel.pipeline`.
 
+Parameter layout is topology-independent: blocks are stored as one flat
+``(num_layers, ...)`` stack regardless of the mesh, and ``apply``
+reshapes to ``(num_stages, layers_per_stage, ...)`` inside the jitted
+step. A checkpoint written on a pp=4 mesh therefore restores bit-for-bit
+onto pp=2 or a single chip (the elastic-resume contract the dense
+checkpoint path promises).
+
 The model is a plain (non-flax) class implementing the framework's model
 contract — ``init(rng, features) -> variables`` / ``apply(variables,
 features, training=, rngs=)`` — because the stage loop lives in a
@@ -31,16 +38,18 @@ from jax.sharding import PartitionSpec as P
 class PipelinedTransformerLM:
     """Decoder-only LM with blocks partitioned into pipeline stages.
 
-    ``layers_per_stage`` blocks run sequentially inside each stage;
-    ``num_stages`` must equal the mesh's ``pp`` extent (or 1 when no mesh
-    is given — pure sequential fallback for single-chip runs).
+    ``num_layers`` total blocks are split evenly across ``num_stages``
+    pipeline stages; ``num_stages`` must equal the mesh's ``pp`` extent
+    (or 1 when no mesh is given — pure sequential fallback for
+    single-chip runs) and must divide ``num_layers`` exactly — the model
+    never silently changes depth to fit a mesh.
     """
 
     def __init__(
         self,
         vocab_size=32000,
+        num_layers=4,
         num_stages=4,
-        layers_per_stage=1,
         num_heads=8,
         embed_dim=512,
         mlp_ratio=4,
@@ -48,9 +57,15 @@ class PipelinedTransformerLM:
         attention_impl="auto",
         mesh=None,
     ):
+        if num_layers % num_stages != 0:
+            raise ValueError(
+                "num_layers=%d is not divisible by num_stages=%d; "
+                "refusing to silently change model depth"
+                % (num_layers, num_stages)
+            )
         self.vocab_size = vocab_size
+        self.num_layers = num_layers
         self.num_stages = num_stages
-        self.layers_per_stage = layers_per_stage
         self.num_microbatches = num_microbatches
         self.mesh = mesh
         self.embed_dim = embed_dim
@@ -67,26 +82,16 @@ class PipelinedTransformerLM:
     # -- model contract ------------------------------------------------
     def init(self, rng, tokens, training=False, rngs=None):
         del training, rngs
-        n_blocks = self.num_stages * self.layers_per_stage
-        keys = jax.random.split(rng, n_blocks + 3)
+        keys = jax.random.split(rng, self.num_layers + 3)
         wte = self._wte.init(keys[0], jnp.asarray(tokens, jnp.int32))
         x = self._wte.apply(wte, jnp.asarray(tokens, jnp.int32))
         block_params = []
-        for i in range(n_blocks):
+        for i in range(self.num_layers):
             variables = self._block.init(keys[1 + i], x, training=False)
             block_params.append(variables["params"])
-        # Stage axis (num_stages) outermost, per-stage layer axis second:
-        # leaves are (S, L, ...).
-        stages = [
-            stack_stage_params(
-                block_params[
-                    s * self.layers_per_stage : (s + 1)
-                    * self.layers_per_stage
-                ]
-            )
-            for s in range(self.num_stages)
-        ]
-        stacked = stack_stage_params(stages)
+        # Flat (num_layers, ...) stack — independent of num_stages, so
+        # checkpoints restore across any pp extent.
+        stacked = stack_stage_params(block_params)
         ln_f = self._ln_f.init(keys[-2], x)
         head = self._head.init(keys[-1], x)
         return {
@@ -116,17 +121,23 @@ class PipelinedTransformerLM:
             return h
 
         if self.mesh is None:
-            # Single-chip sequential fallback.
-            def all_stages(carry, stage_params):
-                return stage_fn(stage_params, carry), None
-
-            x, _ = jax.lax.scan(all_stages, x, params["blocks"])
+            # Single-chip sequential fallback: scan over the flat stack.
+            x = stage_fn(params["blocks"], x)
         else:
-            # pipeline_apply validates num_stages against the mesh's pp
-            # extent and runs every stage sequentially when pp == 1.
+            # Regroup (L, ...) -> (S, L/S, ...) for the schedule. The
+            # leading dim is pp-sharded and S == pp extent, so the
+            # reshape splits exactly along shard boundaries (no
+            # resharding).
+            per_stage = self.num_layers // self.num_stages
+            staged = jax.tree_util.tree_map(
+                lambda leaf: leaf.reshape(
+                    (self.num_stages, per_stage) + leaf.shape[1:]
+                ),
+                params["blocks"],
+            )
             x = pipeline_apply(
                 stage_fn,
-                params["blocks"],
+                staged,
                 x,
                 num_microbatches=self.num_microbatches,
                 mesh=self.mesh,
@@ -136,18 +147,17 @@ class PipelinedTransformerLM:
 
 
 def pipeline_sharding_rules():
-    """Stage axis over pp; within-stage tensor parallelism composes by
-    prepending (pp, layer) to the TransformerLM TP specs. Blocks leaves
-    are (S, L, *param_shape)."""
+    """Layer-stack axis over pp, everything else replicated.
+
+    Blocks leaves are flat ``(num_layers, *param_shape)``; sharding dim 0
+    over pp gives each stage exactly its own layers. Within-stage params
+    are intentionally NOT fsdp/tp-sharded: the stage loop runs inside a
+    ``shard_map`` manual region where GSPMD annotations are inert, so any
+    other spec here would just make jit all-gather the params at the
+    shard_map boundary every step.
+    """
     return ShardingRules(
         rules=[
-            (
-                r"blocks/.*(query|key|value)/kernel$",
-                P("pp", None, "fsdp", "tp", None),
-            ),
-            (r"blocks/.*out_proj/kernel$", P("pp", None, "tp", None, "fsdp")),
-            (r"blocks/.*mlp_up/kernel$", P("pp", None, "fsdp", "tp")),
-            (r"blocks/.*mlp_down/kernel$", P("pp", None, "tp", "fsdp")),
             (r"^blocks/", P("pp")),
             (r"wte/embedding$", P(None, "fsdp")),
             (r"lm_head/kernel$", P("fsdp", None)),
@@ -167,14 +177,20 @@ def mesh_config(num_devices):
 
 
 def custom_model(mesh=None):
-    total_layers = 12
+    num_layers = 12
     num_stages = 1
     if mesh is not None:
-        num_stages = mesh.shape.get("pp", 1)
+        num_stages = max(mesh.shape.get("pp", 1), 1)
+    if num_layers % num_stages != 0:
+        raise ValueError(
+            "pipeline_transformer has %d layers; mesh pp extent %d does "
+            "not divide it — pick pp in {1,2,3,4,6,12}"
+            % (num_layers, num_stages)
+        )
     return PipelinedTransformerLM(
         vocab_size=32000,
-        num_stages=max(num_stages, 1),
-        layers_per_stage=max(1, total_layers // max(num_stages, 1)),
+        num_layers=num_layers,
+        num_stages=num_stages,
         num_heads=12,
         embed_dim=768,
         mesh=mesh,

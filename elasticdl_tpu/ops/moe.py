@@ -1,0 +1,110 @@
+"""Mixture-of-experts routing: top-k router with static capacity.
+
+No reference counterpart (SURVEY.md §2.12: expert parallelism is absent
+from the reference); this is a new TPU-first capability. The design is
+the GShard/Switch dispatch formulation expressed entirely as static-shape
+einsums so XLA can lay expert compute out over an ``ep`` mesh axis and
+insert the all-to-alls itself:
+
+- every token picks its top-k experts from router logits;
+- each expert has a fixed per-group capacity C (static shape!), tokens
+  beyond capacity are dropped (their combine weight is zero, the residual
+  stream carries them through);
+- dispatch/combine are (G, S, E, C) tensors contracted against the token
+  stream, so "send token to expert" is an einsum — exactly the shape
+  GSPMD turns into an all-to-all when tokens are dp-sharded and experts
+  ep-sharded.
+
+Everything is shape-static and jit-friendly: k is a Python int (unrolled
+loop), capacity is computed from static dims.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(seq_len, num_experts, k=1, capacity_factor=1.25):
+    """Static per-group expert capacity: ceil(S*k/E) * factor."""
+    per_expert = (seq_len * k + num_experts - 1) // num_experts
+    return max(1, int(per_expert * capacity_factor))
+
+
+def top_k_routing(router_logits, k, capacity):
+    """Compute dispatch/combine tensors for top-k token→expert routing.
+
+    Args:
+      router_logits: (G, S, E) — G token groups (batch rows), S tokens
+        per group, E experts.
+      k: experts per token (static Python int).
+      capacity: per-(group, expert) token budget C (static Python int).
+
+    Returns:
+      combine: (G, S, E, C) float — weights for re-combining expert
+        outputs back into the token stream (zero for dropped tokens).
+      dispatch: (G, S, E, C) bool — one-hot token→(expert, slot)
+        assignment.
+      aux_loss: scalar — Switch-style load-balance loss, E * Σ_e f_e·p_e
+        where f_e is the fraction of tokens whose FIRST choice is e and
+        p_e the mean router probability of e.
+    """
+    num_experts = router_logits.shape[-1]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gates, indices = jax.lax.top_k(probs, k)  # (G, S, k)
+    # Renormalize the kept gates so combine weights sum to 1 per token.
+    gates = gates / (gates.sum(axis=-1, keepdims=True) + 1e-9)
+
+    # Load-balance aux loss over first choices (Switch Transformer eq. 4).
+    first_choice = jax.nn.one_hot(indices[..., 0], num_experts)
+    tokens_per_expert = first_choice.mean(axis=(0, 1))  # f_e
+    prob_per_expert = probs.mean(axis=(0, 1))  # p_e
+    aux_loss = num_experts * jnp.sum(tokens_per_expert * prob_per_expert)
+
+    # Assign capacity slots choice-rank-major: all rank-0 choices get
+    # priority over rank-1 choices, and within a rank, earlier tokens win
+    # (cumsum order). `counts` carries per-expert occupancy across ranks.
+    combine = jnp.zeros(
+        router_logits.shape + (capacity,), dtype=jnp.float32
+    )
+    dispatch = jnp.zeros(
+        router_logits.shape + (capacity,), dtype=jnp.bool_
+    )
+    counts = jnp.zeros(
+        router_logits.shape[:1] + (num_experts,), dtype=jnp.int32
+    )  # (G, E)
+    for rank in range(k):
+        choice = jax.nn.one_hot(
+            indices[..., rank], num_experts, dtype=jnp.int32
+        )  # (G, S, E)
+        # Position of each token inside its chosen expert's buffer.
+        position = (
+            jnp.cumsum(choice, axis=1) - choice + counts[:, None, :]
+        )  # (G, S, E)
+        within = (position < capacity) & (choice > 0)
+        slot = jax.nn.one_hot(position, capacity, dtype=jnp.float32)
+        dispatch_r = within[..., None] & (slot > 0)  # (G, S, E, C)
+        combine = combine + gates[..., rank, None, None] * dispatch_r
+        dispatch = dispatch | dispatch_r
+        counts = counts + (choice * within).sum(axis=1)
+    return combine, dispatch, aux_loss
+
+
+def moe_dispatch(x, dispatch):
+    """Token stream → per-expert buffers.
+
+    x: (G, S, M); dispatch: (G, S, E, C) → (E, G, C, M).
+    Under GSPMD (tokens g→dp-sharded, output e→ep-sharded) this einsum
+    IS the all-to-all.
+    """
+    return jnp.einsum(
+        "gsec,gsm->egcm", dispatch.astype(x.dtype), x
+    )
+
+
+def moe_combine(expert_out, combine):
+    """Per-expert buffers → token stream (weighted by gate values).
+
+    expert_out: (E, G, C, M); combine: (G, S, E, C) → (G, S, M).
+    """
+    return jnp.einsum(
+        "gsec,egcm->gsm", combine.astype(expert_out.dtype), expert_out
+    )

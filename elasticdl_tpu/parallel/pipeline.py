@@ -19,10 +19,15 @@ Design (GPipe schedule, expressed as shard_map + scan + ppermute):
   schedule is data-independent), so the same function serves forward and
   backward — XLA schedules the reverse pipeline automatically.
 
-Composability: the ``pp`` loop is agnostic to what the stage computes, so
-stages may internally use tensor-parallel kernels (``tp``) or sequence-
-parallel attention (``sp``); the batch stays sharded over dp/fsdp
-throughout because the schedule below is per-data-shard.
+Composability: the schedule is per-data-shard, so pp composes freely
+with data parallelism (batch stays sharded over dp/fsdp throughout).
+Within-stage tensor/sequence parallelism does NOT compose today: the
+stage loop runs inside a shard_map manual region where GSPMD annotations
+are inert, so stage params must be laid out exactly ``P("pp")`` (any
+finer spec would make jit all-gather them at the shard_map boundary
+every step), and a ring/ulysses attention impl would open a nested
+shard_map, which errors. tp-inside-pp needs manual collectives in
+``stage_fn`` — future work.
 """
 
 import jax

@@ -133,8 +133,8 @@ def test_pipelined_lm_matches_sequential_fallback():
     mesh = build_mesh(MeshConfig(dp=2, pp=4))
     kwargs = dict(
         vocab_size=64,
+        num_layers=8,
         num_stages=4,
-        layers_per_stage=2,
         num_heads=2,
         embed_dim=16,
         num_microbatches=2,
@@ -175,12 +175,38 @@ def test_zoo_contract_mesh_injection():
     assert config.pp == 4 and config.dp == 2
 
 
+def test_param_layout_is_topology_independent():
+    """Checkpoints must restore across pp extents: init() leaf shapes
+    cannot depend on num_stages, and a non-divisor pp must raise rather
+    than silently change depth."""
+    batch = _lm_batch()
+    kwargs = dict(
+        vocab_size=64, num_layers=8, num_heads=2, embed_dim=16
+    )
+    v4 = pipeline_transformer.PipelinedTransformerLM(
+        num_stages=4, **kwargs
+    ).init(jax.random.PRNGKey(0), batch["features"])
+    v2 = pipeline_transformer.PipelinedTransformerLM(
+        num_stages=2, **kwargs
+    ).init(jax.random.PRNGKey(0), batch["features"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(v4), jax.tree_util.tree_leaves(v2)
+    ):
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_transformer.PipelinedTransformerLM(
+            num_stages=3, **kwargs
+        )
+
+
 def test_pipelined_lm_trains_on_pp_mesh():
     mesh = build_mesh(MeshConfig(dp=2, pp=4))
     model = pipeline_transformer.PipelinedTransformerLM(
         vocab_size=64,
+        num_layers=4,
         num_stages=4,
-        layers_per_stage=1,
         num_heads=2,
         embed_dim=16,
         num_microbatches=2,
