@@ -353,6 +353,20 @@ def _resolve_ids(categorical, features):
     return categorical.ids(features)
 
 
+def combine_gathered(gathered, w, combiner):
+    """Weighted sum/mean/sqrtn over the sparse-slot axis: gathered is
+    [batch, len, dim], w is [batch, len] (0 on padded slots). Shared by
+    the on-device table path (_combine) and the host-PS pre-gathered
+    path (train/model_handler.PSEmbeddingColumn)."""
+    summed = jnp.einsum("blh,bl->bh", gathered, w)
+    if combiner == "sum":
+        return summed
+    denom = jnp.sum(w, axis=1, keepdims=True)
+    if combiner == "sqrtn":
+        denom = jnp.sqrt(jnp.sum(w * w, axis=1, keepdims=True))
+    return summed / jnp.maximum(denom, 1e-12)
+
+
 def _combine(table, sp: PaddedSparse, combiner):
     ids = jnp.asarray(sp.values)
     mask = jnp.asarray(sp.mask)
@@ -361,10 +375,4 @@ def _combine(table, sp: PaddedSparse, combiner):
     w = mask.astype(rows.dtype)
     if sp.weights is not None:
         w = w * jnp.asarray(sp.weights, rows.dtype)
-    summed = jnp.einsum("blh,bl->bh", rows, w)
-    if combiner == "sum":
-        return summed
-    denom = jnp.sum(w, axis=1, keepdims=True)
-    if combiner == "sqrtn":
-        denom = jnp.sqrt(jnp.sum(w * w, axis=1, keepdims=True))
-    return summed / jnp.maximum(denom, 1e-12)
+    return combine_gathered(rows, w, combiner)

@@ -1,0 +1,227 @@
+"""Strategy-dependent model rewriting: promote big embeddings to host PS.
+
+Reference parity: ModelHandler (elasticdl/python/common/model_handler.py).
+Under the PS strategy the reference clones the Keras model, swapping
+stock ``tf.keras.layers.Embedding`` / TF embedding columns for PS-backed
+EDL equivalents iff the table is big enough to be worth remote storage
+(model_handler.py:98-102, 148-240), and applies the inverse rewrite —
+PS/checkpoint rows materialized back into stock layers — at SavedModel
+export time (model_handler.py:242-284).
+
+TPU redesign: there is no layer graph to clone. Models built from
+feature columns (preprocessing/feature_column.py) pass their column list
+through :func:`promote_large_embeddings`; tables over the threshold are
+routed to the C++ host embedding store via the pre-step gather pipeline
+(train/sparse.py) and the column is replaced by a
+:class:`PSEmbeddingColumn` that combines the pre-pulled rows on device.
+Small tables stay on-device flax params, trained by the dense SPMD path
+— exactly the reference's size-based split, decided at build time
+instead of by graph surgery.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+import jax
+
+from elasticdl_tpu.preprocessing.feature_column import (
+    DenseFeatures,
+    EmbeddingColumn,
+    _consumes_strings,
+    _feature_keys,
+    combine_gathered,
+)
+from elasticdl_tpu.train.export import export_train_state
+from elasticdl_tpu.train.sparse import (
+    INDICES_SUFFIX,
+    ROWS_SUFFIX,
+    SparseEmbeddingSpec,
+)
+
+# The reference promotes embeddings whose table exceeds 2 MB
+# (model_handler.py:98-102: EMBEDDING_SIZE_THRESHOLD_FOR_PS).
+EMBEDDING_PROMOTION_THRESHOLD_BYTES = 2 * 1024 * 1024
+
+MASK_SUFFIX = "__psmask"
+WEIGHTS_SUFFIX = "__psweights"
+IDS_PREFIX = "__psids__"
+
+
+def table_size_bytes(column: EmbeddingColumn, dtype_bytes=4):
+    rows, dim = column.table_shape
+    return int(rows) * int(dim) * dtype_bytes
+
+
+class PSEmbeddingColumn:
+    """Embedding column whose table lives on the host PS.
+
+    Reads the (rows, indices) pair planted by SparseBatchPreparer plus
+    the mask/weights planted by the promotion plan's id materializer,
+    and combines on device. The flax DenseFeatures module treats it as a
+    plain callable column — it owns no parameters.
+    """
+
+    def __init__(self, source: EmbeddingColumn):
+        self.source = source
+        self.categorical = None  # opt out of DenseFeatures.preprocess
+        self.dimension = source.dimension
+        self.combiner = source.combiner
+        self.output_dim = source.dimension
+        self.table_name = source.name
+        self.num_buckets = source.categorical.num_buckets
+
+    @property
+    def name(self):
+        return self.source.name
+
+    def __call__(self, features):
+        rows = features[self.table_name + ROWS_SUFFIX]
+        indices = features[self.table_name + INDICES_SUFFIX]
+        mask = features[self.table_name + MASK_SUFFIX]
+        gathered = rows[indices]  # [B, F, dim]
+        w = jnp.asarray(mask, gathered.dtype)
+        weights_key = self.table_name + WEIGHTS_SUFFIX
+        if weights_key in features:
+            w = w * jnp.asarray(features[weights_key], gathered.dtype)
+        return combine_gathered(gathered, w, self.combiner)
+
+
+class PromotionPlan:
+    """Outcome of promote_large_embeddings: the rewritten column list,
+    the host-PS table specs, and the host-side id materializer that must
+    run in the dataset_fn (before SparseBatchPreparer.prepare)."""
+
+    def __init__(self, columns, promoted, kept):
+        self.columns = list(columns)
+        self.promoted = list(promoted)  # [PSEmbeddingColumn]
+        self.kept = list(kept)
+        self.sparse_specs = [
+            SparseEmbeddingSpec(
+                name=col.table_name,
+                dim=col.dimension,
+                feature_key=IDS_PREFIX + col.table_name,
+                combiner=None,  # PSEmbeddingColumn combines with mask
+                # padded slots must not pull/update PS rows
+                mask_feature_key=col.table_name + MASK_SUFFIX,
+            )
+            for col in self.promoted
+        ]
+        # string keys consumed ONLY by promoted columns can be dropped
+        # after id materialization so the jitted step never sees them
+        kept_string_keys = set()
+        for col in self.kept:
+            cat = getattr(col, "categorical", None)
+            if cat is not None and _consumes_strings(cat):
+                kept_string_keys.update(_feature_keys(cat))
+        self._droppable = set()
+        for col in self.promoted:
+            cat = col.source.categorical
+            if _consumes_strings(cat):
+                self._droppable.update(
+                    _feature_keys(cat) - kept_string_keys
+                )
+
+    @property
+    def table_shapes(self):
+        return {
+            col.table_name: (col.num_buckets, col.dimension)
+            for col in self.promoted
+        }
+
+    def materialize_ids(self, features):
+        """Host-side stage: resolve each promoted column's categorical to
+        padded int ids + mask (+ optional weights) features. Returns a
+        new features dict with raw string keys the promoted columns
+        consumed removed."""
+        out = dict(features)
+        # id resolution may use jnp internally (identity/bucketized
+        # columns); pin it to the host CPU device so the input pipeline
+        # never round-trips through (or syncs) the accelerator
+        cpu = jax.devices("cpu")[0]
+        for col in self.promoted:
+            with jax.default_device(cpu):
+                sp = col.source.categorical.ids(features)
+            values = np.asarray(sp.values)
+            mask = np.asarray(sp.mask)
+            out[IDS_PREFIX + col.table_name] = np.where(
+                mask, values, 0
+            ).astype(np.int64)
+            out[col.table_name + MASK_SUFFIX] = mask
+            if sp.weights is not None:
+                out[col.table_name + WEIGHTS_SUFFIX] = np.asarray(
+                    sp.weights, dtype=np.float32
+                )
+        for key in self._droppable:
+            out.pop(key, None)
+        return out
+
+
+def promote_large_embeddings(
+    columns, threshold_bytes=EMBEDDING_PROMOTION_THRESHOLD_BYTES
+):
+    """Split a column list into device-resident and host-PS embeddings.
+
+    Mirrors the reference's size test (model_handler.py:98-102): an
+    EmbeddingColumn whose float32 table exceeds ``threshold_bytes`` is
+    replaced with a PSEmbeddingColumn; everything else passes through.
+    """
+    new_columns, promoted, kept = [], [], []
+    for col in columns:
+        if (
+            isinstance(col, EmbeddingColumn)
+            and table_size_bytes(col) > threshold_bytes
+        ):
+            ps_col = PSEmbeddingColumn(col)
+            new_columns.append(ps_col)
+            promoted.append(ps_col)
+        else:
+            new_columns.append(col)
+            kept.append(col)
+    return PromotionPlan(new_columns, promoted, kept)
+
+
+def dense_features(plan: PromotionPlan):
+    return DenseFeatures(columns=tuple(plan.columns))
+
+
+def pull_full_table(ps_client, name, num_rows, dim, chunk_size=4096):
+    """Materialize a host-PS table as one dense [num_rows, dim] array —
+    the inverse rewrite's data movement (model_handler.py:242-284 pulls
+    checkpointed EDL rows back into stock Keras embeddings)."""
+    table = np.zeros((num_rows, dim), dtype=np.float32)
+    for start in range(0, num_rows, chunk_size):
+        ids = np.arange(
+            start, min(start + chunk_size, num_rows), dtype=np.int64
+        )
+        table[start : start + len(ids)] = ps_client.pull_embedding_vectors(
+            name, ids
+        )
+    return table
+
+
+def export_promoted_train_state(state, plan: PromotionPlan, ps_client, path):
+    """Export dense state + host-PS tables as one serving bundle — the
+    inverse rewrite: after this, a server needs no PS to serve."""
+    export_train_state(state, path)
+    import os
+
+    tables = {
+        name: pull_full_table(ps_client, name, rows, dim)
+        for name, (rows, dim) in plan.table_shapes.items()
+    }
+    if tables:
+        np.savez(
+            os.path.join(path, "sparse_tables.npz"),
+            **{name: arr for name, arr in tables.items()},
+        )
+    return path
+
+
+def load_exported_tables(path):
+    import os
+
+    fname = os.path.join(path, "sparse_tables.npz")
+    if not os.path.exists(fname):
+        return {}
+    data = np.load(fname)
+    return {name: data[name] for name in data.files}

@@ -30,6 +30,9 @@ from elasticdl_tpu.train.train_state import (
 
 ROWS_SUFFIX = "__rows"
 INDICES_SUFFIX = "__indices"
+# planted by SparseBatchPreparer when a spec has mask_feature_key: bool
+# [B, F] marking real (non-padding) slots, consumed by embedding_lookup
+SLOT_MASK_SUFFIX = "__slotmask"
 
 
 class SparseEmbeddingSpec:
@@ -41,13 +44,18 @@ class SparseEmbeddingSpec:
     """
 
     def __init__(self, name, dim, feature_key=None, combiner="sum",
-                 capacity=0, init_scale=0.05):
+                 capacity=0, init_scale=0.05, mask_feature_key=None):
         self.name = name
         self.dim = dim
         self.feature_key = feature_key or name
         self.combiner = combiner
         self.capacity = capacity
         self.init_scale = init_scale
+        # optional bool feature marking which id slots are real: padded
+        # slots are excluded from the unique-id pull/push so padding
+        # never creates or updates PS rows (id 0 would otherwise absorb
+        # spurious optimizer steps from every padded batch)
+        self.mask_feature_key = mask_feature_key
 
 
 def embedding_lookup(features, name, combiner=None):
@@ -59,17 +67,23 @@ def embedding_lookup(features, name, combiner=None):
     rows = features[name + ROWS_SUFFIX]
     indices = features[name + INDICES_SUFFIX]
     gathered = rows[indices]  # [B, dim] or [B, F, dim]
+    mask = features.get(name + SLOT_MASK_SUFFIX)
     if gathered.ndim == 2 or combiner is None:
+        if mask is not None and gathered.ndim == 3:
+            # padded slots index row 0 of the pulled buffer; zero them
+            gathered = gathered * jnp.asarray(mask, gathered.dtype)[
+                ..., None
+            ]
         return gathered
-    if combiner == "sum":
-        return gathered.sum(axis=1)
-    if combiner == "mean":
-        return gathered.mean(axis=1)
-    if combiner == "sqrtn":
-        return gathered.sum(axis=1) / jnp.sqrt(
-            jnp.asarray(gathered.shape[1], gathered.dtype)
-        )
-    raise ValueError("unknown combiner %r" % combiner)
+    if combiner not in ("sum", "mean", "sqrtn"):
+        raise ValueError("unknown combiner %r" % combiner)
+    from elasticdl_tpu.preprocessing.feature_column import combine_gathered
+
+    if mask is not None:
+        w = jnp.asarray(mask, gathered.dtype)
+    else:
+        w = jnp.ones(gathered.shape[:2], gathered.dtype)
+    return combine_gathered(gathered, w, combiner)
 
 
 class SparseBatchPreparer:
@@ -100,16 +114,35 @@ class SparseBatchPreparer:
             ids = np.asarray(features[spec.feature_key])
             consumed.add(spec.feature_key)
             capacity = spec.capacity or int(np.prod(ids.shape))
-            unique, inverse = np.unique(ids, return_inverse=True)
+            mask = None
+            if (
+                spec.mask_feature_key
+                and spec.mask_feature_key in features
+            ):
+                mask = np.asarray(features[spec.mask_feature_key], bool)
+            if mask is not None:
+                unique, inv_real = np.unique(
+                    ids[mask], return_inverse=True
+                )
+                # padded slots index row 0; the slot-mask feature below
+                # zeroes their contribution in embedding_lookup (and
+                # mask-aware columns do their own masking)
+                inverse = np.zeros(ids.shape, dtype=np.int64)
+                inverse[mask] = inv_real
+                features[spec.name + SLOT_MASK_SUFFIX] = mask
+            else:
+                unique, inverse = np.unique(ids, return_inverse=True)
             if unique.size > capacity:
                 raise ValueError(
                     "Batch has %d unique ids for table %s (capacity %d); "
                     "raise SparseEmbeddingSpec.capacity"
                     % (unique.size, spec.name, capacity)
                 )
-            rows = self._ps.pull_embedding_vectors(spec.name, unique)
             padded = np.zeros((capacity, spec.dim), dtype=np.float32)
-            padded[: unique.size] = rows
+            if unique.size:
+                padded[: unique.size] = self._ps.pull_embedding_vectors(
+                    spec.name, unique
+                )
             features[spec.name + ROWS_SUFFIX] = padded
             features[spec.name + INDICES_SUFFIX] = inverse.reshape(
                 ids.shape
@@ -124,6 +157,8 @@ class SparseBatchPreparer:
     def push_gradients(self, row_grads, pull_info, model_version=0):
         grads_by_table = {}
         for name, (unique, n) in pull_info.items():
+            if n == 0:
+                continue
             grads_by_table[name] = (
                 np.asarray(row_grads[name])[:n],
                 unique,

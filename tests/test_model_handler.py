@@ -1,0 +1,167 @@
+"""ModelHandler: size-based promotion of embeddings to the host PS.
+
+Reference parity: elasticdl/python/common/model_handler.py:98-333 — big
+tables get rewritten to PS-backed storage, small ones stay in the model;
+export performs the inverse rewrite so serving needs no PS.
+"""
+
+import numpy as np
+import flax.linen as nn
+import jax.numpy as jnp
+
+from elasticdl_tpu.data.pipeline import MASK_KEY
+from elasticdl_tpu.preprocessing import feature_column as fc
+from elasticdl_tpu.ps.local_client import LocalPSClient
+from elasticdl_tpu.train import model_handler as mh
+from elasticdl_tpu.train.optimizers import create_optimizer
+from elasticdl_tpu.train.sparse import SparseTrainer
+
+
+def build_columns():
+    big = fc.embedding_column(
+        fc.categorical_column_with_identity("cat_big", 1000),
+        dimension=8,
+        combiner="mean",
+    )  # 1000*8*4 = 32 KB table
+    small = fc.embedding_column(
+        fc.categorical_column_with_identity("cat_small", 10),
+        dimension=4,
+    )  # 160 B table
+    num = fc.numeric_column("x")
+    return [big, small, num]
+
+
+def test_promotion_split_by_size():
+    plan = mh.promote_large_embeddings(
+        build_columns(), threshold_bytes=1024
+    )
+    assert [c.table_name for c in plan.promoted] == ["cat_big_embedding"]
+    assert len(plan.kept) == 2
+    assert plan.table_shapes == {"cat_big_embedding": (1000, 8)}
+    spec = plan.sparse_specs[0]
+    assert spec.dim == 8
+    assert spec.feature_key == mh.IDS_PREFIX + "cat_big_embedding"
+
+
+def test_default_threshold_matches_reference():
+    # 2 MB, model_handler.py:98-102
+    assert mh.EMBEDDING_PROMOTION_THRESHOLD_BYTES == 2 * 1024 * 1024
+    plan = mh.promote_large_embeddings(build_columns())
+    assert not plan.promoted  # 32 KB stays on device by default
+
+
+class _Model(nn.Module):
+    features_layer: nn.Module
+
+    @nn.compact
+    def __call__(self, features, training: bool = False):
+        x = self.features_layer(features)
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(1)(x)[:, 0]
+
+
+def _make_batch(rng, batch_size=64):
+    cat_big = rng.integers(0, 1000, size=(batch_size, 1))
+    cat_small = rng.integers(0, 10, size=(batch_size, 1))
+    x = rng.normal(size=(batch_size,)).astype(np.float32)
+    labels = (cat_big[:, 0] < 500).astype(np.float32)
+    features = {
+        "cat_big": cat_big,
+        "cat_small": cat_small,
+        "x": x,
+    }
+    return {
+        "features": features,
+        "labels": labels,
+        MASK_KEY: np.ones(batch_size, dtype=bool),
+    }
+
+
+def _bce(labels, logits):
+    logits = logits.astype(jnp.float32)
+    return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+
+
+def test_promoted_model_trains_and_exports(tmp_path):
+    plan = mh.promote_large_embeddings(
+        build_columns(), threshold_bytes=1024
+    )
+    model = _Model(features_layer=mh.dense_features(plan))
+    ps = LocalPSClient(opt_type="adam", learning_rate=0.05)
+    trainer = SparseTrainer(
+        model,
+        _bce,
+        create_optimizer("Adam", learning_rate=0.05),
+        plan.sparse_specs,
+        ps,
+        compute_dtype="float32",
+    )
+    rng = np.random.default_rng(0)
+    state, losses = None, []
+    for _ in range(60):
+        batch = _make_batch(rng)
+        batch["features"] = plan.materialize_ids(batch["features"])
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.7
+
+    # promoted table owns no device params; kept table does
+    flat_keys = set(state.params["features_layer"].keys())
+    assert "cat_small_embedding" in flat_keys
+    assert "cat_big_embedding" not in flat_keys
+
+    # inverse rewrite: exported bundle carries the full PS table
+    path = mh.export_promoted_train_state(
+        state, plan, ps, str(tmp_path / "export")
+    )
+    tables = mh.load_exported_tables(path)
+    assert tables["cat_big_embedding"].shape == (1000, 8)
+    # rows the model touched must match live PS rows exactly
+    some_ids = np.arange(0, 1000, 37, dtype=np.int64)
+    np.testing.assert_allclose(
+        tables["cat_big_embedding"][some_ids],
+        ps.pull_embedding_vectors("cat_big_embedding", some_ids),
+    )
+
+
+def test_padded_slots_never_touch_ps_rows():
+    """Variable-length feature: masked padding slots must not pull or
+    update any PS row (id 0 would otherwise take a spurious optimizer
+    step every padded batch)."""
+    from elasticdl_tpu.preprocessing.sparse import from_row_lists
+
+    big = fc.embedding_column(
+        fc.categorical_column_with_identity("tags", 1000), dimension=8
+    )
+    plan = mh.promote_large_embeddings([big], threshold_bytes=1024)
+    model = _Model(features_layer=mh.dense_features(plan))
+    ps = LocalPSClient(opt_type="adam", learning_rate=0.05)
+    trainer = SparseTrainer(
+        model,
+        _bce,
+        create_optimizer("Adam", learning_rate=0.05),
+        plan.sparse_specs,
+        ps,
+        compute_dtype="float32",
+    )
+    # ids 100..199 only, ragged rows -> padding present in every batch
+    rng = np.random.default_rng(1)
+    state = None
+    for _ in range(3):
+        rows = [
+            list(rng.integers(100, 200, size=rng.integers(1, 4)))
+            for _ in range(16)
+        ]
+        sp = from_row_lists(rows, max_len=4)
+        features = plan.materialize_ids({"tags": sp})
+        batch = {
+            "features": features,
+            "labels": np.ones(16, dtype=np.float32),
+            MASK_KEY: np.ones(16, dtype=bool),
+        }
+        state, _ = trainer.train_step(state, batch)
+    ids, _ = ps.store.export_table("tags_embedding")
+    assert ids.size > 0
+    assert ids.min() >= 100, "padding slot created PS row %d" % ids.min()
