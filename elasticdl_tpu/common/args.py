@@ -63,6 +63,7 @@ def parse_master_args(argv=None):
     parser.add_argument("--model_def", default="")
     parser.add_argument("--model_params", default="")
     parser.add_argument("--envs", default="")
+    parser.add_argument("--tensorboard_log_dir", default="")
     return parser.parse_args(argv)
 
 

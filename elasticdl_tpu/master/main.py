@@ -30,6 +30,7 @@ def main(argv=None):
         eval_start_delay_secs=args.evaluation_start_delay_secs,
         saved_model_path=args.output,
         task_timeout_secs=args.task_timeout_secs,
+        tensorboard_log_dir=args.tensorboard_log_dir or None,
     )
     if args.job_name and os.environ.get("KUBERNETES_SERVICE_HOST"):
         # in-cluster: provision and heal worker/PS pods

@@ -43,6 +43,7 @@ class Master:
         pod_manager=None,
         task_timeout_secs=30.0,
         seed=None,
+        tensorboard_log_dir=None,
     ):
         self.spec = get_model_spec(model_zoo_module)
         reader_params = data_reader_params or {}
@@ -68,6 +69,15 @@ class Master:
             self.task_dispatcher.add_deferred_callback_create_train_end_task(
                 {"saved_model_path": saved_model_path}
             )
+        self.tensorboard_service = None
+        if tensorboard_log_dir:
+            from elasticdl_tpu.master.tensorboard_service import (
+                TensorboardService,
+            )
+
+            self.tensorboard_service = TensorboardService(
+                tensorboard_log_dir
+            )
         self.evaluation_service = None
         if validation_data and self.job_type != JobType.PREDICTION_ONLY:
             self.evaluation_service = EvaluationService(
@@ -76,6 +86,7 @@ class Master:
                 eval_start_delay_secs=eval_start_delay_secs,
                 eval_throttle_secs=eval_throttle_secs,
                 eval_steps=eval_steps,
+                summary_writer=self.tensorboard_service,
             )
         self.rendezvous = MeshRendezvous()
         self.servicer = MasterServicer(
@@ -120,6 +131,8 @@ class Master:
         add_master_servicer_to_server(self.servicer, self._server)
         self._server.add_insecure_port("[::]:%d" % self._port)
         self._server.start()
+        if self.tensorboard_service is not None:
+            self.tensorboard_service.start()
         self.task_monitor.start()
         if self.pod_manager is not None:
             self.pod_manager.start()
@@ -155,6 +168,8 @@ class Master:
         self.task_monitor.stop()
         if self.evaluation_service is not None:
             self.evaluation_service.stop()
+        if self.tensorboard_service is not None:
+            self.tensorboard_service.stop()
         if self.pod_manager is not None:
             self.pod_manager.stop()
         if self._server is not None:
