@@ -1,0 +1,126 @@
+"""Opt-in wall-clock accounting per phase + JAX profiler hooks.
+
+Reference parity: common/timing_utils.py:17-48 — `Timing` accumulates
+seconds per named phase (task_process, batch_process, get_model,
+report_gradient) and dumps totals at DEBUG when a task completes.
+
+TPU additions the reference lacks (SURVEY.md §5 "tracing: minimal"):
+- a context-manager surface (`with timing.timeit("batch_process")`)
+- `device_sync` blocks on the last JAX output so a phase that launched
+  async device work is charged its real duration, not dispatch time
+- `trace()` wraps a region in jax.profiler for TensorBoard's trace
+  viewer when EDL_PROFILE_DIR is set.
+"""
+
+import contextlib
+import os
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.common.timing_utils")
+
+PROFILE_DIR_ENV = "EDL_PROFILE_DIR"
+
+
+class Timing:
+    def __init__(self, enabled=None):
+        if enabled is None:
+            enabled = os.environ.get("EDL_TIMING", "") not in ("", "0")
+        self._enabled = enabled
+        self._totals = {}
+        self._counts = {}
+
+    @property
+    def enabled(self):
+        return self._enabled
+
+    def start(self):
+        return time.time() if self._enabled else 0.0
+
+    def end_record(self, phase, start):
+        if not self._enabled:
+            return
+        self._totals[phase] = self._totals.get(phase, 0.0) + (
+            time.time() - start
+        )
+        self._counts[phase] = self._counts.get(phase, 0) + 1
+
+    def end_record_sync(self, phase, start, result=None):
+        """Block on a JAX array (if given) before recording, so async
+        dispatch doesn't make device phases look free."""
+        if not self._enabled:
+            return
+        if result is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(result)
+            except Exception:
+                pass
+        self.end_record(phase, start)
+
+    @contextlib.contextmanager
+    def timeit(self, phase, sync_result=None):
+        """Time a block; pass sync_result=lambda: x to block on a JAX
+        array before stopping the clock (async dispatch otherwise makes
+        device phases look free)."""
+        start = self.start()
+        try:
+            yield
+        finally:
+            if self._enabled and sync_result is not None:
+                result = sync_result()
+                if result is not None:
+                    try:
+                        import jax
+
+                        jax.block_until_ready(result)
+                    except Exception:
+                        pass
+            self.end_record(phase, start)
+
+    def summary(self):
+        return {
+            phase: {
+                "seconds": round(self._totals[phase], 4),
+                "count": self._counts[phase],
+            }
+            for phase in sorted(self._totals)
+        }
+
+    def report(self, context=""):
+        """DEBUG dump + reset, as the reference does per finished task
+        (worker.py:810-812)."""
+        if not self._enabled or not self._totals:
+            return
+        logger.info("Timing%s: %s",
+                    " (%s)" % context if context else "", self.summary())
+        self._totals.clear()
+        self._counts.clear()
+
+
+@contextlib.contextmanager
+def trace(name="edl_train"):
+    """jax.profiler trace region -> EDL_PROFILE_DIR (view in
+    TensorBoard's trace viewer). No-op when the env var is unset."""
+    profile_dir = os.environ.get(PROFILE_DIR_ENV, "")
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(os.path.join(profile_dir, name)):
+        yield
+
+
+@contextlib.contextmanager
+def step_annotation(name, step):
+    """Named sub-region inside a trace (StepTraceAnnotation)."""
+    if not os.environ.get(PROFILE_DIR_ENV, ""):
+        yield
+        return
+    import jax
+
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+        yield

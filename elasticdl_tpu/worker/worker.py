@@ -164,6 +164,11 @@ class Worker:
                 "embedding tables"
             )
         self._callbacks = list(self.spec.callbacks() or [])
+        # opt-in per-phase wall-clock accounting (EDL_TIMING=1),
+        # reference worker.py:298-812 / common/timing_utils.py
+        from elasticdl_tpu.common.timing_utils import Timing
+
+        self._timing = Timing()
         for cb in self._callbacks:
             cb.set_worker(self)
         # Heartbeat keeps master-side liveness fresh while the worker is
@@ -205,16 +210,19 @@ class Worker:
             ):
                 if not self._restore_attempted:
                     self._restore_from_checkpoint(batch)
+                t0 = self._timing.start()
                 self.state, loss = self.trainer.train_step(
                     self.state, batch
                 )
+                self._timing.end_record_sync("batch_process", t0, loss)
                 self._version += 1
                 if (
                     self._checkpoint_mgr is not None
                     and self._version % self._checkpoint_steps == 0
                 ):
                     self._checkpoint_mgr.save(self._version, self.state)
-                self.tds.report_record_done(batch_real_count(batch))
+                with self._timing.timeit("report_record"):
+                    self.tds.report_record_done(batch_real_count(batch))
                 if (
                     self._report_version_steps
                     and self._version % self._report_version_steps == 0
@@ -229,6 +237,8 @@ class Worker:
         except Exception as e:  # report so tasks get retried elsewhere
             logger.exception("Training stream failed")
             self.tds.report_pending_failed(str(e))
+        finally:
+            self._timing.report("training stream")
 
     def _restore_from_checkpoint(self, batch):
         """Resume from --checkpoint_dir_for_init on the first batch.

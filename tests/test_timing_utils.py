@@ -1,0 +1,62 @@
+"""Timing accumulation + profiler hooks (reference timing_utils.py:17-48)."""
+
+import time
+
+from elasticdl_tpu.common.timing_utils import Timing, trace
+
+
+def test_disabled_by_default_records_nothing(monkeypatch):
+    monkeypatch.delenv("EDL_TIMING", raising=False)
+    timing = Timing()
+    with timing.timeit("phase"):
+        pass
+    assert timing.summary() == {}
+
+
+def test_accumulates_per_phase():
+    timing = Timing(enabled=True)
+    for _ in range(3):
+        with timing.timeit("a"):
+            time.sleep(0.01)
+    with timing.timeit("b"):
+        pass
+    summary = timing.summary()
+    assert summary["a"]["count"] == 3
+    assert summary["a"]["seconds"] >= 0.03
+    assert summary["b"]["count"] == 1
+
+
+def test_report_resets():
+    timing = Timing(enabled=True)
+    with timing.timeit("x"):
+        pass
+    timing.report("task done")
+    assert timing.summary() == {}
+
+
+def test_sync_on_jax_result():
+    import jax.numpy as jnp
+
+    timing = Timing(enabled=True)
+    start = timing.start()
+    result = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+    timing.end_record_sync("matmul", start, result)
+    assert timing.summary()["matmul"]["count"] == 1
+
+
+def test_trace_noop_without_env(monkeypatch):
+    monkeypatch.delenv("EDL_PROFILE_DIR", raising=False)
+    with trace("region"):
+        pass  # must not require jax.profiler setup
+
+
+def test_trace_writes_profile(tmp_path, monkeypatch):
+    import glob
+
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("EDL_PROFILE_DIR", str(tmp_path))
+    with trace("region"):
+        (jnp.ones((4, 4)) @ jnp.ones((4, 4))).block_until_ready()
+    assert glob.glob(str(tmp_path / "region" / "**" / "*.xplane.pb"),
+                     recursive=True)
