@@ -1,0 +1,27 @@
+"""Census-income categorical schema shared by the data generators and
+the census model zoo entry (so data/ never imports models/).
+
+Reference: the census feature set used by
+model_zoo/census_wide_deep_model/ (vocabularies hard-coded in the
+model module there too)."""
+
+WORK_CLASS_VOCABULARY = [
+    "Private",
+    "Self-emp-not-inc",
+    "Self-emp-inc",
+    "Federal-gov",
+    "Local-gov",
+    "State-gov",
+    "Without-pay",
+    "Never-worked",
+]
+
+MARITAL_STATUS_VOCABULARY = [
+    "Married-civ-spouse",
+    "Divorced",
+    "Never-married",
+    "Separated",
+    "Widowed",
+    "Married-spouse-absent",
+    "Married-AF-spouse",
+]

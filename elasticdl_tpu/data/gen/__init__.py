@@ -1,0 +1,21 @@
+"""Dataset -> RecordIO converters (the CI data plane).
+
+Reference parity: elasticdl/python/data/recordio_gen/ — image_label.py
+(array pairs -> sharded RecordIO), census_recordio_gen.py,
+frappe_recordio_gen.py, heart_recordio_gen.py. The reference converters
+download public datasets and write tf.train.Example records; these
+write the framework's own example encoding (data/example.py) and can
+either convert caller-provided arrays (the image_label role) or
+fabricate statistically-learnable synthetic data of the same shape —
+the zero-egress CI path (synthetic rows carry a planted signal, so
+training on them must converge; pure noise would make CI meaningless).
+"""
+
+from elasticdl_tpu.data.gen.converters import (  # noqa: F401
+    convert_image_label,
+    convert_rows,
+    gen_census_recordio,
+    gen_frappe_recordio,
+    gen_heart_recordio,
+    gen_mnist_recordio,
+)

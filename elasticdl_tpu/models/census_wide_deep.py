@@ -27,26 +27,10 @@ from elasticdl_tpu.train.optimizers import (
     create_host_schedulable_optimizer,
 )
 
-WORK_CLASS_VOCABULARY = [
-    "Private",
-    "Self-emp-not-inc",
-    "Self-emp-inc",
-    "Federal-gov",
-    "Local-gov",
-    "State-gov",
-    "Without-pay",
-    "Never-worked",
-]
-
-MARITAL_STATUS_VOCABULARY = [
-    "Married-civ-spouse",
-    "Divorced",
-    "Never-married",
-    "Separated",
-    "Widowed",
-    "Married-spouse-absent",
-    "Married-AF-spouse",
-]
+from elasticdl_tpu.data.census_schema import (  # noqa: F401 (re-export)
+    MARITAL_STATUS_VOCABULARY,
+    WORK_CLASS_VOCABULARY,
+)
 
 AGE_BOUNDARIES = [18.0, 25.0, 30.0, 35.0, 40.0, 45.0, 50.0, 60.0, 70.0]
 HOURS_BOUNDARIES = [20.0, 35.0, 40.0, 45.0, 55.0]
