@@ -134,10 +134,57 @@ class CSVDataReader(AbstractDataReader):
 def create_data_reader(data_origin, records_per_task=None, **kwargs):
     """Factory keyed on the data origin's shape.
 
-    Reference parity: data/reader/data_reader_factory.py:23-73 (the ODPS
-    branch has no counterpart here; MaxCompute is outside this
-    framework's storage scope).
+    Reference parity: data/reader/data_reader_factory.py:23-73 — ODPS
+    env vars or an ``odps://project/table`` origin select the table
+    reader; ``.csv`` selects CSV; everything else is RecordIO.
     """
+    if kwargs.get("table_client") is not None or (
+        data_origin and data_origin.startswith("odps://")
+    ) or (
+        data_origin
+        and not os.path.exists(data_origin)
+        # reference is_odps_configured (odps_io.py:64-72): project AND
+        # credentials must all be present before routing to the table
+        # path, else a typo'd local dir would get an opaque SDK error
+        and all(
+            os.environ.get(var)
+            for var in ("MAXCOMPUTE_PROJECT", "MAXCOMPUTE_AK",
+                        "MAXCOMPUTE_SK")
+        )
+    ):
+        from elasticdl_tpu.data.table_reader import (
+            ParallelTableDataReader,
+            TableDataReader,
+        )
+
+        table = data_origin or ""
+        if table.startswith("odps://"):
+            parts = table[len("odps://"):].split("/")
+            kwargs.setdefault("project", parts[0])
+            table = parts[-1]
+        if kwargs.get("table_client") is None:
+            kwargs.setdefault(
+                "project", os.environ.get("MAXCOMPUTE_PROJECT")
+            )
+            kwargs.setdefault(
+                "access_id", os.environ.get("MAXCOMPUTE_AK")
+            )
+            kwargs.setdefault(
+                "access_key", os.environ.get("MAXCOMPUTE_SK")
+            )
+            kwargs.setdefault(
+                "endpoint", os.environ.get("MAXCOMPUTE_ENDPOINT")
+            )
+        cls = (
+            ParallelTableDataReader
+            if kwargs.pop("parallel", False)
+            else TableDataReader
+        )
+        return cls(
+            table=table or "table",
+            records_per_task=records_per_task,
+            **kwargs,
+        )
     if data_origin and (
         data_origin.endswith(".csv")
         or (

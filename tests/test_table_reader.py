@@ -1,0 +1,145 @@
+"""Table readers (reference odps_reader.py parity) against an
+in-memory table client."""
+
+import numpy as np
+
+from elasticdl_tpu.data.pipeline import Dataset
+from elasticdl_tpu.data.readers import create_data_reader
+from elasticdl_tpu.data.table_reader import (
+    InMemoryTableClient,
+    ParallelTableDataReader,
+    TableDataReader,
+)
+
+
+class _Task:
+    def __init__(self, shard_name, start, end):
+        self.shard_name = shard_name
+        self.start = start
+        self.end = end
+
+
+def _iris_client(n=130):
+    rng = np.random.RandomState(0)
+    rows = [
+        (
+            float(rng.rand()),
+            float(rng.rand()),
+            float(rng.rand()),
+            float(rng.rand()),
+            int(rng.randint(0, 3)),
+        )
+        for _ in range(n)
+    ]
+    columns = ["sepal_l", "sepal_w", "petal_l", "petal_w", "class"]
+    return InMemoryTableClient(rows, columns), rows
+
+
+def test_fixed_range_shards_with_remainder():
+    client, _ = _iris_client(130)
+    reader = TableDataReader(
+        table_client=client, table="iris", records_per_task=50
+    )
+    shards = reader.create_shards()
+    # 50+50+30, names <table>:shard_<i> (odps_reader.py:61-82)
+    assert shards == {
+        "iris:shard_0": (0, 50),
+        "iris:shard_1": (50, 50),
+        "iris:shard_2": (100, 30),
+    }
+
+
+def test_read_records_range_and_columns():
+    client, rows = _iris_client(20)
+    reader = TableDataReader(
+        table_client=client,
+        table="iris",
+        records_per_task=8,
+        columns=["petal_l", "class"],
+    )
+    got = list(reader.read_records(_Task("iris:shard_1", 8, 16)))
+    assert got == [(r[2], r[4]) for r in rows[8:16]]
+    assert reader.metadata.column_names == ["petal_l", "class"]
+
+
+def test_parallel_reader_preserves_order():
+    client, rows = _iris_client(101)
+    reader = ParallelTableDataReader(
+        table_client=client,
+        table="iris",
+        records_per_task=101,
+        num_parallel=4,
+        page_size=7,
+    )
+    got = list(reader.read_records(_Task("iris:shard_0", 0, 101)))
+    assert got == [tuple(r) for r in rows]
+
+
+def test_parallel_reader_stops_fetching_when_abandoned():
+    """An abandoned generator (worker stopped mid-task) must not keep
+    reading the remaining pages from the warehouse."""
+    import time
+
+    client, _ = _iris_client(1000)
+    calls = []
+    original = client.read_rows
+
+    def counting_read_rows(start, end, columns=None):
+        calls.append((start, end))
+        time.sleep(0.005)
+        return original(start, end, columns)
+
+    client.read_rows = counting_read_rows
+    reader = ParallelTableDataReader(
+        table_client=client,
+        table="iris",
+        records_per_task=1000,
+        num_parallel=2,
+        page_size=10,  # 100 pages
+    )
+    stream = reader.read_records(_Task("iris:shard_0", 0, 1000))
+    next(stream)
+    stream.close()  # abandons the generator -> cancelled.set()
+    time.sleep(0.2)
+    fetched = len(calls)
+    time.sleep(0.3)
+    assert len(calls) == fetched, "fetches continued after abandonment"
+    assert fetched < 100
+
+
+def test_default_dataset_fn_last_column_is_label():
+    client, rows = _iris_client(10)
+    reader = TableDataReader(table_client=client, table="iris")
+    dataset_fn = reader.default_dataset_fn()
+    dataset = dataset_fn(
+        Dataset(lambda: reader.read_records(_Task("iris:shard_0", 0, 10))),
+        None,
+        reader.metadata,
+    )
+    features, label = next(iter(dataset))
+    assert set(features) == {"sepal_l", "sepal_w", "petal_l", "petal_w"}
+    assert float(label) == float(rows[0][4])
+
+
+def test_factory_routes_table_client():
+    client, _ = _iris_client(10)
+    reader = create_data_reader(
+        "odps://proj/iris", records_per_task=5, table_client=client
+    )
+    assert isinstance(reader, TableDataReader)
+    assert len(reader.create_shards()) == 2
+
+
+def test_odps_sdk_gated_import():
+    import pytest
+
+    from elasticdl_tpu.data.table_reader import ODPSTableClient
+
+    try:
+        import odps  # noqa: F401
+        has_sdk = True
+    except ImportError:
+        has_sdk = False
+    if not has_sdk:
+        with pytest.raises(ImportError, match="odps"):
+            ODPSTableClient("p", "ak", "sk", "t")
