@@ -45,4 +45,4 @@ class LocalPSClient:
             )
             self.store.push_gradients(name, ids, values, lr_scale=lr_scale)
         self.store.bump_version()
-        return self.store.version
+        return True, self.store.version

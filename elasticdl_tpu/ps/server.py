@@ -39,6 +39,11 @@ def parse_ps_args(argv=None):
     parser.add_argument("--checkpoint_dir_for_init", default="")
     parser.add_argument("--use_native_store", type=int, default=1)
     parser.add_argument("--seed", type=int, default=0)
+    # sync-SGD controls (reference go/cmd/elasticdl_ps/main.go flags
+    # use_async/grads_to_wait/sync_version_tolerance)
+    parser.add_argument("--use_async", type=int, default=1)
+    parser.add_argument("--grads_to_wait", type=int, default=1)
+    parser.add_argument("--sync_version_tolerance", type=int, default=0)
     return parser.parse_args(argv)
 
 
@@ -79,6 +84,9 @@ class ParameterServer:
             checkpoint_saver=saver,
             checkpoint_steps=args.checkpoint_steps,
             master_client=master_client,
+            use_async=bool(args.use_async),
+            grads_to_wait=args.grads_to_wait,
+            sync_version_tolerance=args.sync_version_tolerance,
         )
         if args.checkpoint_dir_for_init:
             SparseCheckpointSaver(

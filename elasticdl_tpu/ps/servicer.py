@@ -23,6 +23,7 @@ import numpy as np
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import (
     blob_to_ndarray,
+    deduplicate_indexed_slices,
     deserialize_indexed_slices,
     ndarray_to_blob,
 )
@@ -40,6 +41,9 @@ class PserverServicer:
         checkpoint_saver=None,
         checkpoint_steps=0,
         master_client=None,
+        use_async=True,
+        grads_to_wait=1,
+        sync_version_tolerance=0,
     ):
         self._store = store
         self._ps_id = ps_id
@@ -51,6 +55,15 @@ class PserverServicer:
         self._dense = {}
         self._dense_version = 0
         self._dense_initialized = False
+        # sync-SGD mode (reference ps/servicer.py:166-236): buffer
+        # pushes until grads_to_wait arrive, reject grads older than
+        # version - sync_version_tolerance, single apply, version++
+        self._use_async = use_async
+        self._grads_to_wait = max(1, grads_to_wait)
+        self._sync_tolerance = max(0, sync_version_tolerance)
+        self._push_lock = threading.Lock()
+        self._grad_buffer = {}  # name -> ([values...], [ids...])
+        self._buffer_count = 0
 
     # ------------------------------------------------------------------
     def push_model(self, request, context=None):
@@ -104,6 +117,8 @@ class PserverServicer:
 
     # ------------------------------------------------------------------
     def push_gradients(self, request, context=None):
+        if not self._use_async:
+            return self._push_gradients_sync(request)
         grad_version = request.gradients.version
         lr_scale = 1.0
         if self._staleness_modulation:
@@ -116,6 +131,48 @@ class PserverServicer:
             self._store.push_gradients(name, ids, values, lr_scale=lr_scale)
         self._store.bump_version()
         version = self._store.version
+        self._maybe_checkpoint(version)
+        self._maybe_report_version(version)
+        return pb.PushGradientsResponse(accepted=True, version=version)
+
+    def _push_gradients_sync(self, request):
+        """Sync SGD: accumulate grads_to_wait pushes, reject stale ones
+        (reference ps/servicer.py:166-236; sparse grads are summed, as
+        there — each worker contributes disjoint-sign updates to the
+        rows it touched)."""
+        grad_version = request.gradients.version
+        with self._push_lock:
+            version = self._store.version
+            if grad_version < version - self._sync_tolerance:
+                return pb.PushGradientsResponse(
+                    accepted=False, version=version
+                )
+            # each push's lr_scale is folded into its values at buffer
+            # time (the merged apply is a single optimizer step, so a
+            # per-request LR is expressible only as gradient scaling)
+            push_scale = request.lr_scale if request.lr_scale > 0 else 1.0
+            for name, slices in request.gradients.embedding_tables.items():
+                values, ids = deserialize_indexed_slices(slices)
+                if push_scale != 1.0:
+                    values = values * push_scale
+                bucket = self._grad_buffer.setdefault(name, ([], []))
+                bucket[0].append(values)
+                bucket[1].append(ids)
+            self._buffer_count += 1
+            if self._buffer_count < self._grads_to_wait:
+                return pb.PushGradientsResponse(
+                    accepted=True, version=version
+                )
+            for name, (values_list, ids_list) in self._grad_buffer.items():
+                values = np.concatenate(values_list, axis=0)
+                ids = np.concatenate(ids_list, axis=0)
+                # merge duplicate ids across workers into one apply
+                values, ids = deduplicate_indexed_slices(values, ids)
+                self._store.push_gradients(name, ids, values)
+            self._grad_buffer = {}
+            self._buffer_count = 0
+            self._store.bump_version()
+            version = self._store.version
         self._maybe_checkpoint(version)
         self._maybe_report_version(version)
         return pb.PushGradientsResponse(accepted=True, version=version)

@@ -154,7 +154,8 @@ class SparseBatchPreparer:
         out["features"] = features
         return out, pull_info
 
-    def push_gradients(self, row_grads, pull_info, model_version=0):
+    def push_gradients(self, row_grads, pull_info, model_version=0,
+                       only_shards=None):
         grads_by_table = {}
         for name, (unique, n) in pull_info.items():
             if n == 0:
@@ -163,9 +164,58 @@ class SparseBatchPreparer:
                 np.asarray(row_grads[name])[:n],
                 unique,
             )
-        return self._ps.push_gradients(
-            grads_by_table, model_version=model_version
+        kwargs = {"model_version": model_version}
+        if only_shards is not None:
+            kwargs["only_shards"] = only_shards
+        return _normalize_push_result(
+            self._ps.push_gradients(grads_by_table, **kwargs),
+            model_version,
         )
+
+
+def _normalize_push_result(result, model_version):
+    """Client push results are (accepted, version[, rejected_shards]);
+    None rejected set means 'unknown — treat every shard as retryable'."""
+    if result is None:
+        return True, model_version, ()
+    parts = tuple(result)
+    if len(parts) >= 3:
+        return parts[0], parts[1], tuple(parts[2])
+    accepted, version = parts
+    return accepted, version, None if not accepted else ()
+
+
+def _forward_loss(model, loss_fn, compute_dtype, params, model_state,
+                  rows, features, labels, mask, rngs):
+    """Shared forward+loss used by the train step and the grad-only
+    retry path; returns (masked mean loss, new mutable model state)."""
+    if compute_dtype is not None:
+        params = cast_floating(params, compute_dtype)
+        rows = cast_floating(rows, compute_dtype)
+        features = cast_floating(features, compute_dtype)
+    merged = {**features, **rows}
+    variables = {"params": params, **model_state}
+    if model_state:
+        outputs, new_model_state = model.apply(
+            variables,
+            merged,
+            training=True,
+            rngs=rngs,
+            mutable=list(model_state.keys()),
+        )
+        new_model_state = dict(new_model_state)
+    else:
+        outputs = model.apply(variables, merged, training=True, rngs=rngs)
+        new_model_state = model_state
+    per_sample = loss_fn(labels, outputs)
+    return masked_mean(per_sample.astype(jnp.float32), mask), new_model_state
+
+
+def _split_batch(batch, row_keys):
+    features = dict(batch["features"])
+    labels, mask = batch["labels"], batch[MASK_KEY]
+    rows = {key: features.pop(key) for key in row_keys}
+    return features, labels, mask, rows
 
 
 def make_sparse_train_step(model, loss_fn, tx, specs, compute_dtype=None):
@@ -173,40 +223,15 @@ def make_sparse_train_step(model, loss_fn, tx, specs, compute_dtype=None):
     row_keys = [spec.name + ROWS_SUFFIX for spec in specs]
 
     def train_step(state: TrainState, batch):
-        features = dict(batch["features"])
-        labels, mask = batch["labels"], batch[MASK_KEY]
-        rows = {key: features.pop(key) for key in row_keys}
+        features, labels, mask, rows = _split_batch(batch, row_keys)
         rngs = {
             "dropout": jax.random.fold_in(jax.random.PRNGKey(0), state.step)
         }
 
         def compute_loss(params, rows):
-            compute_params = params
-            compute_rows = rows
-            compute_features = features
-            if compute_dtype is not None:
-                compute_params = cast_floating(params, compute_dtype)
-                compute_rows = cast_floating(rows, compute_dtype)
-                compute_features = cast_floating(features, compute_dtype)
-            merged = {**compute_features, **compute_rows}
-            variables = {"params": compute_params, **state.model_state}
-            if state.model_state:
-                outputs, new_model_state = model.apply(
-                    variables,
-                    merged,
-                    training=True,
-                    rngs=rngs,
-                    mutable=list(state.model_state.keys()),
-                )
-                new_model_state = dict(new_model_state)
-            else:
-                outputs = model.apply(
-                    variables, merged, training=True, rngs=rngs
-                )
-                new_model_state = state.model_state
-            per_sample = loss_fn(labels, outputs)
-            return masked_mean(per_sample.astype(jnp.float32), mask), (
-                new_model_state
+            return _forward_loss(
+                model, loss_fn, compute_dtype, params, state.model_state,
+                rows, features, labels, mask, rngs,
             )
 
         (loss, new_model_state), (param_grads, row_grads) = (
@@ -238,9 +263,44 @@ def make_sparse_train_step(model, loss_fn, tx, specs, compute_dtype=None):
     return train_step
 
 
+def make_row_grads_fn(model, loss_fn, specs, compute_dtype=None):
+    """d(loss)/d(rows) at FIXED params — the sync-PS retry path: when a
+    push is rejected as stale, fresh rows are pulled and only the row
+    gradients are recomputed (dense params were already updated locally;
+    reference worker.py:597-649 re-ran the whole minibatch because its
+    dense params lived on the PS too)."""
+    row_keys = [spec.name + ROWS_SUFFIX for spec in specs]
+
+    def row_grads(state: TrainState, batch):
+        features, labels, mask, rows = _split_batch(batch, row_keys)
+        rngs = {
+            "dropout": jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+        }
+
+        def compute_loss(rows):
+            loss, _ = _forward_loss(
+                model, loss_fn, compute_dtype, state.params,
+                state.model_state, rows, features, labels, mask, rngs,
+            )
+            return loss
+
+        grads = jax.grad(compute_loss)(rows)
+        grads = cast_floating(grads, jnp.float32)
+        return {
+            key[: -len(ROWS_SUFFIX)]: value
+            for key, value in grads.items()
+        }
+
+    return row_grads
+
+
 class SparseTrainer:
     """Trainer surface (create_state/train_step/eval_step) over dense
     on-device params + host-PS sparse tables."""
+
+    # the reference retried a rejected minibatch up to 64 times against
+    # the sync PS (worker/worker.py:49,608)
+    MAX_PUSH_RETRIES = 64
 
     def __init__(
         self,
@@ -263,6 +323,9 @@ class SparseTrainer:
                 model, loss_fn, optimizer, self._specs, compute_dtype
             ),
             donate_argnums=(0,),
+        )
+        self._row_grads = jax.jit(
+            make_row_grads_fn(model, loss_fn, self._specs, compute_dtype)
         )
         from elasticdl_tpu.train.step_fns import make_eval_step
 
@@ -301,9 +364,31 @@ class SparseTrainer:
             state = self.create_state(prepared["features"])
         self._prep_memo = None
         state, loss, row_grads = self._train_step(state, prepared)
-        self._version = self.preparer.push_gradients(
+        accepted, version, rejected = self.preparer.push_gradients(
             row_grads, pull_info, model_version=self._version
         )
+        retries = 0
+        while not accepted and retries < self.MAX_PUSH_RETRIES:
+            # sync PS rejected the push as stale: pull fresh rows and
+            # recompute row grads at current params, then push again —
+            # ONLY to the shards that rejected (the others already
+            # applied this minibatch's contribution)
+            self._version = version
+            prepared, pull_info = self.preparer.prepare(batch)
+            row_grads = self._row_grads(state, prepared)
+            accepted, version, rejected = self.preparer.push_gradients(
+                row_grads,
+                pull_info,
+                model_version=self._version,
+                only_shards=rejected,
+            )
+            retries += 1
+        if not accepted:
+            raise RuntimeError(
+                "sync PS rejected gradients %d times in a row"
+                % self.MAX_PUSH_RETRIES
+            )
+        self._version = version
         return state, loss
 
     def eval_step(self, state, batch):

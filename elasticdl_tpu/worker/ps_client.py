@@ -9,8 +9,18 @@ pull); there is no per-step dense traffic.
 """
 
 import concurrent.futures
+from typing import NamedTuple, Tuple
 
 import numpy as np
+
+
+class PushResult(NamedTuple):
+    """Outcome of a gradient push; a 2-tuple (accepted, version) also
+    satisfies consumers that don't target per-shard retries."""
+
+    accepted: bool
+    version: int
+    rejected_shards: Tuple[int, ...] = ()
 
 from elasticdl_tpu.common.grpc_utils import build_channel
 from elasticdl_tpu.common.tensor_utils import (
@@ -101,13 +111,22 @@ class PSClient:
             rows[positions[shard]] = values
         return rows
 
-    def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0):
+    def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0,
+                       only_shards=None):
         """grads_by_table: {name: (values [n,dim], ids [n])}; dedups then
-        scatters per-PS. Returns the max PS version seen.
+        scatters per-PS. Returns (accepted, max version, rejected shard
+        ids) — a sync-mode PS may reject a stale push (per shard), and a
+        retry must target only the rejecting shards or the others would
+        double-apply the minibatch.
 
         ``lr_scale`` multiplies the PS optimizer's configured learning
         rate (e.g. a worker-side schedule); 0 means "no scaling".
+        ``only_shards``: iterable of shard indices to push to (None =
+        all; the retry path passes the previously rejected set).
         """
+        shard_filter = (
+            None if only_shards is None else set(int(s) for s in only_shards)
+        )
         per_ps = [pb.PushGradientsRequest() for _ in self._stubs]
         for request in per_ps:
             request.gradients.version = model_version
@@ -123,6 +142,8 @@ class PSClient:
                 continue
             shard_of = ids % self.ps_num
             for shard in np.unique(shard_of):
+                if shard_filter is not None and int(shard) not in shard_filter:
+                    continue
                 pos = np.nonzero(shard_of == shard)[0]
                 serialize_indexed_slices(
                     values[pos],
@@ -130,12 +151,21 @@ class PSClient:
                     per_ps[int(shard)].gradients.embedding_tables[name],
                 )
         futures = []
-        for stub, request in zip(self._stubs, per_ps):
+        for shard, (stub, request) in enumerate(zip(self._stubs, per_ps)):
             if not request.gradients.embedding_tables:
                 continue
-            futures.append(self._pool.submit(stub.push_gradients, request))
-        version = 0
-        for future in futures:
+            if shard_filter is not None and shard not in shard_filter:
+                continue
+            futures.append(
+                (shard, self._pool.submit(stub.push_gradients, request))
+            )
+        # empty push (e.g. fully masked batch): version must pass
+        # through unchanged, or a sync worker would look maximally stale
+        version = model_version
+        rejected = []
+        for shard, future in futures:
             response = future.result()
             version = max(version, response.version)
-        return version
+            if not response.accepted:
+                rejected.append(shard)
+        return PushResult(not rejected, version, tuple(rejected))

@@ -104,10 +104,10 @@ def test_ps_client_routing_and_dedup(ps_cluster):
     np.testing.assert_array_equal(again, rows[::-1])
     # push deduped gradients: id 2 appears twice -> summed once
     values = np.ones((3, 4), np.float32)
-    version = client.push_gradients(
+    result = client.push_gradients(
         {"t": (values, np.array([2, 2, 3], dtype=np.int64))}
     )
-    assert version >= 1
+    assert result.accepted and result.version >= 1
     after = client.pull_embedding_vectors("t", np.array([2, 3], np.int64))
     # sgd default lr=0.01: id2 got grad 2.0, id3 got 1.0... but stores
     # use adam here, so just check rows moved and differ

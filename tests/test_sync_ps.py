@@ -1,0 +1,222 @@
+"""Sync-SGD mode of the sparse PS (reference ps/servicer.py:166-236):
+grads_to_wait accumulation, stale rejection, worker retry."""
+
+import numpy as np
+
+from elasticdl_tpu.common.tensor_utils import serialize_indexed_slices
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.ps.embedding_store import create_store
+from elasticdl_tpu.ps.servicer import PserverServicer
+
+
+def _push_request(name, values, ids, version):
+    request = pb.PushGradientsRequest()
+    request.gradients.version = version
+    serialize_indexed_slices(
+        np.asarray(values, np.float32),
+        np.asarray(ids, np.int64),
+        request.gradients.embedding_tables[name],
+    )
+    return request
+
+
+def _servicer(**kwargs):
+    store = create_store(seed=0)
+    store.set_optimizer("sgd", lr=1.0)
+    servicer = PserverServicer(store, use_async=False, **kwargs)
+    infos = pb.Model()
+    infos.embedding_table_infos.add(name="t", dim=2, initializer="0.0")
+    servicer.push_embedding_table_infos(infos)
+    return servicer, store
+
+
+def test_grads_to_wait_accumulates_then_applies_once():
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([5], np.int64)).copy()
+
+    r1 = servicer.push_gradients(_push_request("t", [[1.0, 0.0]], [5], 0))
+    assert r1.accepted and r1.version == 0  # buffered, not applied
+    np.testing.assert_array_equal(
+        store.lookup("t", np.array([5], np.int64)), before
+    )
+
+    r2 = servicer.push_gradients(_push_request("t", [[0.0, 1.0]], [5], 0))
+    assert r2.accepted and r2.version == 1  # applied + version++
+    after = store.lookup("t", np.array([5], np.int64))
+    # sgd lr=1.0: row -= sum of both grads
+    np.testing.assert_allclose(after, before - np.array([[1.0, 1.0]]),
+                               rtol=1e-6)
+
+
+def test_stale_push_rejected_until_refreshed():
+    servicer, store = _servicer(grads_to_wait=1, sync_version_tolerance=0)
+    assert servicer.push_gradients(
+        _push_request("t", [[1.0, 1.0]], [3], 0)
+    ).accepted  # version -> 1
+
+    stale = servicer.push_gradients(_push_request("t", [[1.0, 1.0]], [3], 0))
+    assert not stale.accepted
+    assert stale.version == 1  # tells the worker where to catch up to
+
+    fresh = servicer.push_gradients(
+        _push_request("t", [[1.0, 1.0]], [3], stale.version)
+    )
+    assert fresh.accepted and fresh.version == 2
+
+
+def test_version_tolerance_accepts_slightly_stale():
+    servicer, _ = _servicer(grads_to_wait=1, sync_version_tolerance=2)
+    for _ in range(3):
+        assert servicer.push_gradients(
+            _push_request("t", [[0.1, 0.1]], [1], 0)
+        ).accepted  # version now 3; grad_version 0 >= 3 - 2 fails next
+    assert not servicer.push_gradients(
+        _push_request("t", [[0.1, 0.1]], [1], 0)
+    ).accepted
+
+
+def test_multi_shard_retry_targets_only_rejecting_shard():
+    """With 2 sync shards at different versions, a retry must re-push
+    only to the shard that rejected — the other already applied the
+    minibatch (double-apply hazard)."""
+    from elasticdl_tpu.common.grpc_utils import build_server, find_free_port
+    from elasticdl_tpu.proto.services import (
+        add_pserver_servicer_to_server,
+    )
+    from elasticdl_tpu.worker.ps_client import PSClient
+
+    servicers, servers, addrs, counts = [], [], [], [0, 0]
+    for ps_id in range(2):
+        store = create_store(seed=ps_id)
+        store.set_optimizer("sgd", lr=1.0)
+        servicer = PserverServicer(
+            store, ps_id=ps_id, use_async=False, grads_to_wait=1
+        )
+        original = servicer.push_gradients
+
+        def counted(request, context=None, _i=ps_id, _fn=original):
+            counts[_i] += 1
+            return _fn(request, context)
+
+        servicer.push_gradients = counted
+        server = build_server()
+        add_pserver_servicer_to_server(servicer, server)
+        port = find_free_port()
+        server.add_insecure_port("localhost:%d" % port)
+        server.start()
+        servicers.append(servicer)
+        servers.append(server)
+        addrs.append("localhost:%d" % port)
+    try:
+        client = PSClient(addrs)
+        client.push_embedding_table_infos([("t", 2, 0.05)])
+        grads = np.ones((2, 2), np.float32)
+        even_odd = np.array([2, 3], dtype=np.int64)  # one id per shard
+        # advance shard 0 only (ids that hash to shard 0)
+        assert client.push_gradients(
+            {"t": (np.ones((1, 2), np.float32),
+                   np.array([4], dtype=np.int64))},
+            model_version=0,
+        ).accepted
+        # now a version-0 push: shard 0 (version 1) rejects, shard 1
+        # (version 0) accepts
+        result = client.push_gradients(
+            {"t": (grads, even_odd)}, model_version=0
+        )
+        assert not result.accepted
+        assert result.rejected_shards == (0,)
+        shard1_pushes = counts[1]
+        # targeted retry at the fresh version
+        retry = client.push_gradients(
+            {"t": (grads, even_odd)},
+            model_version=result.version,
+            only_shards=result.rejected_shards,
+        )
+        assert retry.accepted
+        assert counts[1] == shard1_pushes, "accepting shard re-pushed"
+    finally:
+        for server in servers:
+            server.stop(0)
+
+
+def test_sparse_trainer_retries_stale_push():
+    """End-to-end: two trainers sharing one sync PS; the slower one's
+    stale push must be retried transparently and still converge."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.data.pipeline import MASK_KEY
+    from elasticdl_tpu.train.optimizers import create_optimizer
+    from elasticdl_tpu.train.sparse import (
+        SparseEmbeddingSpec,
+        SparseTrainer,
+        embedding_lookup,
+    )
+
+    servicer, store = _servicer(grads_to_wait=1, sync_version_tolerance=0)
+
+    class _SyncClient:
+        """LocalPSClient equivalent speaking to the sync servicer."""
+
+        ps_num = 1
+
+        def push_embedding_table_infos(self, infos):
+            request = pb.Model()
+            for name, dim, init_scale in infos:
+                request.embedding_table_infos.add(
+                    name=name, dim=dim, initializer=str(init_scale)
+                )
+            servicer.push_embedding_table_infos(request)
+
+        def pull_embedding_vectors(self, name, ids):
+            return store.lookup(name, np.asarray(ids, np.int64))
+
+        def push_gradients(self, grads_by_table, model_version=0,
+                           lr_scale=0.0):
+            for name, (values, ids) in grads_by_table.items():
+                response = servicer.push_gradients(
+                    _push_request(name, values, ids, model_version)
+                )
+                return response.accepted, response.version
+            return True, store.version
+
+    class _Model(nn.Module):
+        @nn.compact
+        def __call__(self, features, training: bool = False):
+            emb = embedding_lookup(features, "e", combiner="sum")
+            return nn.Dense(1)(emb)[:, 0]
+
+    def bce(labels, logits):
+        logits = logits.astype(jnp.float32)
+        return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+            jnp.exp(-jnp.abs(logits))
+        )
+
+    specs = [SparseEmbeddingSpec("e", 4, feature_key="ids")]
+    trainers = [
+        SparseTrainer(
+            _Model(), bce, create_optimizer("Adam", learning_rate=0.05),
+            specs, _SyncClient(), compute_dtype="float32",
+        )
+        for _ in range(2)
+    ]
+    rng = np.random.default_rng(0)
+    planted = np.random.default_rng(999).normal(size=50)
+    states = [None, None]
+    losses = []
+    for step in range(40):
+        ids = rng.integers(0, 50, size=(16, 3))
+        labels = (planted[ids].sum(axis=1) > 0).astype(np.float32)
+        batch = {
+            "features": {"ids": ids},
+            "labels": labels,
+            MASK_KEY: np.ones(16, dtype=bool),
+        }
+        # trainer 0 trains every step; trainer 1 joins sometimes with a
+        # stale local version -> its push gets rejected -> retried
+        states[0], loss = trainers[0].train_step(states[0], batch)
+        losses.append(float(loss))
+        if step % 3 == 0:
+            trainers[1]._version = 0  # force staleness
+            states[1], _ = trainers[1].train_step(states[1], batch)
+    assert np.mean(losses[-8:]) < np.mean(losses[:8])
