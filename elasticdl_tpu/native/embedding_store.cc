@@ -37,12 +37,16 @@ struct OptConfig {
   float beta1 = 0.9f;
   float beta2 = 0.999f;
   float epsilon = 1e-8f;
+  // variants (reference go/pkg/ps/optimizer.go supports
+  // Momentum+nesterov and Adam+amsgrad)
+  bool nesterov = false;
+  bool amsgrad = false;
   int slots() const {
     switch (type) {
       case OptType::kSGD: return 0;
       case OptType::kMomentum: return 1;
       case OptType::kAdagrad: return 1;
-      case OptType::kAdam: return 2;
+      case OptType::kAdam: return amsgrad ? 3 : 2;
     }
     return 0;
   }
@@ -100,9 +104,17 @@ void apply_row(const OptConfig& opt, float* row, const float* grad,
     }
     case OptType::kMomentum: {
       float* vel = row + dim;
-      for (int64_t d = 0; d < dim; ++d) {
-        vel[d] = opt.momentum * vel[d] + grad[d];
-        w[d] -= lr * vel[d];
+      if (opt.nesterov) {
+        // lookahead step: w -= lr * (g + mu * vel_new)
+        for (int64_t d = 0; d < dim; ++d) {
+          vel[d] = opt.momentum * vel[d] + grad[d];
+          w[d] -= lr * (grad[d] + opt.momentum * vel[d]);
+        }
+      } else {
+        for (int64_t d = 0; d < dim; ++d) {
+          vel[d] = opt.momentum * vel[d] + grad[d];
+          w[d] -= lr * vel[d];
+        }
       }
       break;
     }
@@ -117,13 +129,20 @@ void apply_row(const OptConfig& opt, float* row, const float* grad,
     case OptType::kAdam: {
       float* m = row + dim;
       float* v = row + 2 * dim;
+      float* vmax = opt.amsgrad ? row + 3 * dim : nullptr;
       const float bc1 = 1.0f - std::pow(opt.beta1, (float)step);
       const float bc2 = 1.0f - std::pow(opt.beta2, (float)step);
       for (int64_t d = 0; d < dim; ++d) {
         m[d] = opt.beta1 * m[d] + (1.0f - opt.beta1) * grad[d];
         v[d] = opt.beta2 * v[d] + (1.0f - opt.beta2) * grad[d] * grad[d];
         const float mhat = m[d] / bc1;
-        const float vhat = v[d] / bc2;
+        float vv = v[d];
+        if (vmax) {
+          // amsgrad: denominator uses the running max of v
+          vmax[d] = vv > vmax[d] ? vv : vmax[d];
+          vv = vmax[d];
+        }
+        const float vhat = vv / bc2;
         w[d] -= lr * mhat / (std::sqrt(vhat) + opt.epsilon);
       }
       break;
@@ -158,8 +177,10 @@ int edl_store_set_optimizer(void* handle, const char* type, float lr,
   std::string t(type);
   if (t == "sgd") cfg.type = OptType::kSGD;
   else if (t == "momentum") cfg.type = OptType::kMomentum;
+  else if (t == "nesterov") { cfg.type = OptType::kMomentum; cfg.nesterov = true; }
   else if (t == "adagrad") cfg.type = OptType::kAdagrad;
   else if (t == "adam") cfg.type = OptType::kAdam;
+  else if (t == "amsgrad") { cfg.type = OptType::kAdam; cfg.amsgrad = true; }
   else return -1;
   cfg.lr = lr;
   cfg.momentum = momentum;

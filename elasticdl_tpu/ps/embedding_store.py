@@ -26,6 +26,22 @@ OPTIMIZER_DEFAULTS = dict(
 )
 
 
+def _normalize_opt_type(opt_type, kwargs):
+    """Fold nesterov=True / amsgrad=True kwargs into the variant opt
+    type strings the kernels dispatch on (reference optimizer.go
+    supports Momentum+nesterov and Adam+amsgrad as flags)."""
+    opt_type = opt_type.lower()
+    if kwargs.pop("nesterov", False):
+        if opt_type != "momentum":
+            raise ValueError("nesterov requires the momentum optimizer")
+        opt_type = "nesterov"
+    if kwargs.pop("amsgrad", False):
+        if opt_type != "adam":
+            raise ValueError("amsgrad requires the adam optimizer")
+        opt_type = "amsgrad"
+    return opt_type
+
+
 def _load_native():
     if not os.path.exists(_SO_PATH):
         try:
@@ -129,6 +145,7 @@ class NativeEmbeddingStore:
             self._handle = None
 
     def set_optimizer(self, opt_type, **kwargs):
+        opt_type = _normalize_opt_type(opt_type, kwargs)
         args = dict(OPTIMIZER_DEFAULTS)
         args.update(kwargs)
         rc = self._lib.edl_store_set_optimizer(
@@ -249,8 +266,10 @@ class NumpyEmbeddingStore:
         self.version = 0
 
     def set_optimizer(self, opt_type, **kwargs):
-        opt_type = opt_type.lower()
-        if opt_type not in ("sgd", "momentum", "adagrad", "adam"):
+        opt_type = _normalize_opt_type(opt_type, kwargs)
+        if opt_type not in (
+            "sgd", "momentum", "nesterov", "adagrad", "adam", "amsgrad"
+        ):
             raise ValueError("unsupported sparse optimizer %r" % opt_type)
         if self._meta:
             # Parity with the native store: slot layout is fixed at
@@ -286,9 +305,10 @@ class NumpyEmbeddingStore:
             table[id_] = self._rng.uniform(-scale, scale, size=dim).astype(
                 np.float32
             )
-            n_slots = {"sgd": 0, "momentum": 1, "adagrad": 1, "adam": 2}[
-                self._opt[0]
-            ]
+            n_slots = {
+                "sgd": 0, "momentum": 1, "nesterov": 1,
+                "adagrad": 1, "adam": 2, "amsgrad": 3,
+            }[self._opt[0]]
             self._slots[name][id_] = np.zeros(
                 (n_slots, dim), dtype=np.float32
             )
@@ -315,20 +335,27 @@ class NumpyEmbeddingStore:
                 step = self._steps[name][i]
                 if opt_type == "sgd":
                     w -= lr * grad
-                elif opt_type == "momentum":
+                elif opt_type in ("momentum", "nesterov"):
                     slots[0] = args["momentum"] * slots[0] + grad
-                    w -= lr * slots[0]
+                    if opt_type == "nesterov":
+                        w -= lr * (grad + args["momentum"] * slots[0])
+                    else:
+                        w -= lr * slots[0]
                 elif opt_type == "adagrad":
                     slots[0] += grad * grad
                     w -= lr * grad / (np.sqrt(slots[0]) + args["epsilon"])
-                elif opt_type == "adam":
+                elif opt_type in ("adam", "amsgrad"):
                     slots[0] = args["beta1"] * slots[0] + (1 - args["beta1"]) * grad
                     slots[1] = (
                         args["beta2"] * slots[1]
                         + (1 - args["beta2"]) * grad * grad
                     )
                     mhat = slots[0] / (1 - args["beta1"] ** step)
-                    vhat = slots[1] / (1 - args["beta2"] ** step)
+                    v = slots[1]
+                    if opt_type == "amsgrad":
+                        slots[2] = np.maximum(slots[2], v)
+                        v = slots[2]
+                    vhat = v / (1 - args["beta2"] ** step)
                     w -= lr * mhat / (np.sqrt(vhat) + args["epsilon"])
 
     def table_size(self, name):

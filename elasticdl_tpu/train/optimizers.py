@@ -7,9 +7,96 @@ but the same string spec survives as the cross-process interchange format
 (CLI flags, sparse-PS optimizer config, checkpoints).
 """
 
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
 import optax
 
-SUPPORTED = ("SGD", "Momentum", "Adam", "Adagrad", "AdamW", "RMSprop")
+SUPPORTED = (
+    "SGD", "Momentum", "Adam", "Adagrad", "AdamW", "RMSprop",
+    "Adamax", "Nadam", "Adadelta", "Ftrl",
+)
+
+
+class FtrlState(NamedTuple):
+    accum: optax.Updates  # n: sum of squared gradients
+    linear: optax.Updates  # z: the proximal linear term
+    count: jnp.ndarray  # step counter for schedule resolution
+
+
+def ftrl(learning_rate, learning_rate_power=-0.5,
+         initial_accumulator_value=0.1, l1_regularization_strength=0.0,
+         l2_regularization_strength=0.0):
+    """FTRL-proximal (McMahan et al. 2013), the CTR workhorse the
+    reference supports via Keras (optimizer_wrapper.py:116-149 lists its
+    slots 'accumulator'/'linear'). optax ships no FTRL, so this is a
+    from-scratch GradientTransformation with the same update rule as
+    tf.keras.optimizers.Ftrl. Note the sign convention: this transform
+    returns delta = w_new - w_old directly (it reconstructs the weight
+    from the proximal closed form), so it composes with apply_updates
+    like any other optax optimizer."""
+    lr_power = learning_rate_power
+    l1 = l1_regularization_strength
+    l2 = l2_regularization_strength
+
+    def init_fn(params):
+        return FtrlState(
+            accum=jax.tree_util.tree_map(
+                lambda p: jnp.full_like(p, initial_accumulator_value),
+                params,
+            ),
+            linear=jax.tree_util.tree_map(jnp.zeros_like, params),
+            count=jnp.zeros((), jnp.int32),
+        )
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("ftrl requires params")
+        # learning_rate may be an optax schedule (step -> lr), like the
+        # optax-built optimizer branches
+        lr = (
+            learning_rate(state.count)
+            if callable(learning_rate)
+            else learning_rate
+        )
+
+        def per_leaf(g, n, z, w):
+            g = g.astype(w.dtype)
+            new_n = n + g * g
+            sigma = (new_n ** -lr_power - n ** -lr_power) / lr
+            new_z = z + g - sigma * w
+            quadratic = new_n ** -lr_power / lr + 2.0 * l2
+            trigger = jnp.abs(new_z) > l1
+            new_w = jnp.where(
+                trigger,
+                (jnp.sign(new_z) * l1 - new_z) / quadratic,
+                jnp.zeros_like(w),
+            )
+            return new_w - w, new_n, new_z
+
+        flat = jax.tree_util.tree_map(
+            per_leaf, grads, state.accum, state.linear, params
+        )
+        updates = jax.tree_util.tree_map(
+            lambda leaf: leaf[0], flat,
+            is_leaf=lambda x: isinstance(x, tuple) and len(x) == 3,
+        )
+        new_accum = jax.tree_util.tree_map(
+            lambda leaf: leaf[1], flat,
+            is_leaf=lambda x: isinstance(x, tuple) and len(x) == 3,
+        )
+        new_linear = jax.tree_util.tree_map(
+            lambda leaf: leaf[2], flat,
+            is_leaf=lambda x: isinstance(x, tuple) and len(x) == 3,
+        )
+        return updates, FtrlState(
+            accum=new_accum,
+            linear=new_linear,
+            count=state.count + 1,
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 def create_optimizer(opt_type: str, **opt_args) -> optax.GradientTransformation:
@@ -56,6 +143,40 @@ def create_optimizer(opt_type: str, **opt_args) -> optax.GradientTransformation:
         momentum = float(opt_args.pop("momentum", 0.0))
         _reject_extra(opt_type, opt_args)
         return optax.rmsprop(lr, decay=decay, eps=eps, momentum=momentum)
+    if opt_type_lower == "adamax":
+        b1 = float(opt_args.pop("beta_1", 0.9))
+        b2 = float(opt_args.pop("beta_2", 0.999))
+        eps = float(opt_args.pop("epsilon", 1e-8))
+        _reject_extra(opt_type, opt_args)
+        return optax.adamax(lr, b1=b1, b2=b2, eps=eps)
+    if opt_type_lower == "nadam":
+        b1 = float(opt_args.pop("beta_1", 0.9))
+        b2 = float(opt_args.pop("beta_2", 0.999))
+        eps = float(opt_args.pop("epsilon", 1e-8))
+        _reject_extra(opt_type, opt_args)
+        return optax.nadam(lr, b1=b1, b2=b2, eps=eps)
+    if opt_type_lower == "adadelta":
+        rho = float(opt_args.pop("rho", 0.95))
+        eps = float(opt_args.pop("epsilon", 1e-7))
+        _reject_extra(opt_type, opt_args)
+        return optax.adadelta(lr, rho=rho, eps=eps)
+    if opt_type_lower == "ftrl":
+        kwargs = {
+            "learning_rate_power": float(
+                opt_args.pop("learning_rate_power", -0.5)
+            ),
+            "initial_accumulator_value": float(
+                opt_args.pop("initial_accumulator_value", 0.1)
+            ),
+            "l1_regularization_strength": float(
+                opt_args.pop("l1_regularization_strength", 0.0)
+            ),
+            "l2_regularization_strength": float(
+                opt_args.pop("l2_regularization_strength", 0.0)
+            ),
+        }
+        _reject_extra(opt_type, opt_args)
+        return ftrl(lr, **kwargs)
     raise ValueError(
         "Unsupported optimizer %r (supported: %s)" % (opt_type, SUPPORTED)
     )

@@ -94,6 +94,10 @@ class SparseBatchPreparer:
         self._ps = ps_client
         self._registered = False
 
+    @property
+    def ps_num(self):
+        return getattr(self._ps, "ps_num", 1)
+
     def register_tables(self):
         if not self._registered:
             self._ps.push_embedding_table_infos(
@@ -373,6 +377,13 @@ class SparseTrainer:
             # recompute row grads at current params, then push again —
             # ONLY to the shards that rejected (the others already
             # applied this minibatch's contribution)
+            if rejected is None and self.preparer.ps_num > 1:
+                # a multi-shard client MUST report which shards rejected,
+                # or a blanket retry would double-apply on the others
+                raise RuntimeError(
+                    "multi-shard PS client rejected a push without "
+                    "reporting rejected_shards; cannot retry safely"
+                )
             self._version = version
             prepared, pull_info = self.preparer.prepare(batch)
             row_grads = self._row_grads(state, prepared)

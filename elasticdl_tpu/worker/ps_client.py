@@ -13,15 +13,6 @@ from typing import NamedTuple, Tuple
 
 import numpy as np
 
-
-class PushResult(NamedTuple):
-    """Outcome of a gradient push; a 2-tuple (accepted, version) also
-    satisfies consumers that don't target per-shard retries."""
-
-    accepted: bool
-    version: int
-    rejected_shards: Tuple[int, ...] = ()
-
 from elasticdl_tpu.common.grpc_utils import build_channel
 from elasticdl_tpu.common.tensor_utils import (
     blob_to_ndarray,
@@ -31,6 +22,15 @@ from elasticdl_tpu.common.tensor_utils import (
 )
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.services import PserverStub
+
+
+class PushResult(NamedTuple):
+    """Outcome of a gradient push; a 2-tuple (accepted, version) also
+    satisfies consumers that don't target per-shard retries."""
+
+    accepted: bool
+    version: int
+    rejected_shards: Tuple[int, ...] = ()
 
 
 class PSClient:

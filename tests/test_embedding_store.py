@@ -33,7 +33,9 @@ def test_native_builds_and_lazy_inits():
 
 
 @needs_native
-@pytest.mark.parametrize("opt", ["sgd", "momentum", "adagrad", "adam"])
+@pytest.mark.parametrize(
+    "opt", ["sgd", "momentum", "nesterov", "adagrad", "adam", "amsgrad"]
+)
 def test_native_matches_numpy_optimizers(opt):
     native = NativeEmbeddingStore(seed=3)
     ref = NumpyEmbeddingStore(seed=3)
@@ -89,3 +91,35 @@ def test_numpy_store_staleness_lr_scale():
     np.testing.assert_allclose(
         store.lookup("t", ids)[0], [-0.5, -0.5]
     )
+
+
+@needs_native
+def test_variant_flags_normalize():
+    """nesterov/amsgrad booleans fold into the variant kernels; wrong
+    base optimizer is rejected."""
+    store = NativeEmbeddingStore(seed=0)
+    store.set_optimizer("momentum", lr=0.1, nesterov=True)
+    store.create_table("t", 2)
+    ref = NumpyEmbeddingStore(seed=0)
+    ref.set_optimizer("adam", amsgrad=True)
+    with pytest.raises(ValueError, match="nesterov requires"):
+        NumpyEmbeddingStore(seed=0).set_optimizer("sgd", nesterov=True)
+    with pytest.raises(ValueError, match="amsgrad requires"):
+        NumpyEmbeddingStore(seed=0).set_optimizer("sgd", amsgrad=True)
+
+
+def test_nesterov_differs_from_momentum():
+    ids = np.array([0], dtype=np.int64)
+    init = np.zeros((1, 2), np.float32)
+    results = {}
+    for opt in ("momentum", "nesterov"):
+        store = NumpyEmbeddingStore(seed=0)
+        store.set_optimizer(opt, lr=0.1, momentum=0.9)
+        store.create_table("t", 2)
+        store.import_table("t", ids, init)
+        for _ in range(3):
+            store.push_gradients("t", ids, np.ones((1, 2), np.float32))
+        results[opt] = store.lookup("t", ids)
+    assert not np.allclose(results["momentum"], results["nesterov"])
+    # nesterov's lookahead steps further along a constant gradient
+    assert (results["nesterov"] < results["momentum"]).all()
