@@ -52,6 +52,32 @@ def test_bf16_stream_f32_stats():
     assert all(o.dtype == jnp.bfloat16 for o in bn_outputs)
 
 
+def test_space_to_depth_packing():
+    """Exact 2x2-block packing semantics."""
+    x = jnp.arange(2 * 4 * 4 * 3).reshape(2, 4, 4, 3)
+    packed = resnet.space_to_depth(x, 2)
+    assert packed.shape == (2, 2, 2, 12)
+    # output pixel (0,0) = rows 0-1 x cols 0-1 of the input, channel-major
+    np.testing.assert_array_equal(
+        np.asarray(packed)[0, 0, 0],
+        np.concatenate([
+            np.asarray(x)[0, 0, 0], np.asarray(x)[0, 0, 1],
+            np.asarray(x)[0, 1, 0], np.asarray(x)[0, 1, 1],
+        ]),
+    )
+
+
+def test_space_to_depth_stem_forward():
+    model = resnet.resnet18(num_classes=4, stem="space_to_depth")
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, training=False)
+    out = model.apply(variables, x, training=False)
+    assert out.shape == (2, 4)
+    # stem grid is half-res, like conv7
+    stem_kernel = variables["params"]["Conv_0"]["kernel"]
+    assert stem_kernel.shape == (4, 4, 12, 64)
+
+
 def _flatten_intermediates(tree, prefix=""):
     items = []
     if isinstance(tree, dict):
