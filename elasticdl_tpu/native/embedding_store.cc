@@ -304,4 +304,63 @@ int edl_store_import(void* handle, const char* name, const int64_t* ids,
   return 0;
 }
 
+int edl_store_table_slots(void* handle, const char* name) {
+  Table* table = static_cast<Store*>(handle)->find(name);
+  return table == nullptr ? -1 : table->slots;
+}
+
+// Full-state export: weight+slot rows ([count, (1+slots)*dim] floats)
+// plus per-row optimizer step counts. The weights-only export above
+// matches the reference's checkpoint content (ps/parameters.py:194-199
+// drops slots); this variant closes that gap so a resumed Adam/Adagrad
+// continues from its exact slot state instead of restarting bias
+// correction (SURVEY.md s7 "optimizer-state checkpointing").
+int64_t edl_store_export_full(void* handle, const char* name,
+                              int64_t* out_ids, float* out_values,
+                              int64_t* out_steps, int64_t capacity) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  std::shared_lock<std::shared_mutex> lock(table->mu);
+  if (out_ids == nullptr) return (int64_t)table->rows.size();
+  const int64_t row_floats = table->dim * (1 + table->slots);
+  int64_t i = 0;
+  for (const auto& kv : table->rows) {
+    if (i >= capacity) break;
+    out_ids[i] = kv.first;
+    std::memcpy(out_values + i * row_floats, kv.second.get(),
+                sizeof(float) * row_floats);
+    auto step_it = table->row_steps.find(kv.first);
+    out_steps[i] = step_it == table->row_steps.end() ? 0 : step_it->second;
+    ++i;
+  }
+  return i;
+}
+
+// Full-state import. row_floats must equal (1+slots)*dim for the
+// CURRENT optimizer; on mismatch (optimizer changed between save and
+// restore) only the leading weight segment is imported and steps are
+// dropped — degrading to the weights-only semantics instead of failing.
+int edl_store_import_full(void* handle, const char* name,
+                          const int64_t* ids, const float* values,
+                          const int64_t* steps, int64_t n,
+                          int64_t row_floats, int shard_id, int shard_num) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  if (row_floats < table->dim) return -2;
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  const int64_t full = table->dim * (1 + table->slots);
+  const bool exact = row_floats == full;
+  for (int64_t i = 0; i < n; ++i) {
+    if (shard_num > 0 && (ids[i] % shard_num + shard_num) % shard_num != shard_id)
+      continue;
+    float* row = table->get_or_init(ids[i]);
+    std::memcpy(row, values + i * row_floats,
+                sizeof(float) * (exact ? full : table->dim));
+    if (exact && steps != nullptr) table->row_steps[ids[i]] = steps[i];
+  }
+  return 0;
+}
+
 }  // extern "C"

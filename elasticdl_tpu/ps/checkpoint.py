@@ -34,10 +34,20 @@ class SparseCheckpointSaver:
         os.makedirs(vdir, exist_ok=True)
         arrays = {}
         for name in store.table_names():
-            ids, values = store.export_table(name)
+            # full train state: weights + optimizer slot rows + per-row
+            # step counts. The reference dropped slot tables from
+            # checkpoints (ps/parameters.py:194-199), so a resumed Adam
+            # restarted its bias correction; saving them closes that gap
+            # (SURVEY.md s7). Old weights-only checkpoints still restore.
+            ids, rows, steps = store.export_table_full(name)
             arrays["ids/" + name] = ids
-            arrays["values/" + name] = values
+            arrays["fullrows/" + name] = rows
+            arrays["steps/" + name] = steps
             arrays["dim/" + name] = np.int64(store.table_dim(name))
+            # slot state is only meaningful under the optimizer that
+            # produced it — a same-width swap (momentum<->adagrad) would
+            # otherwise import foreign slots undetected
+            arrays["opt/" + name] = np.str_(store.opt_type)
         path = os.path.join(
             vdir,
             "embeddings-%d-of-%d.npz" % (self._shard_id, self._shard_num),
@@ -111,13 +121,40 @@ class SparseCheckpointSaver:
             for name in tables:
                 dim = int(data["dim/" + name])
                 store.create_table(name, dim)
-                store.import_table(
-                    name,
-                    data["ids/" + name],
-                    data["values/" + name],
-                    shard_id=self._shard_id,
-                    shard_num=self._shard_num,
+                saved_opt = (
+                    str(data["opt/" + name])
+                    if "opt/" + name in data.files
+                    else None
                 )
+                if (
+                    "fullrows/" + name in data.files
+                    and saved_opt == store.opt_type
+                ):
+                    store.import_table_full(
+                        name,
+                        data["ids/" + name],
+                        data["fullrows/" + name],
+                        data["steps/" + name],
+                        shard_id=self._shard_id,
+                        shard_num=self._shard_num,
+                    )
+                elif "fullrows/" + name in data.files:
+                    # optimizer changed since the save: weights only
+                    store.import_table(
+                        name,
+                        data["ids/" + name],
+                        data["fullrows/" + name][:, :dim],
+                        shard_id=self._shard_id,
+                        shard_num=self._shard_num,
+                    )
+                else:  # weights-only checkpoint (older format)
+                    store.import_table(
+                        name,
+                        data["ids/" + name],
+                        data["values/" + name],
+                        shard_id=self._shard_id,
+                        shard_num=self._shard_num,
+                    )
         logger.info(
             "Restored sparse checkpoint version %d into shard %d/%d",
             version,

@@ -104,6 +104,29 @@ def _load_native():
         ctypes.POINTER(ctypes.c_float),
         ctypes.c_int64,
     ]
+    lib.edl_store_table_slots.restype = ctypes.c_int
+    lib.edl_store_table_slots.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.edl_store_export_full.restype = ctypes.c_int64
+    lib.edl_store_export_full.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
+    lib.edl_store_import_full.restype = ctypes.c_int
+    lib.edl_store_import_full.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
     lib.edl_store_import.argtypes = [
         ctypes.c_void_p,
         ctypes.c_char_p,
@@ -137,6 +160,7 @@ class NativeEmbeddingStore:
             raise RuntimeError("native embedding store unavailable")
         self._handle = ctypes.c_void_p(self._lib.edl_store_create(seed))
         self._dims = {}
+        self._opt_type = "sgd"
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -146,6 +170,7 @@ class NativeEmbeddingStore:
 
     def set_optimizer(self, opt_type, **kwargs):
         opt_type = _normalize_opt_type(opt_type, kwargs)
+        self._opt_type = opt_type
         args = dict(OPTIMIZER_DEFAULTS)
         args.update(kwargs)
         rc = self._lib.edl_store_set_optimizer(
@@ -245,6 +270,57 @@ class NativeEmbeddingStore:
             ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             ids.size,
+            shard_id,
+            shard_num,
+        )
+        if rc != 0:
+            raise KeyError(name)
+
+    @property
+    def opt_type(self):
+        return self._opt_type
+
+    def table_slots(self, name):
+        n = self._lib.edl_store_table_slots(self._handle, name.encode())
+        if n < 0:
+            raise KeyError(name)
+        return n
+
+    def export_table_full(self, name):
+        """Full train state: (ids, rows [n, (1+slots)*dim], steps [n])."""
+        count = self._lib.edl_store_export_full(
+            self._handle, name.encode(), None, None, None, 0
+        )
+        row_floats = self._dims[name] * (1 + self.table_slots(name))
+        ids = np.empty((count,), dtype=np.int64)
+        rows = np.empty((count, row_floats), dtype=np.float32)
+        steps = np.empty((count,), dtype=np.int64)
+        got = self._lib.edl_store_export_full(
+            self._handle,
+            name.encode(),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            steps.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            count,
+        )
+        return ids[:got], rows[:got], steps[:got]
+
+    def import_table_full(self, name, ids, rows, steps,
+                          shard_id=0, shard_num=0):
+        """Inverse of export_table_full; a slot-layout mismatch (the
+        optimizer changed between save and restore) degrades to a
+        weights-only import."""
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        rows = np.ascontiguousarray(rows, dtype=np.float32)
+        steps = np.ascontiguousarray(steps, dtype=np.int64)
+        rc = self._lib.edl_store_import_full(
+            self._handle,
+            name.encode(),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            steps.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ids.size,
+            rows.shape[1] if rows.ndim == 2 else 0,
             shard_id,
             shard_num,
         )
@@ -390,6 +466,60 @@ class NumpyEmbeddingStore:
                 if shard_num > 0 and i % shard_num != shard_id:
                     continue
                 self._row(name, i)[:] = row
+
+    @property
+    def opt_type(self):
+        return self._opt[0]
+
+    def table_slots(self, name):
+        if name not in self._meta:
+            raise KeyError(name)
+        return {
+            "sgd": 0, "momentum": 1, "nesterov": 1,
+            "adagrad": 1, "adam": 2, "amsgrad": 3,
+        }[self._opt[0]]
+
+    def export_table_full(self, name):
+        with self._lock:
+            table = self._tables[name]
+            dim = self._meta[name][0]
+            slots = self.table_slots(name)
+            row_floats = dim * (1 + slots)
+            if not table:
+                return (
+                    np.empty((0,), np.int64),
+                    np.empty((0, row_floats), np.float32),
+                    np.empty((0,), np.int64),
+                )
+            ids = np.fromiter(table.keys(), dtype=np.int64, count=len(table))
+            rows = np.stack([
+                np.concatenate(
+                    [table[int(i)]] + list(self._slots[name][int(i)])
+                )
+                for i in ids
+            ])
+            steps = np.asarray(
+                [self._steps[name][int(i)] for i in ids], np.int64
+            )
+            return ids, rows, steps
+
+    def import_table_full(self, name, ids, rows, steps,
+                          shard_id=0, shard_num=0):
+        dim = self._meta[name][0]
+        slots = self.table_slots(name)
+        rows = np.asarray(rows, np.float32)
+        exact = rows.ndim == 2 and rows.shape[1] == dim * (1 + slots)
+        with self._lock:
+            for idx, i in enumerate(ids):
+                i = int(i)
+                if shard_num > 0 and i % shard_num != shard_id:
+                    continue
+                self._row(name, i)[:] = rows[idx][:dim]
+                if exact:
+                    self._slots[name][i][:] = rows[idx][dim:].reshape(
+                        slots, dim
+                    )
+                    self._steps[name][i] = int(steps[idx])
 
 
 def create_store(seed=0, prefer_native=True):

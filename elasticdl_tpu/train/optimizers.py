@@ -78,17 +78,13 @@ def ftrl(learning_rate, learning_rate_power=-0.5,
         flat = jax.tree_util.tree_map(
             per_leaf, grads, state.accum, state.linear, params
         )
-        updates = jax.tree_util.tree_map(
-            lambda leaf: leaf[0], flat,
-            is_leaf=lambda x: isinstance(x, tuple) and len(x) == 3,
-        )
-        new_accum = jax.tree_util.tree_map(
-            lambda leaf: leaf[1], flat,
-            is_leaf=lambda x: isinstance(x, tuple) and len(x) == 3,
-        )
-        new_linear = jax.tree_util.tree_map(
-            lambda leaf: leaf[2], flat,
-            is_leaf=lambda x: isinstance(x, tuple) and len(x) == 3,
+        # tree_transpose splits the per-leaf (delta, n, z) triples into
+        # three trees shaped like grads — structure-driven, so a params
+        # tree that itself contains 3-tuples cannot be misparsed
+        updates, new_accum, new_linear = jax.tree_util.tree_transpose(
+            jax.tree_util.tree_structure(grads),
+            jax.tree_util.tree_structure((0, 0, 0)),
+            flat,
         )
         return updates, FtrlState(
             accum=new_accum,

@@ -118,3 +118,20 @@ def test_unknown_optimizer_rejected():
 def test_unknown_kwarg_rejected():
     with pytest.raises(Exception):
         create_optimizer("Adam", learning_rate=0.1, blah=3)
+
+
+def test_ftrl_params_tree_with_tuples():
+    """A params tree containing 3-tuples must not confuse the result
+    split (structure-driven tree_transpose, not len-3 sniffing)."""
+    from elasticdl_tpu.train.optimizers import ftrl
+
+    tx = ftrl(0.1)
+    params = (jnp.ones(2), jnp.ones(3), jnp.ones(4))  # a 3-tuple tree
+    state = tx.init(params)
+    grads = (jnp.ones(2), jnp.ones(3), jnp.ones(4))
+    updates, state = tx.update(grads, state, params)
+    assert [u.shape for u in updates] == [(2,), (3,), (4,)]
+    import optax
+
+    new_params = optax.apply_updates(params, updates)
+    assert [p.shape for p in new_params] == [(2,), (3,), (4,)]
