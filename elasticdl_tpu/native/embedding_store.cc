@@ -263,11 +263,9 @@ void edl_store_bump_version(void* handle) {
 }
 
 // Export all (id, weight-row) pairs of a table into caller buffers.
-// Call with out_ids == nullptr to get the count. Weights only — slots
-// are excluded from checkpoints, matching the reference
-// (ps/parameters.py:194-199); unlike the reference this is a documented
-// choice, not an accident: sparse slots rebuild quickly and halve
-// checkpoint size.
+// Call with out_ids == nullptr to get the count. Weights-only variant,
+// used for serving export and weight inspection; checkpoints use
+// edl_store_export_full below so optimizer slot state survives resume.
 int64_t edl_store_export(void* handle, const char* name, int64_t* out_ids,
                          float* out_values, int64_t capacity) {
   auto* store = static_cast<Store*>(handle);

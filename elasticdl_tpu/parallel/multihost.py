@@ -1,0 +1,102 @@
+"""Elastic multi-host runtime: (re)initialize jax.distributed from the
+master's mesh rendezvous.
+
+Reference parity: AllReduceTrainer.init_horovod_if_needed
+(elasticdl/python/worker/allreduce_trainer.py:94-118) — before a step,
+the worker asks the master for (rank, size, rendezvous_id); if the
+rendezvous generation changed, it shuts Horovod down and re-inits
+against the new host set, then restores state by broadcast.
+
+TPU redesign: within a slice the ICI topology is fixed, so there is no
+per-step rendezvous. Elasticity happens at HOST granularity over DCN:
+the master's MeshRendezvous (master/rendezvous.py) assigns ranks and
+bumps a mesh epoch when the alive-host set changes; this helper turns a
+new epoch into `jax.distributed.shutdown()` + `initialize(coordinator,
+world_size, rank)` and tells the caller to rebuild its Mesh and restore
+from the latest checkpoint (broadcast-from-rank-0 has no analogue —
+state recovery is checkpoint-based, SURVEY.md §2.12/§5).
+"""
+
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.parallel.multihost")
+
+COORDINATOR_PORT = 51617
+
+
+class MultiHostRuntime:
+    """Tracks the mesh epoch and re-initializes jax.distributed when it
+    moves. ``distributed`` is injectable (tests pass a fake; production
+    uses jax.distributed)."""
+
+    def __init__(self, master_client, coordinator_port=COORDINATOR_PORT,
+                 distributed=None):
+        self._mc = master_client
+        self._port = coordinator_port
+        if distributed is None:
+            import jax.distributed as distributed
+        self._distributed = distributed
+        self._epoch = None  # epoch of the currently live runtime
+        self.rank = -1
+        self.world_size = 0
+
+    @property
+    def initialized(self):
+        return self._epoch is not None
+
+    def ensure_runtime(self, wait_sleep_secs=1.0, max_wait_secs=0):
+        """Join (or rejoin) the mesh. Blocks while the master hasn't
+        admitted this host (rank -1). Returns True when the runtime was
+        (re)initialized — the caller must rebuild its Mesh/jitted fns
+        and restore state from the latest checkpoint — False when the
+        existing runtime is still current."""
+        start = time.time()
+        while True:
+            info = self._mc.get_comm_info()
+            if info.rank >= 0:
+                break
+            if max_wait_secs and time.time() - start > max_wait_secs:
+                raise TimeoutError(
+                    "master never admitted this host into the mesh"
+                )
+            time.sleep(wait_sleep_secs)
+        if self._epoch == info.mesh_epoch:
+            return False
+        if self._epoch is not None:
+            logger.info(
+                "Mesh epoch %s -> %s: shutting down distributed runtime",
+                self._epoch, info.mesh_epoch,
+            )
+            self._distributed.shutdown()
+        coordinator = "%s:%d" % (
+            info.coordinator_addr.split(":")[0], self._port
+        )
+        self._distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=info.world_size,
+            process_id=info.rank,
+        )
+        self._epoch = info.mesh_epoch
+        self.rank = info.rank
+        self.world_size = info.world_size
+        logger.info(
+            "jax.distributed initialized: rank %d/%d (epoch %s, "
+            "coordinator %s)",
+            info.rank, info.world_size, info.mesh_epoch, coordinator,
+        )
+        return True
+
+    def check_epoch(self):
+        """Cheap between-steps probe (the reference re-checks rendezvous
+        every 20 steps, worker.py:814-819): True iff the epoch moved and
+        ensure_runtime() must be called."""
+        info = self._mc.get_comm_info()
+        return info.mesh_epoch != self._epoch
+
+    def shutdown(self):
+        if self._epoch is not None:
+            self._distributed.shutdown()
+            self._epoch = None
+            self.rank, self.world_size = -1, 0

@@ -26,6 +26,14 @@ OPTIMIZER_DEFAULTS = dict(
 )
 
 
+# optimizer -> slot rows per weight row; must match OptConfig::slots in
+# native/embedding_store.cc
+OPT_SLOT_COUNTS = {
+    "sgd": 0, "momentum": 1, "nesterov": 1,
+    "adagrad": 1, "adam": 2, "amsgrad": 3,
+}
+
+
 def _normalize_opt_type(opt_type, kwargs):
     """Fold nesterov=True / amsgrad=True kwargs into the variant opt
     type strings the kernels dispatch on (reference optimizer.go
@@ -170,7 +178,6 @@ class NativeEmbeddingStore:
 
     def set_optimizer(self, opt_type, **kwargs):
         opt_type = _normalize_opt_type(opt_type, kwargs)
-        self._opt_type = opt_type
         args = dict(OPTIMIZER_DEFAULTS)
         args.update(kwargs)
         rc = self._lib.edl_store_set_optimizer(
@@ -189,6 +196,9 @@ class NativeEmbeddingStore:
             )
         if rc != 0:
             raise ValueError("unsupported sparse optimizer %r" % opt_type)
+        # only after the native call succeeded — a failed swap must not
+        # desync the checkpoint opt tag from the live kernels
+        self._opt_type = opt_type
 
     def create_table(self, name, dim, init_scale=0.05):
         rc = self._lib.edl_store_create_table(
@@ -324,6 +334,12 @@ class NativeEmbeddingStore:
             shard_id,
             shard_num,
         )
+        if rc == -2:
+            raise ValueError(
+                "import_table_full: rows must be [n, (1+slots)*dim] = "
+                "[n, %d] for table %r"
+                % (self._dims[name] * (1 + self.table_slots(name)), name)
+            )
         if rc != 0:
             raise KeyError(name)
 
@@ -343,9 +359,7 @@ class NumpyEmbeddingStore:
 
     def set_optimizer(self, opt_type, **kwargs):
         opt_type = _normalize_opt_type(opt_type, kwargs)
-        if opt_type not in (
-            "sgd", "momentum", "nesterov", "adagrad", "adam", "amsgrad"
-        ):
+        if opt_type not in OPT_SLOT_COUNTS:
             raise ValueError("unsupported sparse optimizer %r" % opt_type)
         if self._meta:
             # Parity with the native store: slot layout is fixed at
@@ -381,10 +395,7 @@ class NumpyEmbeddingStore:
             table[id_] = self._rng.uniform(-scale, scale, size=dim).astype(
                 np.float32
             )
-            n_slots = {
-                "sgd": 0, "momentum": 1, "nesterov": 1,
-                "adagrad": 1, "adam": 2, "amsgrad": 3,
-            }[self._opt[0]]
+            n_slots = OPT_SLOT_COUNTS[self._opt[0]]
             self._slots[name][id_] = np.zeros(
                 (n_slots, dim), dtype=np.float32
             )
@@ -474,10 +485,7 @@ class NumpyEmbeddingStore:
     def table_slots(self, name):
         if name not in self._meta:
             raise KeyError(name)
-        return {
-            "sgd": 0, "momentum": 1, "nesterov": 1,
-            "adagrad": 1, "adam": 2, "amsgrad": 3,
-        }[self._opt[0]]
+        return OPT_SLOT_COUNTS[self._opt[0]]
 
     def export_table_full(self, name):
         with self._lock:

@@ -1,0 +1,106 @@
+"""MultiHostRuntime: mesh-epoch-driven jax.distributed lifecycle
+(reference allreduce_trainer.py:94-118 re-init semantics), driven
+against the real MeshRendezvous."""
+
+import pytest
+
+from elasticdl_tpu.master.rendezvous import MeshRendezvous
+from elasticdl_tpu.parallel.multihost import MultiHostRuntime
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+class FakeDistributed:
+    def __init__(self):
+        self.calls = []
+
+    def initialize(self, coordinator_address, num_processes, process_id):
+        self.calls.append(
+            ("init", coordinator_address, num_processes, process_id)
+        )
+
+    def shutdown(self):
+        self.calls.append(("shutdown",))
+
+
+class Client:
+    """MasterClient stand-in wired straight to a MeshRendezvous."""
+
+    def __init__(self, rendezvous, host):
+        self._r = rendezvous
+        self._host = host
+
+    def get_comm_info(self):
+        rank, size, epoch, coord = self._r.get_comm_info(self._host)
+        return pb.CommInfo(
+            rank=rank, world_size=size, mesh_epoch=epoch,
+            coordinator_addr=coord,
+        )
+
+
+def test_initialize_once_then_noop():
+    rendezvous = MeshRendezvous()
+    rendezvous.set_worker_hosts(["hostA:3333", "hostB:3333"])
+    fake = FakeDistributed()
+    runtime = MultiHostRuntime(
+        Client(rendezvous, "hostB:3333"), distributed=fake,
+        coordinator_port=5000,
+    )
+    assert runtime.ensure_runtime() is True
+    assert fake.calls == [("init", "hostA:5000", 2, 1)]
+    assert runtime.rank == 1 and runtime.world_size == 2
+    # same epoch: no-op
+    assert runtime.ensure_runtime() is False
+    assert len(fake.calls) == 1
+    assert not runtime.check_epoch()
+
+
+def test_membership_change_reinitializes():
+    rendezvous = MeshRendezvous()
+    rendezvous.set_worker_hosts(["hostA:3333", "hostB:3333"])
+    fake = FakeDistributed()
+    runtime = MultiHostRuntime(
+        Client(rendezvous, "hostA:3333"), distributed=fake,
+        coordinator_port=5000,
+    )
+    runtime.ensure_runtime()
+    rendezvous.add_worker_host("hostC:3333")  # epoch bump
+    assert runtime.check_epoch()
+    assert runtime.ensure_runtime() is True
+    assert fake.calls == [
+        ("init", "hostA:5000", 2, 0),
+        ("shutdown",),
+        ("init", "hostA:5000", 3, 0),
+    ]
+
+
+def test_unadmitted_host_blocks_then_joins():
+    rendezvous = MeshRendezvous()
+    rendezvous.set_worker_hosts(["hostA:3333"])
+    fake = FakeDistributed()
+    client = Client(rendezvous, "hostB:3333")
+    runtime = MultiHostRuntime(
+        client, distributed=fake, coordinator_port=5000
+    )
+    with pytest.raises(TimeoutError):
+        runtime.ensure_runtime(wait_sleep_secs=0.01, max_wait_secs=0.05)
+    rendezvous.add_worker_host("hostB:3333")
+    assert runtime.ensure_runtime() is True
+    assert runtime.rank == 1
+
+
+def test_coordinator_loss_promotes_next_rank():
+    """When the coordinator host dies, the surviving worker re-inits
+    with itself as rank 0 / coordinator."""
+    rendezvous = MeshRendezvous()
+    rendezvous.set_worker_hosts(["hostA:3333", "hostB:3333"])
+    fake = FakeDistributed()
+    runtime = MultiHostRuntime(
+        Client(rendezvous, "hostB:3333"), distributed=fake,
+        coordinator_port=5000,
+    )
+    runtime.ensure_runtime()
+    assert runtime.rank == 1
+    rendezvous.remove_worker_host("hostA:3333")
+    assert runtime.ensure_runtime() is True
+    assert runtime.rank == 0
+    assert fake.calls[-1] == ("init", "hostB:5000", 1, 0)
