@@ -87,6 +87,14 @@ def parse_worker_args(argv=None):
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--checkpoint_dir_for_init", default="")
+    # multi-host elastic SPMD: join the master's mesh rendezvous and
+    # (re)initialize jax.distributed; restart on mesh-epoch change
+    from elasticdl_tpu.parallel.multihost import COORDINATOR_PORT
+
+    parser.add_argument("--multihost", type=int, default=0)
+    parser.add_argument(
+        "--coordinator_port", type=int, default=COORDINATOR_PORT
+    )
     return parser.parse_args(argv)
 
 

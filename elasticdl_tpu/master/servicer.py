@@ -56,6 +56,11 @@ class MasterServicer:
             self._worker_liveness.pop(worker_id, None)
             self._worker_hosts.pop(worker_id, None)
 
+    def mesh_worker_ids(self):
+        """Workers registered as mesh members (sent a worker_host)."""
+        with self._lock:
+            return list(self._worker_hosts)
+
     def worker_host(self, worker_id):
         with self._lock:
             return self._worker_hosts.get(worker_id)

@@ -58,10 +58,15 @@ class TaskMonitor:
         now = time.time()
         dead = set()
 
-        # Liveness: worker silent for too long while holding tasks.
+        # Liveness: worker silent for too long while holding tasks OR
+        # while a registered mesh member — an idle member that dies must
+        # still be evicted from the rendezvous, or every future
+        # jax.distributed world size includes the ghost and initialize()
+        # hangs waiting for it.
         liveness = self._servicer.worker_liveness()
         doing = self._dispatcher.doing_tasks()
         holders = {worker_id for worker_id, _ in doing.values()}
+        holders |= set(self._servicer.mesh_worker_ids())
         for worker_id in holders:
             last = liveness.get(worker_id)
             if last is not None and now - last > self._liveness_timeout:

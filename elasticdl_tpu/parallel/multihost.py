@@ -17,6 +17,7 @@ from the latest checkpoint (broadcast-from-rank-0 has no analogue —
 state recovery is checkpoint-based, SURVEY.md §2.12/§5).
 """
 
+import threading
 import time
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
@@ -73,11 +74,31 @@ class MultiHostRuntime:
         coordinator = "%s:%d" % (
             info.coordinator_addr.split(":")[0], self._port
         )
-        self._distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=info.world_size,
-            process_id=info.rank,
+        # initialize() blocks until every process connects, which can be
+        # minutes while peers' pods schedule. Keep liveness fresh during
+        # the wait, or the master's idle-member eviction would boot this
+        # host mid-join and churn the mesh.
+        stop_keepalive = threading.Event()
+
+        def keepalive():
+            while not stop_keepalive.wait(3.0):
+                try:
+                    self._mc.get_comm_info()
+                except Exception:
+                    pass
+
+        keeper = threading.Thread(
+            target=keepalive, name="join-keepalive", daemon=True
         )
+        keeper.start()
+        try:
+            self._distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=info.world_size,
+                process_id=info.rank,
+            )
+        finally:
+            stop_keepalive.set()
         self._epoch = info.mesh_epoch
         self.rank = info.rank
         self.world_size = info.world_size
@@ -89,11 +110,21 @@ class MultiHostRuntime:
         return True
 
     def check_epoch(self):
-        """Cheap between-steps probe (the reference re-checks rendezvous
+        """Between-steps probe (the reference re-checks rendezvous
         every 20 steps, worker.py:814-819): True iff the epoch moved and
-        ensure_runtime() must be called."""
+        ensure_runtime() must be called. A transient RPC failure
+        (mesh_epoch < 0, master_client.py failure marker) is NOT an
+        epoch change — restarting the worker on a network blip would
+        discard un-checkpointed progress."""
         info = self._mc.get_comm_info()
-        return info.mesh_epoch != self._epoch
+        return self.epoch_moved(info.mesh_epoch)
+
+    def epoch_moved(self, seen_epoch):
+        """Compare an externally observed epoch (e.g. recorded by the
+        worker's heartbeat thread) against the live runtime's epoch."""
+        if seen_epoch is None or seen_epoch < 0:
+            return False
+        return self._epoch is not None and seen_epoch != self._epoch
 
     def shutdown(self):
         if self._epoch is not None:

@@ -5,6 +5,7 @@ Usage: python -m elasticdl_tpu.worker.main --master_addr=... --worker_id=0 \
     --model_zoo=... --training_data=...
 """
 
+import os
 import sys
 
 from elasticdl_tpu.common.args import parse_params_string, parse_worker_args
@@ -20,6 +21,30 @@ def main(argv=None):
     import jax
 
     args = parse_worker_args(argv)
+    master_client = MasterClient(args.master_addr, worker_id=args.worker_id)
+    multihost_runtime = None
+    if args.multihost:
+        # must run BEFORE any jax backend initialization
+        from elasticdl_tpu.parallel.multihost import MultiHostRuntime
+
+        multihost_runtime = MultiHostRuntime(
+            master_client, coordinator_port=args.coordinator_port
+        )
+        multihost_runtime.ensure_runtime()
+    # an elastic restart must resume from the freshest state: default
+    # the init dir to the worker's own checkpoint dir, so the relaunch
+    # (same command line) picks up everything checkpointed so far
+    checkpoint_dir_for_init = args.checkpoint_dir_for_init or (
+        args.checkpoint_dir if args.multihost else ""
+    )
+    if args.multihost and not checkpoint_dir_for_init:
+        import warnings
+
+        warnings.warn(
+            "--multihost without --checkpoint_dir: a mesh-epoch restart "
+            "will lose all training progress",
+            stacklevel=1,
+        )
     reader_params = parse_params_string(args.data_reader_params)
     data_origin = (
         args.training_data or args.validation_data or args.prediction_data
@@ -33,7 +58,7 @@ def main(argv=None):
 
         trainer_factory = SpmdTrainer
     worker = Worker(
-        MasterClient(args.master_addr, worker_id=args.worker_id),
+        master_client,
         args.model_zoo,
         reader,
         minibatch_size=args.minibatch_size,
@@ -45,9 +70,36 @@ def main(argv=None):
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_steps=args.checkpoint_steps,
         keep_checkpoint_max=args.keep_checkpoint_max,
-        checkpoint_dir_for_init=args.checkpoint_dir_for_init,
+        checkpoint_dir_for_init=checkpoint_dir_for_init,
+        multihost_runtime=multihost_runtime,
+        # the elastic fallback dir is empty on first launch; only an
+        # explicit operator resume request is strict
+        resume_optional=not args.checkpoint_dir_for_init,
     )
-    worker.run()
+    from elasticdl_tpu.common.log_utils import default_logger
+    from elasticdl_tpu.worker.worker import (
+        EPOCH_RESTART_EXIT_CODE,
+        MeshEpochChanged,
+    )
+
+    logger = default_logger("elasticdl_tpu.worker.main")
+    try:
+        worker.run()
+    except MeshEpochChanged as e:
+        # pod manager relaunches us with the same command line; the
+        # restarted process rejoins at the new epoch and resumes from
+        # checkpoint_dir_for_init (defaulted to checkpoint_dir above).
+        # os._exit, not sys.exit: worker.run() already flushed the
+        # checkpoint manager in its finally block, and lingering
+        # non-daemon threads (orbax's async machinery, the
+        # jax.distributed coordinator) would otherwise block interpreter
+        # teardown forever — the process must die NOW so the pod
+        # restarts into the new mesh.
+        logger.warning("Restarting for new mesh epoch: %s", e)
+        import logging
+
+        logging.shutdown()
+        os._exit(EPOCH_RESTART_EXIT_CODE)
     return 0
 
 

@@ -34,6 +34,18 @@ class CheckpointRestoreError(RuntimeError):
     """Fatal: --checkpoint_dir_for_init was given but restore failed."""
 
 
+class MeshEpochChanged(RuntimeError):
+    """The alive-host set changed: this process must restart, rejoin the
+    mesh at the new epoch, and resume from the latest checkpoint (the
+    elastic-SPMD answer to the reference's Horovod re-init + broadcast,
+    allreduce_trainer.py:66-118). Raised out of the training loop;
+    worker main exits with EPOCH_RESTART_EXIT_CODE so the pod manager
+    relaunches the pod."""
+
+
+EPOCH_RESTART_EXIT_CODE = 3
+
+
 class Worker:
     def __init__(
         self,
@@ -53,6 +65,8 @@ class Worker:
         checkpoint_steps=0,
         keep_checkpoint_max=3,
         checkpoint_dir_for_init="",
+        multihost_runtime=None,
+        resume_optional=False,
     ):
         self._mc = master_client
         self.spec = get_model_spec(model_zoo_module)
@@ -135,6 +149,11 @@ class Worker:
         self._checkpoint_mgr = None
         self._init_checkpoint_dir = checkpoint_dir_for_init
         self._restore_attempted = not checkpoint_dir_for_init
+        # lenient restore: elastic restarts default the init dir to the
+        # job's own checkpoint dir, which legitimately holds nothing on
+        # first launch — fresh init then, instead of a fatal error. An
+        # operator's explicit --checkpoint_dir_for_init stays strict.
+        self._resume_optional = resume_optional
         if checkpoint_dir and checkpoint_steps:
             from elasticdl_tpu.train.checkpoint import (
                 DenseCheckpointManager,
@@ -164,6 +183,7 @@ class Worker:
                 "embedding tables"
             )
         self._callbacks = list(self.spec.callbacks() or [])
+        self._multihost = multihost_runtime
         # opt-in per-phase wall-clock accounting (EDL_TIMING=1),
         # reference worker.py:298-812 / common/timing_utils.py
         from elasticdl_tpu.common.timing_utils import Timing
@@ -176,11 +196,16 @@ class Worker:
         # for 20-40 s, which must not read as worker death.
         self._heartbeat_stop = threading.Event()
         self._heartbeat_thread = None
+        # last mesh epoch seen by the heartbeat; the training loop reads
+        # this instead of issuing its own get_comm_info RPC per probe
+        self._seen_mesh_epoch = None
 
     def _start_heartbeat(self, interval_secs=3.0):
         def beat():
             while not self._heartbeat_stop.wait(interval_secs):
-                self._mc.get_comm_info()
+                info = self._mc.get_comm_info()
+                if info.mesh_epoch >= 0:
+                    self._seen_mesh_epoch = info.mesh_epoch
 
         self._heartbeat_thread = threading.Thread(
             target=beat, name="worker-heartbeat", daemon=True
@@ -189,6 +214,18 @@ class Worker:
 
     def _stop_heartbeat(self):
         self._heartbeat_stop.set()
+
+    def _check_mesh_epoch(self):
+        """Elastic membership probe on the hot loops (the reference
+        re-checks its rendezvous every 20 steps, worker.py:814-819).
+        Reads the heartbeat's cached epoch — no RPC on the step path."""
+        if self._multihost is not None and self._multihost.epoch_moved(
+            self._seen_mesh_epoch
+        ):
+            raise MeshEpochChanged(
+                "mesh epoch moved to %s at version %d"
+                % (self._seen_mesh_epoch, self._version)
+            )
 
     # ------------------------------------------------------------------
     @property
@@ -228,12 +265,21 @@ class Worker:
                     and self._version % self._report_version_steps == 0
                 ):
                     self._mc.report_version(self._version)
+                self._check_mesh_epoch()
                 for cb in self._callbacks:
                     cb.on_batch_end(self._version, loss)
                 if self.stop_training:
                     break
         except CheckpointRestoreError:
-            raise  # fatal: never train from random init after a resume ask
+            raise  # fatal for this process; pod-level restart handles it
+        except MeshEpochChanged:
+            # requeue in-flight tasks NOW: the relaunched process reuses
+            # this worker_id and heartbeats immediately, so the master's
+            # liveness scan would never see this "death" and the tasks
+            # would rot until the slow task-timeout falsely killed the
+            # relaunched worker
+            self.tds.report_pending_failed("mesh epoch changed")
+            raise
         except Exception as e:  # report so tasks get retried elsewhere
             logger.exception("Training stream failed")
             self.tds.report_pending_failed(str(e))
@@ -261,6 +307,23 @@ class Worker:
         else:
             self.state = self.trainer.ensure_state(self.state, batch)
             template = self.state
+        import os as _os
+
+        if self._resume_optional and not _os.path.isdir(
+            self._init_checkpoint_dir
+        ):
+            # elastic-fallback dir that was never created: legitimate
+            # first launch. Leniency covers ONLY "nothing saved yet" —
+            # a restore that finds data but fails stays fatal, else a
+            # transient storage error would silently train from random
+            # init and rotate out the good checkpoints.
+            logger.info(
+                "No checkpoint dir %r yet; fresh initialization",
+                self._init_checkpoint_dir,
+            )
+            self._restore_attempted = True
+            self.state = self.trainer.ensure_state(self.state, batch)
+            return
         mgr = None
         try:
             # constructor included: a nonexistent dir (create=False)
@@ -281,6 +344,16 @@ class Worker:
             if mgr is not None:
                 mgr.close()
         if restored is None:
+            if self._resume_optional:
+                # dir exists but holds no complete checkpoint: also a
+                # legitimate first-launch state under the elastic default
+                logger.info(
+                    "No checkpoint in %r yet; fresh initialization",
+                    self._init_checkpoint_dir,
+                )
+                self._restore_attempted = True
+                self.state = self.trainer.ensure_state(self.state, batch)
+                return
             raise CheckpointRestoreError(
                 "--checkpoint_dir_for_init=%r holds no restorable "
                 "checkpoint" % self._init_checkpoint_dir
@@ -422,6 +495,7 @@ class Worker:
         import time
 
         while True:
+            self._check_mesh_epoch()
             task = self._mc.get_task(task_type)
             if task.task_id == 0:
                 if task.type == pb.WAIT:

@@ -73,6 +73,22 @@ def test_membership_change_reinitializes():
     ]
 
 
+def test_rpc_failure_marker_is_not_an_epoch_change():
+    """mesh_epoch=-1 (MasterClient RPC-failure marker) must not trigger
+    a restart — a network blip would discard un-checkpointed work."""
+    rendezvous = MeshRendezvous()
+    rendezvous.set_worker_hosts(["hostA:3333"])
+    fake = FakeDistributed()
+    runtime = MultiHostRuntime(
+        Client(rendezvous, "hostA:3333"), distributed=fake,
+        coordinator_port=5000,
+    )
+    runtime.ensure_runtime()
+    assert not runtime.epoch_moved(-1)
+    assert not runtime.epoch_moved(None)
+    assert runtime.epoch_moved(rendezvous.mesh_epoch + 1)
+
+
 def test_unadmitted_host_blocks_then_joins():
     rendezvous = MeshRendezvous()
     rendezvous.set_worker_hosts(["hostA:3333"])

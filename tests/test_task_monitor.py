@@ -58,3 +58,32 @@ def test_silent_worker_recovered_and_mesh_epoch_bumped():
         assert rendezvous.hosts() == ["h1:1"]
     finally:
         monitor.stop()
+
+
+def test_idle_mesh_member_evicted_on_silence():
+    """A mesh member holding no tasks must still be evicted when silent
+    (a ghost in the rendezvous wedges jax.distributed's world size)."""
+    import time
+
+    from elasticdl_tpu.master.rendezvous import MeshRendezvous
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.master.task_monitor import TaskMonitor
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    dispatcher = TaskDispatcher(
+        training_shards={"t": (0, 4)}, records_per_task=2, num_epochs=1
+    )
+    rendezvous = MeshRendezvous()
+    servicer = MasterServicer(dispatcher, None, rendezvous)
+    monitor = TaskMonitor(
+        dispatcher, servicer, rendezvous, liveness_timeout_secs=0.05
+    )
+    # idle member joins the mesh via get_comm_info, never takes a task
+    servicer.get_comm_info(
+        pb.GetCommInfoRequest(worker_id=7, worker_host="ghost:3333")
+    )
+    assert rendezvous.hosts() == ["ghost:3333"]
+    time.sleep(0.1)
+    monitor._scan()
+    assert rendezvous.hosts() == []

@@ -211,3 +211,50 @@ def test_worker_checkpoint_resume_and_fatal_restore(tmp_path):
             assert not dispatcher.finished()
         finally:
             server.stop(None)
+
+
+def test_mesh_epoch_change_aborts_for_restart(tmp_path):
+    """A mesh-epoch bump mid-training must raise MeshEpochChanged out of
+    the worker (the process then exits EPOCH_RESTART_EXIT_CODE and the
+    pod manager relaunches it into the new mesh)."""
+    import pytest
+
+    from elasticdl_tpu.worker.worker import MeshEpochChanged
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=256, seed=0)
+
+    server, dispatcher, evals, port = start_master(
+        str(train_dir), str(train_dir), str(tmp_path / "export")
+    )
+
+    class EpochFlipRuntime:
+        def __init__(self):
+            self.calls = 0
+
+        def epoch_moved(self, seen_epoch):
+            self.calls += 1
+            return self.calls >= 2  # second probe sees a new epoch
+
+    runtime = EpochFlipRuntime()
+    try:
+        worker = Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "tests.models.mnist_with_export",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=32,
+            report_version_steps=2,
+            wait_sleep_secs=0.1,
+            multihost_runtime=runtime,
+        )
+        with pytest.raises(MeshEpochChanged):
+            worker.run()
+        assert runtime.calls >= 2
+        # in-flight tasks were requeued on the way out (the relaunched
+        # same-id worker keeps liveness fresh, so the master would never
+        # see this as a death)
+        assert not dispatcher.finished()
+        assert not dispatcher.doing_tasks(), "tasks left orphaned"
+    finally:
+        server.stop(0)
