@@ -26,6 +26,12 @@ class TaskDataService:
         self._lock = threading.Lock()
         # deque of [task, records_total, records_reported]
         self._pending_tasks = collections.deque()
+        # bumped whenever a stream is (re)opened or failed: the stream
+        # producer runs on a prefetch thread, and without a generation
+        # check it could fetch one more task AFTER report_pending_failed
+        # cleared the books — orphaning that task on a worker that is
+        # about to exit
+        self._stream_gen = 0
         self.train_end_task = None
         self.job_over = False
         # non-training tasks encountered while streaming training records;
@@ -40,7 +46,13 @@ class TaskDataService:
         us are parked on ``out_of_band_tasks`` for the worker to process;
         TRAIN_END_CALLBACK is remembered on ``train_end_task``.
         """
+        with self._lock:
+            self._stream_gen += 1
+            my_gen = self._stream_gen
         while True:
+            with self._lock:
+                if self._stream_gen != my_gen:
+                    return  # stream was failed/superseded
             task = self._mc.get_task()
             if task.task_id == 0:
                 if task.type == pb.WAIT:
@@ -59,7 +71,17 @@ class TaskDataService:
                 return
             total = task.end - task.start
             with self._lock:
-                self._pending_tasks.append([task, total, 0])
+                if self._stream_gen != my_gen:
+                    stale = task  # fetched in the failure window
+                else:
+                    stale = None
+                    self._pending_tasks.append([task, total, 0])
+            if stale is not None:
+                # hand it straight back so it requeues for a live worker
+                self._mc.report_task_result(
+                    stale.task_id, "stream closed"
+                )
+                return
             yield from self._reader.read_records(task)
 
     def report_record_done(self, count):
@@ -80,8 +102,12 @@ class TaskDataService:
             self._mc.report_task_result(task.task_id, "")
 
     def report_pending_failed(self, err_message):
-        """Report every pending task as failed (training step blew up)."""
+        """Report every pending task as failed (training step blew up).
+
+        Also invalidates the live stream generation so the prefetch
+        producer can't fetch-and-orphan one more task afterwards."""
         with self._lock:
+            self._stream_gen += 1
             pending = [entry[0] for entry in self._pending_tasks]
             self._pending_tasks.clear()
         for task in pending:

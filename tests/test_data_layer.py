@@ -111,3 +111,55 @@ def test_pipeline_prefetch_propagates_errors():
     ds = Dataset(gen).prefetch(2)
     with pytest.raises(RuntimeError):
         list(ds)
+
+
+def test_task_stream_failure_window_does_not_orphan_tasks():
+    """After report_pending_failed, the (prefetch-threaded) stream must
+    stop fetching; a task fetched in the failure window is handed back
+    immediately rather than orphaned on the exiting worker."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+    class FakeMC:
+        def __init__(self):
+            self.next_id = 0
+            self.reported = []  # (task_id, err)
+
+        def get_task(self, task_type=None):
+            self.next_id += 1
+            return pb.Task(
+                task_id=self.next_id, shard_name="s", start=0, end=2,
+                type=pb.TRAINING,
+            )
+
+        def report_task_result(self, task_id, err=""):
+            self.reported.append((task_id, err))
+
+    class FakeReader:
+        def read_records(self, task):
+            yield b"r0"
+            yield b"r1"
+
+    mc = FakeMC()
+    tds = TaskDataService(mc, FakeReader())
+    stream = tds.training_record_stream()
+    assert next(stream) == b"r0"  # task 1 fetched + pending
+    assert tds.has_pending()
+
+    tds.report_pending_failed("boom")
+    assert [t for t, _ in mc.reported] == [1]
+    assert not tds.has_pending()
+
+    # draining the generator must NOT fetch-and-keep another task:
+    # either it stops straight away, or a task fetched in the window is
+    # reported back ("stream closed") without entering pending
+    rest = list(stream)
+    assert rest == [b"r1"]  # only the already-read task's records
+    assert not tds.has_pending()
+    for task_id, err in mc.reported[1:]:
+        assert err == "stream closed"
+
+    # a FRESH stream works again after the failure
+    stream2 = tds.training_record_stream()
+    assert next(stream2) == b"r0"
+    assert tds.has_pending()
