@@ -1,0 +1,107 @@
+// Concurrency stress test for the embedding store, built with
+// -fsanitize=thread (make tsan). The reference ships no race detection
+// at all (SURVEY.md §5: go test runs without -race); this closes that
+// gap for the one component with real lock contention: concurrent
+// lookups (lazy row creation), gradient pushes, exports, and version
+// bumps across threads and tables.
+//
+// Exit 0 + "STRESS-OK" iff no data race was reported (TSAN aborts the
+// process on findings when TSAN_OPTIONS=halt_on_error=1).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* edl_store_create(uint64_t seed);
+void edl_store_destroy(void* handle);
+int edl_store_set_optimizer(void* handle, const char* type, float lr,
+                            float momentum, float beta1, float beta2,
+                            float epsilon);
+int edl_store_create_table(void* handle, const char* name, int64_t dim,
+                           float init_scale);
+int edl_store_lookup(void* handle, const char* name, const int64_t* ids,
+                     int64_t n, float* out);
+int edl_store_push_gradients(void* handle, const char* name,
+                             const int64_t* ids, const float* grads,
+                             int64_t n, float lr_scale);
+int64_t edl_store_version(void* handle);
+void edl_store_bump_version(void* handle);
+int64_t edl_store_export_full(void* handle, const char* name,
+                              int64_t* out_ids, float* out_values,
+                              int64_t* out_steps, int64_t capacity);
+int edl_store_table_slots(void* handle, const char* name);
+}
+
+namespace {
+constexpr int kDim = 8;
+constexpr int kThreads = 8;
+constexpr int kIters = 400;
+constexpr int kIdsPerOp = 16;
+const char* kTables[2] = {"alpha", "beta"};
+
+void worker(void* store, int tid) {
+  int64_t ids[kIdsPerOp];
+  float buffer[kIdsPerOp * kDim];
+  float grads[kIdsPerOp * kDim];
+  for (int i = 0; i < kIdsPerOp * kDim; ++i) grads[i] = 0.01f;
+  uint64_t rng = 0x9e3779b97f4a7c15ull * (tid + 1);
+  for (int iter = 0; iter < kIters; ++iter) {
+    rng = rng * 6364136223846793005ull + 1442695040888963407ull;
+    const char* table = kTables[(rng >> 33) & 1];
+    for (int i = 0; i < kIdsPerOp; ++i) {
+      ids[i] = (int64_t)((rng >> (i % 24)) % 512);
+    }
+    switch ((rng >> 20) % 4) {
+      case 0:
+      case 1:
+        if (edl_store_lookup(store, table, ids, kIdsPerOp, buffer) != 0)
+          std::abort();
+        break;
+      case 2:
+        if (edl_store_push_gradients(store, table, ids, grads, kIdsPerOp,
+                                     1.0f) != 0)
+          std::abort();
+        edl_store_bump_version(store);
+        break;
+      case 3: {
+        int64_t count =
+            edl_store_export_full(store, table, nullptr, nullptr, nullptr, 0);
+        if (count < 0) std::abort();
+        // row width follows the live optimizer's slot count — a
+        // hardcoded width would heap-overflow if the optimizer under
+        // stress ever changes
+        const int slots = edl_store_table_slots(store, table);
+        if (slots < 0) std::abort();
+        std::vector<int64_t> out_ids(count + 64);
+        std::vector<float> out_values((count + 64) * kDim * (1 + slots));
+        std::vector<int64_t> out_steps(count + 64);
+        if (edl_store_export_full(store, table, out_ids.data(),
+                                  out_values.data(), out_steps.data(),
+                                  count + 64) < 0)
+          std::abort();
+        break;
+      }
+    }
+  }
+}
+}  // namespace
+
+int main() {
+  void* store = edl_store_create(7);
+  edl_store_set_optimizer(store, "adam", 0.01f, 0.9f, 0.9f, 0.999f, 1e-8f);
+  for (const char* table : kTables) {
+    if (edl_store_create_table(store, table, kDim, 0.05f) != 0) return 2;
+  }
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back(worker, store, t);
+  }
+  for (auto& t : threads) t.join();
+  if (edl_store_version(store) <= 0) return 3;
+  edl_store_destroy(store);
+  std::printf("STRESS-OK\n");
+  return 0;
+}

@@ -60,14 +60,24 @@ class TaskDataService:
                     continue
                 self.job_over = True
                 return
-            if task.type == pb.TRAIN_END_CALLBACK:
-                self.train_end_task = task
-                return
             if task.type != pb.TRAINING:
                 # Park it and end the stream: the worker drains
                 # out_of_band_tasks (eval/predict interleave) and then
-                # opens a fresh training stream.
-                self.out_of_band_tasks.append(task)
+                # opens a fresh training stream. Same failure-window
+                # rule as TRAINING below: a task fetched after the
+                # stream was failed must be handed back, not parked by
+                # a worker that is about to exit.
+                with self._lock:
+                    stale = self._stream_gen != my_gen
+                    if not stale:
+                        if task.type == pb.TRAIN_END_CALLBACK:
+                            self.train_end_task = task
+                        else:
+                            self.out_of_band_tasks.append(task)
+                if stale:
+                    self._mc.report_task_result(
+                        task.task_id, "stream closed"
+                    )
                 return
             total = task.end - task.start
             with self._lock:

@@ -271,7 +271,12 @@ class Worker:
                 if self.stop_training:
                     break
         except CheckpointRestoreError:
-            raise  # fatal for this process; pod-level restart handles it
+            # fatal for this process; requeue held tasks first (the
+            # relaunched same-id worker keeps liveness fresh, so the
+            # master would never liveness-recover them) and invalidate
+            # the stream so its prefetch thread stops fetching
+            self.tds.report_pending_failed("checkpoint restore failed")
+            raise
         except MeshEpochChanged:
             # requeue in-flight tasks NOW: the relaunched process reuses
             # this worker_id and heartbeats immediately, so the master's
