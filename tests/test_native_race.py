@@ -17,7 +17,28 @@ NATIVE_DIR = os.path.join(
 )
 
 
-@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def _tsan_available():
+    """g++ alone is not enough — libtsan ships separately on minimal
+    images; probe with a tiny -fsanitize=thread link."""
+    if shutil.which("g++") is None:
+        return False
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        src_path = os.path.join(tmp, "probe.cc")
+        with open(src_path, "w") as f:
+            f.write("int main() { return 0; }\n")
+        probe = subprocess.run(
+            ["g++", "-fsanitize=thread", "-o",
+             os.path.join(tmp, "probe"), src_path],
+            capture_output=True,
+        )
+        return probe.returncode == 0
+
+
+@pytest.mark.skipif(
+    not _tsan_available(), reason="no C++ toolchain with libtsan"
+)
 def test_store_survives_tsan_stress():
     result = subprocess.run(
         ["make", "-s", "tsan"],
