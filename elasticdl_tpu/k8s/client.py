@@ -183,16 +183,21 @@ class Client:
             ]
         return manifest
 
-    def _service_manifest(self, name, port, replica_type, replica_index):
+    def _service_manifest(self, name, port, replica_type, replica_index,
+                          service_type=None):
+        spec = {
+            "selector": self._labels(replica_type, replica_index),
+            "ports": [{"port": port, "targetPort": port}],
+        }
+        if service_type is None:
+            spec["clusterIP"] = "None"  # headless: DNS -> pod IP
+        else:
+            spec["type"] = service_type
         return {
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {"name": name},
-            "spec": {
-                "selector": self._labels(replica_type, replica_index),
-                "ports": [{"port": port, "targetPort": port}],
-                "clusterIP": "None",  # headless: DNS -> pod IP
-            },
+            "spec": spec,
         }
 
     # ------------------------------------------------------------------
@@ -228,6 +233,20 @@ class Client:
         )
         return pod
 
+    def get_tensorboard_service_name(self):
+        return "tensorboard-%s" % self.job_name
+
+    def create_tensorboard_service(self, port=6006):
+        """LoadBalancer service exposing the master pod's tensorboard
+        (reference: common/k8s_tensorboard_client.py:33-66,
+        k8s_client.py:221-237). Deleted by delete_master."""
+        return self._api.create_service(
+            self._service_manifest(
+                self.get_tensorboard_service_name(), port, "master", 0,
+                service_type="LoadBalancer",
+            )
+        )
+
     def delete_worker(self, worker_id):
         self._delete_pod_and_service(self.get_worker_pod_name(worker_id))
 
@@ -236,6 +255,11 @@ class Client:
 
     def delete_master(self):
         self._delete_pod_and_service(self.get_master_pod_name())
+        try:
+            # a LoadBalancer is a billed cloud resource; never orphan it
+            self._api.delete_service(self.get_tensorboard_service_name())
+        except Exception:
+            pass  # best-effort: usually not created
 
     def _delete_pod_and_service(self, name):
         try:

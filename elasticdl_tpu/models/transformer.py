@@ -130,14 +130,21 @@ class TransformerLM(nn.Module):
     dropout: float = 0.0
     attention_impl: str = "auto"
     mesh: Optional[Any] = None
+    # per-block rematerialization: activations recomputed in the
+    # backward pass instead of stored — the standard HBM-for-FLOPs trade
+    # that makes long-sequence / deep configs fit (jax.checkpoint)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, training: bool = False):
         x = nn.Embed(
             self.vocab_size, self.embed_dim, name="wte"
         )(tokens.astype(jnp.int32))
+        block_cls = (
+            nn.remat(Block, static_argnums=(2,)) if self.remat else Block
+        )
         for i in range(self.num_layers):
-            x = Block(
+            x = block_cls(
                 self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 attention_impl=self.attention_impl,

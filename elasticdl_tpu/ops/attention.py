@@ -35,10 +35,12 @@ def xla_attention(q, k, v, causal=False, sm_scale=None):
 
 def _pallas_ok(q, k, block_q, block_k):
     seq_q, seq_k = q.shape[2], k.shape[2]
-    # None = flash_attention's auto-tuner picks the block; its fallback
-    # floor is min(seq, 128), so only divisibility by that floor matters
-    block_q = block_q if block_q is not None else 128
-    block_k = block_k if block_k is not None else 128
+    # None = flash_attention's auto-tuner picks the block; ask it what
+    # it would pick so this gate can't drift from the tuner's fallback
+    if block_q is None:
+        block_q = _flash._auto_block(seq_q, 512)
+    if block_k is None:
+        block_k = _flash._auto_block(seq_k, 1024)
     return (
         seq_q % min(block_q, seq_q) == 0
         and seq_k % min(block_k, seq_k) == 0

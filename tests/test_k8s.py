@@ -264,3 +264,18 @@ def test_cli_zoo_init(tmp_path, monkeypatch):
     dockerfile = (tmp_path / "Dockerfile").read_text()
     assert "FROM python:3.12-slim" in dockerfile
     assert "COPY . /model_zoo" in dockerfile
+
+
+def test_tensorboard_loadbalancer_service():
+    """Reference parity: k8s_tensorboard_client.py:33-66 — a
+    LoadBalancer service selecting the master pod on the TB port."""
+    from elasticdl_tpu.k8s.client import Client
+
+    api = FakeApi()
+    client = Client(api, "job1", image_name="img")
+    client.create_tensorboard_service(port=6006)
+    service = api.services["tensorboard-job1"]
+    assert service["spec"]["type"] == "LoadBalancer"
+    assert service["spec"]["ports"][0]["port"] == 6006
+    selector = service["spec"]["selector"]
+    assert selector["elasticdl-tpu-replica-type"] == "master"

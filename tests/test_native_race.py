@@ -36,10 +36,9 @@ def _tsan_available():
         return probe.returncode == 0
 
 
-@pytest.mark.skipif(
-    not _tsan_available(), reason="no C++ toolchain with libtsan"
-)
 def test_store_survives_tsan_stress():
+    if not _tsan_available():
+        pytest.skip("no C++ toolchain with libtsan")
     result = subprocess.run(
         ["make", "-s", "tsan"],
         cwd=os.path.abspath(NATIVE_DIR),
