@@ -254,12 +254,20 @@ class Client:
         self._delete_pod_and_service(self.get_ps_pod_name(ps_id))
 
     def delete_master(self):
-        self._delete_pod_and_service(self.get_master_pod_name())
         try:
-            # a LoadBalancer is a billed cloud resource; never orphan it
-            self._api.delete_service(self.get_tensorboard_service_name())
-        except Exception:
-            pass  # best-effort: usually not created
+            self._delete_pod_and_service(self.get_master_pod_name())
+        finally:
+            # a LoadBalancer is a billed cloud resource; delete it even
+            # when the pod delete raises (e.g. pod already gone)
+            try:
+                self._api.delete_service(
+                    self.get_tensorboard_service_name()
+                )
+            except Exception as e:
+                logger.warning(
+                    "tensorboard service delete failed (often just "
+                    "never created): %s", e
+                )
 
     def _delete_pod_and_service(self, name):
         try:

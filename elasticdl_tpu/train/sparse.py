@@ -338,6 +338,12 @@ class SparseTrainer:
         # memo of the last prepared batch, so ensure_state followed by
         # eval_step/train_step on the same batch pulls rows once
         self._prep_memo = None
+        # per-phase wall-clock (EDL_TIMING=1): sparse_pull/sparse_push
+        # are this design's analogues of the reference's get_model /
+        # report_gradient phases (common/timing_utils.py, worker.py:298)
+        from elasticdl_tpu.common.timing_utils import Timing
+
+        self.timing = Timing()
 
     def create_state(self, sample_features):
         init_rng, self._rng = jax.random.split(self._rng)
@@ -348,7 +354,8 @@ class SparseTrainer:
     def _prepare_once(self, batch):
         if self._prep_memo is not None and self._prep_memo[0] is batch:
             return self._prep_memo[1], self._prep_memo[2]
-        prepared, pull_info = self.preparer.prepare(batch)
+        with self.timing.timeit("sparse_pull"):
+            prepared, pull_info = self.preparer.prepare(batch)
         self._prep_memo = (batch, prepared, pull_info)
         return prepared, pull_info
 
@@ -367,10 +374,13 @@ class SparseTrainer:
         if state is None:
             state = self.create_state(prepared["features"])
         self._prep_memo = None
+        t0 = self.timing.start()
         state, loss, row_grads = self._train_step(state, prepared)
-        accepted, version, rejected = self.preparer.push_gradients(
-            row_grads, pull_info, model_version=self._version
-        )
+        self.timing.end_record_sync("batch_process", t0, loss)
+        with self.timing.timeit("sparse_push"):
+            accepted, version, rejected = self.preparer.push_gradients(
+                row_grads, pull_info, model_version=self._version
+            )
         retries = 0
         while not accepted and retries < self.MAX_PUSH_RETRIES:
             # sync PS rejected the push as stale: pull fresh rows and
@@ -385,14 +395,18 @@ class SparseTrainer:
                     "reporting rejected_shards; cannot retry safely"
                 )
             self._version = version
-            prepared, pull_info = self.preparer.prepare(batch)
+            with self.timing.timeit("sparse_pull"):
+                prepared, pull_info = self.preparer.prepare(batch)
             row_grads = self._row_grads(state, prepared)
-            accepted, version, rejected = self.preparer.push_gradients(
-                row_grads,
-                pull_info,
-                model_version=self._version,
-                only_shards=rejected,
-            )
+            with self.timing.timeit("sparse_push"):
+                accepted, version, rejected = (
+                    self.preparer.push_gradients(
+                        row_grads,
+                        pull_info,
+                        model_version=self._version,
+                        only_shards=rejected,
+                    )
+                )
             retries += 1
         if not accepted:
             raise RuntimeError(

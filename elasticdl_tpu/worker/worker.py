@@ -290,6 +290,9 @@ class Worker:
             self.tds.report_pending_failed(str(e))
         finally:
             self._timing.report("training stream")
+            trainer_timing = getattr(self.trainer, "timing", None)
+            if trainer_timing is not None:
+                trainer_timing.report("sparse trainer")
 
     def _restore_from_checkpoint(self, batch):
         """Resume from --checkpoint_dir_for_init on the first batch.

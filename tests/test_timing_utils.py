@@ -60,3 +60,45 @@ def test_trace_writes_profile(tmp_path, monkeypatch):
         (jnp.ones((4, 4)) @ jnp.ones((4, 4))).block_until_ready()
     assert glob.glob(str(tmp_path / "region" / "**" / "*.xplane.pb"),
                      recursive=True)
+
+
+def test_sparse_trainer_phases_recorded(monkeypatch, tmp_path):
+    """SparseTrainer records sparse_pull / batch_process / sparse_push
+    (the reference's get_model / batch / report_gradient phases)."""
+    monkeypatch.setenv("EDL_TIMING", "1")
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import numpy as np
+
+    from elasticdl_tpu.data.pipeline import MASK_KEY
+    from elasticdl_tpu.ps.local_client import LocalPSClient
+    from elasticdl_tpu.train.optimizers import create_optimizer
+    from elasticdl_tpu.train.sparse import (
+        SparseEmbeddingSpec,
+        SparseTrainer,
+        embedding_lookup,
+    )
+
+    class _Model(nn.Module):
+        @nn.compact
+        def __call__(self, features, training: bool = False):
+            return nn.Dense(1)(
+                embedding_lookup(features, "e", combiner="sum")
+            )[:, 0]
+
+    trainer = SparseTrainer(
+        _Model(),
+        lambda labels, logits: (logits - labels) ** 2,
+        create_optimizer("SGD", learning_rate=0.1),
+        [SparseEmbeddingSpec("e", 4, feature_key="ids")],
+        LocalPSClient(opt_type="sgd", lr=0.1),
+        compute_dtype="float32",
+    )
+    batch = {
+        "features": {"ids": np.arange(8).reshape(8, 1)},
+        "labels": np.ones(8, np.float32),
+        MASK_KEY: np.ones(8, dtype=bool),
+    }
+    trainer.train_step(None, batch)
+    summary = trainer.timing.summary()
+    assert {"sparse_pull", "batch_process", "sparse_push"} <= set(summary)
