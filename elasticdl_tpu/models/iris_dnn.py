@@ -39,8 +39,10 @@ def optimizer():
 
 def dataset_fn(dataset, mode=None, metadata=None):
     def parse(record):
-        if isinstance(record, bytes):
-            record = record.decode("utf-8")
+        if isinstance(record, (bytes, bytearray, memoryview)):
+            # record readers yield bytes-like objects (the mmap reader
+            # yields zero-copy memoryviews)
+            record = bytes(record).decode("utf-8")
         if isinstance(record, str):
             parts = record.strip().split(",")
         else:  # already a sequence of fields

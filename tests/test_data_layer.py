@@ -163,3 +163,57 @@ def test_task_stream_failure_window_does_not_orphan_tasks():
     stream2 = tds.training_record_stream()
     assert next(stream2) == b"r0"
     assert tds.has_pending()
+
+
+def test_mmap_and_file_readers_agree(tmp_path):
+    """The zero-copy mmap reader and the buffered-file fallback must
+    return byte-identical records for any range."""
+    from elasticdl_tpu.data.recordio import (
+        MmapRecordReader,
+        _PyRecordReader,
+        write_records,
+    )
+
+    path = str(tmp_path / "f.rec")
+    payloads = [b"x" * (i % 7) + bytes([i % 256]) for i in range(257)]
+    write_records(path, payloads)
+    mm = MmapRecordReader(path)
+    py = _PyRecordReader(path)
+    assert len(mm) == len(py) == 257
+    for start, end in ((0, 257), (5, 6), (250, 300), (100, 100), (-3, 2)):
+        assert [bytes(r) for r in mm.read_range(start, end)] == list(
+            py.read_range(start, end)
+        )
+    assert mm.read(13) == py.read(13) == payloads[13]
+    mm.close()
+    py.close()
+
+
+def test_mmap_reader_rejects_garbage(tmp_path):
+    import pytest
+
+    from elasticdl_tpu.data.recordio import RecordReader
+
+    path = str(tmp_path / "junk.bin")
+    with open(path, "wb") as f:
+        f.write(b"this is not an edlrec file at all, definitely not")
+    with pytest.raises(ValueError):
+        RecordReader(path)
+    with open(str(tmp_path / "empty.bin"), "wb"):
+        pass
+    with pytest.raises(ValueError):
+        RecordReader(str(tmp_path / "empty.bin"))
+
+
+def test_mmap_reader_close_with_live_views(tmp_path):
+    """Consumers may hold yielded views past close(); close must not
+    raise and views must stay valid until dropped."""
+    from elasticdl_tpu.data.recordio import MmapRecordReader, write_records
+
+    path = str(tmp_path / "f.rec")
+    write_records(path, [b"hello", b"world"])
+    reader = MmapRecordReader(path)
+    views = list(reader.read_range(0, 2))
+    reader.close()  # BufferError swallowed; map lives via the views
+    assert bytes(views[0]) == b"hello"
+    del views
