@@ -98,9 +98,23 @@ class SavedModelExporter(Callback):
         path = (extended_config or {}).get("saved_model_path")
         if not path:
             return
+        if state is None:
+            # defense in depth — the worker fails the task before this
+            raise RuntimeError("no trained state to export")
         if self._export_fn is not None:
             self._export_fn(state, path)
-        else:
-            from elasticdl_tpu.train.export import export_train_state
+            return
+        from elasticdl_tpu.common.log_utils import default_logger
+        from elasticdl_tpu.train.export import export_train_state
 
-            export_train_state(state, path)
+        spec = getattr(self.worker, "spec", None)
+        if spec is not None and getattr(
+            spec, "sparse_embedding_specs", None
+        ):
+            default_logger(__name__).warning(
+                "Export holds the DENSE state only; this model's sparse "
+                "embedding tables live on the PS — serve them from the "
+                "PS checkpoints, or use train/model_handler's promoted "
+                "export to bundle them"
+            )
+        export_train_state(state, path)

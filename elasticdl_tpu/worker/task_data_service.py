@@ -123,6 +123,22 @@ class TaskDataService:
         for task in pending:
             self._mc.report_task_result(task.task_id, err_message)
 
+    def report_parked_failed(self, err_message):
+        """Hand back tasks parked for later processing (out-of-band
+        eval/predict, train-end). Only for FATAL exits: a worker that
+        keeps running drains these itself. Self-contained: bumps the
+        stream generation under the lock, so a racing stream producer
+        cannot park one more task after the drain."""
+        with self._lock:
+            self._stream_gen += 1
+            parked = list(self.out_of_band_tasks)
+            self.out_of_band_tasks.clear()
+            if self.train_end_task is not None:
+                parked.append(self.train_end_task)
+                self.train_end_task = None
+        for task in parked:
+            self._mc.report_task_result(task.task_id, err_message)
+
     def has_pending(self):
         with self._lock:
             return bool(self._pending_tasks)

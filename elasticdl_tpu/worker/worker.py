@@ -12,6 +12,7 @@ evaluations.
 """
 
 import threading
+import time
 
 import numpy as np
 
@@ -74,6 +75,7 @@ class Worker:
         self._minibatch_size = minibatch_size
         self._mode = mode
         self._report_version_steps = report_version_steps
+        self._wait_sleep_secs = wait_sleep_secs
         self.tds = TaskDataService(
             master_client, data_reader, wait_sleep_secs=wait_sleep_secs
         )
@@ -183,6 +185,15 @@ class Worker:
                 "embedding tables"
             )
         self._callbacks = list(self.spec.callbacks() or [])
+        # --output works for every model, not only those declaring an
+        # exporter: add the default (it no-ops unless the train-end task
+        # carries saved_model_path; reference behavior, callbacks.py:25)
+        from elasticdl_tpu.train.callbacks import SavedModelExporter
+
+        if not any(
+            isinstance(cb, SavedModelExporter) for cb in self._callbacks
+        ):
+            self._callbacks.append(SavedModelExporter())
         self._multihost = multihost_runtime
         # opt-in per-phase wall-clock accounting (EDL_TIMING=1),
         # reference worker.py:298-812 / common/timing_utils.py
@@ -276,14 +287,17 @@ class Worker:
             # master would never liveness-recover them) and invalidate
             # the stream so its prefetch thread stops fetching
             self.tds.report_pending_failed("checkpoint restore failed")
+            self.tds.report_parked_failed("checkpoint restore failed")
             raise
         except MeshEpochChanged:
             # requeue in-flight tasks NOW: the relaunched process reuses
             # this worker_id and heartbeats immediately, so the master's
             # liveness scan would never see this "death" and the tasks
             # would rot until the slow task-timeout falsely killed the
-            # relaunched worker
+            # relaunched worker. Parked out-of-band/train-end tasks go
+            # back too — nothing will ever drain them in this process.
             self.tds.report_pending_failed("mesh epoch changed")
+            self.tds.report_parked_failed("mesh epoch changed")
             raise
         except Exception as e:  # report so tasks get retried elsewhere
             logger.exception("Training stream failed")
@@ -426,12 +440,77 @@ class Worker:
             self._mc.report_task_result(task.task_id, str(e))
 
     def _process_train_end_task(self, task):
+        from elasticdl_tpu.train.callbacks import SavedModelExporter
+
+        wants_export = bool(task.extended_config.get("saved_model_path"))
+        if wants_export and self.state is None:
+            # this worker never trained (e.g. relaunched after an
+            # elastic restart with only the train-end task left): try
+            # to restore state from checkpoint before giving the task up
+            self._try_restore_for_export()
+        if wants_export and self.state is None:
+            # fail the task so the dispatcher re-queues it for a worker
+            # that trained (silently reporting success would end the job
+            # with its only artifact missing); sleep so the refetch loop
+            # can't burn the retry cap in milliseconds
+            self._mc.report_task_result(
+                task.task_id, "no trained state to export"
+            )
+            time.sleep(self._wait_sleep_secs)
+            return
+        export_error = None
         for cb in self._callbacks:
             try:
                 cb.on_train_end(self.state, dict(task.extended_config))
-            except Exception:
+            except Exception as e:
                 logger.exception("train-end callback failed")
+                if isinstance(cb, SavedModelExporter):
+                    export_error = e
+        if export_error is not None:
+            # the export is the job's artifact: a failed exporter fails
+            # the task (bounded by the dispatcher's retry cap)
+            self._mc.report_task_result(
+                task.task_id, "export failed: %s" % export_error
+            )
+            time.sleep(self._wait_sleep_secs)
+            return
         self._mc.report_task_result(task.task_id)
+
+    def _try_restore_for_export(self):
+        """Best-effort state restore for a worker that only ever saw the
+        train-end task: build a template batch from the reader and run
+        the normal checkpoint restore."""
+        if not self._init_checkpoint_dir:
+            return
+        try:
+            shards = self._reader.create_shards()
+            name, (start, count) = next(iter(shards.items()))
+            template_task = pb.Task(
+                shard_name=name,
+                start=start,
+                end=start + min(count, self._minibatch_size),
+                type=pb.TRAINING,
+            )
+            batch = next(
+                iter(
+                    self._batches(
+                        self._reader.read_records(template_task),
+                        Mode.TRAINING,
+                    )
+                )
+            )
+            # strict mode: the lenient elastic default would fall back
+            # to FRESH init here, and we'd export random weights as if
+            # they were the trained model
+            previous = self._resume_optional
+            self._resume_optional = False
+            try:
+                self._restore_attempted = False
+                self._restore_from_checkpoint(batch)
+            finally:
+                self._resume_optional = previous
+        except Exception:
+            logger.exception("restore-for-export failed")
 
     def _drain_out_of_band(self):
         while self.tds.out_of_band_tasks:

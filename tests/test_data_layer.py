@@ -217,3 +217,26 @@ def test_mmap_reader_close_with_live_views(tmp_path):
     reader.close()  # BufferError swallowed; map lives via the views
     assert bytes(views[0]) == b"hello"
     del views
+
+
+def test_report_parked_failed_hands_back_oob_tasks():
+    """Fatal worker exits must hand back parked out-of-band and
+    train-end tasks, not just training-pending ones."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+    class FakeMC:
+        def __init__(self):
+            self.reported = []
+
+        def report_task_result(self, task_id, err=""):
+            self.reported.append((task_id, err))
+
+    mc = FakeMC()
+    tds = TaskDataService(mc, None)
+    tds.out_of_band_tasks.append(pb.Task(task_id=7, type=pb.EVALUATION))
+    tds.train_end_task = pb.Task(task_id=9, type=pb.TRAIN_END_CALLBACK)
+    tds.report_parked_failed("fatal")
+    assert sorted(t for t, _ in mc.reported) == [7, 9]
+    assert all(err == "fatal" for _, err in mc.reported)
+    assert not tds.out_of_band_tasks and tds.train_end_task is None

@@ -253,8 +253,106 @@ def test_mesh_epoch_change_aborts_for_restart(tmp_path):
         assert runtime.calls >= 2
         # in-flight tasks were requeued on the way out (the relaunched
         # same-id worker keeps liveness fresh, so the master would never
-        # see this as a death)
+        # see this as a death). A task fetched in the failure window is
+        # handed back by the prefetch THREAD — poll briefly for it.
+        import time
+
+        deadline = time.time() + 5
+        while dispatcher.doing_tasks() and time.time() < deadline:
+            time.sleep(0.05)
         assert not dispatcher.finished()
         assert not dispatcher.doing_tasks(), "tasks left orphaned"
     finally:
         server.stop(0)
+
+
+def test_output_exports_without_declared_callbacks(tmp_path):
+    """--output must export for models that declare NO callbacks (the
+    default SavedModelExporter; soak regression)."""
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=128, seed=0)
+    export_path = str(tmp_path / "export")
+
+    server, dispatcher, evals, port = start_master(
+        str(train_dir), str(train_dir), export_path
+    )
+    try:
+        worker = Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "elasticdl_tpu.models.mnist",  # no callbacks() in module
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=32,
+            wait_sleep_secs=0.1,
+        )
+        worker.run()
+        assert dispatcher.finished()
+        assert os.path.exists(os.path.join(export_path, "manifest.json"))
+    finally:
+        server.stop(0)
+
+
+def test_stateless_worker_restores_checkpoint_for_export(tmp_path):
+    """A relaunched worker that only ever sees the train-end task must
+    restore from checkpoint and export the TRAINED weights (never
+    random init)."""
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=128, seed=0)
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    # run 1: train with checkpoints
+    server, dispatcher, evals, port = start_master(
+        str(train_dir), str(train_dir), str(tmp_path / "unused"),
+        eval_steps=0,
+    )
+    try:
+        Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "elasticdl_tpu.models.mnist",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=32,
+            wait_sleep_secs=0.1,
+            checkpoint_dir=ckpt_dir,
+            checkpoint_steps=2,
+        ).run()
+        assert dispatcher.finished()
+    finally:
+        server.stop(None)
+
+    # run 2: ONLY the train-end task exists; the worker has no state
+    from elasticdl_tpu.master.servicer import MasterServicer as MS
+
+    dispatcher2 = TaskDispatcher(
+        training_shards={}, records_per_task=64, num_epochs=0
+    )
+    export_path = str(tmp_path / "export2")
+    dispatcher2.add_deferred_callback_create_train_end_task(
+        {"saved_model_path": export_path}
+    )
+    dispatcher2.fire_deferred_callbacks()
+    servicer = MS(dispatcher2, None)
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    try:
+        Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "elasticdl_tpu.models.mnist",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=32,
+            wait_sleep_secs=0.1,
+            checkpoint_dir_for_init=ckpt_dir,
+            resume_optional=True,  # the elastic default
+        ).run()
+        assert dispatcher2.finished()
+        assert os.path.exists(os.path.join(export_path, "manifest.json"))
+        # exported weights are the TRAINED ones (restored step > 0)
+        from elasticdl_tpu.train.export import load_exported
+
+        _, _, step = load_exported(export_path)
+        assert step > 0
+    finally:
+        server.stop(None)
