@@ -159,9 +159,20 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
 
         table = data_origin or ""
         if table.startswith("odps://"):
-            parts = table[len("odps://"):].split("/")
+            # odps://<project>/<table>[/<partition-spec>] — parse the
+            # segments explicitly rather than guessing from parts[-1]
+            # (a partition segment must become the partition kwarg, not
+            # silently shadow the table name).
+            parts = [p for p in table[len("odps://"):].split("/") if p]
+            if len(parts) < 2:
+                raise ValueError(
+                    "odps:// origin must be odps://<project>/<table>"
+                    "[/<partition>], got %r" % data_origin
+                )
             kwargs.setdefault("project", parts[0])
-            table = parts[-1]
+            if len(parts) > 2:
+                kwargs.setdefault("partition", "/".join(parts[2:]))
+            table = parts[1]
         if kwargs.get("table_client") is None:
             kwargs.setdefault(
                 "project", os.environ.get("MAXCOMPUTE_PROJECT")
@@ -175,6 +186,19 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
             kwargs.setdefault(
                 "endpoint", os.environ.get("MAXCOMPUTE_ENDPOINT")
             )
+            missing = [
+                env for env, key in (
+                    ("MAXCOMPUTE_PROJECT", "project"),
+                    ("MAXCOMPUTE_AK", "access_id"),
+                    ("MAXCOMPUTE_SK", "access_key"),
+                    ("MAXCOMPUTE_ENDPOINT", "endpoint"),
+                ) if not kwargs.get(key)
+            ]
+            if missing:
+                raise ValueError(
+                    "table origin %r requires credentials; set %s (or "
+                    "pass table_client=)" % (data_origin, ", ".join(missing))
+                )
         cls = (
             ParallelTableDataReader
             if kwargs.pop("parallel", False)

@@ -207,5 +207,10 @@ class TensorboardService:
     def stop(self):
         if self._proc is not None:
             self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait()
             self._proc = None
         self._writer.close()

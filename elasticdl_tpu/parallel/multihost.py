@@ -71,6 +71,12 @@ class MultiHostRuntime:
                 self._epoch, info.mesh_epoch,
             )
             self._distributed.shutdown()
+        # Mark the runtime down *before* attempting initialize(): if it
+        # raises, a retry must not take the epoch-moved branch and call
+        # shutdown() on an uninitialized runtime (masking the original
+        # failure).
+        self._epoch = None
+        self.rank, self.world_size = -1, 0
         coordinator = "%s:%d" % (
             info.coordinator_addr.split(":")[0], self._port
         )

@@ -64,6 +64,7 @@ class PserverServicer:
         self._push_lock = threading.Lock()
         self._grad_buffer = {}  # name -> ([values...], [ids...])
         self._buffer_count = 0
+        self._buffer_scale_sum = 0.0  # sum of per-push lr_scale
 
     # ------------------------------------------------------------------
     def push_model(self, request, context=None):
@@ -147,30 +148,50 @@ class PserverServicer:
                 return pb.PushGradientsResponse(
                     accepted=False, version=version
                 )
-            # each push's lr_scale is folded into its values at buffer
-            # time (the merged apply is a single optimizer step, so a
-            # per-request LR is expressible only as gradient scaling)
+            # Per-push lr_scale cannot be folded into gradient values:
+            # Adam's update is invariant to gradient scaling (the scale
+            # would be a silent no-op) and for momentum/adagrad scaling
+            # corrupts slot-state semantics. Buffer raw grads and carry
+            # the mean of the pushes' scales through to the kernel's lr
+            # at apply time (workers in a sync round share one schedule,
+            # so the mean is the schedule value).
             push_scale = request.lr_scale if request.lr_scale > 0 else 1.0
             for name, slices in request.gradients.embedding_tables.items():
                 values, ids = deserialize_indexed_slices(slices)
-                if push_scale != 1.0:
-                    values = values * push_scale
-                bucket = self._grad_buffer.setdefault(name, ([], []))
+                bucket = self._grad_buffer.setdefault(name, ([], [], []))
                 bucket[0].append(values)
                 bucket[1].append(ids)
+                bucket[2].append(push_scale)
             self._buffer_count += 1
+            self._buffer_scale_sum += push_scale
             if self._buffer_count < self._grads_to_wait:
                 return pb.PushGradientsResponse(
                     accepted=True, version=version
                 )
-            for name, (values_list, ids_list) in self._grad_buffer.items():
+            apply_scale = self._buffer_scale_sum / self._buffer_count
+            for name, (values_list, ids_list, scales) in (
+                self._grad_buffer.items()
+            ):
+                # Unequal per-push scales (e.g. a late joiner mid-warmup
+                # admitted by sync_version_tolerance) can't be expressed
+                # exactly in one adaptive-optimizer apply; re-weight each
+                # push by scale/apply_scale — exact for SGD, and for
+                # slot-state optimizers the ratio is 1 in the common
+                # equal-schedule case so no corruption is introduced.
+                values_list = [
+                    v * (s / apply_scale) if s != apply_scale else v
+                    for v, s in zip(values_list, scales)
+                ]
                 values = np.concatenate(values_list, axis=0)
                 ids = np.concatenate(ids_list, axis=0)
                 # merge duplicate ids across workers into one apply
                 values, ids = deduplicate_indexed_slices(values, ids)
-                self._store.push_gradients(name, ids, values)
+                self._store.push_gradients(
+                    name, ids, values, lr_scale=apply_scale
+                )
             self._grad_buffer = {}
             self._buffer_count = 0
+            self._buffer_scale_sum = 0.0
             self._store.bump_version()
             version = self._store.version
         self._maybe_checkpoint(version)

@@ -120,3 +120,46 @@ def test_coordinator_loss_promotes_next_rank():
     assert runtime.ensure_runtime() is True
     assert runtime.rank == 0
     assert fake.calls[-1] == ("init", "hostB:5000", 1, 0)
+
+
+def test_failed_reinit_does_not_double_shutdown():
+    """If initialize() raises after shutdown(), the retry must NOT call
+    shutdown() again on the (now uninitialized) runtime — that raise
+    would mask the original failure (ADVICE r1)."""
+
+    class FlakyDistributed(FakeDistributed):
+        def __init__(self):
+            super().__init__()
+            self.fail_next_init = False
+
+        def initialize(self, coordinator_address, num_processes,
+                       process_id):
+            if self.fail_next_init:
+                self.fail_next_init = False
+                self.calls.append(("init-failed",))
+                raise RuntimeError("coordinator unreachable")
+            super().initialize(
+                coordinator_address, num_processes, process_id
+            )
+
+        def shutdown(self):
+            assert self.calls and self.calls[-1][0] != "init-failed", \
+                "shutdown() called on uninitialized runtime"
+            super().shutdown()
+
+    rendezvous = MeshRendezvous()
+    rendezvous.set_worker_hosts(["hostA:3333", "hostB:3333"])
+    fake = FlakyDistributed()
+    runtime = MultiHostRuntime(
+        Client(rendezvous, "hostB:3333"), distributed=fake,
+        coordinator_port=5000,
+    )
+    runtime.ensure_runtime()
+    rendezvous.add_worker_host("hostC:3333")  # epoch bump
+    fake.fail_next_init = True
+    with pytest.raises(RuntimeError, match="coordinator unreachable"):
+        runtime.ensure_runtime()
+    assert not runtime.initialized and runtime.rank == -1
+    # retry succeeds and does not re-shutdown
+    assert runtime.ensure_runtime() is True
+    assert fake.calls[-1] == ("init", "hostA:5000", 3, 1)

@@ -220,3 +220,63 @@ def test_sparse_trainer_retries_stale_push():
             trainers[1]._version = 0  # force staleness
             states[1], _ = trainers[1].train_step(states[1], batch)
     assert np.mean(losses[-8:]) < np.mean(losses[:8])
+
+
+def test_sync_lr_scale_reaches_optimizer_lr():
+    """A sync push's lr_scale must scale the optimizer's lr, not the
+    gradient values (ADVICE r1: Adam is invariant to gradient scaling,
+    so folding it into values silently drops worker LR schedules)."""
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([7], np.int64)).copy()
+
+    r1 = _push_request("t", [[1.0, 0.0]], [7], 0)
+    r1.lr_scale = 0.5
+    servicer.push_gradients(r1)
+    r2 = _push_request("t", [[0.0, 1.0]], [7], 0)
+    r2.lr_scale = 0.5
+    assert servicer.push_gradients(r2).accepted
+
+    after = store.lookup("t", np.array([7], np.int64))
+    # sgd lr=1.0 * mean(scale)=0.5: row -= 0.5 * sum of grads
+    np.testing.assert_allclose(
+        after, before - 0.5 * np.array([[1.0, 1.0]]), rtol=1e-6
+    )
+
+
+def test_sync_lr_scale_adam_not_a_noop():
+    """Under adam the same grads with lr_scale=0.25 must move the row
+    1/4 as far as with lr_scale=1 (gradient folding made this a no-op)."""
+    rows = []
+    for scale in (1.0, 0.25):
+        store = create_store(seed=0)
+        store.set_optimizer("adam", lr=0.1)
+        servicer = PserverServicer(store, use_async=False, grads_to_wait=1)
+        infos = pb.Model()
+        infos.embedding_table_infos.add(name="t", dim=2, initializer="0.0")
+        servicer.push_embedding_table_infos(infos)
+        before = store.lookup("t", np.array([1], np.int64)).copy()
+        req = _push_request("t", [[1.0, 2.0]], [1], 0)
+        req.lr_scale = scale
+        assert servicer.push_gradients(req).accepted
+        rows.append(store.lookup("t", np.array([1], np.int64)) - before)
+    np.testing.assert_allclose(rows[1], 0.25 * rows[0], rtol=1e-5)
+
+
+def test_sync_unequal_scales_preserve_relative_weighting():
+    """Pushes with different lr_scale in one round (tolerance-admitted
+    late joiner mid-warmup): each worker's gradient must keep its own
+    scale — exact for SGD: row -= lr * sum(scale_i * g_i)."""
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([9], np.int64)).copy()
+
+    r1 = _push_request("t", [[1.0, 0.0]], [9], 0)
+    r1.lr_scale = 1.0
+    servicer.push_gradients(r1)
+    r2 = _push_request("t", [[0.0, 1.0]], [9], 0)
+    r2.lr_scale = 0.1
+    assert servicer.push_gradients(r2).accepted
+
+    after = store.lookup("t", np.array([9], np.int64))
+    np.testing.assert_allclose(
+        after, before - np.array([[1.0, 0.1]]), rtol=1e-5
+    )
