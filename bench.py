@@ -35,7 +35,13 @@ def main():
     image_size = 224
     bench_steps = 20
 
-    model = resnet.resnet50(num_classes=1000)
+    # MLPerf-style space_to_depth stem (models/resnet.py): the 7x7/2
+    # conv over 3 channels is the one MXU-hostile conv in the model;
+    # packing 2x2 spatial blocks into channels feeds the MXU a 4x4/1
+    # conv over 12 channels instead. Everything else — including exact
+    # full-batch BatchNorm — is the stock model. See docs/PERF_RESNET.md
+    # for the on-chip profile and the bandwidth-roofline analysis.
+    model = resnet.resnet50(num_classes=1000, stem="space_to_depth")
     tx = create_optimizer(
         "Momentum", learning_rate=0.1, momentum=0.9, nesterov=True
     )

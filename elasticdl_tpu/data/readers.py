@@ -13,7 +13,10 @@ import glob
 import io
 import os
 
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.data import recordio
+
+logger = _logger_factory("elasticdl_tpu.data.readers")
 
 
 class Metadata:
@@ -171,7 +174,8 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
                 )
             kwargs.setdefault("project", parts[0])
             if len(parts) > 2:
-                kwargs.setdefault("partition", "/".join(parts[2:]))
+                # pyodps PartitionSpec wants comma-separated k=v pairs
+                kwargs.setdefault("partition", ",".join(parts[2:]))
             table = parts[1]
         if kwargs.get("table_client") is None:
             kwargs.setdefault(
@@ -191,13 +195,20 @@ def create_data_reader(data_origin, records_per_task=None, **kwargs):
                     ("MAXCOMPUTE_PROJECT", "project"),
                     ("MAXCOMPUTE_AK", "access_id"),
                     ("MAXCOMPUTE_SK", "access_key"),
-                    ("MAXCOMPUTE_ENDPOINT", "endpoint"),
                 ) if not kwargs.get(key)
             ]
             if missing:
                 raise ValueError(
                     "table origin %r requires credentials; set %s (or "
                     "pass table_client=)" % (data_origin, ", ".join(missing))
+                )
+            if not kwargs.get("endpoint"):
+                # endpoint may also come from pyodps' own config; only
+                # warn so such setups keep working (ODPSTableClient
+                # declares endpoint optional)
+                logger.warning(
+                    "no MAXCOMPUTE_ENDPOINT set for %r; relying on the "
+                    "ODPS SDK default endpoint resolution", data_origin
                 )
         cls = (
             ParallelTableDataReader

@@ -4,7 +4,8 @@ Reference parity: model_zoo/cifar10/cifar10_mobilenetv2.py and the
 ImageNet MobileNetV2 benchmarks (docs/benchmark/ftlib_benchmark.md:79-86,
 139-156 — the reference's second headline model). Fresh TPU-first
 implementation: NHWC, depthwise convs via feature_group_count (XLA's
-native depthwise form), ReLU6, width multiples of 8, BatchNorm in f32.
+native depthwise form), ReLU6, width multiples of 8, TpuBatchNorm
+(f32 stats, compute-dtype stream — ops/batch_norm.py).
 
 ``small_inputs=True`` keeps the CIFAR stem at stride 1 (32x32 inputs
 would otherwise collapse before the deep stages).
@@ -15,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.ops.batch_norm import TpuBatchNorm
 from elasticdl_tpu.train import metrics
 from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
 from elasticdl_tpu.train.optimizers import create_optimizer
@@ -34,10 +36,9 @@ class InvertedResidual(nn.Module):
 
     @nn.compact
     def __call__(self, x, training: bool = False):
-        norm = lambda: nn.BatchNorm(  # noqa: E731
+        norm = lambda: TpuBatchNorm(  # noqa: E731
             use_running_average=not training,
             momentum=0.9,
-            dtype=jnp.float32,
         )
         in_ch = x.shape[-1]
         hidden = in_ch * self.expand_ratio
@@ -82,10 +83,9 @@ class MobileNetV2(nn.Module):
 
     @nn.compact
     def __call__(self, x, training: bool = False):
-        norm = lambda: nn.BatchNorm(  # noqa: E731
+        norm = lambda: TpuBatchNorm(  # noqa: E731
             use_running_average=not training,
             momentum=0.9,
-            dtype=jnp.float32,
         )
         stem = _make_divisible(32 * self.width_multiplier)
         stem_strides = (1, 1) if self.small_inputs else (2, 2)

@@ -2,11 +2,13 @@
 
 Reference parity: model_zoo/imagenet_resnet50/, model_zoo/cifar10/ and
 model_zoo/resnet50_subclass/ (Keras applications-based). Fresh TPU-first
-implementation: NHWC layout (TPU conv-native), BatchNorm with f32
-statistics but a residual stream that stays in the compute dtype — a
-BN that forced f32 outputs would promote every downstream conv to f32
-and halve the MXU rate (measured 1.8x step-time cost on v5e), while
-flax already does the reduction in f32 (force_float32_reductions);
+implementation: NHWC layout (TPU conv-native), TpuBatchNorm
+(ops/batch_norm.py: f32 single-pass statistics, residual stream stays
+in the compute dtype — a BN that forced f32 outputs would promote every
+downstream conv to f32 and halve the MXU rate, measured 1.8x step-time
+cost on v5e; the single-pass stats + fused-multiply-add normalize are
+worth another ~8% of step time over flax's nn.BatchNorm, see
+docs/PERF_RESNET.md);
 zero-init on the last BN scale of each block (standard trick: the
 residual branch starts as identity, which stabilizes large-batch
 training), and channel counts that are multiples of 128 in the deep
@@ -21,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.ops.batch_norm import TpuBatchNorm
 from elasticdl_tpu.train import metrics
 from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
 from elasticdl_tpu.train.optimizers import create_optimizer
@@ -33,11 +36,10 @@ class BottleneckBlock(nn.Module):
     @nn.compact
     def __call__(self, x, training: bool = False):
         norm = partial(
-            nn.BatchNorm,
+            TpuBatchNorm,
             use_running_average=not training,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=None,  # stats still f32 (flax force_float32_reductions)
         )
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
@@ -69,11 +71,10 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x, training: bool = False):
         norm = partial(
-            nn.BatchNorm,
+            TpuBatchNorm,
             use_running_average=not training,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=None,  # stats still f32 (flax force_float32_reductions)
         )
         residual = x
         y = nn.Conv(
@@ -146,11 +147,10 @@ class ResNet(nn.Module):
                 self.num_filters, (7, 7), strides=(2, 2),
                 padding=[(3, 3), (3, 3)], use_bias=False,
             )(x)
-        x = nn.BatchNorm(
+        x = TpuBatchNorm(
             use_running_average=not training,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=None,  # stats still f32 (flax force_float32_reductions)
         )(x)
         x = nn.relu(x)
         if not self.small_inputs:

@@ -1,0 +1,109 @@
+"""Capture a jax.profiler trace of the ResNet50 train step on the real
+chip and print a per-HLO-category breakdown (the evidence behind
+docs/PERF_RESNET.md).
+
+Usage: python scripts/profile_resnet.py [--out /tmp/edl_trace]
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="/tmp/edl_trace")
+    parser.add_argument("--batch_size", type=int, default=256)
+    parser.add_argument("--steps", type=int, default=5)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from elasticdl_tpu.models import resnet
+    from elasticdl_tpu.train.optimizers import create_optimizer
+    from elasticdl_tpu.train.step_fns import make_train_step
+    from elasticdl_tpu.train.train_state import create_train_state
+
+    model = resnet.resnet50(num_classes=1000, stem="space_to_depth")
+    tx = create_optimizer(
+        "Momentum", learning_rate=0.1, momentum=0.9, nesterov=True
+    )
+    train_step = make_train_step(
+        model, resnet.loss, tx, compute_dtype=jnp.bfloat16
+    )
+
+    def run_steps(state, batch, n):
+        def body(state, _):
+            state, loss = train_step(state, batch)
+            return state, loss
+        return jax.lax.scan(body, state, None, length=n)
+
+    run = jax.jit(run_steps, static_argnums=(2,), donate_argnums=(0,))
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": jnp.asarray(
+            rng.rand(args.batch_size, 224, 224, 3), jnp.float32
+        ),
+        "labels": jnp.asarray(
+            rng.randint(0, 1000, size=args.batch_size), jnp.int32
+        ),
+        "_mask": jnp.ones((args.batch_size,), jnp.float32),
+    }
+    state = create_train_state(
+        model, tx, jax.random.PRNGKey(0), batch["features"]
+    )
+    state, losses = run(state, batch, args.steps)
+    float(losses[-1])  # fence warmup
+
+    jax.profiler.start_trace(args.out)
+    state, losses = run(state, batch, args.steps)
+    float(losses[-1])  # device->host fetch fences remote execution
+    jax.profiler.stop_trace()
+
+    path = sorted(
+        glob.glob(args.out + "/plugins/profile/*/*.trace.json.gz")
+    )[-1]
+    with gzip.open(path) as f:
+        data = json.load(f)
+    # pid of the TPU device track
+    tpu_pid = None
+    for e in data["traceEvents"]:
+        if e.get("ph") == "M" and e.get("name") == "process_name" and \
+                "TPU" in str(e.get("args", {}).get("name", "")):
+            tpu_pid = e["pid"]
+    ops = [
+        e for e in data["traceEvents"]
+        if e.get("ph") == "X" and e.get("pid") == tpu_pid
+        and "hlo_category" in e.get("args", {})
+        and not e["name"].startswith("while")
+    ]
+    total = sum(e["dur"] for e in ops)
+    cat = collections.Counter()
+    catb = collections.Counter()
+    for e in ops:
+        c = e["args"]["hlo_category"]
+        cat[c] += e["dur"]
+        catb[c] += int(e["args"].get("bytes_accessed", 0))
+    print(
+        "device time: %.1f ms / %d steps; bytes accessed %.1f GB/step"
+        % (total / 1e3, args.steps, sum(catb.values()) / args.steps / 1e9)
+    )
+    for c, d in cat.most_common(12):
+        bw = catb[c] / (d / 1e6) / 1e9 if d else 0
+        print(
+            "%5.1f%%  %8.1fms  bw=%6.0f GB/s  %s"
+            % (d / total * 100, d / 1e3, bw, c)
+        )
+    print("trace at:", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""TpuBatchNorm (ops/batch_norm.py) must match flax nn.BatchNorm
+numerics exactly in f32: forward (train + eval), gradients, and the
+running-statistics update — it is a compiler-friendly reformulation,
+not a different normalization."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.ops.batch_norm import TpuBatchNorm
+
+
+def _flax_bn(training):
+    return nn.BatchNorm(
+        use_running_average=not training, momentum=0.9, epsilon=1e-5,
+        dtype=None,
+    )
+
+
+def _tpu_bn(training, **kw):
+    return TpuBatchNorm(
+        use_running_average=not training, momentum=0.9, epsilon=1e-5, **kw
+    )
+
+
+@pytest.fixture
+def x():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(
+        rng.normal(loc=0.7, scale=2.0, size=(8, 5, 5, 6)), jnp.float32
+    )
+
+
+def test_train_forward_and_stats_match_flax(x):
+    ref, ours = _flax_bn(True), _tpu_bn(True)
+    vref = ref.init(jax.random.PRNGKey(0), x)
+    vours = ours.init(jax.random.PRNGKey(0), x)
+    yref, mref = ref.apply(vref, x, mutable=["batch_stats"])
+    yours, mours = ours.apply(vours, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(yours, yref, rtol=2e-5, atol=2e-5)
+    for key in ("mean", "var"):
+        np.testing.assert_allclose(
+            jax.tree_util.tree_leaves(mours["batch_stats"])[
+                0 if key == "mean" else 1
+            ],
+            jax.tree_util.tree_leaves(mref["batch_stats"])[
+                0 if key == "mean" else 1
+            ],
+            rtol=2e-5, atol=2e-6,
+        )
+
+
+def test_eval_forward_matches_flax(x):
+    ref, ours = _flax_bn(False), _tpu_bn(False)
+    variables = ref.init(jax.random.PRNGKey(0), x)
+    # push non-trivial running stats + affine params into both
+    stats = {
+        "mean": jnp.linspace(-1.0, 1.0, 6),
+        "var": jnp.linspace(0.5, 2.0, 6),
+    }
+    params = {
+        "scale": jnp.linspace(0.5, 1.5, 6),
+        "bias": jnp.linspace(-0.2, 0.2, 6),
+    }
+    variables = {"params": params, "batch_stats": stats}
+    yref = ref.apply(variables, x)
+    yours = ours.apply(variables, x)
+    np.testing.assert_allclose(yours, yref, rtol=2e-5, atol=2e-5)
+
+
+def test_gradients_match_flax(x):
+    ref, ours = _flax_bn(True), _tpu_bn(True)
+    variables = ref.init(jax.random.PRNGKey(0), x)
+
+    def loss(mod):
+        def fn(params, x):
+            y, _ = mod.apply(
+                {"params": params,
+                 "batch_stats": variables["batch_stats"]},
+                x, mutable=["batch_stats"],
+            )
+            return jnp.sum(jnp.tanh(y))
+        return fn
+
+    gref_p, gref_x = jax.grad(loss(ref), argnums=(0, 1))(
+        variables["params"], x
+    )
+    gours_p, gours_x = jax.grad(loss(ours), argnums=(0, 1))(
+        variables["params"], x
+    )
+    np.testing.assert_allclose(gours_x, gref_x, rtol=1e-4, atol=1e-4)
+    for k in gref_p:
+        np.testing.assert_allclose(
+            gours_p[k], gref_p[k], rtol=1e-4, atol=1e-4
+        )
+
+
+def test_scale_init_passthrough(x):
+    bn = _tpu_bn(True, scale_init=nn.initializers.zeros_init())
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_array_equal(
+        variables["params"]["scale"], np.zeros(6)
+    )
+    y, _ = bn.apply(x=x, variables=variables, mutable=["batch_stats"])
+    # zero scale -> output is just the bias (zeros)
+    np.testing.assert_allclose(y, np.zeros_like(x), atol=1e-6)
+
+
+def test_stats_samples_subsampling(x):
+    """stats_samples=k: statistics come from the first k rows only,
+    every row is normalized, and running stats track the k-row stats."""
+    bn = _tpu_bn(True, stats_samples=4)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    y, mutated = bn.apply(x=x, variables=variables, mutable=["batch_stats"])
+    xs = np.asarray(x[:4], np.float64)
+    mean = xs.mean(axis=(0, 1, 2))
+    var = (xs ** 2).mean(axis=(0, 1, 2)) - mean ** 2
+    expect = (np.asarray(x) - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(y, expect, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        mutated["batch_stats"]["mean"], 0.1 * mean, rtol=2e-5, atol=1e-6
+    )
+
+
+def test_bf16_stream_keeps_dtype(x):
+    bn = _tpu_bn(True)
+    xb = x.astype(jnp.bfloat16)
+    variables = bn.init(jax.random.PRNGKey(0), xb)
+    y, _ = bn.apply(x=xb, variables=variables, mutable=["batch_stats"])
+    assert y.dtype == jnp.bfloat16
+    # params/stats stay f32
+    assert variables["params"]["scale"].dtype == jnp.float32
+    assert variables["batch_stats"]["mean"].dtype == jnp.float32
