@@ -95,6 +95,12 @@ def parse_worker_args(argv=None):
     parser.add_argument(
         "--coordinator_port", type=int, default=COORDINATOR_PORT
     )
+    # pipelined sparse training (async PS only): overlap batch N+1's PS
+    # pull with batch N's device step; optional hot-row reuse and push
+    # accumulation (the reference's get_model_steps analogue)
+    parser.add_argument("--sparse_pipeline", type=int, default=0)
+    parser.add_argument("--sparse_cache_staleness", type=int, default=0)
+    parser.add_argument("--sparse_push_interval", type=int, default=1)
     return parser.parse_args(argv)
 
 

@@ -30,6 +30,16 @@ namespace {
 
 enum class OptType { kSGD = 0, kMomentum = 1, kAdagrad = 2, kAdam = 3 };
 
+// Row initializers (reference go/pkg/common/initializer.go:25-155:
+// Zero/Constant/Uniform/Normal/TruncatedNormal). kConstant covers Zero
+// via param=0.
+enum class InitKind {
+  kUniform = 0,         // U(-param, param)
+  kConstant = 1,        // fill(param)
+  kNormal = 2,          // N(0, param^2)
+  kTruncatedNormal = 3  // N(0, param^2) resampled into [-2p, 2p]
+};
+
 struct OptConfig {
   OptType type = OptType::kSGD;
   float lr = 0.01f;
@@ -56,6 +66,7 @@ struct Table {
   std::string name;
   int64_t dim = 0;
   float init_scale = 0.05f;
+  InitKind init_kind = InitKind::kUniform;
   int slots = 0;
   // row layout: [weight(dim) | slot0(dim) | slot1(dim)]
   std::unordered_map<int64_t, std::unique_ptr<float[]>> rows;
@@ -71,8 +82,34 @@ struct Table {
     auto it = rows.find(id);
     if (it != rows.end()) return it->second.get();
     auto row = std::make_unique<float[]>(dim * (1 + slots));
-    std::uniform_real_distribution<float> dist(-init_scale, init_scale);
-    for (int64_t d = 0; d < dim; ++d) row[d] = dist(*rng);
+    switch (init_kind) {
+      case InitKind::kUniform: {
+        std::uniform_real_distribution<float> dist(-init_scale, init_scale);
+        for (int64_t d = 0; d < dim; ++d) row[d] = dist(*rng);
+        break;
+      }
+      case InitKind::kConstant: {
+        for (int64_t d = 0; d < dim; ++d) row[d] = init_scale;
+        break;
+      }
+      case InitKind::kNormal: {
+        if (init_scale <= 0.0f) break;  // stddev<=0: zeros (std UB guard)
+        std::normal_distribution<float> dist(0.0f, init_scale);
+        for (int64_t d = 0; d < dim; ++d) row[d] = dist(*rng);
+        break;
+      }
+      case InitKind::kTruncatedNormal: {
+        if (init_scale <= 0.0f) break;
+        std::normal_distribution<float> dist(0.0f, init_scale);
+        const float bound = 2.0f * init_scale;
+        for (int64_t d = 0; d < dim; ++d) {
+          float x = dist(*rng);
+          while (x < -bound || x > bound) x = dist(*rng);
+          row[d] = x;
+        }
+        break;
+      }
+    }
     std::memset(row.get() + dim, 0, sizeof(float) * dim * slots);
     float* ptr = row.get();
     rows.emplace(id, std::move(row));
@@ -191,26 +228,36 @@ int edl_store_set_optimizer(void* handle, const char* type, float lr,
   return 0;
 }
 
-int edl_store_create_table(void* handle, const char* name, int64_t dim,
-                           float init_scale) {
+// init_kind: InitKind value; init_param: scale / constant / stddev.
+int edl_store_create_table_init(void* handle, const char* name, int64_t dim,
+                                int init_kind, float init_param) {
+  if (init_kind < 0 || init_kind > 3) return -2;
   auto* store = static_cast<Store*>(handle);
   std::lock_guard<std::mutex> lock(store->tables_mu);
   auto it = store->tables.find(name);
   if (it != store->tables.end()) {
     if (it->second->dim != dim) return -1;
-    // Existing table: adopt the (possibly updated) init scale so a
-    // restore-then-register sequence keeps the model's configured scale.
-    it->second->init_scale = init_scale;
+    // Existing table: adopt the (possibly updated) initializer so a
+    // restore-then-register sequence keeps the model's configured init.
+    it->second->init_scale = init_param;
+    it->second->init_kind = static_cast<InitKind>(init_kind);
     return 0;
   }
   auto table = std::make_unique<Table>();
   table->name = name;
   table->dim = dim;
-  table->init_scale = init_scale;
+  table->init_scale = init_param;
+  table->init_kind = static_cast<InitKind>(init_kind);
   table->slots = store->opt.slots();
   table->rng.seed(store->seed * 1000003u + std::hash<std::string>{}(name));
   store->tables.emplace(name, std::move(table));
   return 0;
+}
+
+int edl_store_create_table(void* handle, const char* name, int64_t dim,
+                           float init_scale) {
+  return edl_store_create_table_init(
+      handle, name, dim, (int)InitKind::kUniform, init_scale);
 }
 
 // Batch lookup; missing rows are lazily initialized (the reference's

@@ -33,6 +33,35 @@ OPT_SLOT_COUNTS = {
     "adagrad": 1, "adam": 2, "amsgrad": 3,
 }
 
+# row initializer -> InitKind in native/embedding_store.cc (reference
+# go/pkg/common/initializer.go:25-155; "zeros" is constant 0)
+INIT_KINDS = {
+    "uniform": 0, "constant": 1, "normal": 2, "truncated_normal": 3,
+}
+
+
+def parse_initializer(spec, default_scale=0.05):
+    """Wire-format initializer string -> (kind, param).
+
+    Accepts "0.05" (bare scale = uniform, the original wire format),
+    "normal:0.01", "constant:1.5", "zeros", or "uniform".
+    """
+    if not spec:
+        return "uniform", default_scale
+    spec = str(spec)
+    kind, _, param = spec.partition(":")
+    kind = kind.strip().lower()
+    try:
+        # bare number: legacy uniform-scale encoding
+        return "uniform", float(kind)
+    except ValueError:
+        pass
+    if kind == "zeros":
+        return "constant", 0.0
+    if kind not in INIT_KINDS:
+        raise ValueError("unknown embedding initializer %r" % spec)
+    return kind, float(param) if param else default_scale
+
 
 def _normalize_opt_type(opt_type, kwargs):
     """Fold nesterov=True / amsgrad=True kwargs into the variant opt
@@ -82,6 +111,13 @@ def _load_native():
         ctypes.c_void_p,
         ctypes.c_char_p,
         ctypes.c_int64,
+        ctypes.c_float,
+    ]
+    lib.edl_store_create_table_init.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int,
         ctypes.c_float,
     ]
     lib.edl_store_lookup.argtypes = [
@@ -200,9 +236,12 @@ class NativeEmbeddingStore:
         # desync the checkpoint opt tag from the live kernels
         self._opt_type = opt_type
 
-    def create_table(self, name, dim, init_scale=0.05):
-        rc = self._lib.edl_store_create_table(
-            self._handle, name.encode(), dim, init_scale
+    def create_table(self, name, dim, init_scale=0.05, initializer="uniform"):
+        if initializer == "zeros":
+            initializer, init_scale = "constant", 0.0
+        rc = self._lib.edl_store_create_table_init(
+            self._handle, name.encode(), dim,
+            INIT_KINDS[initializer], init_scale,
         )
         if rc != 0:
             raise ValueError(
@@ -348,7 +387,11 @@ class NumpyEmbeddingStore:
     """Pure-python twin of the native store (same semantics)."""
 
     def __init__(self, seed=0):
-        self._rng = np.random.RandomState(seed)
+        self._seed = seed
+        # per-table RNG, like the native store: lazy-init draws are
+        # deterministic regardless of the order tables are pulled in
+        # (prepare() fans out per-table pulls concurrently)
+        self._rngs = {}
         self._tables = {}  # name -> {id: weight row}
         self._slots = {}  # name -> {id: slot array [slots, dim]}
         self._steps = {}  # name -> {id: step count}
@@ -372,7 +415,11 @@ class NumpyEmbeddingStore:
         args.update(kwargs)
         self._opt = (opt_type, args)
 
-    def create_table(self, name, dim, init_scale=0.05):
+    def create_table(self, name, dim, init_scale=0.05, initializer="uniform"):
+        if initializer == "zeros":
+            initializer, init_scale = "constant", 0.0
+        if initializer not in INIT_KINDS:
+            raise ValueError("unknown embedding initializer %r" % initializer)
         with self._lock:
             if name in self._meta:
                 if self._meta[name][0] != dim:
@@ -381,20 +428,48 @@ class NumpyEmbeddingStore:
                     )
                 # adopt the (possibly updated) scale so restore-then-
                 # register keeps the model's configured init
-                self._meta[name] = (dim, init_scale)
+                self._meta[name] = (dim, init_scale, initializer)
                 return
-            self._meta[name] = (dim, init_scale)
+            self._meta[name] = (dim, init_scale, initializer)
             self._tables[name] = {}
             self._slots[name] = {}
             self._steps[name] = {}
 
+    def _table_rng(self, name):
+        rng = self._rngs.get(name)
+        if rng is None:
+            import zlib
+
+            rng = np.random.RandomState(
+                (self._seed * 1000003 + zlib.crc32(name.encode()))
+                % (2 ** 32)
+            )
+            self._rngs[name] = rng
+        return rng
+
+    def _init_row(self, name, dim, scale, kind):
+        if kind == "constant":
+            return np.full(dim, scale, dtype=np.float32)
+        if scale <= 0:
+            return np.zeros(dim, dtype=np.float32)
+        rng = self._table_rng(name)
+        if kind == "uniform":
+            return rng.uniform(-scale, scale, size=dim).astype(np.float32)
+        if kind == "normal":
+            return rng.normal(0.0, scale, size=dim).astype(np.float32)
+        # truncated_normal: resample outside [-2*stddev, 2*stddev]
+        row = rng.normal(0.0, scale, size=dim)
+        bad = np.abs(row) > 2 * scale
+        while bad.any():
+            row[bad] = rng.normal(0.0, scale, size=int(bad.sum()))
+            bad = np.abs(row) > 2 * scale
+        return row.astype(np.float32)
+
     def _row(self, name, id_):
         table = self._tables[name]
         if id_ not in table:
-            dim, scale = self._meta[name]
-            table[id_] = self._rng.uniform(-scale, scale, size=dim).astype(
-                np.float32
-            )
+            dim, scale, kind = self._meta[name]
+            table[id_] = self._init_row(name, dim, scale, kind)
             n_slots = OPT_SLOT_COUNTS[self._opt[0]]
             self._slots[name][id_] = np.zeros(
                 (n_slots, dim), dtype=np.float32

@@ -9,7 +9,7 @@ that gap.
 import numpy as np
 
 from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
-from elasticdl_tpu.ps.embedding_store import create_store
+from elasticdl_tpu.ps.embedding_store import create_store, parse_initializer
 
 
 class LocalPSClient:
@@ -23,8 +23,11 @@ class LocalPSClient:
         return 1
 
     def push_embedding_table_infos(self, infos):
-        for name, dim, init_scale in infos:
-            self.store.create_table(name, dim, init_scale)
+        for name, dim, init_spec in infos:
+            kind, param = parse_initializer(init_spec)
+            self.store.create_table(
+                name, dim, init_scale=param, initializer=kind
+            )
 
     def push_dense_init(self, params, version=0):
         pass  # single process: dense init is local by definition

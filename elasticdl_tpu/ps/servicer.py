@@ -91,14 +91,20 @@ class PserverServicer:
         return pb.Empty()
 
     def _create_tables(self, infos):
+        from elasticdl_tpu.ps.embedding_store import parse_initializer
+
         for info in infos:
-            init_scale = 0.05
-            if info.initializer:
-                try:
-                    init_scale = float(info.initializer)
-                except ValueError:
-                    pass
-            self._store.create_table(info.name, info.dim, init_scale)
+            try:
+                kind, param = parse_initializer(info.initializer)
+            except ValueError:
+                logger.warning(
+                    "unknown initializer %r for table %s; using uniform",
+                    info.initializer, info.name,
+                )
+                kind, param = "uniform", 0.05
+            self._store.create_table(
+                info.name, info.dim, init_scale=param, initializer=kind
+            )
 
     # ------------------------------------------------------------------
     def pull_dense_parameters(self, request, context=None):

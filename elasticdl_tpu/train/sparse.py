@@ -15,10 +15,13 @@ Static shapes: the unique-id buffer is padded to a fixed per-spec
 capacity so XLA compiles the step once.
 """
 
+import concurrent.futures
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
 from elasticdl_tpu.data.pipeline import MASK_KEY
 from elasticdl_tpu.train.losses import masked_mean
 from elasticdl_tpu.train.train_state import (
@@ -44,18 +47,32 @@ class SparseEmbeddingSpec:
     """
 
     def __init__(self, name, dim, feature_key=None, combiner="sum",
-                 capacity=0, init_scale=0.05, mask_feature_key=None):
+                 capacity=0, init_scale=0.05, mask_feature_key=None,
+                 initializer="uniform"):
         self.name = name
         self.dim = dim
         self.feature_key = feature_key or name
         self.combiner = combiner
         self.capacity = capacity
         self.init_scale = init_scale
+        # row initializer kind: uniform / constant / normal /
+        # truncated_normal / zeros (reference initializer.go:25-155)
+        self.initializer = initializer
         # optional bool feature marking which id slots are real: padded
         # slots are excluded from the unique-id pull/push so padding
         # never creates or updates PS rows (id 0 would otherwise absorb
         # spurious optimizer steps from every padded batch)
         self.mask_feature_key = mask_feature_key
+
+
+def _wire_initializer(spec):
+    """Wire string for EmbeddingTableInfo.initializer: a bare float for
+    uniform (the original encoding) else "kind:param". float() first:
+    numpy scalars repr as np.float64(...) under numpy 2, which the
+    server side cannot parse."""
+    if spec.initializer in (None, "uniform"):
+        return str(float(spec.init_scale))
+    return "%s:%s" % (spec.initializer, float(spec.init_scale))
 
 
 def embedding_lookup(features, name, combiner=None):
@@ -86,32 +103,164 @@ def embedding_lookup(features, name, combiner=None):
     return combine_gathered(gathered, w, combiner)
 
 
-class SparseBatchPreparer:
-    """Host-side: swap raw id features for (rows, indices) pairs."""
+class HotRowCache:
+    """Bounded-staleness host cache of pulled embedding rows.
 
-    def __init__(self, specs, ps_client):
+    The sparse analogue of the reference's ``get_model_steps``
+    amortization (worker.py:287-295, which trained local steps between
+    PS syncs): a pulled row may be reused for up to ``staleness``
+    subsequent prepares even though pushes have since updated it on the
+    PS. CTR id distributions are Zipfian — the hot ids recur in every
+    batch — so this removes most pull bytes. Only sound against the
+    async PS (whose training already tolerates stale rows by design);
+    keep it disabled under the sync PS, where stale rows would be
+    version-rejected anyway.
+    """
+
+    def __init__(self, staleness, capacity=1_000_000):
+        if staleness < 1:
+            raise ValueError("staleness must be >= 1")
+        self.staleness = int(staleness)
+        self.capacity = int(capacity)
+        self._clock = 0
+        # name -> (sorted ids [n], rows [n, dim], pull stamps [n]);
+        # vectorized (searchsorted/merge) — per-id dict loops cost
+        # ~10 ms/step at CTR batch sizes
+        self._tables = {}
+        self.hits = 0
+        self.misses = 0
+
+    def advance(self):
+        self._clock += 1
+
+    def split(self, name, unique):
+        """Partition ``unique`` (sorted) ids into fresh-cached and
+        to-pull.
+
+        Returns (cached_mask [n] bool, cached_rows [hits, dim] or None).
+        """
+        entry = self._tables.get(name)
+        if entry is None:
+            self.misses += int(unique.size)
+            return np.zeros(unique.shape, dtype=bool), None
+        ids, rows, stamps = entry
+        pos = np.searchsorted(ids, unique)
+        pos_clipped = np.minimum(pos, max(ids.size - 1, 0))
+        found = (pos < ids.size) & (ids[pos_clipped] == unique)
+        # stamp records PULL time, not last use: staleness bounds the
+        # age of the VALUE, so a hit must not refresh it. >= so that
+        # staleness=1 reuses a row for exactly one subsequent prepare
+        # (the documented "up to `staleness` subsequent prepares")
+        fresh = found & (
+            stamps[pos_clipped] >= self._clock - self.staleness
+        )
+        n_hit = int(fresh.sum())
+        self.hits += n_hit
+        self.misses += int(unique.size) - n_hit
+        if n_hit == 0:
+            return np.zeros(unique.shape, dtype=bool), None
+        return fresh, rows[pos_clipped[fresh]]
+
+    def put(self, name, new_ids, new_rows):
+        new_ids = np.asarray(new_ids, dtype=np.int64)
+        new_rows = np.asarray(new_rows, dtype=np.float32)
+        if new_ids.size and np.any(np.diff(new_ids) <= 0):
+            # callers normally pass np.unique output; normalize otherwise
+            new_ids, first = np.unique(new_ids, return_index=True)
+            new_rows = new_rows[first]
+        new_stamps = np.full(new_ids.shape, self._clock, dtype=np.int64)
+        entry = self._tables.get(name)
+        if entry is not None:
+            old_ids, old_rows, old_stamps = entry
+            # new entries win on duplicate ids (unique keeps the first
+            # occurrence per id, so concatenate new-first)
+            all_ids = np.concatenate([new_ids, old_ids])
+            merged, first = np.unique(all_ids, return_index=True)
+            all_rows = np.concatenate([new_rows, old_rows], axis=0)
+            all_stamps = np.concatenate([new_stamps, old_stamps])
+            new_ids = merged  # np.unique returns sorted ids
+            new_rows = all_rows[first]
+            new_stamps = all_stamps[first]
+        if new_ids.size > self.capacity:
+            # evict the oldest pulls (and, implicitly, everything
+            # already past staleness)
+            keep = np.argpartition(
+                -new_stamps, self.capacity - 1
+            )[: self.capacity]
+            keep.sort()  # restore sorted-id order after partition
+            new_ids = new_ids[keep]
+            new_rows = new_rows[keep]
+            new_stamps = new_stamps[keep]
+        self._tables[name] = (new_ids, new_rows, new_stamps)
+
+
+class SparseBatchPreparer:
+    """Host-side: swap raw id features for (rows, indices) pairs.
+
+    Pulls for all tables fan out concurrently (DeepFM's second-order
+    and linear tables ride one round trip instead of two), and an
+    optional HotRowCache bounds how often hot rows are re-pulled.
+    """
+
+    def __init__(self, specs, ps_client, cache=None):
         self._specs = list(specs)
         self._ps = ps_client
         self._registered = False
+        self._cache = cache
+        self._pull_pool = None
+        if len(self._specs) > 1:
+            self._pull_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=len(self._specs),
+                thread_name_prefix="sparse-pull",
+            )
 
     @property
     def ps_num(self):
         return getattr(self._ps, "ps_num", 1)
 
+    @property
+    def cache(self):
+        return self._cache
+
     def register_tables(self):
         if not self._registered:
             self._ps.push_embedding_table_infos(
-                [(s.name, s.dim, s.init_scale) for s in self._specs]
+                [(s.name, s.dim, _wire_initializer(s)) for s in self._specs]
             )
             self._registered = True
+
+    def _pull_rows(self, spec, unique):
+        """Pull rows for the unique ids of one table, consulting the
+        hot cache; returns [n_unique, dim] float32."""
+        if self._cache is None:
+            return np.asarray(
+                self._ps.pull_embedding_vectors(spec.name, unique),
+                dtype=np.float32,
+            )
+        cached_mask, cached_rows = self._cache.split(spec.name, unique)
+        rows = np.empty((unique.size, spec.dim), dtype=np.float32)
+        if cached_rows is not None:
+            rows[cached_mask] = cached_rows
+        missing = unique[~cached_mask]
+        if missing.size:
+            pulled = np.asarray(
+                self._ps.pull_embedding_vectors(spec.name, missing),
+                dtype=np.float32,
+            )
+            rows[~cached_mask] = pulled
+            self._cache.put(spec.name, missing, pulled)
+        return rows
 
     def prepare(self, batch):
         """Returns (batch with rows/indices features, pull_info) where
         pull_info = {name: (unique_ids, n_unique)} for the grad push."""
         self.register_tables()
+        if self._cache is not None:
+            self._cache.advance()
         features = dict(batch["features"])
         pull_info = {}
         consumed = set()
+        plans = []
         for spec in self._specs:
             # multiple tables may read the same id feature (e.g. DeepFM's
             # second-order and linear tables), so consume keys at the end
@@ -142,16 +291,35 @@ class SparseBatchPreparer:
                     "raise SparseEmbeddingSpec.capacity"
                     % (unique.size, spec.name, capacity)
                 )
-            padded = np.zeros((capacity, spec.dim), dtype=np.float32)
-            if unique.size:
-                padded[: unique.size] = self._ps.pull_embedding_vectors(
-                    spec.name, unique
-                )
-            features[spec.name + ROWS_SUFFIX] = padded
             features[spec.name + INDICES_SUFFIX] = inverse.reshape(
                 ids.shape
             ).astype(np.int32)
             pull_info[spec.name] = (unique, unique.size)
+            plans.append((spec, unique, capacity))
+        # fan out this batch's pulls across tables (each may itself fan
+        # out across PS shards inside the client)
+        if self._pull_pool is not None and len(plans) > 1:
+            futures = [
+                (spec, capacity,
+                 self._pull_pool.submit(self._pull_rows, spec, unique))
+                for spec, unique, capacity in plans
+                if unique.size
+            ]
+            pulled = {
+                spec.name: (capacity, future.result())
+                for spec, capacity, future in futures
+            }
+        else:
+            pulled = {
+                spec.name: (capacity, self._pull_rows(spec, unique))
+                for spec, unique, capacity in plans
+                if unique.size
+            }
+        for spec, unique, capacity in plans:
+            padded = np.zeros((capacity, spec.dim), dtype=np.float32)
+            if unique.size:
+                padded[: unique.size] = pulled[spec.name][1]
+            features[spec.name + ROWS_SUFFIX] = padded
         for key in consumed:
             features.pop(key, None)
         out = dict(batch)
@@ -315,12 +483,21 @@ class SparseTrainer:
         ps_client,
         compute_dtype=None,
         seed=0,
+        cache_staleness=0,
+        cache_capacity=1_000_000,
     ):
         self._model = model
         self._tx = optimizer
         self._rng = jax.random.PRNGKey(seed)
         self._specs = list(specs)
-        self.preparer = SparseBatchPreparer(self._specs, ps_client)
+        cache = (
+            HotRowCache(cache_staleness, cache_capacity)
+            if cache_staleness > 0
+            else None
+        )
+        self.preparer = SparseBatchPreparer(
+            self._specs, ps_client, cache=cache
+        )
         compute_dtype = resolve_dtype(compute_dtype)
         self._train_step = jax.jit(
             make_sparse_train_step(
@@ -421,3 +598,162 @@ class SparseTrainer:
         self._prep_memo = None
         outputs = self._eval_step(state, prepared["features"])
         return jax.tree_util.tree_map(np.asarray, outputs)
+
+    # ------------------------------------------------------------------
+    def train_stream(self, state, batches, on_first_batch=None,
+                     push_interval=1):
+        """Pipelined training over an iterable of raw batches.
+
+        Overlap structure per step N (async-PS mode):
+
+          dispatch device step N          (returns before completion)
+          yield (state, loss, batch_N)    (the consumer's bookkeeping —
+                                           record reports, callbacks —
+                                           rides under the device step)
+          pull batch N+1                  (PS pull RPCs likewise)
+          fetch step N's row grads        (fences the device)
+          push step N's grads             (background thread; at most
+                                           one push in flight)
+
+        The yield MUST precede the lookahead: the consumer's record
+        report is what lets the master finish the current task and
+        create the next epoch's tasks, and the lookahead blocks on the
+        master handing out a task. Yielding after the lookahead
+        deadlocks every pure-training epoch boundary (master waits for
+        the report, worker waits for the task).
+
+        Rows for batch N+1 are one push stale, and pushed grads land up
+        to one step late — both inside the async PS's staleness
+        envelope (the reference's async workers trained entire
+        minibatches on stale params, servicer.py:120-165). A sync-mode
+        PS will version-reject these pushes: use ``train_step`` there
+        instead.
+
+        ``push_interval=k`` additionally accumulates row gradients over
+        k batches and pushes one merged IndexedSlices — the direct
+        analogue of reference ``get_model_steps`` (worker.py:287-295,
+        744-806: k local steps between PS syncs, one merged update).
+
+        Yields (state, loss, batch) per input batch, in order. ``loss``
+        is an unfetched device scalar (the step has only been
+        dispatched when the consumer sees it). ``on_first_batch(batch)``
+        runs before the first dispatch (the worker's checkpoint-restore
+        hook); if it returns a state, that state is used.
+        """
+        if push_interval < 1:
+            raise ValueError("push_interval must be >= 1")
+        it = iter(batches)
+        sentinel = object()
+        batch = next(it, sentinel)
+        if batch is sentinel:
+            return
+        if on_first_batch is not None:
+            restored = on_first_batch(batch)
+            if restored is not None:
+                state = restored
+        # _prepare_once: reuse the rows ensure_state/restore already
+        # pulled for this same batch object
+        prepared, pull_info = self._prepare_once(batch)
+        self._prep_memo = None
+        if state is None:
+            state = self.create_state(prepared["features"])
+        push_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="sparse-push"
+        )
+        push_future = None
+        acc = {}  # table -> (values, ids) accumulated since last push
+        acc_steps = 0
+        push_rpc = self.preparer._ps.push_gradients
+        in_flight = None  # (row_grads, pull_info) dispatched, not pushed
+
+        def fold_in_flight():
+            """Fetch the in-flight step's row grads (fences the device)
+            and fold them into the accumulator."""
+            nonlocal in_flight, acc_steps
+            row_grads, flight_info = in_flight
+            in_flight = None
+            fetched = {
+                name: np.asarray(value)
+                for name, value in row_grads.items()
+            }
+            for name, (unique, n) in flight_info.items():
+                if n == 0:
+                    continue
+                values, ids = fetched[name][:n], unique
+                if name in acc:
+                    prev_v, prev_i = acc[name]
+                    values = np.concatenate([prev_v, values], axis=0)
+                    ids = np.concatenate([prev_i, ids], axis=0)
+                    values, ids = deduplicate_indexed_slices(values, ids)
+                acc[name] = (values, ids)
+            acc_steps += 1
+
+        try:
+            while True:
+                t0 = self.timing.start()
+                state, loss, row_grads = self._train_step(state, prepared)
+                in_flight = (row_grads, pull_info)
+                # ---- overlap window: device is busy with step N ----
+                # consumer bookkeeping first (its record report unblocks
+                # the master's next task — see docstring), then the
+                # lookahead pull
+                yield state, loss, batch
+                next_batch = next(it, sentinel)
+                next_prep = None
+                if next_batch is not sentinel:
+                    with self.timing.timeit("sparse_pull"):
+                        next_prep = self.preparer.prepare(next_batch)
+                fold_in_flight()  # fences device execution for step N
+                self.timing.end_record_sync("batch_process", t0, loss)
+                if acc_steps >= push_interval and acc:
+                    # snapshot on this thread BEFORE handing to the push
+                    # thread — the next interval mutates ``acc``
+                    snapshot, acc = acc, {}
+                    acc_steps = 0
+                    if push_future is not None:
+                        with self.timing.timeit("sparse_push"):
+                            self._finish_push(push_future.result())
+                    push_future = push_pool.submit(
+                        push_rpc, snapshot, model_version=self._version
+                    )
+                if next_batch is sentinel:
+                    break
+                batch, (prepared, pull_info) = next_batch, next_prep
+            if push_future is not None:
+                with self.timing.timeit("sparse_push"):
+                    self._finish_push(push_future.result())
+                push_future = None
+            if acc:  # tail accumulation shorter than push_interval
+                with self.timing.timeit("sparse_push"):
+                    self._finish_push(
+                        push_rpc(acc, model_version=self._version)
+                    )
+                acc = {}
+        finally:
+            if push_future is not None:
+                push_future.result()
+            # closed mid-stream (stop_training, exception unwinding): a
+            # dispatched step's grads and any short accumulation would
+            # otherwise be silently dropped — flush best-effort
+            try:
+                if in_flight is not None:
+                    fold_in_flight()
+                if acc:
+                    self._finish_push(
+                        push_rpc(acc, model_version=self._version)
+                    )
+            except Exception:
+                pass  # the original exception matters more
+            push_pool.shutdown(wait=True)
+
+    def _finish_push(self, result):
+        accepted, version, _ = _normalize_push_result(
+            result, self._version
+        )
+        if not accepted:
+            raise RuntimeError(
+                "train_stream pushed gradients to a sync-mode PS which "
+                "rejected them as stale; pipelined training requires "
+                "the async PS (use train_step with --use_async=false)"
+            )
+        self._version = version

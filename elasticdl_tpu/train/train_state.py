@@ -24,7 +24,13 @@ class TrainState:
 
 def create_train_state(model, tx, rng, sample_features):
     """Initialize model + optimizer state from one sample batch."""
-    variables = model.init(rng, sample_features, training=False)
+    # jit the init: eager flax init compiles (and dispatches) every
+    # primitive separately — ~30 s of per-op XLA compiles for a model
+    # with large host-side row buffers; one traced program is seconds.
+    # Inside an outer trace (SpmdTrainer's sharded init) jit inlines.
+    variables = jax.jit(
+        lambda r, feats: model.init(r, feats, training=False)
+    )(rng, sample_features)
     variables = dict(variables)
     params = variables.pop("params")
     model_state = variables  # whatever collections remain (batch_stats, ...)

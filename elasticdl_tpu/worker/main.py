@@ -72,6 +72,9 @@ def main(argv=None):
         keep_checkpoint_max=args.keep_checkpoint_max,
         checkpoint_dir_for_init=checkpoint_dir_for_init,
         multihost_runtime=multihost_runtime,
+        sparse_pipeline=bool(args.sparse_pipeline),
+        sparse_cache_staleness=args.sparse_cache_staleness,
+        sparse_push_interval=args.sparse_push_interval,
         # the elastic fallback dir is empty on first launch; only an
         # explicit operator resume request is strict
         resume_optional=not args.checkpoint_dir_for_init,

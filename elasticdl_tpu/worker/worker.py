@@ -68,6 +68,9 @@ class Worker:
         checkpoint_dir_for_init="",
         multihost_runtime=None,
         resume_optional=False,
+        sparse_pipeline=False,
+        sparse_cache_staleness=0,
+        sparse_push_interval=1,
     ):
         self._mc = master_client
         self.spec = get_model_spec(model_zoo_module)
@@ -108,6 +111,8 @@ class Worker:
                 batch_size=minibatch_size
             )
             trainer_kwargs["ps_client"] = PSClient(ps_addrs)
+            if sparse_cache_staleness > 0:
+                trainer_kwargs["cache_staleness"] = sparse_cache_staleness
         else:
             factory = trainer_factory or JaxTrainer
         # SPMD-capable factories take the model's sharding rules; the
@@ -140,6 +145,14 @@ class Worker:
         else:
             trainer_kwargs["model"] = self.spec.custom_model()
         self.trainer = factory(**trainer_kwargs)
+        # pipelined sparse stream only where it exists AND the model is
+        # sparse (async-PS staleness envelope; sparse.py train_stream)
+        self._sparse_pipeline = bool(
+            sparse_pipeline
+            and self.spec.sparse_embedding_specs
+            and hasattr(self.trainer, "train_stream")
+        )
+        self._sparse_push_interval = max(1, sparse_push_interval)
         self.state = None
         self.stop_training = False
         self._version = 0
@@ -250,37 +263,75 @@ class Worker:
         return dataset.batch(self._minibatch_size).prefetch(2)
 
     # ------------------------------------------------------------------
+    def _after_train_batch(self, batch, loss):
+        """Per-batch bookkeeping shared by both loop shapes: version,
+        checkpoint, record accounting, liveness, callbacks."""
+        self._version += 1
+        if (
+            self._checkpoint_mgr is not None
+            and self._version % self._checkpoint_steps == 0
+        ):
+            self._checkpoint_mgr.save(self._version, self.state)
+        with self._timing.timeit("report_record"):
+            self.tds.report_record_done(batch_real_count(batch))
+        if (
+            self._report_version_steps
+            and self._version % self._report_version_steps == 0
+        ):
+            self._mc.report_version(self._version)
+        self._check_mesh_epoch()
+        for cb in self._callbacks:
+            cb.on_batch_end(self._version, loss)
+
+    def _train_batches_pipelined(self, batches):
+        """Drive the sparse trainer's pipelined stream: batch N+1's PS
+        pull rides under batch N's device step, pushes go out on a
+        background thread (train/sparse.py train_stream — async-PS
+        mode's answer to reference get_model_steps)."""
+
+        def on_first_batch(batch):
+            if not self._restore_attempted:
+                self._restore_from_checkpoint(batch)
+            return self.state
+
+        import contextlib
+
+        stream = self.trainer.train_stream(
+            self.state,
+            batches,
+            on_first_batch=on_first_batch,
+            push_interval=self._sparse_push_interval,
+        )
+        # deterministic close: the stream's finally drains the in-flight
+        # background push even when we break or an exception unwinds
+        with contextlib.closing(stream):
+            for state, loss, batch in stream:
+                self.state = state
+                self._after_train_batch(batch, loss)
+                if self.stop_training:
+                    break
+
+    def _train_batches_sequential(self, batches):
+        for batch in batches:
+            if not self._restore_attempted:
+                self._restore_from_checkpoint(batch)
+            t0 = self._timing.start()
+            self.state, loss = self.trainer.train_step(self.state, batch)
+            self._timing.end_record_sync("batch_process", t0, loss)
+            self._after_train_batch(batch, loss)
+            if self.stop_training:
+                break
+
     def _run_training_stream(self):
         """Consume one continuous training stream until it pauses."""
         try:
-            for batch in self._batches(
+            batches = self._batches(
                 self.tds.training_record_stream(), Mode.TRAINING
-            ):
-                if not self._restore_attempted:
-                    self._restore_from_checkpoint(batch)
-                t0 = self._timing.start()
-                self.state, loss = self.trainer.train_step(
-                    self.state, batch
-                )
-                self._timing.end_record_sync("batch_process", t0, loss)
-                self._version += 1
-                if (
-                    self._checkpoint_mgr is not None
-                    and self._version % self._checkpoint_steps == 0
-                ):
-                    self._checkpoint_mgr.save(self._version, self.state)
-                with self._timing.timeit("report_record"):
-                    self.tds.report_record_done(batch_real_count(batch))
-                if (
-                    self._report_version_steps
-                    and self._version % self._report_version_steps == 0
-                ):
-                    self._mc.report_version(self._version)
-                self._check_mesh_epoch()
-                for cb in self._callbacks:
-                    cb.on_batch_end(self._version, loss)
-                if self.stop_training:
-                    break
+            )
+            if self._sparse_pipeline:
+                self._train_batches_pipelined(batches)
+            else:
+                self._train_batches_sequential(batches)
         except CheckpointRestoreError:
             # fatal for this process; requeue held tasks first (the
             # relaunched same-id worker keeps liveness fresh, so the

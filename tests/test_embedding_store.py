@@ -123,3 +123,49 @@ def test_nesterov_differs_from_momentum():
     assert not np.allclose(results["momentum"], results["nesterov"])
     # nesterov's lookahead steps further along a constant gradient
     assert (results["nesterov"] < results["momentum"]).all()
+
+
+@pytest.mark.parametrize("cls", [NumpyEmbeddingStore, NativeEmbeddingStore])
+@pytest.mark.parametrize(
+    "initializer,param,check",
+    [
+        ("constant", 1.5, lambda r: np.testing.assert_array_equal(
+            r, np.full_like(r, 1.5))),
+        ("zeros", 0.0, lambda r: np.testing.assert_array_equal(
+            r, np.zeros_like(r))),
+        ("uniform", 0.2, lambda r: (
+            (np.abs(r) <= 0.2).all() and r.std() > 0.05
+        ) or pytest.fail("uniform out of range")),
+        ("normal", 0.1, lambda r: (
+            abs(float(r.mean())) < 0.02 and 0.05 < float(r.std()) < 0.2
+        ) or pytest.fail("normal stats off")),
+        ("truncated_normal", 0.1, lambda r: (
+            (np.abs(r) <= 0.2 + 1e-6).all() and float(r.std()) > 0.03
+        ) or pytest.fail("truncated_normal out of bound")),
+    ],
+)
+def test_initializer_kinds(cls, initializer, param, check):
+    """Row initializers match reference initializer.go:25-155 semantics:
+    Zero/Constant exact, Normal/TruncatedNormal by moments, truncation
+    bounded by 2*stddev."""
+    if cls is NativeEmbeddingStore and native_lib() is None:
+        pytest.skip("native store unavailable")
+    store = cls(seed=11)
+    store.set_optimizer("sgd", lr=0.1)
+    store.create_table("t", 64, init_scale=param, initializer=initializer)
+    rows = store.lookup("t", np.arange(32, dtype=np.int64))
+    check(rows)
+
+
+def test_parse_initializer_wire_formats():
+    from elasticdl_tpu.ps.embedding_store import parse_initializer
+
+    assert parse_initializer("0.07") == ("uniform", 0.07)
+    assert parse_initializer("") == ("uniform", 0.05)
+    assert parse_initializer("normal:0.01") == ("normal", 0.01)
+    assert parse_initializer("constant:2.0") == ("constant", 2.0)
+    assert parse_initializer("zeros") == ("constant", 0.0)
+    assert parse_initializer("truncated_normal") == (
+        "truncated_normal", 0.05)
+    with pytest.raises(ValueError):
+        parse_initializer("glorot:1.0")
