@@ -1,0 +1,176 @@
+"""Pipeline-parallel MLP with tensor parallelism inside each stage.
+
+No reference counterpart (SURVEY.md §2.12 lists pp as absent from the
+reference); this is the minimal model exercising the pp x tp
+composition: stages are Megatron-style column+row parallel MLP blocks —
+W1 sharded on its output dim over ``tp``, W2 on its input dim, one
+manual ``psum`` per block rejoining the activation — scheduled through
+:func:`elasticdl_tpu.parallel.pipeline.pipeline_apply` (1f1b schedule,
+optional interleaved chunks).
+
+Model contract: plain class with ``init``/``apply`` (the stage loop
+lives in a shard_map; see pipeline_transformer.py for the idiom).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.parallel.pipeline import pipeline_apply
+from elasticdl_tpu.parallel.sharding import ShardingRules
+from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
+from elasticdl_tpu.train.optimizers import create_optimizer
+
+
+def _make_stage_fn(use_tp):
+    """Column-parallel W1, row-parallel W2, one psum over tp."""
+
+    def layer_fn(p, x):
+        h = jnp.maximum(x @ p["W1"], 0.0)
+        out = h @ p["W2"]
+        if use_tp:
+            out = jax.lax.psum(out, "tp")
+        return jnp.tanh(out + p["b"]) + x  # residual keeps depth trainable
+
+    return layer_fn
+
+
+class PipelinedMlpNet:
+    """Residual MLP classifier, layers split into pipeline stages."""
+
+    def __init__(self, num_classes=16, dim=32, hidden=64, num_layers=4,
+                 num_stages=1, num_chunks=1, num_microbatches=2,
+                 mesh=None):
+        chunks = num_stages * num_chunks
+        if num_layers % chunks != 0:
+            raise ValueError(
+                "num_layers=%d not divisible by stages*chunks=%d"
+                % (num_layers, chunks)
+            )
+        self.num_classes = num_classes
+        self.dim = dim
+        self.hidden = hidden
+        self.num_layers = num_layers
+        self.num_stages = num_stages
+        self.num_chunks = num_chunks
+        self.num_microbatches = num_microbatches
+        self.mesh = mesh
+
+    def init(self, rng, features, training=False, rngs=None):
+        del training, rngs
+        keys = jax.random.split(rng, 3)
+        scale_in = 1.0 / jnp.sqrt(self.dim)
+        blocks = {
+            "W1": jax.random.normal(
+                keys[0], (self.num_layers, self.dim, self.hidden)
+            ) * scale_in,
+            "W2": jax.random.normal(
+                keys[1], (self.num_layers, self.hidden, self.dim)
+            ) / jnp.sqrt(self.hidden),
+            "b": jnp.zeros((self.num_layers, self.dim)),
+        }
+        head = jax.random.normal(
+            keys[2], (self.dim, self.num_classes)
+        ) * scale_in
+        return {"params": {"blocks": blocks, "head": head}}
+
+    def apply(self, variables, features, training=False, rngs=None):
+        del training, rngs
+        params = variables["params"]
+        x = jnp.asarray(features, jnp.float32)
+        if x.shape[-1] != self.dim:
+            raise ValueError(
+                "features last dim %d != model dim %d"
+                % (x.shape[-1], self.dim)
+            )
+        blocks = params["blocks"]
+        if self.mesh is None:
+            layer_fn = _make_stage_fn(use_tp=False)
+
+            def layer(carry, p):
+                return layer_fn(p, carry), None
+
+            x, _ = jax.lax.scan(layer, x, blocks)
+        else:
+            chunks = self.num_stages * self.num_chunks
+            per_chunk = self.num_layers // chunks
+            staged = jax.tree_util.tree_map(
+                lambda leaf: leaf.reshape(
+                    (chunks, per_chunk) + leaf.shape[1:]
+                ),
+                blocks,
+            )
+            tp = self.mesh.shape.get("tp", 1)
+            layer_fn = _make_stage_fn(use_tp=tp > 1)
+            param_specs = {
+                "W1": P("pp", None, None, "tp") if tp > 1 else P("pp"),
+                "W2": P("pp", None, "tp", None) if tp > 1 else P("pp"),
+                "b": P("pp"),
+            }
+
+            def stage(p, h):
+                def layer(carry, lp):
+                    return layer_fn(lp, carry), None
+
+                h, _ = jax.lax.scan(layer, h, p)
+                return h
+
+            x = pipeline_apply(
+                stage,
+                staged,
+                x,
+                num_microbatches=self.num_microbatches,
+                mesh=self.mesh,
+                num_chunks=self.num_chunks,
+                param_specs=param_specs,
+            )
+        return x @ params["head"]
+
+
+def pipeline_mlp_sharding_rules():
+    """State layout for the FLAT [num_layers, ...] block stack (the
+    chunked rank-4 view exists only inside ``apply``)."""
+    return ShardingRules(
+        rules=[
+            (r"blocks/W1$", P("pp", None, "tp")),
+            (r"blocks/W2$", P("pp", "tp", None)),
+            (r"blocks/b$", P("pp")),
+            (r".*", P()),
+        ],
+        default_spec=P(),
+    )
+
+
+# -- model-zoo contract -----------------------------------------------------
+
+def mesh_config(num_devices):
+    from elasticdl_tpu.parallel.mesh import MeshConfig
+
+    if num_devices % 4 == 0:
+        return MeshConfig(dp=num_devices // 4, pp=2, tp=2)
+    if num_devices % 2 == 0:
+        return MeshConfig(dp=num_devices // 2, pp=2)
+    return MeshConfig(dp=num_devices)
+
+
+def custom_model(mesh=None):
+    num_stages = max(mesh.shape.get("pp", 1), 1) if mesh is not None else 1
+    return PipelinedMlpNet(num_stages=num_stages, mesh=mesh)
+
+
+def loss(labels, logits):
+    return sparse_softmax_cross_entropy(labels, logits)
+
+
+def optimizer():
+    return create_optimizer("Adam", learning_rate=0.01)
+
+
+def sharding_rules():
+    return pipeline_mlp_sharding_rules()
+
+
+def eval_metrics_fn():
+    from elasticdl_tpu.train import metrics
+
+    return {"accuracy": metrics.Accuracy()}

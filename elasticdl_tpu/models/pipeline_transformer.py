@@ -56,16 +56,20 @@ class PipelinedTransformerLM:
         num_microbatches=4,
         attention_impl="auto",
         mesh=None,
+        num_chunks=1,
     ):
-        if num_layers % num_stages != 0:
+        if num_layers % (num_stages * num_chunks) != 0:
             raise ValueError(
-                "num_layers=%d is not divisible by num_stages=%d; "
-                "refusing to silently change model depth"
-                % (num_layers, num_stages)
+                "num_layers=%d is not divisible by num_stages*num_chunks"
+                "=%d; refusing to silently change model depth"
+                % (num_layers, num_stages * num_chunks)
             )
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_stages = num_stages
+        # interleaved virtual chunks per device (Megatron interleaved
+        # schedule; parallel/pipeline.py) — divides the bubble by V
+        self.num_chunks = num_chunks
         self.num_microbatches = num_microbatches
         self.mesh = mesh
         self.embed_dim = embed_dim
@@ -124,14 +128,14 @@ class PipelinedTransformerLM:
             # Single-chip sequential fallback: scan over the flat stack.
             x = stage_fn(params["blocks"], x)
         else:
-            # Regroup (L, ...) -> (S, L/S, ...) for the schedule. The
-            # leading dim is pp-sharded and S == pp extent, so the
-            # reshape splits exactly along shard boundaries (no
-            # resharding).
-            per_stage = self.num_layers // self.num_stages
+            # Regroup (L, ...) -> (S*V, L/(S*V), ...) for the schedule.
+            # The leading dim is pp-sharded, so the reshape splits along
+            # shard boundaries (no resharding).
+            chunks = self.num_stages * self.num_chunks
+            per_chunk = self.num_layers // chunks
             staged = jax.tree_util.tree_map(
                 lambda leaf: leaf.reshape(
-                    (self.num_stages, per_stage) + leaf.shape[1:]
+                    (chunks, per_chunk) + leaf.shape[1:]
                 ),
                 params["blocks"],
             )
@@ -141,6 +145,7 @@ class PipelinedTransformerLM:
                 x,
                 num_microbatches=self.num_microbatches,
                 mesh=self.mesh,
+                num_chunks=self.num_chunks,
             )
         x = self._ln_f.apply({"params": params["ln_f"]}, x)
         return self._head.apply({"params": params["lm_head"]}, x)
