@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# One-command local reproduction of CI tiers 1-2
+# (.github/workflows/ci.yml; reference pipeline: .travis.yml:30-98).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tier 1a: native store build + TSAN race stress =="
+make -C elasticdl_tpu/native
+make -C elasticdl_tpu/native stress_tsan
+./elasticdl_tpu/native/store_stress_tsan
+
+echo "== tier 1b: unit suite (8-virtual-device CPU mesh) =="
+python -m pytest tests/ -x -q
+
+echo "== tier 2a: multi-chip SPMD dryrun (dp/fsdp, tp/sp, ep, pp, pp x tp) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== tier 2b: client dry-run job submission =="
+JAX_PLATFORMS=cpu python -m elasticdl_tpu.client.main train \
+  --model_zoo elasticdl_tpu/models \
+  --model_def mnist.custom_model \
+  --training_data /tmp/does-not-matter \
+  --num_workers 2 --num_ps_pods 1 \
+  --image_name elasticdl-tpu:ci \
+  --job_name ci-dryrun --dry_run > /dev/null
+
+echo "CI tiers 1-2 OK"
