@@ -64,6 +64,179 @@ def _block_update(carry, k_blk, v_blk, q, mask):
     return m_new, l_new, acc_new
 
 
+def _spec_axis_names(spec):
+    names = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            names.extend(entry)
+        else:
+            names.append(entry)
+    return tuple(names)
+
+
+def _make_flash_ring(axis_name, sp_size, causal, sm_scale, spec_axes,
+                     block_q, block_k, interpret):
+    """Per-device ring fold whose block compute is the Pallas flash
+    kernel (ops/flash_attention.py) instead of einsum math.
+
+    Forward: each ring step runs the kernel on (q, k_blk) and merges
+    the partial (o_t, lse_t) into the running output with the standard
+    log-sum-exp combine. Backward (custom_vjp — the kernel's own vjp
+    can't serve because the merge needs lse as a live output): re-rotate
+    the KV ring, call the kernel's backward per block with the GLOBAL
+    (o, lse, do) — exp(s - lse_global) IS the global softmax restricted
+    to the block — accumulate dq locally, and let each block's (dk, dv)
+    accumulators ride the ring home (sp hops = full circle).
+    Schedule per Liu et al. RingAttention; implementation original.
+    """
+    from elasticdl_tpu.ops import flash_attention as F
+
+    NEG = F.NEG_INF
+    vary = lambda x: jax.lax.pcast(x, spec_axes, to="varying")
+
+    def lse_w(lse_from, lse_to):
+        # (bh, 1, S) log-weights -> (bh, S, 1) multiplicative weights
+        return jnp.exp(lse_from - lse_to).transpose(0, 2, 1)
+
+    def kernel_fwd(q_m, k_blk, v_blk, src, my_idx):
+        def zeros(_):
+            return (
+                jnp.zeros(q_m.shape, jnp.float32),
+                jnp.full(
+                    (q_m.shape[0], 1, q_m.shape[1]), NEG, jnp.float32
+                ),
+            )
+
+        def run(_):
+            def call(diag):
+                def inner(_):
+                    o_t, lse_t = F._fwd(
+                        q_m, k_blk, v_blk, sm_scale, diag,
+                        block_q, block_k, interpret,
+                    )
+                    return o_t.astype(jnp.float32), lse_t
+
+                return inner
+
+            if not causal:
+                return call(False)(None)
+            return jax.lax.cond(
+                src == my_idx, call(True), call(False), None
+            )
+
+        if not causal:
+            return run(None)
+        return jax.lax.cond(src > my_idx, zeros, run, None)
+
+    def kernel_bwd(q_m, k_blk, v_blk, o_m, lse, do_m, src, my_idx):
+        def zeros(_):
+            return (
+                jnp.zeros(q_m.shape, jnp.float32),
+                jnp.zeros(k_blk.shape, jnp.float32),
+                jnp.zeros(v_blk.shape, jnp.float32),
+            )
+
+        def run(_):
+            def call(diag):
+                def inner(_):
+                    dq, dk, dv = F._bwd(
+                        q_m, k_blk, v_blk, o_m, lse, do_m, sm_scale,
+                        diag, block_q, block_k, interpret,
+                    )
+                    return (
+                        dq.astype(jnp.float32),
+                        dk.astype(jnp.float32),
+                        dv.astype(jnp.float32),
+                    )
+
+                return inner
+
+            if not causal:
+                return call(False)(None)
+            return jax.lax.cond(
+                src == my_idx, call(True), call(False), None
+            )
+
+        if not causal:
+            return run(None)
+        return jax.lax.cond(src > my_idx, zeros, run, None)
+
+    perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+
+    @jax.custom_vjp
+    def fold(q_m, k_m, v_m):
+        o, _ = _fold_fwd(q_m, k_m, v_m)
+        return o
+
+    def _fold_fwd(q_m, k_m, v_m):
+        my_idx = jax.lax.axis_index(axis_name)
+        bh, seq, _ = q_m.shape
+
+        def step(carry, t):
+            o, lse, k_blk, v_blk = carry
+            src = (my_idx - t) % sp_size
+            o_t, lse_t = kernel_fwd(q_m, k_blk, v_blk, src, my_idx)
+            lse_new = jnp.logaddexp(lse, lse_t)
+            o = o * lse_w(lse, lse_new) + o_t * lse_w(lse_t, lse_new)
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            return (o, lse_new, k_blk, v_blk), None
+
+        init = (
+            vary(jnp.zeros(q_m.shape, jnp.float32)),
+            vary(jnp.full((bh, 1, seq), NEG, jnp.float32)),
+            k_m,
+            v_m,
+        )
+        (o, lse, _, _), _ = jax.lax.scan(
+            step, init, jnp.arange(sp_size)
+        )
+        o = o.astype(q_m.dtype)
+        return o, (q_m, k_m, v_m, o, lse)
+
+    def _fold_bwd(res, do_m):
+        q_m, k_m, v_m, o_m, lse = res
+        my_idx = jax.lax.axis_index(axis_name)
+
+        def step(carry, t):
+            dq, k_blk, v_blk, dk_acc, dv_acc = carry
+            src = (my_idx - t) % sp_size
+            dq_t, dk_t, dv_t = kernel_bwd(
+                q_m, k_blk, v_blk, o_m, lse, do_m, src, my_idx
+            )
+            dq = dq + dq_t
+            dk_acc = dk_acc + dk_t
+            dv_acc = dv_acc + dv_t
+            # the (dk, dv) accumulators ride with their blocks: after
+            # sp hops both are back on the block's owner
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+            return (dq, k_blk, v_blk, dk_acc, dv_acc), None
+
+        init = (
+            vary(jnp.zeros(q_m.shape, jnp.float32)),
+            k_m,
+            v_m,
+            vary(jnp.zeros(k_m.shape, jnp.float32)),
+            vary(jnp.zeros(v_m.shape, jnp.float32)),
+        )
+        (dq, _, _, dk, dv), _ = jax.lax.scan(
+            step, init, jnp.arange(sp_size)
+        )
+        return (
+            dq.astype(q_m.dtype),
+            dk.astype(k_m.dtype),
+            dv.astype(v_m.dtype),
+        )
+
+    fold.defvjp(_fold_fwd, _fold_bwd)
+    return fold
+
+
 def ring_attention(
     q,
     k,
@@ -74,21 +247,95 @@ def ring_attention(
     sm_scale=None,
     spec=None,
     remat=True,
+    block_impl="auto",
+    block_q=None,
+    block_k=None,
+    interpret=False,
 ):
     """Attention with q/k/v sequence-sharded over ``axis_name``.
 
     Shapes are the global (batch, heads, seq, head_dim); sharding of the
     operands must match ``spec`` (default: batch over dp/fsdp, heads over
     tp, seq over sp).
+
+    ``block_impl`` picks the per-block compute inside the ring fold:
+    "einsum" (XLA math, any backend), "flash" (the Pallas kernel —
+    per-device work becomes true flash attention), or "auto" (flash on
+    TPU when the local sequence fits the kernel's block constraints).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     spec = spec if spec is not None else _default_spec()
     sp_size = mesh.shape[axis_name]
     if sp_size == 1:
-        from elasticdl_tpu.ops.attention import xla_attention
+        from elasticdl_tpu.ops.attention import dot_product_attention
 
-        return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        # honor block_impl even in the ring-of-one degenerate case: a
+        # user who pinned "einsum" (e.g. around a kernel bug) must not
+        # silently get the Pallas path back via impl="auto"
+        impl = {"flash": "pallas", "einsum": "xla"}.get(
+            block_impl, "auto"
+        )
+        return dot_product_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale, impl=impl,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+
+    spec_axes = _spec_axis_names(spec)
+    seq_loc_global = q.shape[2] // sp_size
+    resolved = block_impl
+    if resolved == "auto":
+        from elasticdl_tpu.ops import flash_attention as _F
+
+        blk = _F._auto_block(seq_loc_global, 512)
+        ok = (
+            jax.default_backend() == "tpu"
+            and seq_loc_global >= 128
+            and seq_loc_global % min(blk, seq_loc_global) == 0
+        )
+        resolved = "flash" if ok else "einsum"
+    if resolved not in ("flash", "einsum"):
+        raise ValueError("unknown ring block_impl %r" % (block_impl,))
+    if resolved == "flash":
+        from elasticdl_tpu.ops import flash_attention as _F
+
+        blk_q = min(
+            block_q or _F._auto_block(seq_loc_global, 512),
+            seq_loc_global,
+        )
+        blk_k = min(
+            block_k or _F._auto_block(seq_loc_global, 1024),
+            seq_loc_global,
+        )
+        if seq_loc_global % blk_q or seq_loc_global % blk_k:
+            # the kernel grid would silently skip the tail rows
+            raise ValueError(
+                "flash ring fold needs the local sequence (%d = global "
+                "%d / sp %d) divisible by the blocks (%d, %d)"
+                % (seq_loc_global, q.shape[2], sp_size, blk_q, blk_k)
+            )
+        fold = _make_flash_ring(
+            axis_name, sp_size, causal, sm_scale, spec_axes,
+            blk_q, blk_k, interpret,
+        )
+
+        def flash_local_fn(q_loc, k_loc, v_loc):
+            b, h, s, d = q_loc.shape
+            merge = lambda t: t.reshape(b * h, s, d)
+            o = fold(merge(q_loc), merge(k_loc), merge(v_loc))
+            return o.reshape(b, h, s, d)
+
+        # check_vma=False: pallas_call's out ShapeDtypeStructs carry no
+        # vma annotation, which the VMA checker rejects inside a
+        # checked manual region; the specs here mirror the (long
+        # VMA-checked) einsum path below
+        return jax.shard_map(
+            flash_local_fn,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
 
     def local_fn(q_loc, k_loc, v_loc):
         my_idx = jax.lax.axis_index(axis_name)

@@ -105,3 +105,60 @@ def test_ring_attention_sp1_falls_back():
     expected = xla_attention(q, k, v, causal=True)
     got = ring_attention(q, k, v, mesh, causal=True)
     np.testing.assert_allclose(got, expected, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_ring_matches_full(causal):
+    """Ring fold with the Pallas kernel as block compute (VERDICT.md
+    round-1 item #6): per-device work is true flash attention, output
+    matches full single-device attention."""
+    mesh = build_mesh(MeshConfig(dp=2, sp=4))
+    q, k, v = _inputs(seq=256, dim=32)
+    expected = xla_attention(q, k, v, causal=causal)
+    got = jax.jit(
+        lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=causal,
+            block_impl="flash", interpret=True,
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(got, expected, atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_ring_grads_match_full(causal):
+    mesh = build_mesh(MeshConfig(dp=2, sp=4))
+    q, k, v = _inputs(seq=256, dim=32, seed=5)
+
+    def loss_ref(q, k, v):
+        out = xla_attention(q, k, v, causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_ring(q, k, v):
+        out = ring_attention(
+            q, k, v, mesh, causal=causal,
+            block_impl="flash", interpret=True,
+        )
+        return jnp.sum(out * jnp.cos(out))
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+
+def test_flash_ring_agrees_with_einsum_ring():
+    """The two block computes are different executions of the same
+    math: outputs must agree tightly."""
+    mesh = build_mesh(MeshConfig(dp=2, sp=4))
+    q, k, v = _inputs(seq=256, dim=32, seed=9)
+    a = jax.jit(
+        lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=True, block_impl="flash", interpret=True
+        )
+    )(q, k, v)
+    b = jax.jit(
+        lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=True, block_impl="einsum"
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
