@@ -91,25 +91,42 @@ def gen_census_recordio(data_dir, num_records=2048, seed=0,
     work_scores = np.random.RandomState(7).randn(
         len(WORK_CLASS_VOCABULARY)
     )
+    relationships = ["Wife", "Own-child", "Husband", "Not-in-family",
+                     "Other-relative", "Unmarried"]
+    races = ["White", "Black", "Asian-Pac-Islander",
+             "Amer-Indian-Eskimo", "Other"]
+    countries = ["United-States", "Mexico", "Philippines", "Germany",
+                 "Canada", "India"]
     for _ in range(num_records):
         age = float(rng.randint(17, 80))
         hours = float(rng.randint(10, 70))
+        capital_gain = float(rng.exponential(600.0)) if rng.rand() < 0.2 else 0.0
+        capital_loss = float(rng.exponential(300.0)) if rng.rand() < 0.1 else 0.0
         wc = rng.randint(0, len(WORK_CLASS_VOCABULARY))
         score = (
             0.08 * (age - 40)
             + 0.07 * (hours - 40)
+            + 0.001 * (capital_gain - capital_loss)
             + work_scores[wc]
             + rng.randn() * 0.25
         )
         rows.append({
             "age": np.float32(age),
             "hours_per_week": np.float32(hours),
+            "capital_gain": np.float32(capital_gain),
+            "capital_loss": np.float32(capital_loss),
             "work_class": WORK_CLASS_VOCABULARY[wc],
             "marital_status": MARITAL_STATUS_VOCABULARY[
                 rng.randint(0, len(MARITAL_STATUS_VOCABULARY))
             ],
             "education": educations[rng.randint(0, len(educations))],
             "occupation": occupations[rng.randint(0, len(occupations))],
+            "relationship": relationships[
+                rng.randint(0, len(relationships))
+            ],
+            "race": races[rng.randint(0, len(races))],
+            "sex": "Male" if rng.rand() < 0.5 else "Female",
+            "native_country": countries[rng.randint(0, len(countries))],
             "label": np.int64(1 if score > 0 else 0),
         })
     return convert_rows(data_dir, rows, "census", records_per_shard)

@@ -1,0 +1,106 @@
+"""Census-income DNN over embedded categorical features.
+
+Reference parity: model_zoo/census_dnn_model/ (census_feature_columns.py
++ census_functional_api.py / census_sequential.py / census_subclass.py
+— all three build the same network: 4 numeric columns, 8 categorical
+columns hashed into 64 buckets and embedded at dim 16, DenseFeatures
+into a 16-16-1 sigmoid tower).
+
+TPU redesign: hashing runs per record in dataset_fn (host-only string
+op); the flax model consumes numeric arrays + identity categorical ids
+so the forward is one jit-fused program. Logits out; sigmoid lives in
+the loss.
+"""
+
+import flax.linen as nn
+import numpy as np
+
+from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.preprocessing import Hashing
+from elasticdl_tpu.preprocessing import feature_column as fc
+from elasticdl_tpu.train import metrics
+from elasticdl_tpu.train.losses import sigmoid_binary_cross_entropy
+from elasticdl_tpu.train.optimizers import create_optimizer
+
+# reference census_feature_columns.py:18-33 (our census RecordIO schema
+# uses underscores in place of the dashes of the raw CSV headers)
+CATEGORICAL_KEYS = [
+    "work_class",
+    "education",
+    "marital_status",
+    "occupation",
+    "relationship",
+    "race",
+    "sex",
+    "native_country",
+]
+NUMERIC_KEYS = ["age", "capital_gain", "capital_loss", "hours_per_week"]
+HASH_BUCKETS = 64  # :47
+EMBED_DIM = 16  # :49
+
+_hashers = {key: Hashing(HASH_BUCKETS) for key in CATEGORICAL_KEYS}
+
+
+def build_columns():
+    columns = [fc.numeric_column(key) for key in NUMERIC_KEYS]
+    for key in CATEGORICAL_KEYS:
+        columns.append(
+            fc.embedding_column(
+                fc.categorical_column_with_identity(
+                    key + "_id", HASH_BUCKETS
+                ),
+                dimension=EMBED_DIM,
+            )
+        )
+    return tuple(columns)
+
+
+class CensusDnn(nn.Module):
+    hidden: tuple = (16, 16)  # census_functional_api.py:26-27
+
+    def setup(self):
+        self.features = fc.DenseFeatures(columns=build_columns())
+        self.layers = [nn.Dense(w) for w in self.hidden]
+        self.logit = nn.Dense(1)
+
+    def __call__(self, features, training: bool = False):
+        x = self.features(features)
+        for layer in self.layers:
+            x = nn.relu(layer(x))
+        return self.logit(x).squeeze(-1)
+
+
+def custom_model():
+    return CensusDnn()
+
+
+def loss(labels, predictions):
+    return sigmoid_binary_cross_entropy(labels, predictions)
+
+
+def optimizer():
+    return create_optimizer("Adam", learning_rate=0.001)
+
+
+def dataset_fn(dataset, mode=None, metadata=None):
+    def parse(payload):
+        example = decode_example(payload)
+        features = {
+            key: np.float32(example[key]).reshape(())
+            for key in NUMERIC_KEYS
+        }
+        for key in CATEGORICAL_KEYS:
+            value = example.get(key, "")
+            features[key + "_id"] = _hashers[key](
+                np.array([str(value)])
+            ).reshape((1,))
+        return features, np.float32(example["label"]).reshape(())
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {
+        "auc": metrics.AUC(from_logits=True),
+        "accuracy": metrics.BinaryAccuracy(from_logits=True),
+    }

@@ -1,0 +1,191 @@
+"""Census Wide&Deep generated from a declarative transform spec.
+
+Reference parity: model_zoo/census_model_sqlflow/wide_and_deep/ — the
+SQLFlow ``COLUMN`` clause compiles into a transform graph
+(feature_configs.py: Vocabularize/Hash/Bucketize ops, three Concat id
+groups with cumulative id offsets, wide dim-1 + deep dim-8 embeddings
+per group) that the model interprets (transform_ops.py,
+wide_deep_functional_keras.py).
+
+TPU redesign keeps the declarative shape — ``TRANSFORMS`` below is the
+data a SQLFlow codegen would emit — and interprets it in two stages:
+string ops (vocab/hash) per record in dataset_fn on the host, numeric
+ops (bucketize, group concat via id offsets, embeddings) as feature
+columns inside the jitted forward. Group extents and embedding dims
+match feature_configs.py:76-205 exactly.
+"""
+
+import flax.linen as nn
+import numpy as np
+
+from elasticdl_tpu.data.census_schema import (
+    MARITAL_STATUS_VOCABULARY,
+    WORK_CLASS_VOCABULARY,
+)
+from elasticdl_tpu.data.example import decode_example
+from elasticdl_tpu.preprocessing import Hashing, IndexLookup
+from elasticdl_tpu.preprocessing import feature_column as fc
+from elasticdl_tpu.train import metrics
+from elasticdl_tpu.train.losses import sigmoid_binary_cross_entropy
+from elasticdl_tpu.train.optimizers import create_optimizer
+
+RELATIONSHIP_VOCABULARY = [
+    "Wife", "Own-child", "Husband", "Not-in-family", "Other-relative",
+    "Unmarried",
+]
+RACE_VOCABULARY = [
+    "White", "Asian-Pac-Islander", "Amer-Indian-Eskimo", "Other", "Black",
+]
+SEX_VOCABULARY = ["Female", "Male"]
+AGE_BOUNDARIES = [0.0, 20.0, 40.0, 60.0, 80.0]
+CAPITAL_GAIN_BOUNDARIES = [6000.0, 6500.0, 7000.0, 7500.0, 8000.0]
+CAPITAL_LOSS_BOUNDARIES = [2000.0, 2500.0, 3000.0, 3500.0, 4000.0]
+HOURS_BOUNDARIES = [10.0, 20.0, 30.0, 40.0, 50.0, 60.0]
+
+# The SQLFlow COLUMN clause, compiled: (output, op, input, param).
+# vocab/hash rows run on the host per record; bucketize rows become
+# feature columns. Cardinalities feed the Concat id offsets below.
+TRANSFORMS = [
+    ("workclass_lookup", "vocab", "work_class", WORK_CLASS_VOCABULARY),
+    ("marital_status_lookup", "vocab", "marital_status",
+     MARITAL_STATUS_VOCABULARY),
+    ("relationship_lookup", "vocab", "relationship",
+     RELATIONSHIP_VOCABULARY),
+    ("race_lookup", "vocab", "race", RACE_VOCABULARY),
+    ("sex_lookup", "vocab", "sex", SEX_VOCABULARY),
+    ("education_hash", "hash", "education", 30),
+    ("occupation_hash", "hash", "occupation", 30),
+    ("native_country_hash", "hash", "native_country", 100),
+    ("age_bucketize", "bucketize", "age", AGE_BOUNDARIES),
+    ("capital_gain_bucketize", "bucketize", "capital_gain",
+     CAPITAL_GAIN_BOUNDARIES),
+    ("capital_loss_bucketize", "bucketize", "capital_loss",
+     CAPITAL_LOSS_BOUNDARIES),
+    ("hours_per_week_bucketize", "bucketize", "hours_per_week",
+     HOURS_BOUNDARIES),
+]
+
+# feature_configs.py:141-168: three Concat groups over transform outputs
+GROUPS = {
+    "group1": ["workclass_lookup", "hours_per_week_bucketize",
+               "capital_gain_bucketize", "capital_loss_bucketize"],
+    "group2": ["education_hash", "marital_status_lookup",
+               "relationship_lookup", "occupation_hash"],
+    "group3": ["age_bucketize", "sex_lookup", "race_lookup",
+               "native_country_hash"],
+}
+WIDE_GROUPS = ["group1", "group2"]  # dim-1 embeddings (:170-183)
+DEEP_GROUPS = ["group1", "group2", "group3"]  # dim-8 (:185-205)
+DEEP_DIM = 8
+
+
+def _cardinality(name):
+    for out, op, _, param in TRANSFORMS:
+        if out != name:
+            continue
+        if op == "vocab":
+            return len(param) + 1  # +1 OOV slot (IndexLookup)
+        if op == "hash":
+            return param
+        if op == "bucketize":
+            return len(param) + 1
+    raise KeyError(name)
+
+
+_host_ops = {}
+for _out, _op, _src, _param in TRANSFORMS:
+    if _op == "vocab":
+        _host_ops[_out] = (_src, IndexLookup(_param, num_oov_tokens=1))
+    elif _op == "hash":
+        _host_ops[_out] = (_src, Hashing(_param))
+
+
+def build_columns():
+    wide_cols, deep_cols = [], []
+    for group_name in sorted(GROUPS):
+        parts = []
+        for member in GROUPS[group_name]:
+            op = next(t[1] for t in TRANSFORMS if t[0] == member)
+            if op == "bucketize":
+                src = next(t[2] for t in TRANSFORMS if t[0] == member)
+                bounds = next(t[3] for t in TRANSFORMS if t[0] == member)
+                parts.append(fc.bucketized_column(
+                    fc.numeric_column(src), list(bounds)
+                ))
+            else:
+                parts.append(fc.categorical_column_with_identity(
+                    member, _cardinality(member)
+                ))
+        group = fc.concatenated_categorical_column(parts)
+        if group_name in WIDE_GROUPS:
+            wide_cols.append(
+                fc.embedding_column(group, dimension=1, combiner="sum")
+            )
+        if group_name in DEEP_GROUPS:
+            deep_cols.append(
+                fc.embedding_column(
+                    group, dimension=DEEP_DIM, combiner="sum"
+                )
+            )
+    return tuple(wide_cols), tuple(deep_cols)
+
+
+class SqlflowWideDeep(nn.Module):
+    hidden: tuple = (16, 8)  # wide_deep_functional_keras.py:60-80
+
+    def setup(self):
+        wide_cols, deep_cols = build_columns()
+        self.wide_features = fc.DenseFeatures(columns=wide_cols)
+        self.deep_features = fc.DenseFeatures(columns=deep_cols)
+        self.deep_layers = [nn.Dense(w) for w in self.hidden]
+        self.logit = nn.Dense(1)
+
+    def __call__(self, features, training: bool = False):
+        wide = self.wide_features(features)
+        deep = self.deep_features(features)
+        for layer in self.deep_layers:
+            deep = nn.relu(layer(deep))
+        logit = jnp_sum_keepdim(wide) + self.logit(deep)
+        return logit.squeeze(-1)
+
+
+def jnp_sum_keepdim(x):
+    return x.sum(axis=-1, keepdims=True)
+
+
+def custom_model():
+    return SqlflowWideDeep()
+
+
+def loss(labels, predictions):
+    return sigmoid_binary_cross_entropy(labels, predictions)
+
+
+def optimizer():
+    return create_optimizer("Adam", learning_rate=0.001)
+
+
+def dataset_fn(dataset, mode=None, metadata=None):
+    numeric = [
+        t[2] for t in TRANSFORMS if t[1] == "bucketize"
+    ]
+
+    def parse(payload):
+        example = decode_example(payload)
+        features = {
+            key: np.float32(example.get(key, 0.0)).reshape(())
+            for key in numeric
+        }
+        for out, (src, op) in _host_ops.items():
+            value = str(example.get(src, ""))
+            features[out] = op(np.array([value])).reshape((1,))
+        return features, np.float32(example["label"]).reshape(())
+
+    return dataset.map(parse)
+
+
+def eval_metrics_fn():
+    return {
+        "auc": metrics.AUC(from_logits=True),
+        "accuracy": metrics.BinaryAccuracy(from_logits=True),
+    }
