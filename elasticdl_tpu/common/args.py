@@ -95,6 +95,9 @@ def parse_worker_args(argv=None):
     parser.add_argument(
         "--coordinator_port", type=int, default=COORDINATOR_PORT
     )
+    # identity in the master's mesh rendezvous; defaults to the pod
+    # hostname — override for several workers on one machine
+    parser.add_argument("--worker_host", default="")
     # pipelined sparse training (async PS only): overlap batch N+1's PS
     # pull with batch N's device step; optional hot-row reuse and push
     # accumulation (the reference's get_model_steps analogue)

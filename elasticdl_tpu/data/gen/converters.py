@@ -106,7 +106,9 @@ def gen_census_recordio(data_dir, num_records=2048, seed=0,
         score = (
             0.08 * (age - 40)
             + 0.07 * (hours - 40)
-            + 0.001 * (capital_gain - capital_loss)
+            # small weight: capital columns are invisible to the legacy
+            # wide&deep model, so they must stay a minor label factor
+            + 0.0003 * (capital_gain - capital_loss)
             + work_scores[wc]
             + rng.randn() * 0.25
         )

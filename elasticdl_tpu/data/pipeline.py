@@ -169,3 +169,35 @@ def normalize_outputs(outputs, real):
     if isinstance(outputs, dict):
         return {k: np.asarray(v)[:real] for k, v in outputs.items()}
     return {"output": np.asarray(outputs)[:real]}
+
+
+def pad_batch(batch, size):
+    """Zero-pad every leaf's leading dim to ``size``; padded rows carry
+    mask 0 so the loss/metrics machinery weighs them out. Used by the
+    multi-host lockstep loop, where every process must feed
+    identically-shaped shards every step."""
+    import jax.tree_util
+
+    n = int(np.asarray(batch[MASK_KEY]).shape[0])
+    if n == size:
+        return batch
+    if n > size:
+        raise ValueError("batch of %d rows exceeds pad size %d" % (n, size))
+
+    def pad(leaf):
+        leaf = np.asarray(leaf)
+        fill = np.zeros((size - n,) + leaf.shape[1:], leaf.dtype)
+        return np.concatenate([leaf, fill], axis=0)
+
+    return jax.tree_util.tree_map(pad, batch)
+
+
+def zero_batch_like(batch):
+    """An all-padding batch (mask 0 everywhere): a lockstep process
+    whose task stream ran dry feeds these until the global consensus
+    says every process is done."""
+    import jax.tree_util
+
+    return jax.tree_util.tree_map(
+        lambda leaf: np.zeros_like(np.asarray(leaf)), batch
+    )

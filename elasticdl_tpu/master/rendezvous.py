@@ -11,6 +11,7 @@ latest checkpoint.
 """
 
 import threading
+import time
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 
@@ -24,6 +25,12 @@ class MeshRendezvous:
         # host string -> rank; ranks assigned by join order (the reference
         # sorts by pod start time: k8s_instance_manager.py:367-385)
         self._hosts = []
+        # wall time of the last epoch bump: every bump makes EVERY member
+        # restart its process to re-initialize jax.distributed, so
+        # liveness-based eviction must grant a grace window after it
+        # (TaskMonitor.mesh_restart_grace_secs) or the restart gap itself
+        # evicts members and the mesh epoch churns forever
+        self._last_change = 0.0
 
     def set_worker_hosts(self, hosts):
         """Replace the alive-host list; bump the epoch if it changed."""
@@ -33,6 +40,7 @@ class MeshRendezvous:
                 return self._mesh_epoch
             self._hosts = hosts
             self._mesh_epoch += 1
+            self._last_change = time.time()
             logger.info(
                 "Mesh epoch -> %d with %d hosts", self._mesh_epoch, len(hosts)
             )
@@ -44,6 +52,7 @@ class MeshRendezvous:
                 return self._mesh_epoch
             self._hosts.append(host)
             self._mesh_epoch += 1
+            self._last_change = time.time()
             logger.info(
                 "Mesh epoch -> %d (+%s, %d hosts)",
                 self._mesh_epoch,
@@ -58,6 +67,7 @@ class MeshRendezvous:
                 return self._mesh_epoch
             self._hosts.remove(host)
             self._mesh_epoch += 1
+            self._last_change = time.time()
             logger.info(
                 "Mesh epoch -> %d (-%s, %d hosts)",
                 self._mesh_epoch,
@@ -75,6 +85,11 @@ class MeshRendezvous:
             rank = self._hosts.index(host) if host in self._hosts else -1
             coordinator = self._hosts[0] if self._hosts else ""
             return rank, len(self._hosts), self._mesh_epoch, coordinator
+
+    @property
+    def last_change_time(self):
+        with self._lock:
+            return self._last_change
 
     @property
     def mesh_epoch(self):

@@ -45,7 +45,12 @@ class MasterServicer:
     # ------------------------------------------------------------------
     def _touch(self, worker_id):
         with self._lock:
-            self._worker_liveness[worker_id] = time.time()
+            # monotonic max: extend_liveness may have credited a future
+            # horizon (mesh-restart allowance); an ordinary ping must
+            # not pull the clock back below it
+            self._worker_liveness[worker_id] = max(
+                time.time(), self._worker_liveness.get(worker_id, 0.0)
+            )
 
     def worker_liveness(self):
         with self._lock:
@@ -55,6 +60,21 @@ class MasterServicer:
         with self._lock:
             self._worker_liveness.pop(worker_id, None)
             self._worker_hosts.pop(worker_id, None)
+
+    def extend_liveness(self, worker_ids, horizon):
+        """Credit workers with liveness up to a future ``horizon``: the
+        task monitor calls this on a mesh-epoch bump, when every member
+        goes dark for its process relaunch (possibly several attempts
+        against a not-yet-restarted coordinator). A forward-dated clock
+        is churn-proof where deleting the entry is not — stray pings
+        from the pre-restart process can't shorten the allowance
+        (_touch is monotonic), and eviction resumes automatically once
+        the horizon passes (task_monitor.py)."""
+        with self._lock:
+            for worker_id in worker_ids:
+                self._worker_liveness[worker_id] = max(
+                    self._worker_liveness.get(worker_id, 0.0), horizon
+                )
 
     def mesh_worker_ids(self):
         """Workers registered as mesh members (sent a worker_host)."""
@@ -86,15 +106,32 @@ class MasterServicer:
         # pass): tell the worker to wait and re-poll.
         return pb.Task(type=pb.WAIT)
 
+    def reset_worker(self, request, context=None):
+        """A freshly (re)launched worker declares itself: anything still
+        assigned to its id belongs to a dead predecessor incarnation
+        (the new process holds nothing by definition) — requeue it
+        uncounted NOW instead of waiting out the task timeout. The
+        liveness clock can't catch this: the successor reuses the
+        worker_id and heartbeats immediately."""
+        self._touch(request.worker_id)
+        self._task_dispatcher.recover_tasks(request.worker_id)
+        return pb.Empty()
+
     def report_task_result(self, request, context=None):
         self._touch(request.worker_id)
         success = not request.err_message
+        # "requeue:" prefix = mesh-lifecycle handback (worker restarting
+        # for a new epoch / lockstep peer died): requeue WITHOUT charging
+        # the task's retry cap (task_dispatcher.report docstring)
+        count_failure = not request.err_message.startswith("requeue:")
         if not success:
-            logger.warning(
+            log = logger.info if not count_failure else logger.warning
+            log(
                 "Task %s failed: %s", request.task_id, request.err_message
             )
         self._task_dispatcher.report(
-            request.task_id, success, worker_id=request.worker_id
+            request.task_id, success, worker_id=request.worker_id,
+            count_failure=count_failure,
         )
         return pb.Empty()
 

@@ -197,8 +197,15 @@ class TaskDispatcher:
             self._worker_doing.setdefault(worker_id, set()).add(task_id)
             return self._records[task_id].task
 
-    def report(self, task_id, success, worker_id=None):
+    def report(self, task_id, success, worker_id=None, count_failure=True):
         """Mark a task done or failed; failed tasks re-queue up to the cap.
+
+        ``count_failure=False`` requeues without charging the retry cap:
+        mesh-lifecycle handbacks (worker restarting for a new epoch, a
+        lockstep peer dying mid-collective) are not evidence against the
+        TASK — charging them burns the cap in seconds during an elastic
+        transition and falsely fails the job. Mirrors ``recover_tasks``
+        (liveness-recovery is uncounted too).
 
         ``worker_id``, when provided, must match the task's current
         assignee — otherwise the report is stale (the task was recovered
@@ -252,7 +259,8 @@ class TaskDispatcher:
                 completed_callbacks = list(self._task_completed_callbacks)
                 result = (task.type == pb.EVALUATION, task)
             else:
-                record.retry_count += 1
+                if count_failure:
+                    record.retry_count += 1
                 if record.retry_count > self._max_task_retries:
                     logger.error(
                         "Task %s failed %d times; marking job failed",
@@ -294,7 +302,12 @@ class TaskDispatcher:
         with self._lock:
             task_ids = list(self._worker_doing.get(worker_id, set()))
         for task_id in task_ids:
-            self.report(task_id, success=False, worker_id=worker_id)
+            # worker death is not evidence against the TASK: requeue
+            # without charging its retry cap
+            self.report(
+                task_id, success=False, worker_id=worker_id,
+                count_failure=False,
+            )
         with self._lock:
             self._worker_doing.pop(worker_id, None)
         if task_ids:

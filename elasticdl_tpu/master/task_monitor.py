@@ -26,12 +26,32 @@ class TaskMonitor:
         liveness_timeout_secs=30.0,
         timeout_factor=3.0,
         scan_interval_secs=1.0,
+        mesh_restart_grace_secs=30.0,
+        mesh_rejoin_timeout_secs=90.0,
     ):
         self._dispatcher = task_dispatcher
         self._servicer = servicer
         self._rendezvous = rendezvous
         self._on_worker_dead = on_worker_dead
         self._liveness_timeout = liveness_timeout_secs
+        # An epoch bump makes EVERY mesh member exit and relaunch to
+        # re-initialize jax.distributed; their liveness necessarily
+        # lapses for the restart duration. Evicting during that gap
+        # bumps the epoch again and the mesh churns forever (each bump
+        # triggers the restarts that trigger the next eviction) — so
+        # mesh-membership eviction pauses for this window after any
+        # membership change. Task recovery is NOT paused: orphaned
+        # tasks still requeue on liveness timeout.
+        self._mesh_restart_grace = mesh_restart_grace_secs
+        # On a bump the members' liveness clocks are forward-dated by
+        # (rejoin_timeout - liveness_timeout): they go dark for a
+        # python+jax relaunch, possibly several attempts while the new
+        # rank-0 coordinator comes up (a stale coordinator makes
+        # jax.distributed fatal-abort the joiner). Net effect: a member
+        # is evicted only if silent for rejoin_timeout after the bump;
+        # normal eviction resumes once it pings again.
+        self._mesh_rejoin_timeout = mesh_rejoin_timeout_secs
+        self._seen_epoch = None
         self._timeout_factor = timeout_factor
         self._scan_interval = scan_interval_secs
         self._stopping = threading.Event()
@@ -63,11 +83,33 @@ class TaskMonitor:
         # still be evicted from the rendezvous, or every future
         # jax.distributed world size includes the ghost and initialize()
         # hangs waiting for it.
+        mesh_ids = set(self._servicer.mesh_worker_ids())
+        if self._rendezvous is not None:
+            epoch = self._rendezvous.mesh_epoch
+            if epoch != self._seen_epoch:
+                # every member restarts for the new epoch: forward-date
+                # their clocks so the relaunch gap can't read as death
+                # (see __init__)
+                self._seen_epoch = epoch
+                self._servicer.extend_liveness(
+                    mesh_ids,
+                    now + self._mesh_rejoin_timeout
+                    - self._liveness_timeout,
+                )
         liveness = self._servicer.worker_liveness()
         doing = self._dispatcher.doing_tasks()
         holders = {worker_id for worker_id, _ in doing.values()}
-        holders |= set(self._servicer.mesh_worker_ids())
+        holders |= mesh_ids
+        # restart grace: see __init__ — members go silent while they
+        # relaunch for the new epoch; don't mistake that for death
+        in_grace = (
+            self._rendezvous is not None
+            and now - self._rendezvous.last_change_time
+            < self._mesh_restart_grace
+        )
         for worker_id in holders:
+            if in_grace and worker_id in mesh_ids:
+                continue
             last = liveness.get(worker_id)
             if last is not None and now - last > self._liveness_timeout:
                 logger.warning(

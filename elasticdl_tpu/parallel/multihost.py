@@ -33,7 +33,8 @@ class MultiHostRuntime:
     uses jax.distributed)."""
 
     def __init__(self, master_client, coordinator_port=COORDINATOR_PORT,
-                 distributed=None):
+                 distributed=None, init_attempt_timeout_secs=30.0,
+                 max_init_attempts=20):
         self._mc = master_client
         self._port = coordinator_port
         if distributed is None:
@@ -42,10 +43,34 @@ class MultiHostRuntime:
         self._epoch = None  # epoch of the currently live runtime
         self.rank = -1
         self.world_size = 0
+        # per-attempt bound on initialize(): a join started against
+        # membership that then changed (e.g. the coordinator host died
+        # between this worker fetching comm info and connecting) would
+        # otherwise block for jax's 300 s default while the mesh has
+        # already moved on; on timeout the join retries with FRESH
+        # membership. Slow-but-healthy worlds are unaffected — the
+        # retry reuses the same parameters until they change.
+        # int: the C++ binding rejects float timeouts
+        self._init_attempt_timeout = int(init_attempt_timeout_secs)
+        # a permanently broken join (port squatted, firewalled) must
+        # surface as a process exit the operator can see, not an
+        # infinite warn loop whose keepalive keeps liveness green
+        self._max_init_attempts = max_init_attempts
 
     @property
     def initialized(self):
         return self._epoch is not None
+
+    def _wait_admitted(self, wait_sleep_secs, max_wait_secs, start):
+        while True:
+            info = self._mc.get_comm_info()
+            if info.rank >= 0:
+                return info
+            if max_wait_secs and time.time() - start > max_wait_secs:
+                raise TimeoutError(
+                    "master never admitted this host into the mesh"
+                )
+            time.sleep(wait_sleep_secs)
 
     def ensure_runtime(self, wait_sleep_secs=1.0, max_wait_secs=0):
         """Join (or rejoin) the mesh. Blocks while the master hasn't
@@ -54,15 +79,7 @@ class MultiHostRuntime:
         and restore state from the latest checkpoint — False when the
         existing runtime is still current."""
         start = time.time()
-        while True:
-            info = self._mc.get_comm_info()
-            if info.rank >= 0:
-                break
-            if max_wait_secs and time.time() - start > max_wait_secs:
-                raise TimeoutError(
-                    "master never admitted this host into the mesh"
-                )
-            time.sleep(wait_sleep_secs)
+        info = self._wait_admitted(wait_sleep_secs, max_wait_secs, start)
         if self._epoch == info.mesh_epoch:
             return False
         if self._epoch is not None:
@@ -77,9 +94,6 @@ class MultiHostRuntime:
         # failure).
         self._epoch = None
         self.rank, self.world_size = -1, 0
-        coordinator = "%s:%d" % (
-            info.coordinator_addr.split(":")[0], self._port
-        )
         # initialize() blocks until every process connects, which can be
         # minutes while peers' pods schedule. Keep liveness fresh during
         # the wait, or the master's idle-member eviction would boot this
@@ -98,11 +112,43 @@ class MultiHostRuntime:
         )
         keeper.start()
         try:
-            self._distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=info.world_size,
-                process_id=info.rank,
-            )
+            attempts = 0
+            while True:
+                coordinator = "%s:%d" % (
+                    info.coordinator_addr.split(":")[0], self._port
+                )
+                try:
+                    self._distributed.initialize(
+                        coordinator_address=coordinator,
+                        num_processes=info.world_size,
+                        process_id=info.rank,
+                        initialization_timeout=self._init_attempt_timeout,
+                    )
+                    break
+                except Exception as e:
+                    attempts += 1
+                    if attempts >= self._max_init_attempts:
+                        raise RuntimeError(
+                            "distributed join failed %d times (last: "
+                            "%s via %s)" % (attempts, e, coordinator)
+                        ) from e
+                    # join attempt expired/failed — the membership this
+                    # attempt targeted may be gone (e.g. coordinator
+                    # host died mid-join); refresh and retry. A
+                    # slow-but-live world just retries with the same
+                    # parameters.
+                    logger.warning(
+                        "distributed join (rank %d/%d via %s) failed: "
+                        "%s; refreshing membership and retrying",
+                        info.rank, info.world_size, coordinator, e,
+                    )
+                    try:
+                        self._distributed.shutdown()
+                    except Exception:
+                        pass
+                    info = self._wait_admitted(
+                        wait_sleep_secs, max_wait_secs, start
+                    )
         finally:
             stop_keepalive.set()
         self._epoch = info.mesh_epoch

@@ -22,6 +22,11 @@ _MASTER_METHODS = {
     "report_evaluation_metrics": (pb.ReportEvaluationMetricsRequest, pb.Empty),
     "report_version": (pb.ReportVersionRequest, pb.Empty),
     "get_comm_info": (pb.GetCommInfoRequest, pb.CommInfo),
+    # fresh-incarnation declaration: requeue everything still assigned
+    # to this worker_id (a relaunched worker reuses its id, so stale
+    # assignments from a fatally-aborted predecessor would otherwise
+    # look live until the slow task timeout)
+    "reset_worker": (pb.GetTaskRequest, pb.Empty),
 }
 
 _PSERVER_METHODS = {

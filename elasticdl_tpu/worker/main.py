@@ -15,13 +15,27 @@ from elasticdl_tpu.worker.worker import Worker
 
 
 def main(argv=None):
+    if os.environ.get("EDL_FAULTHANDLER"):
+        # stack dumps on demand (kill -USR1 <pid>): lockstep multi-host
+        # hangs are otherwise invisible
+        import faulthandler
+        import signal
+
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
     from elasticdl_tpu.common.platform import apply_platform_overrides
 
     apply_platform_overrides()
     import jax
 
     args = parse_worker_args(argv)
-    master_client = MasterClient(args.master_addr, worker_id=args.worker_id)
+    master_client = MasterClient(
+        args.master_addr,
+        worker_id=args.worker_id,
+        worker_host=args.worker_host or None,
+    )
+    # fresh incarnation: flush any task a fatally-aborted predecessor
+    # with this worker_id still holds (it can't have requeued them)
+    master_client.reset_worker()
     multihost_runtime = None
     if args.multihost:
         # must run BEFORE any jax backend initialization
@@ -51,9 +65,17 @@ def main(argv=None):
     )
     reader = create_data_reader(data_origin, **reader_params)
     # More than one local device: run the SPMD trainer over the chip mesh
-    # (gradients ride ICI inside the compiled step).
+    # (gradients ride ICI inside the compiled step). A jax.distributed
+    # world of >1 processes gets the lockstep multi-host trainer — the
+    # mesh spans the processes and dp psums ride DCN.
     trainer_factory = None
-    if jax.device_count() > 1:
+    if jax.process_count() > 1:
+        from elasticdl_tpu.parallel.multihost_trainer import (
+            MultiHostSpmdTrainer,
+        )
+
+        trainer_factory = MultiHostSpmdTrainer
+    elif jax.device_count() > 1:
         from elasticdl_tpu.parallel.spmd_trainer import SpmdTrainer
 
         trainer_factory = SpmdTrainer
@@ -88,6 +110,16 @@ def main(argv=None):
     logger = default_logger("elasticdl_tpu.worker.main")
     try:
         worker.run()
+        if multihost_runtime is not None:
+            # orderly leave: jax.distributed.shutdown is a barrier; a
+            # process that just exits makes peers' shutdown fail and
+            # their runtime abort them even though the job completed
+            try:
+                multihost_runtime.shutdown()
+            except Exception:
+                logger.warning(
+                    "distributed shutdown barrier failed (peers gone?)"
+                )
     except MeshEpochChanged as e:
         # pod manager relaunches us with the same command line; the
         # restarted process rejoins at the new epoch and resumes from

@@ -77,6 +77,17 @@ class MasterClient:
         except grpc.RpcError:
             logger.warning("report_version(%s) failed", model_version)
 
+    def reset_worker(self):
+        """Declare this process a fresh incarnation of worker_id: the
+        master requeues (uncounted) any task a dead predecessor still
+        holds. Call once at startup (servicer.reset_worker)."""
+        try:
+            self._stub.reset_worker(
+                pb.GetTaskRequest(worker_id=self._worker_id)
+            )
+        except grpc.RpcError:
+            logger.warning("reset_worker failed")
+
     def get_comm_info(self):
         try:
             return self._stub.get_comm_info(

@@ -76,7 +76,7 @@ class TaskDataService:
                             self.out_of_band_tasks.append(task)
                 if stale:
                     self._mc.report_task_result(
-                        task.task_id, "stream closed"
+                        task.task_id, "requeue: stream closed"
                     )
                 return
             total = task.end - task.start
@@ -89,7 +89,7 @@ class TaskDataService:
             if stale is not None:
                 # hand it straight back so it requeues for a live worker
                 self._mc.report_task_result(
-                    stale.task_id, "stream closed"
+                    stale.task_id, "requeue: stream closed"
                 )
                 return
             yield from self._reader.read_records(task)

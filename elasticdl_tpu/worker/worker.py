@@ -145,6 +145,10 @@ class Worker:
         else:
             trainer_kwargs["model"] = self.spec.custom_model()
         self.trainer = factory(**trainer_kwargs)
+        # lockstep multi-host SPMD: the trainer's mesh spans jax
+        # processes and exposes the consensus collective
+        # (parallel/multihost_trainer.py)
+        self._lockstep = hasattr(self.trainer, "consensus")
         # pipelined sparse stream only where it exists AND the model is
         # sparse (async-PS staleness envelope; sparse.py train_stream)
         self._sparse_pipeline = bool(
@@ -263,15 +267,27 @@ class Worker:
         return dataset.batch(self._minibatch_size).prefetch(2)
 
     # ------------------------------------------------------------------
+    def _save_checkpoint(self):
+        state = self.state
+        if self._lockstep:
+            # orbax's save is itself a cross-process collective
+            # (sync_global_processes barriers) — EVERY rank must call it,
+            # at the same version, which the lockstep loop guarantees.
+            # Each rank hands over its local replica (v1 layout keeps
+            # non-dp axes within a process, so the replica is the full
+            # state); orbax's primary-host logic decides who writes.
+            state = self.trainer.local_state(state)
+        self._checkpoint_mgr.save(self._version, state)
+
     def _after_train_batch(self, batch, loss):
-        """Per-batch bookkeeping shared by both loop shapes: version,
+        """Per-batch bookkeeping shared by every loop shape: version,
         checkpoint, record accounting, liveness, callbacks."""
         self._version += 1
         if (
             self._checkpoint_mgr is not None
             and self._version % self._checkpoint_steps == 0
         ):
-            self._checkpoint_mgr.save(self._version, self.state)
+            self._save_checkpoint()
         with self._timing.timeit("report_record"):
             self.tds.report_record_done(batch_real_count(batch))
         if (
@@ -322,13 +338,54 @@ class Worker:
             if self.stop_training:
                 break
 
+    def _train_batches_lockstep(self, batches):
+        """Multi-host SPMD: every process must execute the same
+        collective sequence (multihost_trainer.py lockstep contract).
+        Per iteration: a consensus collective counts processes that
+        still hold real batches; partial batches are padded to the
+        fixed minibatch size and dried-up processes feed zero-masked
+        batches until the count reaches zero, so nobody leaves a peer
+        blocked inside a collective."""
+        from elasticdl_tpu.data.pipeline import pad_batch, zero_batch_like
+
+        it = iter(batches)
+        template = None
+        while True:
+            batch = next(it, None)
+            have = batch is not None
+            if have:
+                batch = pad_batch(batch, self._minibatch_size)
+                template = batch
+            alive = self.trainer.consensus(have)
+            if alive == 0:
+                break
+            if not have:
+                if template is None:
+                    # joined a lockstep round having never seen a batch:
+                    # no shapes to feed the collective with
+                    raise RuntimeError(
+                        "lockstep worker has no batch template (zero "
+                        "local batches this stream)"
+                    )
+                batch = zero_batch_like(template)
+            if not self._restore_attempted:
+                self._restore_from_checkpoint(batch)
+            t0 = self._timing.start()
+            self.state, loss = self.trainer.train_step(self.state, batch)
+            self._timing.end_record_sync("batch_process", t0, loss)
+            self._after_train_batch(batch, loss)
+            if self.stop_training:
+                break
+
     def _run_training_stream(self):
         """Consume one continuous training stream until it pauses."""
         try:
             batches = self._batches(
                 self.tds.training_record_stream(), Mode.TRAINING
             )
-            if self._sparse_pipeline:
+            if self._lockstep:
+                self._train_batches_lockstep(batches)
+            elif self._sparse_pipeline:
                 self._train_batches_pipelined(batches)
             else:
                 self._train_batches_sequential(batches)
@@ -347,11 +404,30 @@ class Worker:
             # would rot until the slow task-timeout falsely killed the
             # relaunched worker. Parked out-of-band/train-end tasks go
             # back too — nothing will ever drain them in this process.
-            self.tds.report_pending_failed("mesh epoch changed")
-            self.tds.report_parked_failed("mesh epoch changed")
+            # "requeue:" = lifecycle handback, uncounted (servicer.py).
+            self.tds.report_pending_failed("requeue: mesh epoch changed")
+            self.tds.report_parked_failed("requeue: mesh epoch changed")
             raise
         except Exception as e:  # report so tasks get retried elsewhere
             logger.exception("Training stream failed")
+            if self._lockstep:
+                # a lockstep step error is a MESH event (a peer died or
+                # restarted mid-collective — the distributed runtime's
+                # collective state is unrecoverable in-process), not
+                # evidence against the task: hand tasks back uncounted
+                # and restart this process to rejoin at the new epoch.
+                # Retrying tasks in-process would burn each task's retry
+                # cap within seconds of gloo errors and falsely fail
+                # the job.
+                self.tds.report_pending_failed(
+                    "requeue: lockstep peer failure (%s)" % (e,)
+                )
+                self.tds.report_parked_failed(
+                    "requeue: lockstep peer failure"
+                )
+                raise MeshEpochChanged(
+                    "lockstep collective failed: %s" % (e,)
+                ) from e
             self.tds.report_pending_failed(str(e))
         finally:
             self._timing.report("training stream")
@@ -404,10 +480,13 @@ class Worker:
             mgr = DenseCheckpointManager(
                 self._init_checkpoint_dir, keep_max=0, create=False
             )
-            restored = mgr.restore(
-                template=template,
-                shardings=getattr(self.trainer, "state_shardings", None),
-            )
+            # a lockstep trainer restores to host arrays first
+            # (restore_shardings None) and lays them out globally below
+            if hasattr(self.trainer, "restore_shardings"):
+                shardings = self.trainer.restore_shardings
+            else:
+                shardings = getattr(self.trainer, "state_shardings", None)
+            restored = mgr.restore(template=template, shardings=shardings)
         except Exception as e:
             raise CheckpointRestoreError(
                 "restore from --checkpoint_dir_for_init=%r failed: %s"
@@ -432,6 +511,8 @@ class Worker:
                 "checkpoint" % self._init_checkpoint_dir
             )
         self._restore_attempted = True
+        if hasattr(self.trainer, "adopt_restored"):
+            restored = self.trainer.adopt_restored(restored)
         self.state = restored
         self._version = int(restored.step)
         logger.info(
@@ -482,6 +563,12 @@ class Worker:
                         normalize_outputs(outputs, real),
                         self._mc.worker_id,
                     )
+            if processor is not None and hasattr(processor, "close"):
+                # flush buffered table writes BEFORE reporting the task
+                # done — a task whose outputs are still in flight must
+                # not be marked complete (write failures surface here
+                # and requeue the task)
+                processor.close()
             self._mc.report_task_result(task.task_id)
         except CheckpointRestoreError:
             self._mc.report_task_result(task.task_id, "restore failed")

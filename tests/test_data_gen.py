@@ -36,8 +36,9 @@ def test_census_rows_match_model_schema(tmp_path):
     with RecordReader(paths[0]) as reader:
         example = decode_example(reader.read(0))
     assert set(example) == {
-        "age", "hours_per_week", "work_class", "marital_status",
-        "education", "occupation", "label",
+        "age", "hours_per_week", "capital_gain", "capital_loss",
+        "work_class", "marital_status", "education", "occupation",
+        "relationship", "race", "sex", "native_country", "label",
     }
     assert str(example["work_class"].reshape(())) in [
         "Private", "Self-emp-not-inc", "Self-emp-inc", "Federal-gov",
@@ -82,14 +83,14 @@ def test_generated_census_is_learnable(tmp_path):
 
     train_dir = tmp_path / "train"
     valid_dir = tmp_path / "valid"
-    gen.gen_census_recordio(str(train_dir), num_records=1024, seed=0)
+    gen.gen_census_recordio(str(train_dir), num_records=2048, seed=0)
     gen.gen_census_recordio(str(valid_dir), num_records=256, seed=1)
     executor = LocalExecutor(
         "elasticdl_tpu.models.census_wide_deep",
         training_data=str(train_dir),
         validation_data=str(valid_dir),
         minibatch_size=64,
-        num_epochs=5,
+        num_epochs=8,
     )
     executor.train()
     summary = executor.evaluate()

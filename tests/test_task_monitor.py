@@ -19,6 +19,10 @@ def test_silent_worker_recovered_and_mesh_epoch_bumped():
         rendezvous,
         liveness_timeout_secs=0.3,
         scan_interval_secs=0.05,
+        # scale the mesh-restart allowances with the test's tiny
+        # liveness timeout (production defaults are 30s/90s)
+        mesh_restart_grace_secs=0.2,
+        mesh_rejoin_timeout_secs=0.6,
     )
     # worker 1 joins the mesh and takes a task
     info = servicer.get_comm_info(
@@ -77,7 +81,8 @@ def test_idle_mesh_member_evicted_on_silence():
     rendezvous = MeshRendezvous()
     servicer = MasterServicer(dispatcher, None, rendezvous)
     monitor = TaskMonitor(
-        dispatcher, servicer, rendezvous, liveness_timeout_secs=0.05
+        dispatcher, servicer, rendezvous, liveness_timeout_secs=0.05,
+        mesh_restart_grace_secs=0.02, mesh_rejoin_timeout_secs=0.08,
     )
     # idle member joins the mesh via get_comm_info, never takes a task
     servicer.get_comm_info(
@@ -85,5 +90,10 @@ def test_idle_mesh_member_evicted_on_silence():
     )
     assert rendezvous.hosts() == ["ghost:3333"]
     time.sleep(0.1)
+    # first scan sees the join's epoch bump and credits the restart
+    # allowance; eviction happens once that horizon + the liveness
+    # timeout pass with no ping
+    monitor._scan()
+    time.sleep(0.15)
     monitor._scan()
     assert rendezvous.hosts() == []
