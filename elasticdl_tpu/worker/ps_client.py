@@ -9,11 +9,14 @@ pull); there is no per-step dense traffic.
 """
 
 import concurrent.futures
+import time
 from typing import NamedTuple, Tuple
 
+import grpc
 import numpy as np
 
 from elasticdl_tpu.common.grpc_utils import build_channel
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import (
     blob_to_ndarray,
     deduplicate_indexed_slices,
@@ -22,6 +25,37 @@ from elasticdl_tpu.common.tensor_utils import (
 )
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.services import PserverStub
+
+logger = _logger_factory("elasticdl_tpu.worker.ps_client")
+
+# A PS pod restart (same-id relaunch behind its stable per-pod Service,
+# k8s/instance_manager.py) takes tens of seconds; treating that window
+# as task failures burns the job's retry caps. Reference parity: the
+# worker main connected to every PS channel with retry/timeout
+# (worker/main.py:87). UNAVAILABLE/UNKNOWN-connection errors retry with
+# backoff up to this budget; anything else (bad request, server logic
+# error) surfaces immediately.
+PS_RETRY_BUDGET_SECS = 120.0
+_RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
+
+
+def _call_with_retry(fn, what, budget_secs=None):
+    budget = PS_RETRY_BUDGET_SECS if budget_secs is None else budget_secs
+    deadline = time.time() + budget
+    delay = 0.5
+    while True:
+        try:
+            return fn()
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code not in _RETRYABLE or time.time() + delay > deadline:
+                raise
+            logger.warning(
+                "PS %s unavailable (%s); retrying in %.1fs", what, code,
+                delay,
+            )
+            time.sleep(delay)
+            delay = min(delay * 2, 10.0)
 
 
 class PushResult(NamedTuple):
@@ -56,7 +90,10 @@ class PSClient:
             )
         list(
             self._pool.map(
-                lambda stub: stub.push_embedding_table_infos(request),
+                lambda stub: _call_with_retry(
+                    lambda: stub.push_embedding_table_infos(request),
+                    "push_embedding_table_infos",
+                ),
                 self._stubs,
             )
         )
@@ -85,8 +122,12 @@ class PSClient:
         if ids.size == 0:
             return np.empty((0, 0), dtype=np.float32)
         if self.ps_num == 1:
-            blob = self._stubs[0].pull_embedding_vectors(
-                pb.PullEmbeddingVectorsRequest(name=name, ids=ids.tolist())
+            request = pb.PullEmbeddingVectorsRequest(
+                name=name, ids=ids.tolist()
+            )
+            blob = _call_with_retry(
+                lambda: self._stubs[0].pull_embedding_vectors(request),
+                "pull_embedding_vectors",
             )
             return blob_to_ndarray(blob)
         shard_of = ids % self.ps_num
@@ -98,8 +139,12 @@ class PSClient:
             request = pb.PullEmbeddingVectorsRequest(
                 name=name, ids=ids[pos].tolist()
             )
+            stub = self._stubs[int(shard)]
             futures[int(shard)] = self._pool.submit(
-                self._stubs[int(shard)].pull_embedding_vectors, request
+                _call_with_retry,
+                lambda stub=stub, request=request:
+                    stub.pull_embedding_vectors(request),
+                "pull_embedding_vectors",
             )
         dim = None
         rows = None
@@ -156,8 +201,18 @@ class PSClient:
                 continue
             if shard_filter is not None and shard not in shard_filter:
                 continue
+            # NOTE at-least-once on connection loss: if the server
+            # applied the push but the connection died before the
+            # response, the retry re-applies it (async-PS semantics
+            # tolerate this; the reference's gRPC retries had the same
+            # window)
             futures.append(
-                (shard, self._pool.submit(stub.push_gradients, request))
+                (shard, self._pool.submit(
+                    _call_with_retry,
+                    lambda stub=stub, request=request:
+                        stub.push_gradients(request),
+                    "push_gradients",
+                ))
             )
         # empty push (e.g. fully masked batch): version must pass
         # through unchanged, or a sync worker would look maximally stale

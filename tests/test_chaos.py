@@ -6,6 +6,7 @@ the job. The reference had no fault-injection tests at all (SURVEY.md
 import os
 import subprocess
 import sys
+import threading
 import time
 
 from elasticdl_tpu.common.grpc_utils import build_server, find_free_port
@@ -85,3 +86,113 @@ def test_worker_crash_recovers_and_job_completes(tmp_path):
     finally:
         monitor.stop()
         server.stop(0)
+
+
+def test_ps_crash_restart_job_completes(tmp_path):
+    """A parameter-server shard dies mid-training and is relaunched on
+    the same address with checkpoint restore; the worker's PS client
+    retries through the outage (ps_client.py PS_RETRY_BUDGET) and the
+    job completes — no task-retry budget burned on the restart window.
+    (Reference behavior: same-id PS relaunch behind a stable per-pod
+    Service, instance_manager; worker main's channel connect retries.)"""
+    import signal
+    import socket
+
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from tests.test_utils import create_ctr_recordio
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def wait_port(port, timeout=90):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            s = socket.socket()
+            try:
+                s.connect(("127.0.0.1", port))
+                return
+            except OSError:
+                time.sleep(0.3)
+            finally:
+                s.close()
+        raise TimeoutError(port)
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_ctr_recordio(str(train_dir / "f0.rec"), num_records=768, seed=0)
+    reader = RecordIODataReader(data_dir=str(train_dir))
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(),
+        records_per_task=128,
+        num_epochs=2,
+        seed=0,
+    )
+    server = build_server()
+    add_master_servicer_to_server(MasterServicer(dispatcher, None), server)
+    master_port = find_free_port()
+    server.add_insecure_port("localhost:%d" % master_port)
+    server.start()
+
+    ps_port = free_port()
+    ckpt_dir = str(tmp_path / "ps_ckpt")
+
+    def spawn_ps(restore):
+        cmd = [
+            sys.executable, "-m", "elasticdl_tpu.ps.server",
+            "--ps_id", "0", "--num_ps_pods", "1",
+            "--port", str(ps_port),
+            "--opt_type", "adam", "--opt_args", "lr=0.01",
+            "--checkpoint_dir", ckpt_dir,
+            "--checkpoint_steps", "2",
+        ]
+        if restore:
+            cmd += ["--checkpoint_dir_for_init", ckpt_dir]
+        return subprocess.Popen(
+            cmd,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+    ps_proc = spawn_ps(restore=False)
+    wait_port(ps_port)
+    try:
+        worker = Worker(
+            MasterClient("localhost:%d" % master_port, worker_id=0),
+            "elasticdl_tpu.models.deepfm",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=64,
+            wait_sleep_secs=0.1,
+            ps_addrs=["localhost:%d" % ps_port],
+        )
+        runner = threading.Thread(target=worker.run, daemon=True)
+        runner.start()
+
+        # let training make progress (PS checkpoints every 2 versions)
+        deadline = time.time() + 120
+        while time.time() < deadline and not (
+            os.path.isdir(ckpt_dir) and os.listdir(ckpt_dir)
+        ):
+            time.sleep(0.2)
+        assert os.listdir(ckpt_dir), "PS never checkpointed"
+
+        # chaos: SIGKILL the PS shard mid-job, relaunch with restore
+        ps_proc.send_signal(signal.SIGKILL)
+        ps_proc.wait(timeout=30)
+        time.sleep(2)  # let the worker hit the outage window
+        ps_proc = spawn_ps(restore=True)
+
+        runner.join(timeout=180)
+        assert not runner.is_alive(), "worker never finished after PS restart"
+        assert dispatcher.finished(), "job did not complete"
+        assert not dispatcher.job_failed(), (
+            "PS restart window burned the task retry budget"
+        )
+    finally:
+        server.stop(0)
+        if ps_proc.poll() is None:
+            ps_proc.kill()
