@@ -166,6 +166,35 @@ def bench_deepfm():
     }
 
 
+def bench_transformer_mfu():
+    """TransformerLM training MFU, best measured single-chip config
+    (docs/PERF_TRANSFORMER.md). Runs in a subprocess so its ~10 GB of
+    device state never coexists with the ResNet bench's."""
+    import os
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, "scripts/bench_transformer_mfu.py",
+         "--d", "2048", "--layers", "10", "--heads", "8",
+         "--seq", "1024", "--batch", "12", "--remat", "none"],
+        capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            r = json.loads(line)
+            return {
+                "transformer_mfu": r["mfu"],
+                "transformer_tokens_per_sec": r["tokens_per_sec"],
+                "transformer_params_m": r["params_m"],
+                "transformer_step_ms": r["step_ms"],
+            }
+    raise RuntimeError(
+        "no JSON line from bench_transformer_mfu.py: %s"
+        % (out.stderr[-500:],)
+    )
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -177,13 +206,20 @@ def main():
 
     sys.path.insert(0, ".")
 
-    # CTR bench first: it is latency-sensitive (live PS round trips) and
-    # measures noticeably slower when run after the ResNet bench's large
-    # device state in the same process.
+    # Transformer bench first: it runs in a subprocess that needs the
+    # TPU, and on single-process libtpu runtimes the chip is exclusive —
+    # the parent must not have initialized JAX-on-TPU yet. Then the CTR
+    # bench: it is latency-sensitive (live PS round trips) and measures
+    # noticeably slower after the ResNet bench's large device state.
+    extra = {}
     try:
-        extra = bench_deepfm()
+        extra.update(bench_transformer_mfu())
     except Exception as e:  # the headline metric must survive
-        extra = {"deepfm_error": repr(e)}
+        extra["transformer_error"] = repr(e)
+    try:
+        extra.update(bench_deepfm())
+    except Exception as e:
+        extra["deepfm_error"] = repr(e)
     from elasticdl_tpu.models import resnet
     from elasticdl_tpu.train.optimizers import create_optimizer
     from elasticdl_tpu.train.step_fns import make_train_step

@@ -134,15 +134,48 @@ class TransformerLM(nn.Module):
     # backward pass instead of stored — the standard HBM-for-FLOPs trade
     # that makes long-sequence / deep configs fit (jax.checkpoint)
     remat: bool = False
+    # remat policy: "full" recomputes everything (min memory, ~1/3 extra
+    # FLOPs); "dots" saves matmul outputs and recomputes only elementwise
+    # ops (LayerNorm/GELU/residual) — near-zero extra MXU work, which is
+    # what keeps MFU high on memory-tight configs (docs/PERF_TRANSFORMER.md)
+    remat_policy: str = "full"
 
     @nn.compact
     def __call__(self, tokens, training: bool = False):
         x = nn.Embed(
             self.vocab_size, self.embed_dim, name="wte"
         )(tokens.astype(jnp.int32))
-        block_cls = (
-            nn.remat(Block, static_argnums=(2,)) if self.remat else Block
-        )
+        if self.remat:
+            import jax
+
+            from elasticdl_tpu.ops.flash_attention import (
+                FLASH_LSE_NAME,
+                FLASH_OUT_NAME,
+            )
+
+            if self.remat_policy not in ("full", "dots"):
+                raise ValueError(
+                    "remat_policy must be 'full' or 'dots', got %r"
+                    % (self.remat_policy,)
+                )
+            # "dots" also saves the flash kernel's (o, lse) named
+            # outputs: without them remat re-runs the forward flash
+            # pass inside every block's backward (flash_attention.py
+            # "custom_vjp wrapper" note)
+            policy = (
+                jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable,
+                    jax.checkpoint_policies.save_only_these_names(
+                        FLASH_OUT_NAME, FLASH_LSE_NAME
+                    ),
+                )
+                if self.remat_policy == "dots"
+                else None
+            )
+            block_cls = nn.remat(Block, static_argnums=(2,), policy=policy)
+        else:
+            block_cls = Block
         for i in range(self.num_layers):
             x = block_cls(
                 self.num_heads,

@@ -18,12 +18,18 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 # Lane width of the m/l scratch rows (min f32 tile is (8, 128)).
 _STATS_LANES = 128
+# checkpoint_name labels on the forward kernel's outputs; remat policies
+# reference these (e.g. models/transformer.py) to save o/lse instead of
+# re-running the forward flash pass in backward.
+FLASH_OUT_NAME = "flash_out"
+FLASH_LSE_NAME = "flash_lse"
 
 
 def _auto_block(seq, cap):
@@ -84,9 +90,15 @@ def _fwd_kernel(
 
     @pl.when(diag_ok)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # Matmuls run on inputs in their NATIVE dtype with f32 MXU
+        # accumulation: for bf16 inputs bf16xbf16->f32 is bit-identical
+        # to upcasting first (bf16 products are exact in f32), while an
+        # f32xf32 matmul the MXU must emulate in multiple passes runs
+        # ~4-6x slower — this was 19% of transformer step time
+        # (docs/PERF_TRANSFORMER.md). Softmax statistics stay in f32.
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = (
             jax.lax.dot_general(
                 q,
@@ -106,7 +118,7 @@ def _fwd_kernel(
         p = jnp.exp(s - m_new)
         l_new = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * correction + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -206,10 +218,11 @@ def _dq_kernel(
 
     @pl.when(diag_ok)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Native-dtype matmul inputs, f32 accumulation (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
         s = (
@@ -230,7 +243,7 @@ def _dq_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_acc_ref[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     @pl.when(k_block == num_k - 1)
@@ -272,10 +285,11 @@ def _dkv_kernel(
 
     @pl.when(diag_ok)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Native-dtype matmul inputs, f32 accumulation (see _fwd_kernel)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
         s = (
@@ -291,7 +305,7 @@ def _dkv_kernel(
             s = _causal_mask(s, q_block, k_block, block_q, block_k)
         p = jnp.exp(s - lse)
         dv_acc_ref[:] += jax.lax.dot_general(
-            p,
+            p.astype(do.dtype),
             do,
             (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -302,7 +316,7 @@ def _dkv_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_acc_ref[:] += jax.lax.dot_general(
             ds,
             q,
@@ -396,30 +410,71 @@ def _bwd(
 # ---------------------------------------------------------------------------
 # custom_vjp wrapper
 # ---------------------------------------------------------------------------
+#
+# The gradient is attached by an identity-primal custom_vjp ``_attach``
+# over explicit (o, lse) values rather than by wrapping the forward
+# kernel itself. Rationale: if the forward pallas_call lives inside the
+# custom_vjp, its lse output exists only as a hidden residual, so a
+# rematerialization policy (jax.checkpoint) can never mark it saveable —
+# every rematted transformer block then pays a SECOND forward flash pass
+# during backward (~5% of train-step time at S=2k, docs/
+# PERF_TRANSFORMER.md). Here (o, lse) are ordinary named primal values
+# (checkpoint_name "flash_out"/"flash_lse"): a policy that saves them
+# lets remat DCE the forward kernel in the backward re-trace, while
+# ``_attach``'s own primal is a free identity.
 
 
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
-)
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _attach(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
+            interpret):
     return o
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+def _attach_fwd(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
+                interpret):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+def _attach_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
     dq, dk, dv = _bwd(
         q, k, v, o, lse, do, sm_scale, causal, block_q, block_k, interpret
     )
-    return dq, dk, dv
+    # o/lse arrive behind stop_gradient; their cotangents are discarded.
+    return dq, dk, dv, jnp.zeros_like(o), jnp.zeros_like(lse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_attach.defvjp(_attach_fwd, _attach_bwd)
+
+
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    # stop_gradient on the kernel inputs keeps AD linearization out of
+    # the forward pallas_call (it has no JVP rule and needs none — all
+    # gradients flow through _attach's bwd kernels).
+    o, lse = _fwd(
+        jax.lax.stop_gradient(q),
+        jax.lax.stop_gradient(k),
+        jax.lax.stop_gradient(v),
+        sm_scale,
+        causal,
+        block_q,
+        block_k,
+        interpret,
+    )
+    o = checkpoint_name(o, FLASH_OUT_NAME)
+    lse = checkpoint_name(lse, FLASH_LSE_NAME)
+    return _attach(
+        q,
+        k,
+        v,
+        o,
+        lse,
+        sm_scale,
+        causal,
+        block_q,
+        block_k,
+        interpret,
+    )
 
 
 def flash_attention(

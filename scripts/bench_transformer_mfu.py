@@ -1,0 +1,219 @@
+"""Measure TransformerLM training MFU on the real chip.
+
+The evidence behind docs/PERF_TRANSFORMER.md (VERDICT r2 item 1: prove
+>=50% MFU on a compute-bound workload). Runs the full train step —
+forward, backward, AdamW update — under one jit'd lax.scan so the
+wall-clock between dispatch and the fetched loss is pure device time
+(immune to the axon tunnel's per-call latency; see
+.claude/skills/verify/SKILL.md "Timing on the real chip").
+
+Model FLOPs are counted exactly from the architecture (matmul FLOPs
+only, causal attention halved, embedding gather excluded) — NOT from
+the 6NT approximation — so remat recompute never inflates MFU.
+
+Usage:
+  python scripts/bench_transformer_mfu.py --d 2048 --layers 12 \
+      --seq 2048 --batch 8 --remat dots [--profile /tmp/tlm_trace]
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# v5e (TPU v5 lite): bf16 peak per chip.
+PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v4": 275e12, "TPU v5p": 459e12}
+
+
+def model_train_flops(d, layers, seq, batch, vocab, mlp_ratio=4):
+    """Exact matmul FLOPs for one train step (fwd + bwd = 3x fwd)."""
+    tokens = batch * seq
+    # per layer: qkv (3 d^2) + out-proj (d^2) + mlp up/down
+    # (2 * mlp_ratio * d^2)
+    proj = 2 * tokens * ((4 + 2 * mlp_ratio) * d * d) * layers
+    # attention: QK^T + PV, causal halves the score matrix
+    attn = 2 * (2 * batch * seq * seq * d) * layers / 2
+    head = 2 * tokens * d * vocab
+    return 3 * (proj + attn + head)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--d", type=int, default=2048)
+    p.add_argument("--layers", type=int, default=12)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--mlp_ratio", type=int, default=4)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument(
+        "--remat", choices=["none", "full", "dots"], default="dots"
+    )
+    p.add_argument(
+        "--attn", choices=["auto", "pallas", "xla"], default="pallas"
+    )
+    p.add_argument("--opt", default="AdamW")
+    p.add_argument("--profile", default=None, help="trace output dir")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from elasticdl_tpu.models.transformer import TransformerLM
+    from elasticdl_tpu.train.optimizers import create_optimizer
+    from elasticdl_tpu.train.step_fns import make_train_step
+    from elasticdl_tpu.train.train_state import create_train_state
+
+    model = TransformerLM(
+        vocab_size=args.vocab,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        embed_dim=args.d,
+        mlp_ratio=args.mlp_ratio,
+        attention_impl=args.attn,
+        remat=args.remat != "none",
+        remat_policy=args.remat,
+    )
+    tx = create_optimizer(
+        args.opt, learning_rate=3e-4, weight_decay=0.01
+    )
+
+    from elasticdl_tpu.models.transformer import loss as loss_fn
+
+    train_step = make_train_step(
+        model, loss_fn, tx, compute_dtype=jnp.bfloat16
+    )
+
+    def run_steps(state, batch, n):
+        def body(state, _):
+            state, loss = train_step(state, batch)
+            return state, loss
+
+        return jax.lax.scan(body, state, None, length=n)
+
+    run = jax.jit(run_steps, static_argnums=(2,), donate_argnums=(0,))
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(
+        rng.randint(0, args.vocab, size=(args.batch, args.seq)), jnp.int32
+    )
+    batch = {
+        "features": tokens,
+        "labels": tokens,
+        "_mask": jnp.ones((args.batch,), jnp.float32),
+    }
+    state = create_train_state(
+        model, tx, jax.random.PRNGKey(0), batch["features"]
+    )
+    n_params = sum(
+        x.size for x in jax.tree_util.tree_leaves(state.params)
+    )
+
+    t0 = time.perf_counter()
+    state, losses = run(state, batch, args.steps)
+    float(losses[-1])
+    compile_s = time.perf_counter() - t0
+
+    start = time.perf_counter()
+    state, losses = run(state, batch, args.steps)
+    final_loss = float(losses[-1])
+    elapsed = time.perf_counter() - start
+    assert np.isfinite(final_loss), final_loss
+
+    step_ms = elapsed / args.steps * 1e3
+    flops = model_train_flops(
+        args.d, args.layers, args.seq, args.batch, args.vocab,
+        args.mlp_ratio,
+    )
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind, 197e12)
+    mfu = flops / (elapsed / args.steps) / peak
+    toks_per_sec = args.batch * args.seq / (elapsed / args.steps)
+
+    mem = {}
+    try:
+        stats = jax.devices()[0].memory_stats()
+        mem = {
+            "hbm_peak_gb": round(
+                stats.get("peak_bytes_in_use", 0) / 1e9, 2
+            ),
+            "hbm_live_gb": round(stats.get("bytes_in_use", 0) / 1e9, 2),
+        }
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "config": {
+            "d": args.d, "layers": args.layers, "heads": args.heads,
+            "seq": args.seq, "batch": args.batch, "vocab": args.vocab,
+            "remat": args.remat, "attn": args.attn, "opt": args.opt,
+        },
+        "params_m": round(n_params / 1e6, 1),
+        "device": kind,
+        "peak_tflops": peak / 1e12,
+        "model_tflop_per_step": round(flops / 1e12, 2),
+        "step_ms": round(step_ms, 2),
+        "tokens_per_sec": round(toks_per_sec, 1),
+        "mfu": round(mfu, 4),
+        "compile_s": round(compile_s, 1),
+        **mem,
+    }))
+
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+        state, losses = run(state, batch, args.steps)
+        float(losses[-1])
+        jax.profiler.stop_trace()
+        path = sorted(
+            glob.glob(args.profile + "/plugins/profile/*/*.trace.json.gz")
+        )[-1]
+        with gzip.open(path) as f:
+            data = json.load(f)
+        tpu_pid = None
+        for e in data["traceEvents"]:
+            if e.get("ph") == "M" and e.get("name") == "process_name" \
+                    and "TPU" in str(e.get("args", {}).get("name", "")):
+                tpu_pid = e["pid"]
+        ops = [
+            e for e in data["traceEvents"]
+            if e.get("ph") == "X" and e.get("pid") == tpu_pid
+            and "hlo_category" in e.get("args", {})
+            and not e["name"].startswith("while")
+        ]
+        total = sum(e["dur"] for e in ops)
+        cat = collections.Counter()
+        catb = collections.Counter()
+        catf = collections.Counter()
+        for e in ops:
+            c = e["args"]["hlo_category"]
+            cat[c] += e["dur"]
+            catb[c] += int(e["args"].get("bytes_accessed", 0))
+            catf[c] += int(float(e["args"].get("flops", 0)))
+        print(
+            "device time: %.1f ms / %d steps; bytes %.1f GB/step"
+            % (total / 1e3, args.steps,
+               sum(catb.values()) / args.steps / 1e9)
+        )
+        for c, dur in cat.most_common(14):
+            bw = catb[c] / (dur / 1e6) / 1e9 if dur else 0
+            tf = catf[c] / (dur / 1e6) / 1e12 if dur else 0
+            print(
+                "%5.1f%%  %8.1fms  bw=%6.0f GB/s  %6.1f TFLOP/s  %s"
+                % (dur / total * 100, dur / 1e3, bw, tf, c)
+            )
+        print("trace at:", path)
+
+
+if __name__ == "__main__":
+    main()

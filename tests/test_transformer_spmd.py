@@ -140,3 +140,77 @@ def test_remat_matches_no_remat():
     flat1 = jax.tree_util.tree_leaves(g1)
     for a, b in zip(flat0, flat1):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+@pytest.mark.parametrize("remat_policy", ["full", "dots"])
+@pytest.mark.parametrize("attention_impl", ["xla", "pallas"])
+def test_remat_policies_match_no_remat(remat_policy, attention_impl,
+                                       monkeypatch):
+    """Every remat policy must leave loss/gradients identical, including
+    over the pallas flash kernel (whose o/lse the "dots" policy saves
+    via checkpoint_name — the _attach custom_vjp machinery in
+    ops/flash_attention.py). Pallas runs in interpret mode on CPU."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from elasticdl_tpu.models import transformer
+
+    if attention_impl == "pallas":
+        orig = transformer.dot_product_attention
+        monkeypatch.setattr(
+            transformer,
+            "dot_product_attention",
+            functools.partial(orig, interpret=True),
+        )
+
+    tokens = jnp.asarray(
+        np.random.RandomState(1).randint(0, 64, (2, 16)), jnp.int32
+    )
+
+    def loss_and_grads(remat):
+        model = transformer.TransformerLM(
+            vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
+            attention_impl=attention_impl, remat=remat,
+            remat_policy=remat_policy,
+        )
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+
+        def loss_fn(params):
+            logits = model.apply({"params": params}, tokens)
+            return jnp.mean(
+                transformer.loss(tokens, logits).astype(jnp.float32)
+            )
+
+        return jax.value_and_grad(loss_fn)(variables["params"])
+
+    v0, g0 = loss_and_grads(False)
+    v1, g1 = loss_and_grads(True)
+    assert np.isclose(float(v0), float(v1), rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_remat_policy_validated():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest as _pytest
+
+    from elasticdl_tpu.models import transformer
+
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (1, 8)), jnp.int32
+    )
+    model = transformer.TransformerLM(
+        vocab_size=64, num_layers=1, num_heads=2, embed_dim=32,
+        attention_impl="xla", remat=True, remat_policy="Dots",
+    )
+    with _pytest.raises(ValueError, match="remat_policy"):
+        model.init(jax.random.PRNGKey(0), tokens)
