@@ -95,6 +95,11 @@ def parse_worker_args(argv=None):
     parser.add_argument(
         "--coordinator_port", type=int, default=COORDINATOR_PORT
     )
+    # mesh axis sizes for the SPMD/lockstep trainers; dp=-1 absorbs the
+    # remaining devices so the flag survives elastic world-size changes
+    parser.add_argument(
+        "--mesh", default="", help='axis sizes, e.g. "dp=4,fsdp=2"'
+    )
     # identity in the master's mesh rendezvous; defaults to the pod
     # hostname — override for several workers on one machine
     parser.add_argument("--worker_host", default="")

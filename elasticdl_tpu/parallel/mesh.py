@@ -60,6 +60,32 @@ class MeshConfig:
         return (dp, self.fsdp, self.pp, self.tp, self.sp, self.ep, devices)
 
 
+def parse_mesh_spec(spec: str) -> MeshConfig:
+    """Parse the CLI mesh string, e.g. ``"dp=4,fsdp=2"``. Unnamed axes
+    default (dp absorbs the remaining devices). Empty string -> None."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    sizes = {}
+    for part in spec.split(","):
+        name, _, value = part.partition("=")
+        name = name.strip()
+        if name not in AXES:
+            raise ValueError(
+                "unknown mesh axis %r (valid: %s)" % (name, ", ".join(AXES))
+            )
+        if name in sizes:
+            raise ValueError("duplicate mesh axis %r in %r" % (name, spec))
+        try:
+            sizes[name] = int(value)
+        except ValueError:
+            raise ValueError(
+                "mesh axis %r needs an integer size, e.g. %s=2 (got %r)"
+                % (name, name, value)
+            ) from None
+    return MeshConfig(**sizes)
+
+
 def build_mesh(config: MeshConfig = None, num_devices=None) -> Mesh:
     config = config or MeshConfig()
     *shape, devices = config.resolve(num_devices)

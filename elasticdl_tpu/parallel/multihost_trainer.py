@@ -28,11 +28,18 @@ and resume from the checkpoint — exactly the reference's
 re-init-and-reload flow (allreduce_trainer.py:66-118), with
 checkpoint restore replacing Horovod's broadcast-from-rank-0.
 
-v1 layout constraint: the TrainState must be *process-replicated* (dp
-across processes; fsdp/tp/sp/ep extents must fit within one process's
-local devices). That keeps checkpointing trivial — rank 0's local
-replica is the full state — and matches the standard "dp rides DCN,
-model parallelism rides ICI" placement.
+v2 layout contract: data parallelism (``dp``) spans processes/hosts
+(gradients psum over DCN); model-parallel axes (fsdp/tp/sp/ep) may take
+any extent that fits within one process's local devices — the
+"dp rides DCN, model parallelism rides ICI" placement, e.g. a v5p-32
+job as 4 processes x 8 chips with ``dp=4, fsdp=8``. Checkpoints are
+*make_array-aware*: save hands orbax the global jax.Arrays (its writes
+are cross-process collectives) and restore materializes directly into
+the current mesh's shardings, so resume onto a different world size
+re-shards implicitly. Cross-process *state* sharding (ZeRO over DCN)
+also trains/saves/restores; only the process-local eval pull
+(``local_state``) rejects it, since a single process no longer holds a
+full cover of every leaf.
 """
 
 import jax
@@ -49,25 +56,33 @@ logger = _logger_factory("elasticdl_tpu.parallel.multihost_trainer")
 class MultiHostSpmdTrainer(SpmdTrainer):
     """SpmdTrainer whose mesh spans every jax process."""
 
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
+    # explicit signature (not *args/**kwargs): the Worker feeds
+    # sharding_rules/batch_spec/mesh_config by inspecting the factory's
+    # parameters (worker.py), which a splat signature would hide
+    def __init__(
+        self,
+        model,
+        loss_fn,
+        optimizer,
+        compute_dtype=None,
+        seed=0,
+        mesh=None,
+        mesh_config=None,
+        sharding_rules=None,
+        batch_spec=None,
+    ):
+        super().__init__(
+            model,
+            loss_fn,
+            optimizer,
+            compute_dtype=compute_dtype,
+            seed=seed,
+            mesh=mesh,
+            mesh_config=mesh_config,
+            sharding_rules=sharding_rules,
+            batch_spec=batch_spec,
+        )
         self._process_count = jax.process_count()
-        non_dp = 1
-        for name, size in dict(self.mesh.shape).items():
-            if name != "dp":
-                non_dp *= size
-        if self._process_count > 1 and non_dp > 1:
-            # With non-dp sharding on a process-spanning mesh, a leaf's
-            # jax.Array spans non-addressable devices and local_state /
-            # eval_step / rank-local checkpointing (np.asarray) raise.
-            # v1 therefore supports exactly the "dp rides DCN" layout;
-            # in-host fsdp/tp under multi-host needs a
-            # make_array-aware checkpoint path first.
-            raise ValueError(
-                "multi-host lockstep v1 is dp-only across processes "
-                "(got non-dp extents %d); run fsdp/tp meshes within a "
-                "single process" % non_dp
-            )
         self._replicated = NamedSharding(self.mesh, P())
         self._consensus = jax.jit(
             lambda flags: jnp.sum(flags), out_shardings=self._replicated
@@ -134,25 +149,78 @@ class MultiHostSpmdTrainer(SpmdTrainer):
             round(float(self._consensus(flags)) / jax.local_device_count())
         )
 
-    # -- checkpoint surface (rank-0 local copy is the full state) ------
-    def local_state(self, state):
-        """Pull the full state to host numpy. Valid because v1 keeps
-        every leaf either replicated across processes or sharded only
-        over this process's local devices."""
-        return jax.tree_util.tree_map(np.asarray, state)
+    # -- checkpoint surface (make_array-aware, v2) ---------------------
+    def checkpoint_state(self, state):
+        """What the worker hands the checkpoint manager: the GLOBAL
+        jax.Array state, unchanged. orbax's save is a cross-process
+        collective — every rank calls it (the lockstep loop guarantees
+        same-version alignment) and each process writes the shards it
+        holds, so fsdp/tp-sharded state checkpoints without ever being
+        gathered onto one host."""
+        return state
 
-    def adopt_restored(self, local_state):
-        """Lay a host-restored (or freshly initialized) local state out
-        over the global mesh."""
+    def local_state(self, state):
+        """Pull the full state to host numpy WITHOUT communication, by
+        stitching this process's addressable shards. Valid for the v2
+        layout contract (model-parallel axes within a process): every
+        leaf's addressable shards cover the whole array. State sharded
+        over a cross-process axis raises — a single process does not
+        hold it, and pulling it would require a collective the
+        per-worker eval path must not issue."""
+
+        def pull(leaf):
+            if not isinstance(leaf, jax.Array) or leaf.is_fully_addressable:
+                return np.asarray(leaf)
+            out = np.empty(leaf.shape, leaf.dtype)
+            seen = {}
+            for shard in leaf.addressable_shards:
+                key = tuple(
+                    (s.start, s.stop, s.step) for s in shard.index
+                )
+                if key in seen:
+                    continue
+                data = np.asarray(shard.data)
+                seen[key] = data.size
+                out[shard.index] = data
+            if sum(seen.values()) < out.size:
+                raise ValueError(
+                    "state leaf %s x %s is sharded over a cross-process "
+                    "mesh axis; this process holds %d of %d elements. "
+                    "Process-local eval/pull supports model-parallel "
+                    "axes within a process (dp-over-DCN layout) only."
+                    % (leaf.shape, leaf.dtype, sum(seen.values()),
+                       out.size)
+                )
+            return out
+
+        return jax.tree_util.tree_map(pull, state)
+
+    def adopt_restored(self, restored):
+        """Accept a restored state: global jax.Arrays (the v2 restore
+        path, already laid out by orbax) pass through; host arrays (a
+        template-shaped local restore or fresh init) are laid out over
+        the global mesh."""
         if self._state_shardings is None:
             raise RuntimeError("call abstract_state/create_state first")
-        local_state = jax.tree_util.tree_map(np.asarray, local_state)
-        return self._put_global(local_state, self._state_shardings)
+        pairs = zip(
+            jax.tree_util.tree_leaves(restored),
+            jax.tree_util.tree_leaves(self._state_shardings),
+        )
+        if all(
+            isinstance(leaf, jax.Array) and leaf.sharding == sharding
+            for leaf, sharding in pairs
+        ):
+            # the restore_shardings path: orbax already materialized
+            # every leaf into the current mesh's layout (true at any
+            # world size — a host-numpy round trip here would double
+            # restore latency for nothing)
+            return restored
+        restored = jax.tree_util.tree_map(np.asarray, restored)
+        return self._put_global(restored, self._state_shardings)
 
     def abstract_state(self, sample_features):
-        """Local (host-shaped) restore template; restore reads the same
-        checkpoint files on every process, then adopt_restored lays the
-        result out globally."""
+        """Restore template (shapes/dtypes); restore_shardings lays the
+        checkpoint out directly over the current global mesh."""
         from elasticdl_tpu.train.train_state import abstract_train_state
         from elasticdl_tpu.parallel.sharding import infer_state_shardings
 
@@ -169,9 +237,13 @@ class MultiHostSpmdTrainer(SpmdTrainer):
 
     @property
     def restore_shardings(self):
-        """Checkpoints restore to host-local arrays (no device layout);
-        the worker then calls adopt_restored."""
-        return None
+        """Restore directly into the current mesh's global shardings
+        (orbax reads are cross-process collectives; every rank calls
+        restore at the same point — the first-batch hook does). A
+        checkpoint written by a different world size re-shards
+        implicitly because orbax materializes into these shardings,
+        not the save-time layout."""
+        return self._state_shardings
 
     # -- eval: local compute on the pulled replica ---------------------
     def eval_step(self, state, batch):

@@ -79,10 +79,19 @@ def main(argv=None):
         from elasticdl_tpu.parallel.spmd_trainer import SpmdTrainer
 
         trainer_factory = SpmdTrainer
+    # --mesh "fsdp=4" etc: explicit axis sizes; dp=-1 absorbs whatever
+    # devices remain, so the same flag survives elastic world-size
+    # changes (a relaunch at a smaller world just gets a smaller dp).
+    mesh_config = None
+    if args.mesh:
+        from elasticdl_tpu.parallel.mesh import parse_mesh_spec
+
+        mesh_config = parse_mesh_spec(args.mesh)
     worker = Worker(
         master_client,
         args.model_zoo,
         reader,
+        mesh_config=mesh_config,
         minibatch_size=args.minibatch_size,
         mode=args.mode,
         compute_dtype=args.compute_dtype or None,

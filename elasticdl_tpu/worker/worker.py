@@ -273,10 +273,10 @@ class Worker:
             # orbax's save is itself a cross-process collective
             # (sync_global_processes barriers) — EVERY rank must call it,
             # at the same version, which the lockstep loop guarantees.
-            # Each rank hands over its local replica (v1 layout keeps
-            # non-dp axes within a process, so the replica is the full
-            # state); orbax's primary-host logic decides who writes.
-            state = self.trainer.local_state(state)
+            # v2: each rank hands over the GLOBAL jax.Array state and
+            # orbax writes the shards this process holds (make_array-
+            # aware path; fsdp/tp state is never gathered onto one host).
+            state = self.trainer.checkpoint_state(state)
         self._checkpoint_mgr.save(self._version, state)
 
     def _after_train_batch(self, batch, loss):
@@ -480,8 +480,10 @@ class Worker:
             mgr = DenseCheckpointManager(
                 self._init_checkpoint_dir, keep_max=0, create=False
             )
-            # a lockstep trainer restores to host arrays first
-            # (restore_shardings None) and lays them out globally below
+            # a lockstep trainer restores directly into the global
+            # mesh's shardings (a cross-process collective — every rank
+            # reaches this first-batch hook); adopt_restored below
+            # passes the already-global result through
             if hasattr(self.trainer, "restore_shardings"):
                 shardings = self.trainer.restore_shardings
             else:

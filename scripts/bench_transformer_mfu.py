@@ -17,9 +17,6 @@ Usage:
 """
 
 import argparse
-import collections
-import glob
-import gzip
 import json
 import os
 import sys
@@ -171,48 +168,13 @@ def main():
     }))
 
     if args.profile:
+        from scripts.trace_summary import summarize_trace
+
         jax.profiler.start_trace(args.profile)
         state, losses = run(state, batch, args.steps)
         float(losses[-1])
         jax.profiler.stop_trace()
-        path = sorted(
-            glob.glob(args.profile + "/plugins/profile/*/*.trace.json.gz")
-        )[-1]
-        with gzip.open(path) as f:
-            data = json.load(f)
-        tpu_pid = None
-        for e in data["traceEvents"]:
-            if e.get("ph") == "M" and e.get("name") == "process_name" \
-                    and "TPU" in str(e.get("args", {}).get("name", "")):
-                tpu_pid = e["pid"]
-        ops = [
-            e for e in data["traceEvents"]
-            if e.get("ph") == "X" and e.get("pid") == tpu_pid
-            and "hlo_category" in e.get("args", {})
-            and not e["name"].startswith("while")
-        ]
-        total = sum(e["dur"] for e in ops)
-        cat = collections.Counter()
-        catb = collections.Counter()
-        catf = collections.Counter()
-        for e in ops:
-            c = e["args"]["hlo_category"]
-            cat[c] += e["dur"]
-            catb[c] += int(e["args"].get("bytes_accessed", 0))
-            catf[c] += int(float(e["args"].get("flops", 0)))
-        print(
-            "device time: %.1f ms / %d steps; bytes %.1f GB/step"
-            % (total / 1e3, args.steps,
-               sum(catb.values()) / args.steps / 1e9)
-        )
-        for c, dur in cat.most_common(14):
-            bw = catb[c] / (dur / 1e6) / 1e9 if dur else 0
-            tf = catf[c] / (dur / 1e6) / 1e12 if dur else 0
-            print(
-                "%5.1f%%  %8.1fms  bw=%6.0f GB/s  %6.1f TFLOP/s  %s"
-                % (dur / total * 100, dur / 1e3, bw, tf, c)
-            )
-        print("trace at:", path)
+        summarize_trace(args.profile, args.steps)
 
 
 if __name__ == "__main__":

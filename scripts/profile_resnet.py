@@ -6,10 +6,6 @@ Usage: python scripts/profile_resnet.py [--out /tmp/edl_trace]
 """
 
 import argparse
-import collections
-import glob
-import gzip
-import json
 import sys
 import os
 
@@ -68,41 +64,9 @@ def main():
     float(losses[-1])  # device->host fetch fences remote execution
     jax.profiler.stop_trace()
 
-    path = sorted(
-        glob.glob(args.out + "/plugins/profile/*/*.trace.json.gz")
-    )[-1]
-    with gzip.open(path) as f:
-        data = json.load(f)
-    # pid of the TPU device track
-    tpu_pid = None
-    for e in data["traceEvents"]:
-        if e.get("ph") == "M" and e.get("name") == "process_name" and \
-                "TPU" in str(e.get("args", {}).get("name", "")):
-            tpu_pid = e["pid"]
-    ops = [
-        e for e in data["traceEvents"]
-        if e.get("ph") == "X" and e.get("pid") == tpu_pid
-        and "hlo_category" in e.get("args", {})
-        and not e["name"].startswith("while")
-    ]
-    total = sum(e["dur"] for e in ops)
-    cat = collections.Counter()
-    catb = collections.Counter()
-    for e in ops:
-        c = e["args"]["hlo_category"]
-        cat[c] += e["dur"]
-        catb[c] += int(e["args"].get("bytes_accessed", 0))
-    print(
-        "device time: %.1f ms / %d steps; bytes accessed %.1f GB/step"
-        % (total / 1e3, args.steps, sum(catb.values()) / args.steps / 1e9)
-    )
-    for c, d in cat.most_common(12):
-        bw = catb[c] / (d / 1e6) / 1e9 if d else 0
-        print(
-            "%5.1f%%  %8.1fms  bw=%6.0f GB/s  %s"
-            % (d / total * 100, d / 1e3, bw, c)
-        )
-    print("trace at:", path)
+    from scripts.trace_summary import summarize_trace
+
+    summarize_trace(args.out, args.steps)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,58 @@
+"""Shared per-HLO-category breakdown of a jax.profiler trace.
+
+Used by scripts/profile_resnet.py and scripts/bench_transformer_mfu.py
+(the evidence generators behind docs/PERF_RESNET.md and
+docs/PERF_TRANSFORMER.md).
+"""
+
+import collections
+import glob
+import gzip
+import json
+
+
+def latest_trace_path(trace_dir):
+    return sorted(
+        glob.glob(trace_dir + "/plugins/profile/*/*.trace.json.gz")
+    )[-1]
+
+
+def summarize_trace(trace_dir, steps, top=14):
+    """Print device time / bytes / bandwidth / flops by HLO category for
+    the newest trace under ``trace_dir``; returns the trace path."""
+    path = latest_trace_path(trace_dir)
+    with gzip.open(path) as f:
+        data = json.load(f)
+    tpu_pid = None
+    for e in data["traceEvents"]:
+        if e.get("ph") == "M" and e.get("name") == "process_name" \
+                and "TPU" in str(e.get("args", {}).get("name", "")):
+            tpu_pid = e["pid"]
+    ops = [
+        e for e in data["traceEvents"]
+        if e.get("ph") == "X" and e.get("pid") == tpu_pid
+        and "hlo_category" in e.get("args", {})
+        and not e["name"].startswith("while")
+    ]
+    total = sum(e["dur"] for e in ops)
+    cat = collections.Counter()
+    catb = collections.Counter()
+    catf = collections.Counter()
+    for e in ops:
+        c = e["args"]["hlo_category"]
+        cat[c] += e["dur"]
+        catb[c] += int(e["args"].get("bytes_accessed", 0))
+        catf[c] += int(float(e["args"].get("flops", 0)))
+    print(
+        "device time: %.1f ms / %d steps; bytes %.1f GB/step"
+        % (total / 1e3, steps, sum(catb.values()) / steps / 1e9)
+    )
+    for c, dur in cat.most_common(top):
+        bw = catb[c] / (dur / 1e6) / 1e9 if dur else 0
+        tf = catf[c] / (dur / 1e6) / 1e12 if dur else 0
+        print(
+            "%5.1f%%  %8.1fms  bw=%6.0f GB/s  %6.1f TFLOP/s  %s"
+            % (dur / total * 100, dur / 1e3, bw, tf, c)
+        )
+    print("trace at:", path)
+    return path

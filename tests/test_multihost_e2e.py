@@ -32,33 +32,39 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _spawn_worker(idx, master_port, coordinator_port, train_dir,
-                  ckpt_dir, log_path):
+                  ckpt_dir, log_path, devices_per_proc=1, mesh=""):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         EDL_FAULTHANDLER="1",
         PYTHONPATH=REPO,
         # workers must NOT inherit the test session's 8 virtual devices:
-        # one device per process keeps the global mesh 2 x 1
-        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        # devices_per_proc local devices per worker process (1 keeps the
+        # global mesh 2 x 1; 4 with --mesh fsdp=4 exercises in-host
+        # model parallelism under a process-spanning mesh)
+        XLA_FLAGS="--xla_force_host_platform_device_count=%d"
+        % devices_per_proc,
     )
     log = open(log_path, "ab")
     log.write(b"\n===== incarnation spawn =====\n")
     log.flush()
+    cmd = [
+        sys.executable, "-m", "elasticdl_tpu.worker.main",
+        "--master_addr", "localhost:%d" % master_port,
+        "--worker_id", str(idx),
+        "--model_zoo", "elasticdl_tpu.models.mnist",
+        "--training_data", train_dir,
+        "--minibatch_size", "32",
+        "--multihost", "1",
+        "--coordinator_port", str(coordinator_port),
+        "--worker_host", "localhost:%d" % (61000 + idx),
+        "--checkpoint_dir", ckpt_dir,
+        "--checkpoint_steps", "2",
+    ]
+    if mesh:
+        cmd += ["--mesh", mesh]
     return subprocess.Popen(
-        [
-            sys.executable, "-m", "elasticdl_tpu.worker.main",
-            "--master_addr", "localhost:%d" % master_port,
-            "--worker_id", str(idx),
-            "--model_zoo", "elasticdl_tpu.models.mnist",
-            "--training_data", train_dir,
-            "--minibatch_size", "32",
-            "--multihost", "1",
-            "--coordinator_port", str(coordinator_port),
-            "--worker_host", "localhost:%d" % (61000 + idx),
-            "--checkpoint_dir", ckpt_dir,
-            "--checkpoint_steps", "2",
-        ],
+        cmd,
         env=env,
         stdout=log,
         stderr=subprocess.STDOUT,
@@ -67,7 +73,22 @@ def _spawn_worker(idx, master_port, coordinator_port, train_dir,
 
 
 @pytest.mark.slow
-def test_kill_one_host_epoch_bump_reinit_restore_completes(tmp_path):
+@pytest.mark.parametrize(
+    "devices_per_proc,mesh",
+    [
+        (1, ""),  # v1 scenario: dp-only 2x1 mesh, one device per host
+        # v2 scenario: dp spans the 2 processes, fsdp=4 inside each —
+        # state is fsdp-sharded (mnist's big kernels exceed the
+        # fsdp_auto_spec threshold), so checkpoint save/restore runs the
+        # make_array-aware global-Array path, and the post-kill restart
+        # re-shards the 8-device checkpoint onto the survivor's 1x4 mesh
+        (4, "fsdp=4"),
+    ],
+    ids=["dp_only", "fsdp_inhost"],
+)
+def test_kill_one_host_epoch_bump_reinit_restore_completes(
+    tmp_path, devices_per_proc, mesh
+):
     train_dir = tmp_path / "train"
     train_dir.mkdir()
     create_mnist_recordio(
@@ -126,7 +147,7 @@ def test_kill_one_host_epoch_bump_reinit_restore_completes(tmp_path):
         for i in (0, 1):
             procs[i] = _spawn_worker(
                 i, master_port, coordinator_port, str(train_dir),
-                ckpt_dir, logs[i],
+                ckpt_dir, logs[i], devices_per_proc, mesh,
             )
 
         def supervise():
@@ -147,7 +168,7 @@ def test_kill_one_host_epoch_bump_reinit_restore_completes(tmp_path):
                 )
                 procs[i] = _spawn_worker(
                     i, master_port, coordinator_port, str(train_dir),
-                    ckpt_dir, logs[i],
+                    ckpt_dir, logs[i], devices_per_proc, mesh,
                 )
 
         def committed_checkpoints():
@@ -202,6 +223,10 @@ def test_kill_one_host_epoch_bump_reinit_restore_completes(tmp_path):
         assert "rank 0/1" in log0
         assert "Resumed from checkpoint" in log0
         assert relaunches[0] >= 1, "survivor was never relaunched"
+        if mesh:
+            # the fsdp extent really was in the process-spanning mesh
+            # (2-host phase) and in the survivor's post-restart mesh
+            assert "'fsdp': 4" in log0, log0[-2000:]
     finally:
         for proc in procs.values():
             if proc.poll() is None:

@@ -104,44 +104,6 @@ def test_model_contract_loads():
     assert spec.batch_spec is not None
 
 
-def test_remat_matches_no_remat():
-    """Per-block remat must be a pure memory/compute trade: identical
-    loss and gradients."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from elasticdl_tpu.models import transformer
-
-    tokens = jnp.asarray(
-        np.random.RandomState(0).randint(0, 64, (2, 16)), jnp.int32
-    )
-
-    def loss_for(remat):
-        model = transformer.TransformerLM(
-            vocab_size=64, num_layers=2, num_heads=2, embed_dim=32,
-            attention_impl="xla", remat=remat,
-        )
-        variables = model.init(jax.random.PRNGKey(0), tokens)
-
-        def loss_fn(params):
-            logits = model.apply({"params": params}, tokens)
-            return jnp.mean(
-                transformer.loss(tokens, logits).astype(jnp.float32)
-            )
-
-        value, grads = jax.value_and_grad(loss_fn)(variables["params"])
-        return value, grads
-
-    v0, g0 = loss_for(False)
-    v1, g1 = loss_for(True)
-    assert np.isclose(float(v0), float(v1), rtol=1e-6)
-    flat0 = jax.tree_util.tree_leaves(g0)
-    flat1 = jax.tree_util.tree_leaves(g1)
-    for a, b in zip(flat0, flat1):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
-
-
 @pytest.mark.parametrize("remat_policy", ["full", "dots"])
 @pytest.mark.parametrize("attention_impl", ["xla", "pallas"])
 def test_remat_policies_match_no_remat(remat_policy, attention_impl,
