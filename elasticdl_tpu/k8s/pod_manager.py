@@ -26,6 +26,7 @@ _FORWARDED_WORKER_FLAGS = (
     "checkpoint_steps",
     "keep_checkpoint_max",
     "checkpoint_dir_for_init",
+    "mesh",
 )
 
 

@@ -60,7 +60,7 @@ class MeshConfig:
         return (dp, self.fsdp, self.pp, self.tp, self.sp, self.ep, devices)
 
 
-def parse_mesh_spec(spec: str) -> MeshConfig:
+def parse_mesh_spec(spec: str) -> "MeshConfig | None":
     """Parse the CLI mesh string, e.g. ``"dp=4,fsdp=2"``. Unnamed axes
     default (dp absorbs the remaining devices). Empty string -> None."""
     spec = (spec or "").strip()

@@ -104,22 +104,17 @@ class MultiHostSpmdTrainer(SpmdTrainer):
         return jax.tree_util.tree_map(put, tree, shardings)
 
     def create_state(self, sample_features):
-        # identical local init on every process (shared seed), then laid
-        # out over the global mesh
-        from elasticdl_tpu.train.train_state import create_train_state
-        from elasticdl_tpu.parallel.sharding import infer_state_shardings
-
-        init_rng, self._rng = jax.random.split(self._rng)
-        local_state = create_train_state(
-            self._model, self._tx, init_rng, sample_features
+        # The sharded jit init (SpmdTrainer.create_state) runs as one
+        # SPMD program over the process-spanning mesh — no process ever
+        # materializes the full state. Features are zeroed first: a jit
+        # under a multi-process mesh implicitly replicates host
+        # operands, which ASSUMES identical values on every process;
+        # zeros make that true (flax init derives parameter values from
+        # the rng — shared seed — not from the batch).
+        zeros = jax.tree_util.tree_map(
+            lambda leaf: np.zeros_like(np.asarray(leaf)), sample_features
         )
-        self._state_shardings = infer_state_shardings(
-            local_state, self.mesh, self._rules
-        )
-        self._train_step = None
-        self._eval_step = None
-        local_state = jax.tree_util.tree_map(np.asarray, local_state)
-        return self._put_global(local_state, self._state_shardings)
+        return super().create_state(zeros)
 
     def shard_batch(self, local_batch):
         """This process's batch is its shard of the global batch: the
@@ -218,22 +213,8 @@ class MultiHostSpmdTrainer(SpmdTrainer):
         restored = jax.tree_util.tree_map(np.asarray, restored)
         return self._put_global(restored, self._state_shardings)
 
-    def abstract_state(self, sample_features):
-        """Restore template (shapes/dtypes); restore_shardings lays the
-        checkpoint out directly over the current global mesh."""
-        from elasticdl_tpu.train.train_state import abstract_train_state
-        from elasticdl_tpu.parallel.sharding import infer_state_shardings
-
-        init_rng, _ = jax.random.split(self._rng)
-        abstract = abstract_train_state(
-            self._model, self._tx, init_rng, sample_features
-        )
-        self._state_shardings = infer_state_shardings(
-            abstract, self.mesh, self._rules
-        )
-        self._train_step = None
-        self._eval_step = None
-        return abstract
+    # abstract_state: inherited — the eval_shape skeleton +
+    # infer_state_shardings logic is identical to SpmdTrainer's.
 
     @property
     def restore_shardings(self):

@@ -77,17 +77,27 @@ class SpmdTrainer:
 
     # ------------------------------------------------------------------
     def create_state(self, sample_features):
-        # Init on one device, then lay out over the mesh. (For models too
-        # large for one device's HBM, swap to an eval_shape + sharded-init
-        # jit; the flagship models here fit a single chip at init.)
+        # Sharded init: shardings are inferred from an eval_shape
+        # skeleton (no buffers), then the whole init runs under one jit
+        # with out_shardings — XLA materializes every leaf directly in
+        # its target layout, so a ZeRO/fsdp-sharded model larger than
+        # one device's HBM initializes without ever existing whole on
+        # any single device (tests/test_spmd_trainer.py asserts the
+        # per-device live-byte bound).
         init_rng, self._rng = jax.random.split(self._rng)
-        state = create_train_state(
+        abstract = abstract_train_state(
             self._model, self._tx, init_rng, sample_features
         )
         self._state_shardings = infer_state_shardings(
-            state, self.mesh, self._rules
+            abstract, self.mesh, self._rules
         )
-        state = jax.device_put(state, self._state_shardings)
+        with self.mesh:
+            state = jax.jit(
+                lambda rng, feats: create_train_state(
+                    self._model, self._tx, rng, feats
+                ),
+                out_shardings=self._state_shardings,
+            )(init_rng, sample_features)
         self._train_step = None
         self._eval_step = None
         return state

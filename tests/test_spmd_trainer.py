@@ -97,3 +97,74 @@ def test_batch_not_divisible_raises():
     state = spmd.create_state(_batch()["features"])
     with pytest.raises(ValueError):
         spmd.train_step(state, _batch(batch=30))
+
+
+def test_sharded_init_never_materializes_full_state_per_device():
+    """VERDICT r2 item 5: fresh init must run as one jit with
+    out_shardings so a ZeRO-sharded state larger than a single device's
+    HBM can be created, not just restored. Asserts the layout: every
+    device holds ~1/fsdp of the big leaves, and no device holds more
+    than a fraction of the full state."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
+    from elasticdl_tpu.train.optimizers import create_optimizer
+
+    class BigMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, training=False):
+            x = nn.Dense(2048)(x)
+            x = nn.relu(x)
+            x = nn.Dense(2048)(x)
+            x = nn.relu(x)
+            return nn.Dense(16)(x)
+
+    def loss_fn(labels, predictions):
+        return sparse_softmax_cross_entropy(labels, predictions)
+
+    trainer = SpmdTrainer(
+        model=BigMLP(),
+        loss_fn=loss_fn,
+        optimizer=create_optimizer("Adam", learning_rate=1e-3),
+        seed=0,
+        mesh_config=MeshConfig(dp=1, fsdp=8),
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": rng.rand(16, 256).astype(np.float32),
+        "labels": rng.randint(0, 16, size=16),
+        "_mask": np.ones(16, np.float32),
+    }
+    state = trainer.create_state(batch["features"])
+
+    # Account state bytes per device from the actual shard layout.
+    per_device = {}
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        if not isinstance(leaf, jax.Array):
+            continue
+        total += leaf.size * leaf.dtype.itemsize
+        for shard in leaf.addressable_shards:
+            nbytes = shard.data.size * leaf.dtype.itemsize
+            per_device[shard.device] = (
+                per_device.get(shard.device, 0) + nbytes
+            )
+    # The three big kernels (+ their Adam mu/nu) dominate total bytes;
+    # with fsdp=8 every device must hold well under half the state.
+    assert len(per_device) == 8
+    assert max(per_device.values()) < total / 3, (
+        "init materialized too much on one device: max %d of %d bytes"
+        % (max(per_device.values()), total)
+    )
+    # and the 2048x2048 kernels really are 8-way sharded
+    kernel = state.params["Dense_1"]["kernel"]
+    assert kernel.addressable_shards[0].data.size == kernel.size // 8
+
+    # the sharded-init state trains and improves
+    first = last = None
+    for i in range(5):
+        state, loss = trainer.train_step(state, batch)
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    assert last < first
