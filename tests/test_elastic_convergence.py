@@ -1,0 +1,43 @@
+"""Smoke-scale elastic convergence-equivalence gate.
+
+Runs scripts/convergence_elastic.py (the experiment behind
+docs/CONVERGENCE_ELASTIC.md — reference report_cn.md:106-117 parity) at
+reduced scale: fixed-2 / fixed-4 / elastic 2->4->3 with a real mid-job
+worker add + SIGKILL, asserting the final held-out AUCs agree. The
+script itself fails if the elastic triggers never fire or any gap
+exceeds tolerance.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_elastic_converges_like_fixed(tmp_path):
+    out_csv = str(tmp_path / "curves.csv")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "scripts/convergence_elastic.py",
+         "--records", "2048", "--valid_records", "512",
+         "--records_per_task", "128", "--num_epochs", "1",
+         "--eval_steps", "4",
+         # small-scale runs are noisier than the documented full run
+         "--tolerance", "0.05",
+         "--out_csv", out_csv],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    summary = json.loads(
+        [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["converged_equivalently"], summary
+    assert os.path.exists(out_csv)
+    # the elastic scenario really churned (the script prints both events)
+    assert "+2 workers at" in proc.stdout, proc.stdout[-2000:]
+    assert "SIGKILL worker" in proc.stdout, proc.stdout[-2000:]
