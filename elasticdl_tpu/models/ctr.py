@@ -24,8 +24,15 @@ from elasticdl_tpu.train import metrics
 from elasticdl_tpu.train.losses import sigmoid_binary_cross_entropy
 from elasticdl_tpu.train.optimizers import create_optimizer
 
-VOCAB = 1000
-NUM_FIELDS = 10
+# Deployable defaults are criteo-dac scale (reference model_zoo/dac_ctr/
+# feature_config.py: 39 raw columns hashed into a shared id space): the
+# zoo module an operator launches is the shape the bench tunes. The
+# models are field-count agnostic at apply time; vocab sizes the tables
+# ([1M, 8] f32 = 32 MB, comfortably device-resident). Override per-job
+# via custom_model(vocab=..., embed_dim=...) or EDL_CTR_VOCAB /
+# EDL_CTR_EMBED_DIM.
+VOCAB = 1_000_000
+NUM_FIELDS = 39
 EMBED_DIM = 8
 
 
@@ -122,11 +129,18 @@ class WideDeep(nn.Module):
     """wide = linear over per-field 1-d embeddings; deep = DNN over
     concatenated field embeddings (wide_deep_model.py)."""
 
+    vocab: int = VOCAB
+    embed_dim: int = EMBED_DIM
+
     @nn.compact
     def __call__(self, features, training: bool = False):
         ids = features["ids"]
-        wide = FieldEmbeddings(dim=1, name="wide")(ids)  # [B,F,1]
-        deep_emb = FieldEmbeddings(name="deep")(ids)  # [B,F,D]
+        wide = FieldEmbeddings(
+            vocab=self.vocab, dim=1, name="wide"
+        )(ids)  # [B,F,1]
+        deep_emb = FieldEmbeddings(
+            vocab=self.vocab, dim=self.embed_dim, name="deep"
+        )(ids)  # [B,F,D]
         deep = DNN()(deep_emb.reshape((ids.shape[0], -1)))
         logit = wide.sum(axis=(1, 2), keepdims=False)[:, None]
         logit = logit + nn.Dense(1)(deep)
@@ -137,10 +151,13 @@ class DCN(nn.Module):
     """CrossNet + DNN over the flattened embeddings, concat -> logit
     (dcn_model.py:53-88)."""
 
+    vocab: int = VOCAB
+    embed_dim: int = EMBED_DIM
+
     @nn.compact
     def __call__(self, features, training: bool = False):
         ids = features["ids"]
-        emb = FieldEmbeddings()(ids)
+        emb = FieldEmbeddings(vocab=self.vocab, dim=self.embed_dim)(ids)
         flat = emb.reshape((ids.shape[0], -1))
         cross = CrossNet(num_layers=2)(flat)
         deep = DNN()(flat)
@@ -151,11 +168,18 @@ class DCN(nn.Module):
 class XDeepFM(nn.Module):
     """linear + CIN + DNN (xdeepfm_model.py:55-101)."""
 
+    vocab: int = VOCAB
+    embed_dim: int = EMBED_DIM
+
     @nn.compact
     def __call__(self, features, training: bool = False):
         ids = features["ids"]
-        linear = FieldEmbeddings(dim=1, name="linear")(ids)
-        emb = FieldEmbeddings(name="deep")(ids)
+        linear = FieldEmbeddings(
+            vocab=self.vocab, dim=1, name="linear"
+        )(ids)
+        emb = FieldEmbeddings(
+            vocab=self.vocab, dim=self.embed_dim, name="deep"
+        )(ids)
         cin_out = CIN()(emb)
         deep = DNN()(emb.reshape((ids.shape[0], -1)))
         logit = (
@@ -169,11 +193,15 @@ class XDeepFM(nn.Module):
 _VARIANTS = {"wide_deep": WideDeep, "dcn": DCN, "xdeepfm": XDeepFM}
 
 
-def custom_model(variant="dcn"):
+def custom_model(variant="dcn", vocab=None, embed_dim=None):
     import os
 
     variant = os.environ.get("EDL_CTR_VARIANT", variant)
-    return _VARIANTS[variant]()
+    vocab = int(os.environ.get("EDL_CTR_VOCAB", vocab or VOCAB))
+    embed_dim = int(
+        os.environ.get("EDL_CTR_EMBED_DIM", embed_dim or EMBED_DIM)
+    )
+    return _VARIANTS[variant](vocab=vocab, embed_dim=embed_dim)
 
 
 def loss(labels, predictions):

@@ -56,12 +56,35 @@ def optimizer():
     return create_optimizer("Adam", learning_rate=0.001)
 
 
-def sparse_embedding_specs(num_features=10, batch_size=64):
+# Deployable default shape: criteo-dac (reference model_zoo/dac_ctr/
+# feature_config.py groups 39 raw columns). The models are field-count
+# agnostic at apply time; this default sizes the id buffers.
+NUM_FIELDS = 39
+# Measured ceiling on the padded unique-id buffer (docs/PERF_SPARSE.md
+# round-2 addendum): CTR id streams are Zipfian, so a batch carries far
+# fewer unique ids than batch*fields — right-sizing this buffer was
+# +22% steps/s on chip. Overflow raises a ValueError naming the knob.
+MAX_ID_CAPACITY = 8192
+
+
+def sparse_embedding_specs(num_features=NUM_FIELDS, batch_size=64,
+                           capacity=None):
     """Host-PS tables this model trains against (TPU-contract addition:
     the reference discovers elasticdl.layers.Embedding instances via
     model introspection, model_handler.py:98-102; here the module
-    declares them)."""
-    capacity = batch_size * num_features
+    declares them). The capacity default is the perf-tuned criteo
+    config the bench measures — the zoo module IS the benched one.
+    Near-uniform id streams that overflow it (the clear ValueError at
+    train/sparse.py names this knob) can raise it per-job without a
+    source edit via ``capacity=`` or EDL_SPARSE_ID_CAPACITY (e.g. the
+    always-safe worst case batch*fields)."""
+    import os
+
+    if capacity is None:
+        capacity = int(os.environ.get(
+            "EDL_SPARSE_ID_CAPACITY",
+            min(batch_size * num_features, MAX_ID_CAPACITY),
+        ))
     return [
         SparseEmbeddingSpec(
             "deepfm_emb",

@@ -44,7 +44,33 @@ def parse_ps_args(argv=None):
     parser.add_argument("--use_async", type=int, default=1)
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
+    # benchmarking knob: sleep this long at the top of every RPC handler
+    # to emulate network RTT between worker and PS pods (the
+    # controlled-latency experiment behind docs/PERF_SPARSE.md — a
+    # localhost PS otherwise measures at ~0 RTT)
+    parser.add_argument("--inject_rpc_delay_ms", type=float, default=0.0)
     return parser.parse_args(argv)
+
+
+class _DelayedServicer:
+    """Wraps a servicer so every RPC handler sleeps ``delay_ms`` first —
+    an injectable stand-in for worker<->PS network latency."""
+
+    def __init__(self, servicer, delay_ms):
+        self._servicer = servicer
+        self._delay = delay_ms / 1e3
+
+    def __getattr__(self, name):
+        attr = getattr(self._servicer, name)
+        if not callable(attr) or name.startswith("_"):
+            return attr
+        delay = self._delay
+
+        def delayed(*args, **kwargs):
+            time.sleep(delay)
+            return attr(*args, **kwargs)
+
+        return delayed
 
 
 class ParameterServer:
@@ -98,7 +124,16 @@ class ParameterServer:
 
     def prepare(self):
         self.server = build_server()
-        add_pserver_servicer_to_server(self.servicer, self.server)
+        servicer = self.servicer
+        if getattr(self.args, "inject_rpc_delay_ms", 0):
+            servicer = _DelayedServicer(
+                servicer, self.args.inject_rpc_delay_ms
+            )
+            logger.info(
+                "Injecting %.1f ms per-RPC delay (latency experiment)",
+                self.args.inject_rpc_delay_ms,
+            )
+        add_pserver_servicer_to_server(servicer, self.server)
         self.server.add_insecure_port("[::]:%d" % self.args.port)
         self.server.start()
         logger.info(
