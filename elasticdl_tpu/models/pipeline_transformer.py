@@ -6,12 +6,19 @@ mesh axis: transformer blocks are pipeline *stages* whose stacked
 parameters shard ``P("pp")`` over the mesh, and the forward runs the
 GPipe microbatch schedule in :mod:`elasticdl_tpu.parallel.pipeline`.
 
-Parameter layout is topology-independent: blocks are stored as one flat
-``(num_layers, ...)`` stack regardless of the mesh, and ``apply``
-reshapes to ``(num_stages, layers_per_stage, ...)`` inside the jitted
-step. A checkpoint written on a pp=4 mesh therefore restores bit-for-bit
-onto pp=2 or a single chip (the elastic-resume contract the dense
-checkpoint path promises).
+Parameter layout is topology-independent by default: blocks are stored
+as one flat ``(num_layers, ...)`` stack regardless of the mesh, and
+``apply`` reshapes to ``(num_stages, layers_per_stage, ...)`` inside
+the jitted step. A checkpoint written on a pp=4 mesh therefore restores
+bit-for-bit onto pp=2 or a single chip (the elastic-resume contract the
+dense checkpoint path promises). EXCEPTION: ``device_major_params=True``
+(the interleaved-schedule perf opt-in, docs/PERF_PIPELINE.md) stores
+the stack in device-placement order pinned to the current
+``(num_stages, num_chunks)``; such state lives under the pytree key
+``blocks_device_major`` instead of ``blocks``, so restoring it into a
+job with the other layout setting fails LOUDLY on pytree structure
+instead of silently scrambling layers — convert with
+``blocks_to_portable``/``blocks_from_portable`` when moving topology.
 
 The model is a plain (non-flax) class implementing the framework's model
 contract — ``init(rng, features) -> variables`` / ``apply(variables,
@@ -28,9 +35,14 @@ don't regularize via dropout either).
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from elasticdl_tpu.models import transformer
-from elasticdl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from elasticdl_tpu.parallel.pipeline import (
+    device_major_order,
+    pipeline_apply,
+    stack_stage_params,
+)
 from elasticdl_tpu.parallel.sharding import ShardingRules
 from jax.sharding import PartitionSpec as P
 
@@ -57,6 +69,7 @@ class PipelinedTransformerLM:
         attention_impl="auto",
         mesh=None,
         num_chunks=1,
+        device_major_params=False,
     ):
         if num_layers % (num_stages * num_chunks) != 0:
             raise ValueError(
@@ -64,12 +77,24 @@ class PipelinedTransformerLM:
                 "=%d; refusing to silently change model depth"
                 % (num_layers, num_stages * num_chunks)
             )
+        if device_major_params and (num_chunks == 1 or mesh is None):
+            raise ValueError(
+                "device_major_params only applies to interleaved "
+                "pipelines (num_chunks > 1 with a mesh) — at V=1 the "
+                "portable layout is already device-contiguous"
+            )
         self.vocab_size = vocab_size
         self.num_layers = num_layers
         self.num_stages = num_stages
         # interleaved virtual chunks per device (Megatron interleaved
         # schedule; parallel/pipeline.py) — divides the bubble by V
         self.num_chunks = num_chunks
+        # device-major at-rest layer order: removes the interleaved
+        # schedule's per-step cross-shard permutation of the stage
+        # stack (parallel/pipeline.py params_layout note) at the price
+        # of an (S, V)-pinned checkpoint layout — convert with
+        # blocks_to_portable/blocks_from_portable at topology changes
+        self.device_major_params = device_major_params
         self.num_microbatches = num_microbatches
         self.mesh = mesh
         self.embed_dim = embed_dim
@@ -94,18 +119,72 @@ class PipelinedTransformerLM:
             variables = self._block.init(keys[1 + i], x, training=False)
             block_params.append(variables["params"])
         # Flat (num_layers, ...) stack — independent of num_stages, so
-        # checkpoints restore across any pp extent.
+        # checkpoints restore across any pp extent. With
+        # device_major_params the flat order is instead the device-
+        # placement order for (num_stages, num_chunks) — see
+        # _layer_order.
+        order = self._layer_order()
+        if order is not None:
+            block_params = [block_params[i] for i in order]
         stacked = stack_stage_params(block_params)
         ln_f = self._ln_f.init(keys[-2], x)
         head = self._head.init(keys[-1], x)
         return {
             "params": {
                 "wte": wte["params"],
-                "blocks": stacked,
+                # layout-specific key: a device-major checkpoint can
+                # never be restored into a portable-layout job (or vice
+                # versa) without a loud pytree-structure mismatch
+                self.blocks_key: stacked,
                 "ln_f": ln_f["params"],
                 "lm_head": head["params"],
             }
         }
+
+    @property
+    def blocks_key(self):
+        return (
+            "blocks_device_major" if self.device_major_params else "blocks"
+        )
+
+    def _layer_order(self):
+        """Flat layer order at rest: None = layer order (portable);
+        device_major_params = layers grouped so the contiguous P("pp")
+        split hands each device its interleaved chunks with no per-step
+        permutation (flat position p*per_chunk + k holds layer
+        order_dm[p]*per_chunk + k)."""
+        if not self.device_major_params:
+            return None
+        per_chunk = self.num_layers // (self.num_stages * self.num_chunks)
+        order = []
+        for chunk in device_major_order(self.num_stages, self.num_chunks):
+            order.extend(
+                range(chunk * per_chunk, (chunk + 1) * per_chunk)
+            )
+        return order
+
+    def blocks_to_portable(self, blocks):
+        """Reorder device-major-at-rest block leaves back to flat layer
+        order (the topology-portable checkpoint layout). Host-side; use
+        before handing a device-major checkpoint to a job with a
+        different (num_stages, num_chunks)."""
+        order = self._layer_order()
+        if order is None:
+            return blocks
+        inverse = np.argsort(order)
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.take(leaf, inverse, axis=0), blocks
+        )
+
+    def blocks_from_portable(self, blocks):
+        """Inverse of blocks_to_portable."""
+        order = self._layer_order()
+        if order is None:
+            return blocks
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.take(leaf, np.asarray(order), axis=0),
+            blocks,
+        )
 
     def apply(self, variables, tokens, training=False, rngs=None):
         del rngs
@@ -126,7 +205,7 @@ class PipelinedTransformerLM:
 
         if self.mesh is None:
             # Single-chip sequential fallback: scan over the flat stack.
-            x = stage_fn(params["blocks"], x)
+            x = stage_fn(params[self.blocks_key], x)
         else:
             # Regroup (L, ...) -> (S*V, L/(S*V), ...) for the schedule.
             # The leading dim is pp-sharded, so the reshape splits along
@@ -137,7 +216,7 @@ class PipelinedTransformerLM:
                 lambda leaf: leaf.reshape(
                     (chunks, per_chunk) + leaf.shape[1:]
                 ),
-                params["blocks"],
+                params[self.blocks_key],
             )
             x = pipeline_apply(
                 stage_fn,
@@ -146,6 +225,9 @@ class PipelinedTransformerLM:
                 num_microbatches=self.num_microbatches,
                 mesh=self.mesh,
                 num_chunks=self.num_chunks,
+                params_layout=(
+                    "device" if self.device_major_params else "chunk"
+                ),
             )
         x = self._ln_f.apply({"params": params["ln_f"]}, x)
         return self._head.apply({"params": params["lm_head"]}, x)
@@ -163,7 +245,7 @@ def pipeline_sharding_rules():
     """
     return ShardingRules(
         rules=[
-            (r"^blocks/", P("pp")),
+            (r"^blocks(_device_major)?/", P("pp")),
             (r"wte/embedding$", P(None, "fsdp")),
             (r"lm_head/kernel$", P("fsdp", None)),
             (r".*", P()),

@@ -106,6 +106,7 @@ def pipeline_apply(
     schedule="1f1b",
     num_chunks=1,
     param_specs=None,
+    params_layout="chunk",
 ):
     """Run ``x`` through a stack of pipeline stages.
 
@@ -130,13 +131,20 @@ def pipeline_apply(
         backward; the flag is ignored there.
       num_chunks: interleaved virtual chunks per device (V). V > 1
         requires ``num_microbatches <= num_stages`` (the conflict-free
-        window of the interleaved schedule) and schedule="1f1b". Cost
-        note: the chunk stack arrives chunk-major (chunk c at row c,
-        the checkpoint-stable layout) but devices need it device-major,
-        so V > 1 pays a cross-shard permutation of the stage stack per
-        step (fwd, bwd in, bwd out); storing device-major at rest would
-        remove it at the price of a topology-dependent checkpoint
-        layout.
+        window of the interleaved schedule) and schedule="1f1b".
+      params_layout: how the stacked chunk axis is ordered. "chunk"
+        (default): chunk c at row c — the topology-portable layout a
+        checkpoint wants — but devices need rows device-major, so V > 1
+        pays a cross-shard permutation of the whole stage stack per
+        step (fwd params, bwd params, bwd param-cotangents: ~3x the
+        stage-stack bytes over ICI every step). "device": the caller
+        stores the stack device-major at rest (row d*V + v holds chunk
+        v*S + d — ``device_major_order``); the permutes vanish and
+        parameter cotangents return device-major to match. Checkpoints
+        of device-major state are pinned to (S, V) — convert with
+        ``chunk major <-> device major`` helpers at
+        save/restore-for-a-different-topology boundaries
+        (models/pipeline_transformer.py wires this).
       param_specs: optional pytree of PartitionSpecs for
         ``stacked_params`` (default ``P(axis)`` on the leading dim);
         use to shard stage-parameter dims over ``tp`` for
@@ -145,6 +153,11 @@ def pipeline_apply(
     Returns the stacked stages' output with the same shape/sharding as
     ``x`` would have after all chunks' sequential application.
     """
+    if params_layout not in ("chunk", "device"):
+        raise ValueError(
+            "params_layout must be 'chunk' or 'device', got %r"
+            % (params_layout,)
+        )
     num_stages = mesh.shape[axis]
     stage_axis_sizes = {
         leaf.shape[0] for leaf in jax.tree_util.tree_leaves(stacked_params)
@@ -184,6 +197,12 @@ def pipeline_apply(
             lambda _: pipeline_spec(), stacked_params
         )
     if schedule == "gpipe":
+        if params_layout != "chunk":
+            raise ValueError(
+                "params_layout='device' requires schedule='1f1b' "
+                "(gpipe has no interleaving, so there is nothing to "
+                "save)"
+            )
         return _gpipe_apply(
             stage_fn, stacked_params, x, num_microbatches, mesh, axis,
             spec, param_specs, remat,
@@ -192,7 +211,7 @@ def pipeline_apply(
         raise ValueError("unknown pipeline schedule %r" % schedule)
     return _1f1b_apply(
         stage_fn, stacked_params, x, num_microbatches, mesh, axis,
-        spec, param_specs, num_chunks,
+        spec, param_specs, num_chunks, params_layout,
     )
 
 
@@ -288,18 +307,31 @@ def _spec_axes(spec):
             names.append(entry)
     return tuple(names)
 
-def _device_major(stacked, S, V):
-    """Reorder the chunk axis so P("pp") slicing hands device ``d`` its
-    interleaved chunks {d, d+S, ..., d+(V-1)S} as local rows [V].
-
-    Chunk ``c`` lives on device ``c mod S``; shard_map slices the
-    leading dim into contiguous blocks per device, so global row
-    ``d*V + v`` must hold chunk ``v*S + d``."""
-    if V == 1:
-        return stacked
+def device_major_order(S, V):
+    """Chunk-axis permutation putting row ``d*V + v`` = chunk
+    ``v*S + d`` — the order P("pp") slicing needs so device ``d`` gets
+    its interleaved chunks {d, d+S, ..., d+(V-1)S} as local rows."""
     import numpy as _np
 
-    order = _np.arange(S * V).reshape(V, S).T.reshape(-1)
+    return _np.arange(S * V).reshape(V, S).T.reshape(-1)
+
+
+def chunk_major_order(S, V):
+    """Inverse of :func:`device_major_order`."""
+    import numpy as _np
+
+    return _np.arange(S * V).reshape(S, V).T.reshape(-1)
+
+
+def _device_major(stacked, S, V):
+    """Reorder the chunk axis so P("pp") slicing hands device ``d`` its
+    interleaved chunks as local rows [V] (see device_major_order).
+    A cross-shard gather of the whole stage stack when traced on a
+    pp-sharded array — the per-step cost params_layout="device"
+    removes."""
+    if V == 1:
+        return stacked
+    order = device_major_order(S, V)
     return jax.tree_util.tree_map(
         lambda leaf: jnp.take(leaf, order, axis=0), stacked
     )
@@ -309,16 +341,14 @@ def _chunk_major(stacked, S, V):
     """Inverse of :func:`_device_major` (for parameter cotangents)."""
     if V == 1:
         return stacked
-    import numpy as _np
-
-    order = _np.arange(S * V).reshape(S, V).T.reshape(-1)
+    order = chunk_major_order(S, V)
     return jax.tree_util.tree_map(
         lambda leaf: jnp.take(leaf, order, axis=0), stacked
     )
 
 
 def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
-                param_specs, V):
+                param_specs, V, params_layout="chunk"):
     """Explicit forward/backward pipeline schedule.
 
     Chunk c (0..S*V-1) lives on device ``c mod S`` as its local chunk
@@ -517,13 +547,25 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
         )
         return dparams, dx
 
+    # params_layout="device": the caller's stack is already device-
+    # major at rest, so the three per-step cross-shard permutations
+    # (fwd params, bwd params, bwd cotangents) are identity.
+    to_device = (
+        (lambda p: p) if params_layout == "device"
+        else (lambda p: _device_major(p, S, V))
+    )
+    to_rest = (
+        (lambda p: p) if params_layout == "device"
+        else (lambda p: _chunk_major(p, S, V))
+    )
+
     def _sharded_fwd(params, x):
         return jax.shard_map(
             fwd_local,
             mesh=mesh,
             in_specs=(param_specs, spec),
             out_specs=(spec, saved_spec),
-        )(_device_major(params, S, V), x)
+        )(to_device(params), x)
 
     @jax.custom_vjp
     def run(params, x):
@@ -544,11 +586,11 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
             mesh=mesh,
             in_specs=(param_specs, saved_spec, spec),
             out_specs=(partial_specs, spec),
-        )(_device_major(params, S, V), saved, g)
+        )(to_device(params), saved, g)
         dparams = jax.tree_util.tree_map(
             lambda leaf: leaf.sum(axis=0), dparams
         )
-        return _chunk_major(dparams, S, V), dx
+        return to_rest(dparams), dx
 
     run.defvjp(run_fwd, run_bwd)
     return run(stacked_params, x)
