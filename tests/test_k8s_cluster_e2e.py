@@ -1,0 +1,204 @@
+"""Real-cluster integration tier (env-gated).
+
+Reference parity: the reference's minikube CI tier submitted a train
+job and validated the pod lifecycle
+(`scripts/travis/run_job.sh:28-51` + `scripts/validate_job_status.py`).
+This is the same pair for this framework, against any live cluster
+(kind / minikube / GKE). It is OFF by default — this image carries no
+cluster or docker daemon — and turns on with:
+
+    K8S_TESTS=True \
+    EDL_K8S_API_URL=http://127.0.0.1:8001 \   # e.g. `kubectl proxy`
+    EDL_TEST_IMAGE=<image with this repo installed> \
+    [EDL_K8S_TOKEN=...] [EDL_K8S_NAMESPACE=default] \
+    python -m pytest tests/test_k8s_cluster_e2e.py -v
+
+The image must contain this package plus a copy of the mnist RecordIO
+data at /data/train (the manifest mounts nothing), and the namespace's
+default ServiceAccount needs pods+services create/watch RBAC — the
+master provisions workers in-cluster (the Role/RoleBinding CI applies
+in .github/workflows/ci.yml, mirroring the reference run_job.sh RBAC
+setup). CI wires this as an optional, non-blocking tier.
+
+The docker zoo-build gate (`edl zoo init/build` against a local
+daemon, reference .travis.yml:77-98) is its own env gate:
+EDL_DOCKER_TESTS=True.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+import uuid
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_K8S_ON = os.environ.get("K8S_TESTS") == "True"
+_DOCKER_ON = os.environ.get("EDL_DOCKER_TESTS") == "True"
+
+
+def _env_api():
+    from elasticdl_tpu.k8s.api import K8sApi
+
+    url = os.environ.get("EDL_K8S_API_URL")
+    if not url:
+        pytest.skip("EDL_K8S_API_URL not set")
+    return K8sApi(
+        base_url=url,
+        token=os.environ.get("EDL_K8S_TOKEN", ""),
+        namespace=os.environ.get("EDL_K8S_NAMESPACE", "default"),
+        verify=not url.startswith("http://"),
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _K8S_ON, reason="K8S_TESTS != True")
+def test_submit_train_job_completes_on_cluster(monkeypatch):
+    """Submit a small mnist train job through the real client path and
+    validate the pod lifecycle the way the reference's
+    validate_job_status.py did: master pod reaches Running, worker pods
+    appear with the job's labels, master reaches Succeeded."""
+    image = os.environ.get("EDL_TEST_IMAGE")
+    if not image:
+        pytest.skip("EDL_TEST_IMAGE not set")
+
+    from elasticdl_tpu.client import api as client_api
+    from elasticdl_tpu.client import main as client_main
+    from elasticdl_tpu.k8s.client import (
+        ELASTICDL_JOB_KEY,
+        ELASTICDL_REPLICA_TYPE_KEY,
+    )
+
+    api = _env_api()
+    monkeypatch.setattr(client_api, "_make_api", lambda parsed: api)
+
+    from elasticdl_tpu.k8s.client import Client
+
+    job_name = "edl-e2e-%s" % uuid.uuid4().hex[:8]
+    master_pod = Client(api, job_name).get_master_pod_name()
+    argv = [
+        "train",
+        "--image_name", image,
+        "--job_name", job_name,
+        "--model_zoo", "elasticdl_tpu.models.mnist",
+        "--training_data", "/data/train",
+        "--num_workers", "2",
+        "--num_epochs", "1",
+        "--records_per_task", "128",
+        "--minibatch_size", "32",
+        "--master_resource_request", "cpu=0.5,memory=1024Mi",
+        "--worker_resource_request", "cpu=0.5,memory=1024Mi",
+    ]
+
+    def phase():
+        try:
+            pod = api.get_pod(master_pod)
+        except Exception:
+            return None
+        return pod.get("status", {}).get("phase")
+
+    def pods_with(selector, want, timeout):
+        deadline = time.time() + timeout
+        seen = set()
+        while time.time() < deadline:
+            for event in api.watch_pods(
+                label_selector=selector, timeout_seconds=10
+            ):
+                obj = event.get("object", {})
+                seen.add(obj.get("metadata", {}).get("name"))
+                if len(seen) >= want:
+                    return seen
+        return seen
+
+    try:
+        client_main.main(argv)
+
+        # master schedules and runs
+        deadline = time.time() + 300
+        while time.time() < deadline and phase() not in (
+            "Running", "Succeeded"
+        ):
+            time.sleep(2)
+        assert phase() in ("Running", "Succeeded"), phase()
+
+        # the master provisions the workers (label-selected, as
+        # validate_job_status.py selected on the job name)
+        selector = "%s=%s,%s=worker" % (
+            ELASTICDL_JOB_KEY, job_name, ELASTICDL_REPLICA_TYPE_KEY,
+        )
+        workers = pods_with(selector, want=2, timeout=300)
+        assert len(workers) >= 2, workers
+
+        # the job drains and the master exits cleanly
+        deadline = time.time() + 600
+        while time.time() < deadline and phase() not in (
+            "Succeeded", "Failed"
+        ):
+            time.sleep(5)
+        assert phase() == "Succeeded", phase()
+    finally:
+        # delete the master AND any worker pods it provisioned (on a
+        # shared cluster leaked uuid-named workers accumulate)
+        leftovers = {master_pod}
+        try:
+            for event in api.watch_pods(
+                label_selector="%s=%s" % (ELASTICDL_JOB_KEY, job_name),
+                timeout_seconds=5,
+            ):
+                name = (
+                    event.get("object", {}).get("metadata", {}).get("name")
+                )
+                if name:
+                    leftovers.add(name)
+        except Exception:
+            pass
+        for name in leftovers:
+            try:
+                api.delete_pod(name)
+            except Exception:
+                pass
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _DOCKER_ON, reason="EDL_DOCKER_TESTS != True")
+def test_zoo_init_build_against_local_daemon(tmp_path):
+    """`edl zoo init` + `edl zoo build` really build an image
+    (reference .travis.yml:77-98 built and pushed the zoo image)."""
+    if shutil.which("docker") is None:
+        pytest.skip("no docker CLI")
+    zoo_dir = str(tmp_path / "zoo")
+    os.makedirs(zoo_dir)
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def run(argv, cwd):
+        return subprocess.run(
+            [sys.executable, "-m", "elasticdl_tpu.client.main"] + argv,
+            env=env, cwd=cwd, capture_output=True, text=True,
+            timeout=600,
+        )
+
+    # zoo init writes ./Dockerfile into the zoo directory
+    out = run(["zoo", "init"], cwd=zoo_dir)
+    assert out.returncode == 0, out.stderr
+    dockerfile = os.path.join(zoo_dir, "Dockerfile")
+    assert os.path.exists(dockerfile)
+    # the rendered template pip-installs the framework package, which
+    # is not on public PyPI in CI — what this gate exercises is the
+    # docker build invocation path, so swap in an installable package
+    content = open(dockerfile).read()
+    content = content.replace(
+        "pip install elasticdl_tpu", "pip install numpy"
+    )
+    with open(dockerfile, "w") as f:
+        f.write(content)
+    tag = "elasticdl-tpu-zoo-test:%s" % uuid.uuid4().hex[:8]
+    out = run(["zoo", "build", "--image", tag, zoo_dir], cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    images = subprocess.run(
+        ["docker", "images", "-q", tag], capture_output=True, text=True
+    )
+    assert images.stdout.strip(), "built image not found in daemon"
+    subprocess.run(["docker", "rmi", tag], capture_output=True)
