@@ -37,14 +37,16 @@ from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
 from elasticdl_tpu.train.optimizers import create_optimizer
 
 
-def rotary_embedding(x, base=10000.0):
-    """Apply RoPE over (batch, heads, seq, head_dim)."""
-    _, _, seq, dim = x.shape
+def rotary_embedding(x, base=10000.0, seq_axis=2):
+    """Apply RoPE; seq_axis=2 for (B, H, S, d), 1 for (B, S, H, d)."""
+    seq, dim = x.shape[seq_axis], x.shape[-1]
     half = dim // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, None]
-    sin = jnp.sin(angles)[None, None]
+    shape = [1] * x.ndim
+    shape[seq_axis], shape[-1] = seq, half
+    cos = jnp.cos(angles).reshape(shape)
+    sin = jnp.sin(angles).reshape(shape)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
@@ -67,7 +69,13 @@ class Attention(nn.Module):
             use_bias=False,
             name=name,
         )
-        # (B, S, H, d) -> (B, H, S, d)
+        # (B, S, H, d) -> (B, H, S, d). A transpose-free path exists
+        # (dot_product_attention(layout="bshd") — the flash kernel can
+        # address heads as lane-aligned blocks of the fused minor dim)
+        # but measured net-NEGATIVE on v5e (+1.4% device time at the
+        # best-MFU config): XLA's transposes already run near the HBM
+        # roofline, and removing them shifts cost into strided kernel
+        # DMA and worse qkv-matmul layouts. docs/PERF_TRANSFORMER.md.
         to_bhsd = lambda t: t.transpose(0, 2, 1, 3)
         q = to_bhsd(dense("query")(x))
         k = to_bhsd(dense("key")(x))

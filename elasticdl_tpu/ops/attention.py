@@ -17,24 +17,39 @@ import jax.numpy as jnp
 from elasticdl_tpu.ops import flash_attention as _flash
 
 
-def xla_attention(q, k, v, causal=False, sm_scale=None):
-    """Reference O(S^2) attention over (batch, heads, seq, dim)."""
+def _check_layout(layout):
+    if layout not in ("bhsd", "bshd"):
+        raise ValueError("layout must be 'bhsd' or 'bshd', got %r"
+                         % (layout,))
+
+
+def xla_attention(q, k, v, causal=False, sm_scale=None, layout="bhsd"):
+    """Reference O(S^2) attention ((batch, heads, seq, dim) or, with
+    layout="bshd", (batch, seq, heads, dim) — no transposes either
+    way, einsum handles both)."""
+    _check_layout(layout)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * sm_scale
+    qk, pv = (
+        ("bhqd,bhkd->bhqk", "bhqk,bhkd->bhqd")
+        if layout == "bhsd"
+        else ("bqhd,bkhd->bhqk", "bhqk,bkhd->bqhd")
+    )
+    s = jnp.einsum(qk, q, k, preferred_element_type=jnp.float32) * sm_scale
     if causal:
         seq_q, seq_k = s.shape[-2], s.shape[-1]
         q_pos = jnp.arange(seq_q)[:, None]
         k_pos = jnp.arange(seq_k)[None, :]
         s = jnp.where(q_pos >= k_pos, s, _flash.NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return jnp.einsum(pv, p, v)
 
 
-def _pallas_ok(q, k, block_q, block_k):
-    seq_q, seq_k = q.shape[2], k.shape[2]
+def _pallas_ok(q, k, block_q, block_k, layout):
+    seq_axis = 2 if layout == "bhsd" else 1
+    seq_q, seq_k = q.shape[seq_axis], k.shape[seq_axis]
+    if layout == "bshd" and q.shape[-1] % 128:
+        return False  # fused-head addressing needs lane-aligned heads
     # None = flash_attention's auto-tuner picks the block; ask it what
     # it would pick so this gate can't drift from the tuner's fallback
     if block_q is None:
@@ -59,15 +74,32 @@ def dot_product_attention(
     block_q=None,
     block_k=None,
     interpret=False,
+    layout="bhsd",
 ):
+    _check_layout(layout)
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         impl = (
             "pallas"
-            if on_tpu and _pallas_ok(q, k, block_q, block_k)
+            if on_tpu and _pallas_ok(q, k, block_q, block_k, layout)
             else "xla"
         )
     if impl == "pallas":
+        if layout == "bshd" and q.shape[-1] % 128:
+            # fused-head addressing needs lane-aligned head_dim; honor
+            # the explicit pallas request through a transpose adapter
+            to_bhsd = lambda t: t.transpose(0, 2, 1, 3)
+            out = _flash.flash_attention(
+                to_bhsd(q),
+                to_bhsd(k),
+                to_bhsd(v),
+                causal=causal,
+                sm_scale=sm_scale,
+                block_q=block_q,
+                block_k=block_k,
+                interpret=interpret,
+            )
+            return out.transpose(0, 2, 1, 3)
         return _flash.flash_attention(
             q,
             k,
@@ -77,7 +109,10 @@ def dot_product_attention(
             block_q=block_q,
             block_k=block_k,
             interpret=interpret,
+            layout=layout,
         )
     if impl == "xla":
-        return xla_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return xla_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale, layout=layout
+        )
     raise ValueError("unknown attention impl %r" % (impl,))

@@ -9,8 +9,13 @@ No reference counterpart — the reference's models are CTR/vision Keras
 nets with no attention anywhere (SURVEY.md §5 "long-context: absent");
 this is a new TPU-first capability.
 
-Layout: (batch, heads, seq, head_dim) — "BHSD". Kernels flatten
-batch*heads into one parallel grid axis.
+Layouts: (batch, heads, seq, head_dim) — "BHSD", kernels flatten
+batch*heads into one parallel grid axis — or "bshd"
+(batch, seq, heads, head_dim), where the kernels address each head as
+a lane-aligned d-wide block of the fused (heads*head_dim) minor dim so
+callers skip the BHSD transposes (``flash_attention(layout=...)``;
+measured net-negative for the stock TransformerLM on v5e but available
+for shapes where it wins — docs/PERF_TRANSFORMER.md §6).
 """
 
 import functools
@@ -135,9 +140,40 @@ def _fwd_kernel(
         )
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    bh, seq_q, head_dim = q.shape
-    seq_k = k.shape[1]
+def _q_specs(heads):
+    """(q-ish spec, k-ish spec, lse-ish spec) index maps for the two
+    kernel views.
+
+    - ``heads is None``: the merged "(bh, seq, d)" view — batch*heads
+      flattened into grid axis 0, arrays carry one head each.
+    - ``heads = H``: the fused-BSHD "(B, seq, H*d)" view — grid axis 0
+      is still B*H, but the head selects a d-wide block of the fused
+      minor dim instead of a row of a transposed array. This is what
+      lets the model skip the BHSD transposes entirely: the kernel sees
+      the exact (block, d) tiles either way (d is a lane multiple), so
+      the bodies are shared.
+    """
+    if heads is None:
+        q_idx = lambda b, i, j: (b, i, 0)
+        k_idx = lambda b, i, j: (b, j, 0)
+        stat_idx = lambda b, i, j: (b, 0, i)
+    else:
+        q_idx = lambda g, i, j: (g // heads, i, g % heads)
+        k_idx = lambda g, i, j: (g // heads, j, g % heads)
+        stat_idx = lambda g, i, j: (g, 0, i)
+    return q_idx, k_idx, stat_idx
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+         heads=None):
+    if heads is None:
+        bh, seq_q, head_dim = q.shape
+        seq_k = k.shape[1]
+    else:
+        batch, seq_q, fused = q.shape
+        head_dim = fused // heads
+        seq_k = k.shape[1]
+        bh = batch * heads
     num_q = seq_q // block_q
     num_k = seq_k // block_k
     grid = (bh, num_q, num_k)
@@ -149,6 +185,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         block_q=block_q,
         block_k=block_k,
     )
+    q_idx, k_idx, stat_idx = _q_specs(heads)
     # lse rides in (bh, 1, seq) — the singleton axis makes the block's
     # second-minor dim equal the full array dim, satisfying the TPU
     # (8, 128) tiling rule that a 2-D (1, block_q) block violates
@@ -160,13 +197,13 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, head_dim), q_idx),
+            pl.BlockSpec((1, block_k, head_dim), k_idx),
+            pl.BlockSpec((1, block_k, head_dim), k_idx),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, block_q, head_dim), q_idx),
+            pl.BlockSpec((1, 1, block_q), stat_idx),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, head_dim), jnp.float32),
@@ -331,16 +368,35 @@ def _dkv_kernel(
 
 
 def _bwd(
-    q, k, v, o, lse, do, sm_scale, causal, block_q, block_k, interpret
+    q, k, v, o, lse, do, sm_scale, causal, block_q, block_k, interpret,
+    heads=None,
 ):
-    bh, seq_q, head_dim = q.shape
-    seq_k = k.shape[1]
+    if heads is None:
+        bh, seq_q, head_dim = q.shape
+        seq_k = k.shape[1]
+        delta = jnp.sum(
+            o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+        )[:, None, :]  # (bh, 1, seq): same tiling-friendly layout as lse
+    else:
+        batch, seq_q, fused = q.shape
+        head_dim = fused // heads
+        seq_k = k.shape[1]
+        bh = batch * heads
+        # per-head dot(o, do): (B, S, H) -> (B*H, 1, S)
+        delta = jnp.sum(
+            o.astype(jnp.float32).reshape(batch, seq_q, heads, head_dim)
+            * do.astype(jnp.float32).reshape(
+                batch, seq_q, heads, head_dim
+            ),
+            axis=-1,
+        ).transpose(0, 2, 1).reshape(bh, 1, seq_q)
     num_q = seq_q // block_q
     num_k = seq_k // block_k
+    q_idx, k_idx, stat_idx = _q_specs(heads)
 
-    delta = jnp.sum(
-        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
-    )[:, None, :]  # (bh, 1, seq): same tiling-friendly layout as lse
+    def swapped(idx):
+        # the dkv grid iterates (bh, k-block, q-block)
+        return lambda b, j, i: idx(b, i, j)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -352,16 +408,14 @@ def _bwd(
         ),
         grid=(bh, num_q, num_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, block_q, head_dim), q_idx),
+            pl.BlockSpec((1, block_k, head_dim), k_idx),
+            pl.BlockSpec((1, block_k, head_dim), k_idx),
+            pl.BlockSpec((1, block_q, head_dim), q_idx),
+            pl.BlockSpec((1, 1, block_q), stat_idx),
+            pl.BlockSpec((1, 1, block_q), stat_idx),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, head_dim), lambda b, i, j: (b, i, 0)
-        ),
+        out_specs=pl.BlockSpec((1, block_q, head_dim), q_idx),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         compiler_params=pltpu.CompilerParams(
@@ -380,16 +434,16 @@ def _bwd(
         ),
         grid=(bh, num_k, num_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, head_dim), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, head_dim), swapped(q_idx)),
+            pl.BlockSpec((1, block_k, head_dim), swapped(k_idx)),
+            pl.BlockSpec((1, block_k, head_dim), swapped(k_idx)),
+            pl.BlockSpec((1, block_q, head_dim), swapped(q_idx)),
+            pl.BlockSpec((1, 1, block_q), swapped(stat_idx)),
+            pl.BlockSpec((1, 1, block_q), swapped(stat_idx)),
         ],
         out_specs=(
-            pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head_dim), swapped(k_idx)),
+            pl.BlockSpec((1, block_k, head_dim), swapped(k_idx)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_k, head_dim), jnp.float32),
@@ -424,21 +478,23 @@ def _bwd(
 # ``_attach``'s own primal is a free identity.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _attach(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
-            interpret):
+            interpret, heads):
     return o
 
 
 def _attach_fwd(q, k, v, o, lse, sm_scale, causal, block_q, block_k,
-                interpret):
+                interpret, heads):
     return o, (q, k, v, o, lse)
 
 
-def _attach_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+def _attach_bwd(sm_scale, causal, block_q, block_k, interpret, heads,
+                res, do):
     q, k, v, o, lse = res
     dq, dk, dv = _bwd(
-        q, k, v, o, lse, do, sm_scale, causal, block_q, block_k, interpret
+        q, k, v, o, lse, do, sm_scale, causal, block_q, block_k,
+        interpret, heads,
     )
     # o/lse arrive behind stop_gradient; their cotangents are discarded.
     return dq, dk, dv, jnp.zeros_like(o), jnp.zeros_like(lse)
@@ -447,7 +503,8 @@ def _attach_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
 _attach.defvjp(_attach_fwd, _attach_bwd)
 
 
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+           heads=None):
     # stop_gradient on the kernel inputs keeps AD linearization out of
     # the forward pallas_call (it has no JVP rule and needs none — all
     # gradients flow through _attach's bwd kernels).
@@ -460,6 +517,7 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         block_q,
         block_k,
         interpret,
+        heads,
     )
     o = checkpoint_name(o, FLASH_OUT_NAME)
     lse = checkpoint_name(lse, FLASH_LSE_NAME)
@@ -474,6 +532,7 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         block_q,
         block_k,
         interpret,
+        heads,
     )
 
 
@@ -486,13 +545,24 @@ def flash_attention(
     block_q=None,
     block_k=None,
     interpret=False,
+    layout="bhsd",
 ):
-    """Blockwise attention over (batch, heads, seq, head_dim) inputs.
+    """Blockwise attention.
+
+    layout selects the input/output convention:
+    - "bhsd" (default): (batch, heads, seq, head_dim).
+    - "bshd": (batch, seq, heads, head_dim) — the layout qkv
+      projections naturally produce. The kernel addresses each head as
+      a d-wide block of the fused trailing (heads*head_dim) dim, so NO
+      transpose is ever materialized; measured ~3% of transformer step
+      time on v5e was BHSD<->BSHD "data formatting"
+      (docs/PERF_TRANSFORMER.md). Requires head_dim to be a multiple of
+      128 lanes (the auto dispatcher checks).
 
     Sequence lengths must be multiples of the block sizes (the auto
     dispatcher in ops/attention.py falls back to the XLA impl when they
     are not); head_dim should be a multiple of 128 lanes for best MXU
-    utilisation but any size compiles.
+    utilisation but any size compiles in the "bhsd" layout.
 
     block_q/block_k default to the largest power-of-two blocks (up to
     512/1024) dividing the sequence: measured on v5e at S=16k, (512,
@@ -500,9 +570,21 @@ def flash_attention(
     the online-softmax rescale and keep the MXU fed.
     """
     if q.ndim != 4:
-        raise ValueError("expected (batch, heads, seq, head_dim)")
-    batch, heads, seq_q, head_dim = q.shape
-    seq_k = k.shape[2]
+        raise ValueError("expected 4-D q/k/v")
+    if layout == "bhsd":
+        batch, heads, seq_q, head_dim = q.shape
+        seq_k = k.shape[2]
+    elif layout == "bshd":
+        batch, seq_q, heads, head_dim = q.shape
+        seq_k = k.shape[1]
+        if head_dim % 128:
+            raise ValueError(
+                "layout='bshd' needs head_dim %% 128 == 0 (got %d): "
+                "the head is addressed as a lane-aligned block of the "
+                "fused minor dim" % head_dim
+            )
+    else:
+        raise ValueError("layout must be 'bhsd' or 'bshd'")
     if block_q is None:
         block_q = _auto_block(seq_q, 512)
     if block_k is None:
@@ -516,6 +598,20 @@ def flash_attention(
         )
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
+    if layout == "bshd":
+        fuse = lambda t: t.reshape(batch, t.shape[1], heads * head_dim)
+        o = _flash(
+            fuse(q),
+            fuse(k),
+            fuse(v),
+            sm_scale,
+            causal,
+            block_q,
+            block_k,
+            interpret,
+            heads,
+        )
+        return o.reshape(batch, seq_q, heads, head_dim)
     merge = lambda t: t.reshape(batch * heads, t.shape[2], head_dim)
     o = _flash(
         merge(q),
