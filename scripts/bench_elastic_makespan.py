@@ -1,0 +1,251 @@
+"""Elastic co-scheduling makespan experiment (reference §B parity).
+
+Reference result (BASELINE.md §B, report_cn.md:66-88, data/1c,1s.csv):
+two training jobs on a fixed-size cluster — gang scheduling makes job 2
+wait for job 1's resources (makespan ~795 s); elastic scheduling starts
+job 2 immediately on leftover slots and shrinks job 1 (makespan
+~580 s, job-2 wait ~0).
+
+This reproduces the same scenario with this framework's actual
+runtime: a fixed pool of WORKER SLOTS (default 4), two DeepFM jobs
+(each its own in-process master + task queue + 2 PS OS processes),
+workers as real OS processes occupying slots.
+
+- gang:    job 1 takes all slots; job 2 waits until job 1 completes,
+           then takes all slots.
+- elastic: job 1 starts on all slots; when job 2 arrives (T_ARRIVE
+           seconds in), the scheduler SIGKILLs half of job 1's workers
+           (their in-flight tasks are recovered by the liveness
+           monitor) and starts job 2 on the freed slots; whichever job
+           finishes first hands its slots back to the other.
+
+Prints one JSON line: makespans, job-2 wait, and the elastic speedup.
+CPU backend; runs in ~4-8 min.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+class Job:
+    """One training job: in-process master + PS subprocesses + a set of
+    worker subprocesses this script grows/shrinks."""
+
+    def __init__(self, name, train_dir, tmp, records_per_task=256,
+                 num_epochs=2):
+        from elasticdl_tpu.common.grpc_utils import (
+            build_server, find_free_port,
+        )
+        from elasticdl_tpu.data.readers import RecordIODataReader
+        from elasticdl_tpu.master.servicer import MasterServicer
+        from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+        from elasticdl_tpu.master.task_monitor import TaskMonitor
+        from elasticdl_tpu.proto.services import (
+            add_master_servicer_to_server,
+        )
+        from scripts.convergence_elastic import _spawn_ps, _wait_port
+
+        self.name = name
+        self.tmp = tmp
+        self.train_dir = train_dir
+        reader = RecordIODataReader(data_dir=train_dir)
+        self.dispatcher = TaskDispatcher(
+            training_shards=reader.create_shards(),
+            records_per_task=records_per_task,
+            num_epochs=num_epochs,
+            seed=0,
+        )
+        self.servicer = MasterServicer(self.dispatcher, None)
+        self.monitor = TaskMonitor(
+            self.dispatcher, self.servicer,
+            liveness_timeout_secs=8.0, scan_interval_secs=0.5,
+        )
+        self.server = build_server()
+        add_master_servicer_to_server(self.servicer, self.server)
+        self.master_port = find_free_port()
+        self.server.add_insecure_port("localhost:%d" % self.master_port)
+        self.server.start()
+        self.monitor.start()
+        ports = [find_free_port() for _ in range(2)]
+        self.ps_procs = [
+            _spawn_ps(i, 2, p, 0.01) for i, p in enumerate(ports)
+        ]
+        for p in ports:
+            _wait_port(p)
+        self.ps_addrs = ",".join("localhost:%d" % p for p in ports)
+        self.workers = {}
+        self.next_idx = 0
+        self.started = time.time()
+        self.finished_at = None
+
+    def spawn_worker(self):
+        from scripts.convergence_elastic import _spawn_worker
+
+        idx = self.next_idx
+        self.next_idx += 1
+        self.workers[idx] = _spawn_worker(
+            idx, self.master_port, self.ps_addrs, self.train_dir,
+            os.path.join(self.tmp, "%s_w%d.log" % (self.name, idx)),
+        )
+
+    def kill_worker(self):
+        live = sorted(
+            i for i, p in self.workers.items() if p.poll() is None
+        )
+        if not live:
+            return  # job already drained; nothing to yield
+        self.workers[live[0]].send_signal(signal.SIGKILL)
+        del self.workers[live[0]]
+
+    def live_workers(self):
+        return sum(1 for p in self.workers.values() if p.poll() is None)
+
+    def finished(self):
+        if self.dispatcher.finished():
+            if self.finished_at is None:
+                self.finished_at = time.time()
+            return True
+        return False
+
+    def shutdown(self):
+        for p in self.workers.values():
+            if p.poll() is None:
+                p.kill()
+        for p in self.ps_procs:
+            p.terminate()
+        for p in self.ps_procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        self.monitor.stop()
+        self.server.stop(0)
+
+
+def run_gang(train1, train2, tmp, slots, **job_kw):
+    """Job 2 waits for all of job 1's slots."""
+    t0 = time.time()
+    job1 = Job("gang1", train1, tmp, **job_kw)
+    for _ in range(slots):
+        job1.spawn_worker()
+    job2_arrives = t0 + 10.0
+    try:
+        while not job1.finished():
+            time.sleep(0.5)
+        t1_done = time.time()
+        job2 = Job("gang2", train2, tmp, **job_kw)
+        job2_start = time.time()
+        for _ in range(slots):
+            job2.spawn_worker()
+        try:
+            while not job2.finished():
+                time.sleep(0.5)
+        finally:
+            job2.shutdown()
+        end = time.time()
+        return {
+            "makespan_s": round(end - t0, 1),
+            "job1_s": round(t1_done - t0, 1),
+            "job2_wait_s": round(job2_start - job2_arrives, 1),
+        }
+    finally:
+        job1.shutdown()
+
+
+def run_elastic(train1, train2, tmp, slots, **job_kw):
+    """Job 2 starts the moment it arrives; job 1 shrinks to make room,
+    then regrows when a job completes."""
+    t0 = time.time()
+    job1 = Job("el1", train1, tmp, **job_kw)
+    for _ in range(slots):
+        job1.spawn_worker()
+    job2 = None
+    job2_arrives = t0 + 10.0
+    half = slots // 2
+    try:
+        while True:
+            now = time.time()
+            if job2 is None and now >= job2_arrives:
+                for _ in range(half):
+                    job1.kill_worker()
+                job2 = Job("el2", train2, tmp, **job_kw)
+                job2_start = time.time()
+                for _ in range(half):
+                    job2.spawn_worker()
+            done1 = job1.finished()
+            done2 = job2.finished() if job2 is not None else False
+            if done1 and job2 is not None and not done2:
+                # return job 1's slots to job 2
+                while job2.live_workers() < slots:
+                    job2.spawn_worker()
+            if done2 and not done1:
+                while job1.live_workers() < slots:
+                    job1.spawn_worker()
+            if done1 and done2:
+                break
+            time.sleep(0.5)
+        end = time.time()
+        return {
+            "makespan_s": round(end - t0, 1),
+            "job1_s": round(job1.finished_at - t0, 1),
+            "job2_wait_s": round(job2_start - job2_arrives, 1),
+        }
+    finally:
+        job1.shutdown()
+        if job2 is not None:
+            job2.shutdown()
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--records", type=int, default=4096)
+    parser.add_argument("--records_per_task", type=int, default=256)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    args = parser.parse_args()
+
+    from tests.test_utils import create_ctr_recordio
+
+    tmp = tempfile.mkdtemp(prefix="edl_makespan_")
+    dirs = []
+    for i in (1, 2):
+        d = os.path.join(tmp, "train%d" % i)
+        os.makedirs(d)
+        create_ctr_recordio(
+            os.path.join(d, "f0.rec"), num_records=args.records, seed=i
+        )
+        dirs.append(d)
+
+    job_kw = dict(
+        records_per_task=args.records_per_task,
+        num_epochs=args.num_epochs,
+    )
+    gang = run_gang(dirs[0], dirs[1], tmp, args.slots, **job_kw)
+    print("[gang]    %s" % gang, flush=True)
+    elastic = run_elastic(dirs[0], dirs[1], tmp, args.slots, **job_kw)
+    print("[elastic] %s" % elastic, flush=True)
+
+    print(json.dumps({
+        "slots": args.slots,
+        "gang": gang,
+        "elastic": elastic,
+        "makespan_speedup": round(
+            gang["makespan_s"] / elastic["makespan_s"], 2
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()
