@@ -521,6 +521,20 @@ class SparseTrainer:
         from elasticdl_tpu.common.timing_utils import Timing
 
         self.timing = Timing()
+        # copy_to_host_async HANGS on the experimental axon PJRT plugin
+        # (measured: the call itself never returns); every other
+        # backend (cpu, real tpu, gpu) supports it. Gate on the
+        # configured platform list, not device.platform — the plugin
+        # reports its devices as plain "tpu".
+        import importlib.util
+
+        platforms = str(getattr(jax.config, "jax_platforms", "") or "")
+        self._async_host_copy = (
+            "axon" not in platforms
+            # plugin can also auto-register with JAX_PLATFORMS unset;
+            # its presence as an importable package is the tell
+            and importlib.util.find_spec("axon") is None
+        )
 
     def create_state(self, sample_features):
         init_rng, self._rng = jax.random.split(self._rng)
@@ -692,6 +706,16 @@ class SparseTrainer:
             while True:
                 t0 = self.timing.start()
                 state, loss, row_grads = self._train_step(state, prepared)
+                # Start the device->host copy of the row grads NOW:
+                # np.asarray in fold_in_flight would otherwise only
+                # begin the transfer after the lookahead pull returns,
+                # putting fetch and pull in series. The fetch is a long
+                # leg of the step, so overlapping it with the pull
+                # matters at non-zero PS RTT (docs/PERF_SPARSE.md).
+                if self._async_host_copy:
+                    for leaf in jax.tree_util.tree_leaves(row_grads):
+                        if hasattr(leaf, "copy_to_host_async"):
+                            leaf.copy_to_host_async()
                 in_flight = (row_grads, pull_info)
                 # ---- overlap window: device is busy with step N ----
                 # consumer bookkeeping first (its record report unblocks

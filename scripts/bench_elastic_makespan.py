@@ -104,8 +104,12 @@ class Job:
         )
         if not live:
             return  # job already drained; nothing to yield
-        self.workers[live[0]].send_signal(signal.SIGKILL)
-        del self.workers[live[0]]
+        proc = self.workers.pop(live[0])
+        proc.send_signal(signal.SIGKILL)
+        try:
+            proc.wait(timeout=10)  # reap — no zombie for the run's rest
+        except Exception:
+            pass
 
     def live_workers(self):
         return sum(1 for p in self.workers.values() if p.poll() is None)
@@ -143,6 +147,10 @@ def run_gang(train1, train2, tmp, slots, **job_kw):
         while not job1.finished():
             time.sleep(0.5)
         t1_done = time.time()
+        # job 2 cannot start before it arrives, even if job 1 finished
+        # first (a tiny-input run would otherwise report negative wait)
+        while time.time() < job2_arrives:
+            time.sleep(0.2)
         job2 = Job("gang2", train2, tmp, **job_kw)
         job2_start = time.time()
         for _ in range(slots):
@@ -170,6 +178,7 @@ def run_elastic(train1, train2, tmp, slots, **job_kw):
     for _ in range(slots):
         job1.spawn_worker()
     job2 = None
+    handed1 = handed2 = False
     job2_arrives = t0 + 10.0
     half = slots // 2
     try:
@@ -184,13 +193,17 @@ def run_elastic(train1, train2, tmp, slots, **job_kw):
                     job2.spawn_worker()
             done1 = job1.finished()
             done2 = job2.finished() if job2 is not None else False
-            if done1 and job2 is not None and not done2:
-                # return job 1's slots to job 2
-                while job2.live_workers() < slots:
+            # hand slots back ONCE per direction: near job end workers
+            # exit naturally as the queue drains, and re-topping every
+            # poll tick would churn ~12 s-boot processes for nothing
+            if done1 and job2 is not None and not done2 and not handed2:
+                for _ in range(slots - job2.live_workers()):
                     job2.spawn_worker()
-            if done2 and not done1:
-                while job1.live_workers() < slots:
+                handed2 = True
+            if done2 and not done1 and not handed1:
+                for _ in range(slots - job1.live_workers()):
                     job1.spawn_worker()
+                handed1 = True
             if done1 and done2:
                 break
             time.sleep(0.5)
