@@ -1,0 +1,193 @@
+"""AllReduce (lockstep SPMD) worker-scaling curve (reference §A parity).
+
+Reference family (BASELINE.md §A / ftlib_benchmark.md:69-86): CIFAR-10
+CNN throughput scaling 1 -> 8 AllReduce workers on an on-prem CPU
+cluster (cpu=4/mem=8GiB per worker; ResNet50 scaled 4.61x at 8,
+MobileNetV2 1.83x). This measures the same shape with this framework's
+cross-host data plane: N worker OS processes under live
+jax.distributed, the mesh spanning the processes, dp psums riding the
+(loopback) DCN, elastic task queue feeding shards — i.e. the
+multi-host lockstep trainer, not a simulated mesh.
+
+Caveat printed with the result: all N workers share ONE machine's
+cores, so compute contention caps the curve well below a real
+cluster's; the number that transfers is the framework overhead (the
+collective + consensus + task-queue path), not the hardware scaling.
+
+Prints one JSON line with examples/sec per world size.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _spawn_worker(idx, master_port, coordinator_port, train_dir, tmp,
+                  model):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    log = open(os.path.join(tmp, "w%d.log" % idx), "ab")
+    return subprocess.Popen(
+        [sys.executable, "-m", "elasticdl_tpu.worker.main",
+         "--master_addr", "localhost:%d" % master_port,
+         "--worker_id", str(idx),
+         "--model_zoo", model,
+         "--training_data", train_dir,
+         "--minibatch_size", "64",
+         "--multihost", "1",
+         "--coordinator_port", str(coordinator_port),
+         "--worker_host", "localhost:%d" % (62000 + idx)],
+        env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+    )
+
+
+def run_world(n, train_dir, records, model):
+    from elasticdl_tpu.common.grpc_utils import (
+        build_server, find_free_port,
+    )
+    from elasticdl_tpu.data.readers import RecordIODataReader
+    from elasticdl_tpu.master.rendezvous import MeshRendezvous
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.master.task_monitor import TaskMonitor
+    from elasticdl_tpu.proto.services import add_master_servicer_to_server
+
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    tmp = tempfile.mkdtemp(prefix="edl_scale%d_" % n)
+    reader = RecordIODataReader(data_dir=train_dir)
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(),
+        records_per_task=256,
+        num_epochs=1,
+        seed=0,
+    )
+    # (timestamp, cumulative records) at every completed train task —
+    # the steady-state rate is fit over the back half, excluding the
+    # join/restart storm while the world assembles
+    progress = []
+    done_records = [0]
+
+    def on_task_done(task):
+        if task.type == pb.TRAINING:
+            done_records[0] += task.end - task.start
+            progress.append((time.time(), done_records[0]))
+
+    dispatcher.add_task_completed_callback(on_task_done)
+    rendezvous = MeshRendezvous()
+    servicer = MasterServicer(dispatcher, None, rendezvous=rendezvous)
+    monitor = TaskMonitor(
+        dispatcher, servicer, rendezvous=rendezvous,
+        liveness_timeout_secs=30.0, scan_interval_secs=0.5,
+        mesh_restart_grace_secs=25.0,
+    )
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    master_port = find_free_port()
+    server.add_insecure_port("localhost:%d" % master_port)
+    server.start()
+    monitor.start()
+    coordinator_port = find_free_port()
+
+    procs = {}
+    try:
+        for i in range(n):
+            procs[i] = _spawn_worker(
+                i, master_port, coordinator_port, train_dir, tmp, model
+            )
+
+        def supervise():
+            """Pod-manager stand-in: workers exit on every mesh-epoch
+            bump while the world assembles (the elastic re-init
+            contract) and must be relaunched."""
+            for i, proc in list(procs.items()):
+                if proc.poll() is not None:
+                    procs[i] = _spawn_worker(
+                        i, master_port, coordinator_port, train_dir,
+                        tmp, model,
+                    )
+
+        # the steady-state window starts when the full world has joined
+        deadline = time.time() + 600
+        while time.time() < deadline and len(rendezvous.hosts()) < n:
+            supervise()
+            time.sleep(0.2)
+        assert len(rendezvous.hosts()) == n, (
+            "only %d/%d workers joined" % (len(rendezvous.hosts()), n)
+        )
+        joined = time.time()
+        while not dispatcher.finished():
+            if time.time() > deadline:
+                raise TimeoutError("world %d never finished" % n)
+            supervise()
+            time.sleep(0.2)
+        window = time.time() - joined
+        # steady-state rate: records completed between the halfway mark
+        # and the end (the first half absorbs the join/restart storm)
+        half = records // 2
+        steady = [(t, c) for t, c in progress if c >= half]
+        if len(steady) >= 2:
+            (t0, c0), (t1, c1) = steady[0], steady[-1]
+            steady_rate = (c1 - c0) / max(t1 - t0, 1e-6)
+        else:
+            steady_rate = records / window
+        return {
+            "workers": n,
+            "examples_per_sec_steady": round(steady_rate, 1),
+            "examples_per_sec_incl_join": round(records / window, 1),
+            "window_s": round(window, 1),
+        }
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        monitor.stop()
+        server.stop(0)
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worlds", default="1,2,4")
+    parser.add_argument("--records", type=int, default=8192)
+    parser.add_argument(
+        "--model", default="elasticdl_tpu.models.mnist"
+    )
+    args = parser.parse_args()
+
+    from elasticdl_tpu.data.gen.converters import gen_mnist_recordio
+
+    tmp = tempfile.mkdtemp(prefix="edl_scale_data_")
+    train_dir = os.path.join(tmp, "train")
+    gen_mnist_recordio(train_dir, num_records=args.records)
+
+    rows = []
+    for n in [int(w) for w in args.worlds.split(",")]:
+        rows.append(run_world(n, train_dir, args.records, args.model))
+        print("[world %d] %s" % (n, rows[-1]), flush=True)
+    base = rows[0]["examples_per_sec_steady"]
+    for row in rows:
+        row["scaling"] = round(row["examples_per_sec_steady"] / base, 2)
+    print(json.dumps({
+        "model": args.model,
+        "note": "all workers share one machine's cores; framework-"
+                "overhead scaling, not hardware scaling",
+        "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -126,3 +126,38 @@ def test_dense_cold_start_protocol(ps_cluster):
     initialized, version, params = client.pull_dense_init()
     assert initialized and version == 5
     np.testing.assert_array_equal(params["w"], np.ones((2, 2)))
+
+
+def test_delayed_servicer_wraps_and_delays():
+    """ps/server.py _DelayedServicer: every public method sleeps the
+    injected delay then delegates (the latency-experiment knob)."""
+    import time
+
+    from elasticdl_tpu.ps.server import _DelayedServicer
+
+    class Fake:
+        attr = 7
+
+        def pull_embedding_vectors(self, request, context=None):
+            return ("pulled", request)
+
+    wrapped = _DelayedServicer(Fake(), delay_ms=30.0)
+    assert wrapped.attr == 7  # non-callables pass through
+    t0 = time.perf_counter()
+    out = wrapped.pull_embedding_vectors("req")
+    elapsed = time.perf_counter() - t0
+    assert out == ("pulled", "req")
+    assert elapsed >= 0.025, elapsed
+
+
+def test_sparse_capacity_env_override(monkeypatch):
+    from elasticdl_tpu.models import deepfm
+
+    monkeypatch.delenv("EDL_SPARSE_ID_CAPACITY", raising=False)
+    specs = deepfm.sparse_embedding_specs(batch_size=512)
+    assert specs[0].capacity == deepfm.MAX_ID_CAPACITY
+    specs = deepfm.sparse_embedding_specs(batch_size=512, capacity=19968)
+    assert specs[0].capacity == 19968
+    monkeypatch.setenv("EDL_SPARSE_ID_CAPACITY", "4096")
+    specs = deepfm.sparse_embedding_specs(batch_size=512)
+    assert specs[0].capacity == 4096
