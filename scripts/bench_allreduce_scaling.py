@@ -22,6 +22,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -39,7 +40,8 @@ def _spawn_worker(idx, master_port, coordinator_port, train_dir, tmp,
         XLA_FLAGS="--xla_force_host_platform_device_count=1",
     )
     log = open(os.path.join(tmp, "w%d.log" % idx), "ab")
-    return subprocess.Popen(
+    try:
+        return subprocess.Popen(
         [sys.executable, "-m", "elasticdl_tpu.worker.main",
          "--master_addr", "localhost:%d" % master_port,
          "--worker_id", str(idx),
@@ -49,8 +51,10 @@ def _spawn_worker(idx, master_port, coordinator_port, train_dir, tmp,
          "--multihost", "1",
          "--coordinator_port", str(coordinator_port),
          "--worker_host", "localhost:%d" % (62000 + idx)],
-        env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
-    )
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        )
+    finally:
+        log.close()  # Popen dup'd the fd; don't leak one per relaunch
 
 
 def run_world(n, train_dir, records, model):
@@ -80,10 +84,15 @@ def run_world(n, train_dir, records, model):
     progress = []
     done_records = [0]
 
+    progress_lock = threading.Lock()
+
     def on_task_done(task):
+        # completion callbacks run on concurrent gRPC threads outside
+        # the dispatcher lock
         if task.type == pb.TRAINING:
-            done_records[0] += task.end - task.start
-            progress.append((time.time(), done_records[0]))
+            with progress_lock:
+                done_records[0] += task.end - task.start
+                progress.append((time.time(), done_records[0]))
 
     dispatcher.add_task_completed_callback(on_task_done)
     rendezvous = MeshRendezvous()
@@ -108,12 +117,19 @@ def run_world(n, train_dir, records, model):
                 i, master_port, coordinator_port, train_dir, tmp, model
             )
 
+        relaunches = [0]
+
         def supervise():
             """Pod-manager stand-in: workers exit on every mesh-epoch
             bump while the world assembles (the elastic re-init
-            contract) and must be relaunched."""
+            contract) and must be relaunched. Capped: a worker that
+            crash-loops at startup must surface its error, not spin."""
             for i, proc in list(procs.items()):
                 if proc.poll() is not None:
+                    relaunches[0] += 1
+                    assert relaunches[0] < 12 * n, (
+                        "worker restart loop; see logs under %s" % tmp
+                    )
                     procs[i] = _spawn_worker(
                         i, master_port, coordinator_port, train_dir,
                         tmp, model,
@@ -128,7 +144,11 @@ def run_world(n, train_dir, records, model):
             "only %d/%d workers joined" % (len(rendezvous.hosts()), n)
         )
         joined = time.time()
+        with progress_lock:
+            records_at_join = done_records[0]
         while not dispatcher.finished():
+            if dispatcher.job_failed():
+                raise RuntimeError("world %d job failed" % n)
             if time.time() > deadline:
                 raise TimeoutError("world %d never finished" % n)
             supervise()
@@ -146,7 +166,9 @@ def run_world(n, train_dir, records, model):
         return {
             "workers": n,
             "examples_per_sec_steady": round(steady_rate, 1),
-            "examples_per_sec_incl_join": round(records / window, 1),
+            "examples_per_sec_incl_join": round(
+                (records - records_at_join) / window, 1
+            ),
             "window_s": round(window, 1),
         }
     finally:
@@ -178,9 +200,13 @@ def main():
     for n in [int(w) for w in args.worlds.split(",")]:
         rows.append(run_world(n, train_dir, args.records, args.model))
         print("[world %d] %s" % (n, rows[-1]), flush=True)
-    base = rows[0]["examples_per_sec_steady"]
-    for row in rows:
-        row["scaling"] = round(row["examples_per_sec_steady"] / base, 2)
+    base_rows = [r for r in rows if r["workers"] == 1]
+    if base_rows:
+        base = base_rows[0]["examples_per_sec_steady"]
+        for row in rows:
+            row["scaling_vs_1_worker"] = round(
+                row["examples_per_sec_steady"] / base, 2
+            )
     print(json.dumps({
         "model": args.model,
         "note": "all workers share one machine's cores; framework-"
