@@ -191,7 +191,40 @@ def bench_transformer_mfu():
     )
 
 
+def _probe_device(timeout=180):
+    """Touch the accelerator from a THROWAWAY subprocess first: a
+    wedged tunnel/plugin makes jax.devices() hang forever (observed on
+    the axon tunnel after a client was SIGKILLed mid-transfer), and a
+    hang inside this process would lose the whole bench. A subprocess
+    hang is killable; the bench then fails fast with a diagnostic JSON
+    line instead of silently never printing one."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        if out.returncode == 0:
+            return None
+        return "device probe failed: %s" % out.stderr[-300:]
+    except subprocess.TimeoutExpired:
+        return "device probe hung >%ds (wedged tunnel/plugin?)" % timeout
+
+
 def main():
+    probe_error = _probe_device()
+    if probe_error:
+        print(json.dumps({
+            "metric": "resnet50_imagenet_train_throughput_per_chip",
+            "value": 0.0,
+            "unit": "images/sec",
+            "vs_baseline": 0.0,
+            "extra": {"error": probe_error},
+        }))
+        sys.exit(1)
+
     import jax
     import jax.numpy as jnp
 

@@ -155,9 +155,12 @@ def run_world(n, train_dir, records, model):
             time.sleep(0.2)
         window = time.time() - joined
         # steady-state rate: records completed between the halfway mark
-        # and the end (the first half absorbs the join/restart storm)
+        # and the end (the first half absorbs the join/restart storm).
+        # Under the lock: the final task's completion callback may still
+        # be appending on a gRPC thread after finished() flips.
         half = records // 2
-        steady = [(t, c) for t, c in progress if c >= half]
+        with progress_lock:
+            steady = [(t, c) for t, c in progress if c >= half]
         if len(steady) >= 2:
             (t0, c0), (t1, c1) = steady[0], steady[-1]
             steady_rate = (c1 - c0) / max(t1 - t0, 1e-6)

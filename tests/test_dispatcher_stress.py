@@ -14,6 +14,7 @@ correct:
 
 import random
 import threading
+import time
 
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
@@ -28,6 +29,10 @@ def test_concurrent_workers_with_churn_complete_every_record_once():
         num_epochs=epochs,
         seed=0,
     )
+    # make the TRAIN_END_CALLBACK surface real: one worker will receive
+    # the deferred train-end task after the last epoch drains
+    dispatcher.add_deferred_callback_create_train_end_task()
+    train_end_seen = []
     completed = []  # (start, end) per completed task, appended under lock
     completed_lock = threading.Lock()
     stop = threading.Event()
@@ -38,11 +43,13 @@ def test_concurrent_workers_with_churn_complete_every_record_once():
         try:
             while not stop.is_set():
                 task = dispatcher.get(worker_id)
-                if task is None or task.type == pb.WAIT:
+                if task is None:
                     if dispatcher.finished():
                         return
+                    time.sleep(0.001)  # don't starve the task holder
                     continue
                 if task.type == pb.TRAIN_END_CALLBACK:
+                    train_end_seen.append(worker_id)
                     dispatcher.report(task.task_id, True,
                                       worker_id=worker_id)
                     continue
@@ -72,10 +79,14 @@ def test_concurrent_workers_with_churn_complete_every_record_once():
     ]
     for t in threads:
         t.start()
+    wedged = False
     for t in threads:
         t.join(timeout=120)
-        assert not t.is_alive(), "worker thread wedged"
+        wedged = wedged or t.is_alive()
+    stop.set()  # release any spinners BEFORE asserting, or pytest hangs
+    assert not wedged, "worker thread wedged"
     assert not errors, errors
+    assert len(train_end_seen) == 1, train_end_seen
 
     assert dispatcher.finished()
     assert not dispatcher.doing_tasks()
