@@ -2,7 +2,7 @@
 
 The reference's parallelism topology is worker pods x PS pods connected
 by gRPC; its only "mesh" is the Horovod ring. On TPU the topology is a
-``jax.sharding.Mesh`` over ICI-connected chips, with four logical axes:
+``jax.sharding.Mesh`` over ICI-connected chips, with six logical axes:
 
 - ``dp``   — pure data parallelism (params replicated)
 - ``fsdp`` — data parallelism with parameter/optimizer sharding (ZeRO)

@@ -1,0 +1,50 @@
+"""Mesh construction + the --mesh CLI spec parser."""
+
+import pytest
+
+from elasticdl_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    data_parallel_size,
+    parse_mesh_spec,
+)
+
+
+def test_parse_mesh_spec_empty_is_none():
+    assert parse_mesh_spec("") is None
+    assert parse_mesh_spec("  ") is None
+
+
+def test_parse_mesh_spec_axes():
+    config = parse_mesh_spec("dp=2,fsdp=4")
+    assert config.dp == 2 and config.fsdp == 4
+    config = parse_mesh_spec("fsdp=4")
+    assert config.dp == -1  # absorbs the remaining devices
+    config = parse_mesh_spec("pp=2, tp=2")
+    assert config.pp == 2 and config.tp == 2
+
+
+@pytest.mark.parametrize(
+    "bad,match",
+    [
+        ("bogus=2", "unknown mesh axis"),
+        ("fsdp", "integer size"),
+        ("fsdp=", "integer size"),
+        ("dp=2,dp=4", "duplicate"),
+    ],
+)
+def test_parse_mesh_spec_rejects(bad, match):
+    with pytest.raises(ValueError, match=match):
+        parse_mesh_spec(bad)
+
+
+def test_build_mesh_from_parsed_spec():
+    mesh = build_mesh(parse_mesh_spec("fsdp=4"), num_devices=8)
+    assert dict(mesh.shape)["fsdp"] == 4
+    assert dict(mesh.shape)["dp"] == 2  # -1 absorbed 8/4
+    assert data_parallel_size(mesh) == 8  # dp * fsdp
+
+
+def test_build_mesh_rejects_non_divisible():
+    with pytest.raises(ValueError, match="not divisible"):
+        MeshConfig(fsdp=3).resolve(num_devices=8)
