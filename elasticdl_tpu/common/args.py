@@ -48,6 +48,7 @@ def parse_master_args(argv=None):
     parser.add_argument("--num_workers", type=int, default=1)
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--checkpoint_steps", type=int, default=0)
+    parser.add_argument("--async_checkpoint", type=int, default=0)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--checkpoint_dir_for_init", default="")
     # flags the client CLI forwards (client/args.py); consumed when the
@@ -83,6 +84,10 @@ def parse_worker_args(argv=None):
         choices=["training", "evaluation", "prediction"],
     )
     parser.add_argument("--report_version_steps", type=int, default=10)
+    # async dense checkpointing: the save's file writes ride orbax's
+    # background machinery instead of blocking the training loop
+    # (single-process workers only; lockstep multi-host stays sync)
+    parser.add_argument("--async_checkpoint", type=int, default=0)
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)

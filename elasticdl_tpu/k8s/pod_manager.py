@@ -24,6 +24,7 @@ _FORWARDED_WORKER_FLAGS = (
     "compute_dtype",
     "checkpoint_dir",
     "checkpoint_steps",
+    "async_checkpoint",
     "keep_checkpoint_max",
     "checkpoint_dir_for_init",
     "mesh",

@@ -100,6 +100,7 @@ def main(argv=None):
         ps_addrs=args.ps_addrs or None,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_steps=args.checkpoint_steps,
+        async_checkpoint=bool(args.async_checkpoint),
         keep_checkpoint_max=args.keep_checkpoint_max,
         checkpoint_dir_for_init=checkpoint_dir_for_init,
         multihost_runtime=multihost_runtime,

@@ -65,6 +65,7 @@ class Worker:
         checkpoint_dir="",
         checkpoint_steps=0,
         keep_checkpoint_max=3,
+        async_checkpoint=False,
         checkpoint_dir_for_init="",
         multihost_runtime=None,
         resume_optional=False,
@@ -178,8 +179,18 @@ class Worker:
                 DenseCheckpointManager,
             )
 
+            if async_checkpoint and self._lockstep:
+                # orbax async saves are cross-process coordination on
+                # top of cross-process collectives; unproven here —
+                # keep the lockstep path on the measured sync mode
+                logger.warning(
+                    "--async_checkpoint ignored under lockstep "
+                    "multi-host (sync saves only)"
+                )
             self._checkpoint_mgr = DenseCheckpointManager(
-                checkpoint_dir, keep_max=keep_checkpoint_max
+                checkpoint_dir,
+                keep_max=keep_checkpoint_max,
+                async_save=async_checkpoint and not self._lockstep,
             )
         if checkpoint_dir and not checkpoint_steps:
             logger.warning(

@@ -166,3 +166,36 @@ def test_spmd_checkpoint_restores_on_single_chip(tmp_path):
     new_state, loss = single.train_step(restored, batch)
     assert np.isfinite(float(loss))
     assert int(new_state.step) == 2
+
+
+def test_async_save_commits_and_restores(tmp_path):
+    """async_save=True: save returns before the write is durable, the
+    next wait/save joins it, latest_version only reports COMMITTED
+    steps, and restore round-trips exactly."""
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.models import mnist
+    from elasticdl_tpu.train.checkpoint import DenseCheckpointManager
+    from elasticdl_tpu.train.optimizers import create_optimizer
+    from elasticdl_tpu.train.train_state import create_train_state
+
+    model = mnist.custom_model()
+    tx = create_optimizer("Adam", learning_rate=0.01)
+    sample = np.zeros((2, 8, 8), np.float32)
+    state = create_train_state(model, tx, jax.random.PRNGKey(0), sample)
+
+    mgr = DenseCheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    try:
+        mgr.save(1, state)
+        mgr.save(2, state)  # joins save 1 internally
+        mgr.wait_until_finished()
+        assert mgr.latest_version() == 2
+        restored = mgr.restore(template=state)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(state),
+            jax.tree_util.tree_leaves(restored),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        mgr.close()
