@@ -123,6 +123,7 @@ def add_train_arguments(parser):
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--async_checkpoint", type=int, default=0)
+    parser.add_argument("--grad_accum_steps", type=int, default=1)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--checkpoint_dir_for_init", default="")
     parser.add_argument("--output", default="")

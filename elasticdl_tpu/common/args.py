@@ -49,6 +49,7 @@ def parse_master_args(argv=None):
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--async_checkpoint", type=int, default=0)
+    parser.add_argument("--grad_accum_steps", type=int, default=1)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--checkpoint_dir_for_init", default="")
     # flags the client CLI forwards (client/args.py); consumed when the
@@ -88,6 +89,9 @@ def parse_worker_args(argv=None):
     # background machinery instead of blocking the training loop
     # (single-process workers only; lockstep multi-host stays sync)
     parser.add_argument("--async_checkpoint", type=int, default=0)
+    # split each batch into k microbatches with one optimizer update
+    # (exact large-batch semantics, activation memory / k)
+    parser.add_argument("--grad_accum_steps", type=int, default=1)
     parser.add_argument("--checkpoint_dir", default="")
     parser.add_argument("--checkpoint_steps", type=int, default=0)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)

@@ -25,6 +25,7 @@ _FORWARDED_WORKER_FLAGS = (
     "checkpoint_dir",
     "checkpoint_steps",
     "async_checkpoint",
+    "grad_accum_steps",
     "keep_checkpoint_max",
     "checkpoint_dir_for_init",
     "mesh",

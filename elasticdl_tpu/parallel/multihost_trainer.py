@@ -70,6 +70,7 @@ class MultiHostSpmdTrainer(SpmdTrainer):
         mesh_config=None,
         sharding_rules=None,
         batch_spec=None,
+        grad_accum_steps=1,
     ):
         super().__init__(
             model,
@@ -81,6 +82,7 @@ class MultiHostSpmdTrainer(SpmdTrainer):
             mesh_config=mesh_config,
             sharding_rules=sharding_rules,
             batch_spec=batch_spec,
+            grad_accum_steps=grad_accum_steps,
         )
         self._process_count = jax.process_count()
         self._replicated = NamedSharding(self.mesh, P())

@@ -49,6 +49,7 @@ class SpmdTrainer:
         mesh_config: MeshConfig = None,
         sharding_rules: ShardingRules = None,
         batch_spec=None,
+        grad_accum_steps=1,
     ):
         self._model = model
         self._tx = optimizer
@@ -57,7 +58,8 @@ class SpmdTrainer:
         self._rules = sharding_rules
         compute_dtype = resolve_dtype(compute_dtype)
         self._train_step_fn = make_train_step(
-            model, loss_fn, optimizer, compute_dtype
+            model, loss_fn, optimizer, compute_dtype,
+            grad_accum_steps=grad_accum_steps,
         )
         self._eval_step_fn = make_eval_step(model, compute_dtype)
         # batch_spec overrides the default dim-0-over-data-axes layout

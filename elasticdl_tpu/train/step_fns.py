@@ -12,7 +12,6 @@ import jax
 import jax.numpy as jnp
 
 from elasticdl_tpu.data.pipeline import MASK_KEY
-from elasticdl_tpu.train.losses import masked_mean
 from elasticdl_tpu.train.train_state import TrainState, cast_floating
 
 
@@ -36,41 +35,50 @@ def _apply_model(model, params, model_state, features, training, rngs):
     return outputs, model_state
 
 
-def make_train_step(model, loss_fn, tx, compute_dtype=None):
-    """Returns train_step(state, batch) -> (new_state, loss)."""
+def make_train_step(model, loss_fn, tx, compute_dtype=None,
+                    grad_accum_steps=1):
+    """Returns train_step(state, batch) -> (new_state, loss).
 
-    def train_step(state: TrainState, batch):
-        features, labels, mask = (
-            batch["features"],
-            batch["labels"],
-            batch[MASK_KEY],
+    ``grad_accum_steps=k`` splits the batch into k equal microbatches
+    scanned sequentially, accumulating MASK-WEIGHTED gradient sums and
+    applying ONE optimizer update — bit-exact large-batch semantics
+    (the masked mean is taken over the whole batch's weight, so ragged
+    masks don't skew toward emptier microbatches) with activation
+    memory divided by k. Mutable model collections (batch stats) see
+    per-microbatch statistics, the standard ghost-BN-style trade."""
+
+    if grad_accum_steps < 1:
+        raise ValueError(
+            "grad_accum_steps must be >= 1, got %r" % (grad_accum_steps,)
         )
-        rngs = {"dropout": jax.random.fold_in(jax.random.PRNGKey(0), state.step)}
 
-        def compute_loss(params):
-            compute_params = params
-            compute_features = features
-            if compute_dtype is not None:
-                compute_params = cast_floating(params, compute_dtype)
-                compute_features = cast_floating(features, compute_dtype)
-            outputs, new_model_state = _apply_model(
-                model,
-                compute_params,
-                state.model_state,
-                compute_features,
-                training=True,
-                rngs=rngs,
-            )
-            per_sample = loss_fn(labels, outputs)
-            return masked_mean(per_sample.astype(jnp.float32), mask), (
-                new_model_state
-            )
+    def _loss_sum(params, model_state, features, labels, mask, rngs):
+        """(masked loss SUM, mask weight, new model state) — summed
+        (not averaged) so microbatch grads add linearly."""
+        compute_params = params
+        compute_features = features
+        if compute_dtype is not None:
+            compute_params = cast_floating(params, compute_dtype)
+            compute_features = cast_floating(features, compute_dtype)
+        outputs, new_model_state = _apply_model(
+            model,
+            compute_params,
+            model_state,
+            compute_features,
+            training=True,
+            rngs=rngs,
+        )
+        per_sample = loss_fn(labels, outputs).astype(jnp.float32)
+        # same row-collapse masked_mean applies (multi-dim per-sample
+        # losses average over their trailing dims first)
+        per_sample = per_sample.reshape(mask.shape[0], -1).mean(axis=1)
+        return jnp.sum(per_sample * mask), (jnp.sum(mask), new_model_state)
 
-        (loss, new_model_state), grads = jax.value_and_grad(
-            compute_loss, has_aux=True
-        )(state.params)
+    def _apply_update(state, grads, loss, new_model_state):
         grads = cast_floating(grads, jnp.float32)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        updates, new_opt_state = tx.update(
+            grads, state.opt_state, state.params
+        )
         new_params = jax.tree_util.tree_map(
             lambda p, u: (p + u).astype(p.dtype), state.params, updates
         )
@@ -82,6 +90,89 @@ def make_train_step(model, loss_fn, tx, compute_dtype=None):
                 opt_state=new_opt_state,
             ),
             loss,
+        )
+
+    def train_step(state: TrainState, batch):
+        features, labels, mask = (
+            batch["features"],
+            batch["labels"],
+            batch[MASK_KEY],
+        )
+        rngs = {
+            "dropout": jax.random.fold_in(
+                jax.random.PRNGKey(0), state.step
+            )
+        }
+
+        if grad_accum_steps == 1:
+            def compute_loss(params):
+                loss_sum, (weight, new_model_state) = _loss_sum(
+                    params, state.model_state, features, labels, mask,
+                    rngs,
+                )
+                return loss_sum / jnp.maximum(weight, 1.0), (
+                    new_model_state
+                )
+
+            (loss, new_model_state), grads = jax.value_and_grad(
+                compute_loss, has_aux=True
+            )(state.params)
+            return _apply_update(state, grads, loss, new_model_state)
+
+        k = int(grad_accum_steps)
+
+        def to_micro(leaf):
+            if leaf.shape[0] % k:
+                raise ValueError(
+                    "batch dim %d not divisible by grad_accum_steps=%d"
+                    % (leaf.shape[0], k)
+                )
+            return leaf.reshape((k, leaf.shape[0] // k) + leaf.shape[1:])
+
+        micro = jax.tree_util.tree_map(
+            to_micro, (features, labels, mask)
+        )
+        grad_fn = jax.value_and_grad(_loss_sum, has_aux=True)
+        zero_grads = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+
+        def body(carry, micro_slice):
+            grads_acc, loss_acc, weight_acc, model_state, i = carry
+            m_features, m_labels, m_mask = micro_slice
+            micro_rngs = {
+                "dropout": jax.random.fold_in(rngs["dropout"], i)
+            }
+            (loss_sum, (weight, model_state)), grads = grad_fn(
+                state.params, model_state, m_features, m_labels, m_mask,
+                micro_rngs,
+            )
+            grads_acc = jax.tree_util.tree_map(
+                lambda a, g: a + cast_floating(g, jnp.float32),
+                grads_acc,
+                grads,
+            )
+            return (
+                grads_acc,
+                loss_acc + loss_sum,
+                weight_acc + weight,
+                model_state,
+                i + 1,
+            ), None
+
+        (grads_sum, loss_sum, weight, new_model_state, _), _ = (
+            jax.lax.scan(
+                body,
+                (zero_grads, 0.0, 0.0, state.model_state, 0),
+                micro,
+            )
+        )
+        weight = jnp.maximum(weight, 1.0)
+        grads = jax.tree_util.tree_map(
+            lambda g: g / weight, grads_sum
+        )
+        return _apply_update(
+            state, grads, loss_sum / weight, new_model_state
         )
 
     return train_step

@@ -92,6 +92,7 @@ def main(argv=None):
         args.model_zoo,
         reader,
         mesh_config=mesh_config,
+        grad_accum_steps=args.grad_accum_steps,
         minibatch_size=args.minibatch_size,
         mode=args.mode,
         compute_dtype=args.compute_dtype or None,

@@ -28,13 +28,17 @@ class JaxTrainer:
         optimizer,
         compute_dtype=None,
         seed=0,
+        grad_accum_steps=1,
     ):
         self._model = model
         self._tx = optimizer
         self._rng = jax.random.PRNGKey(seed)
         compute_dtype = resolve_dtype(compute_dtype)
         self._train_step = jax.jit(
-            make_train_step(model, loss_fn, optimizer, compute_dtype),
+            make_train_step(
+                model, loss_fn, optimizer, compute_dtype,
+                grad_accum_steps=grad_accum_steps,
+            ),
             donate_argnums=(0,),
         )
         self._eval_step = jax.jit(make_eval_step(model, compute_dtype))

@@ -61,6 +61,7 @@ class Worker:
         seed=0,
         trainer_factory=None,
         mesh_config=None,
+        grad_accum_steps=1,
         ps_addrs=None,
         checkpoint_dir="",
         checkpoint_steps=0,
@@ -119,6 +120,14 @@ class Worker:
         # SPMD-capable factories take the model's sharding rules; the
         # single-chip trainer does not.
         factory_params = inspect.signature(factory).parameters
+        if grad_accum_steps > 1:
+            if "grad_accum_steps" in factory_params:
+                trainer_kwargs["grad_accum_steps"] = grad_accum_steps
+            else:
+                logger.warning(
+                    "--grad_accum_steps ignored: trainer %s does not "
+                    "support it", factory.__name__,
+                )
         if "sharding_rules" in factory_params and self.spec.sharding_rules:
             trainer_kwargs["sharding_rules"] = self.spec.sharding_rules()
         if "batch_spec" in factory_params and self.spec.batch_spec:
