@@ -188,8 +188,12 @@ def pipeline_apply(
         if num_microbatches > num_stages:
             raise ValueError(
                 "interleaved schedule needs num_microbatches (%d) <= "
-                "num_stages (%d) — the conflict-free window; raise pp "
-                "or lower M" % (num_microbatches, num_stages)
+                "num_stages (%d) — the conflict-free window; raise pp, "
+                "lower M, or process more microbatches per update via "
+                "the trainer's grad_accum_steps (each accumulation "
+                "slice runs its own M<=S pipeline pass with exact "
+                "large-batch semantics)"
+                % (num_microbatches, num_stages)
             )
     spec = batch_spec if batch_spec is not None else P(DATA_AXES)
     if param_specs is None:
