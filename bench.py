@@ -53,10 +53,11 @@ def deepfm_run(pipelined, inject_rpc_delay_ms=0.0, batch_size=512,
     from elasticdl_tpu.train.sparse import SparseTrainer
     from elasticdl_tpu.worker.ps_client import PSClient
 
-    # criteo-dac shape and tuned id-buffer capacity come from the zoo
-    # module itself (deepfm.sparse_embedding_specs) — the benched model
-    # IS the deployable one. The Zipfian-vs-worst-case buffer story is
-    # documented at deepfm.MAX_ID_CAPACITY / docs/PERF_SPARSE.md.
+    # criteo-dac shape from the zoo module; the bench is the DEPLOYMENT
+    # config, so it opts into the measured Zipfian id-buffer cap
+    # (deepfm.MAX_ID_CAPACITY, +22% steps/s on chip) that the library
+    # default — the always-safe batch*fields worst case — leaves off.
+    # See docs/PERF_SPARSE.md.
     fields, vocab = deepfm.NUM_FIELDS, 1_000_000
     rng = np.random.RandomState(0)
     batches = []
@@ -102,7 +103,11 @@ def deepfm_run(pipelined, inject_rpc_delay_ms=0.0, batch_size=512,
             loss_fn=deepfm.loss,
             optimizer=deepfm.optimizer(),
             specs=deepfm.sparse_embedding_specs(
-                batch_size=batch_size
+                batch_size=batch_size,
+                capacity=min(
+                    batch_size * deepfm.NUM_FIELDS,
+                    deepfm.MAX_ID_CAPACITY,
+                ),
             ),
             ps_client=PSClient(addrs),
             seed=0,
@@ -151,7 +156,17 @@ def bench_deepfm():
     # docs/PERF_SPARSE.md) measured it 1.2x sequential once worker<->PS
     # RTT is a meaningful fraction of step time; on this tunneled box
     # the two modes sit within noise (~230 ms device round trip
-    # dominates), so this costs the headline nothing.
+    # dominates), so this costs the headline nothing. If an environment
+    # ever inverts that (e.g. GIL contention starving the pipeline
+    # threads), say so loudly — the headline would silently under-report
+    # relative to max(modes).
+    if sequential > pipelined * 1.1:
+        print(
+            "bench: WARNING deepfm sequential (%.2f steps/s) beats the "
+            "pipelined headline (%.2f) by >10%% — pipelined-path "
+            "regression?" % (sequential, pipelined),
+            file=sys.stderr,
+        )
     return {
         "deepfm_ctr_steps_per_sec": round(pipelined, 2),
         "deepfm_ctr_examples_per_sec": round(pipelined * batch_size, 1),
@@ -191,13 +206,9 @@ def bench_transformer_mfu():
     )
 
 
-def _probe_device(timeout=180):
-    """Touch the accelerator from a THROWAWAY subprocess first: a
-    wedged tunnel/plugin makes jax.devices() hang forever (observed on
-    the axon tunnel after a client was SIGKILLed mid-transfer), and a
-    hang inside this process would lose the whole bench. A subprocess
-    hang is killable; the bench then fails fast with a diagnostic JSON
-    line instead of silently never printing one."""
+def _probe_once(timeout):
+    """One probe attempt in a THROWAWAY subprocess; returns None on
+    success or (error string, retryable) — only hangs are retryable."""
     import subprocess
 
     try:
@@ -208,9 +219,45 @@ def _probe_device(timeout=180):
         )
         if out.returncode == 0:
             return None
-        return "device probe failed: %s" % out.stderr[-300:]
+        # deterministic failure (bad env, plugin missing): retrying a
+        # doomed probe only delays the diagnostic
+        return ("device probe failed: %s" % out.stderr[-300:], False)
     except subprocess.TimeoutExpired:
-        return "device probe hung >%ds (wedged tunnel/plugin?)" % timeout
+        # hang = the transient-wedge signature; worth a retry
+        return (
+            "device probe hung >%ds (wedged tunnel/plugin?)" % timeout,
+            True,
+        )
+
+
+def _probe_device(timeout=180, retries=2, backoff_secs=45.0):
+    """Touch the accelerator from a THROWAWAY subprocess first: a
+    wedged tunnel/plugin makes jax.devices() hang forever (observed on
+    the axon tunnel after a client was SIGKILLed mid-transfer), and a
+    hang inside this process would lose the whole bench. A subprocess
+    hang is killable.
+
+    A single wedged probe must not cost the whole round's perf evidence
+    (round 3 lost its BENCH artifact exactly this way): transient
+    tunnel wedges have been observed to clear, and each attempt runs in
+    a FRESH subprocess — a fresh PJRT client re-dials the tunnel, which
+    is the only re-init available from this side of the relay. So:
+    bounded retry with backoff between attempts, and only after every
+    attempt fails does the bench fail fast with the diagnostic JSON
+    line (the terminal state is unchanged)."""
+    errors = []
+    for attempt in range(retries + 1):
+        if attempt:
+            time.sleep(backoff_secs)
+        result = _probe_once(timeout)
+        if result is None:
+            return None
+        error, retryable = result
+        errors.append("attempt %d: %s" % (attempt + 1, error))
+        print("bench: %s" % errors[-1], file=sys.stderr)
+        if not retryable:
+            break
+    return "; ".join(errors)
 
 
 def main():

@@ -13,11 +13,14 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.data.example import decode_example
 from elasticdl_tpu.train import metrics
 from elasticdl_tpu.train.losses import sigmoid_binary_cross_entropy
 from elasticdl_tpu.train.optimizers import create_optimizer
 from elasticdl_tpu.train.sparse import SparseEmbeddingSpec, embedding_lookup
+
+_logger = _logger_factory("elasticdl_tpu.models.deepfm")
 
 EMBEDDING_DIM = 8
 
@@ -60,10 +63,13 @@ def optimizer():
 # feature_config.py groups 39 raw columns). The models are field-count
 # agnostic at apply time; this default sizes the id buffers.
 NUM_FIELDS = 39
-# Measured ceiling on the padded unique-id buffer (docs/PERF_SPARSE.md
-# round-2 addendum): CTR id streams are Zipfian, so a batch carries far
-# fewer unique ids than batch*fields — right-sizing this buffer was
-# +22% steps/s on chip. Overflow raises a ValueError naming the knob.
+# Measured ceiling on the padded unique-id buffer for ZIPFIAN id
+# streams (docs/PERF_SPARSE.md round-2 addendum): a CTR batch carries
+# far fewer unique ids than batch*fields, and right-sizing the buffer
+# was +22% steps/s on chip. This is an opt-in deployment tuning (the
+# bench config uses it); the library default below stays the always-
+# safe worst case so near-uniform id streams never hit the capacity
+# ValueError out of the box.
 MAX_ID_CAPACITY = 8192
 
 
@@ -72,19 +78,25 @@ def sparse_embedding_specs(num_features=NUM_FIELDS, batch_size=64,
     """Host-PS tables this model trains against (TPU-contract addition:
     the reference discovers elasticdl.layers.Embedding instances via
     model introspection, model_handler.py:98-102; here the module
-    declares them). The capacity default is the perf-tuned criteo
-    config the bench measures — the zoo module IS the benched one.
-    Near-uniform id streams that overflow it (the clear ValueError at
-    train/sparse.py names this knob) can raise it per-job without a
-    source edit via ``capacity=`` or EDL_SPARSE_ID_CAPACITY (e.g. the
-    always-safe worst case batch*fields)."""
+    declares them). The capacity default is the always-safe worst case
+    ``batch_size * num_features`` — any id stream fits. Zipfian CTR
+    streams should opt into the measured perf cap (+22% steps/s on
+    chip) via ``capacity=min(batch*fields, MAX_ID_CAPACITY)`` or
+    EDL_SPARSE_ID_CAPACITY, as the bench config does; overflow raises
+    a clear ValueError naming the knob (train/sparse.py)."""
     import os
 
     if capacity is None:
         capacity = int(os.environ.get(
-            "EDL_SPARSE_ID_CAPACITY",
-            min(batch_size * num_features, MAX_ID_CAPACITY),
+            "EDL_SPARSE_ID_CAPACITY", batch_size * num_features
         ))
+    if capacity < batch_size * num_features:
+        _logger.info(
+            "deepfm id-buffer capacity %d < worst case %d (batch %d x "
+            "%d fields): fine for Zipfian id streams; a near-uniform "
+            "stream will raise a capacity ValueError naming this knob",
+            capacity, batch_size * num_features, batch_size, num_features,
+        )
     return [
         SparseEmbeddingSpec(
             "deepfm_emb",

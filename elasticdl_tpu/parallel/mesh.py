@@ -39,6 +39,20 @@ class MeshConfig:
     devices: list = field(default_factory=list)
 
     def resolve(self, num_devices=None):
+        # validate sizes HERE (not only in the CLI parser): a
+        # programmatically built MeshConfig(fsdp=0) would otherwise
+        # surface as a bare ZeroDivisionError / numpy reshape error
+        for axis in ("fsdp", "pp", "tp", "sp", "ep"):
+            if getattr(self, axis) < 1:
+                raise ValueError(
+                    "mesh axis %s=%d: sizes must be >= 1"
+                    % (axis, getattr(self, axis))
+                )
+        if self.dp < 1 and self.dp != -1:
+            raise ValueError(
+                "mesh axis dp=%d: must be >= 1, or -1 to absorb the "
+                "remaining devices" % self.dp
+            )
         devices = list(self.devices) or list(jax.devices())
         if num_devices is not None:
             devices = devices[:num_devices]
@@ -83,6 +97,15 @@ def parse_mesh_spec(spec: str) -> "MeshConfig | None":
                 "mesh axis %r needs an integer size, e.g. %s=2 (got %r)"
                 % (name, name, value)
             ) from None
+        # catch bad sizes HERE with the axis name attached: a negative
+        # or zero size would otherwise surface much later as a baffling
+        # numpy reshape / "not divisible" error inside build_mesh
+        # (dp=-1 alone is the documented absorb-the-rest value)
+        if sizes[name] < 1 and not (name == "dp" and sizes[name] == -1):
+            raise ValueError(
+                "mesh axis %s=%d: sizes must be >= 1 (only dp may be -1 "
+                "to absorb the remaining devices)" % (name, sizes[name])
+            )
     return MeshConfig(**sizes)
 
 

@@ -127,7 +127,17 @@ def make_train_step(model, loss_fn, tx, compute_dtype=None,
                     "batch dim %d not divisible by grad_accum_steps=%d"
                     % (leaf.shape[0], k)
                 )
-            return leaf.reshape((k, leaf.shape[0] // k) + leaf.shape[1:])
+            # STRIDED split (microbatch i = rows i::k), not contiguous
+            # blocks: under an SPMD trainer the batch dim is sharded
+            # over the data axes, and a contiguous microbatch would live
+            # on only a subset of devices — GSPMD then reshards the
+            # whole input batch every step. The strided split draws each
+            # microbatch equally from every device's local block, so
+            # splitting stays communication-free. Row-to-microbatch
+            # assignment doesn't change the accumulated sums.
+            return leaf.reshape(
+                (leaf.shape[0] // k, k) + leaf.shape[1:]
+            ).swapaxes(0, 1)
 
         micro = jax.tree_util.tree_map(
             to_micro, (features, labels, mask)

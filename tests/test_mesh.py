@@ -31,11 +31,21 @@ def test_parse_mesh_spec_axes():
         ("fsdp", "integer size"),
         ("fsdp=", "integer size"),
         ("dp=2,dp=4", "duplicate"),
+        # non-positive sizes must fail HERE with the axis named, not
+        # later as a baffling reshape error inside mesh_utils
+        ("fsdp=-1", "sizes must be >= 1"),
+        ("fsdp=0", "sizes must be >= 1"),
+        ("dp=0", "sizes must be >= 1"),
+        ("dp=-2", "sizes must be >= 1"),
     ],
 )
 def test_parse_mesh_spec_rejects(bad, match):
     with pytest.raises(ValueError, match=match):
         parse_mesh_spec(bad)
+
+
+def test_parse_mesh_spec_dp_absorb_allowed():
+    assert parse_mesh_spec("dp=-1,fsdp=2").fsdp == 2
 
 
 def test_build_mesh_from_parsed_spec():

@@ -154,10 +154,15 @@ def test_sparse_capacity_env_override(monkeypatch):
     from elasticdl_tpu.models import deepfm
 
     monkeypatch.delenv("EDL_SPARSE_ID_CAPACITY", raising=False)
+    # library default = the always-safe worst case (any id stream fits);
+    # the measured Zipfian cap is an explicit deployment opt-in
     specs = deepfm.sparse_embedding_specs(batch_size=512)
+    assert specs[0].capacity == 512 * deepfm.NUM_FIELDS
+    specs = deepfm.sparse_embedding_specs(
+        batch_size=512,
+        capacity=min(512 * deepfm.NUM_FIELDS, deepfm.MAX_ID_CAPACITY),
+    )
     assert specs[0].capacity == deepfm.MAX_ID_CAPACITY
-    specs = deepfm.sparse_embedding_specs(batch_size=512, capacity=19968)
-    assert specs[0].capacity == 19968
     monkeypatch.setenv("EDL_SPARSE_ID_CAPACITY", "4096")
     specs = deepfm.sparse_embedding_specs(batch_size=512)
     assert specs[0].capacity == 4096
