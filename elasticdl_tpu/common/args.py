@@ -7,6 +7,7 @@ same way the reference does (args.py:543-565).
 """
 
 import argparse
+import os
 
 
 def _add_common(parser):
@@ -52,6 +53,7 @@ def parse_master_args(argv=None):
     parser.add_argument("--grad_accum_steps", type=int, default=1)
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--checkpoint_dir_for_init", default="")
+    parser.add_argument("--consensus_interval", type=int, default=1)
     # flags the client CLI forwards (client/args.py); consumed when the
     # master provisions pods via the instance manager
     parser.add_argument("--job_name", default="")
@@ -118,6 +120,13 @@ def parse_worker_args(argv=None):
     parser.add_argument("--sparse_pipeline", type=int, default=0)
     parser.add_argument("--sparse_cache_staleness", type=int, default=0)
     parser.add_argument("--sparse_push_interval", type=int, default=1)
+    # lockstep consensus cadence (worker.py _train_batches_lockstep);
+    # EDL_CONSENSUS_INTERVAL overrides for A/B harnesses
+    parser.add_argument(
+        "--consensus_interval",
+        type=int,
+        default=int(os.environ.get("EDL_CONSENSUS_INTERVAL", "1")),
+    )
     return parser.parse_args(argv)
 
 

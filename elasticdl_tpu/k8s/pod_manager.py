@@ -29,6 +29,7 @@ _FORWARDED_WORKER_FLAGS = (
     "keep_checkpoint_max",
     "checkpoint_dir_for_init",
     "mesh",
+    "consensus_interval",
 )
 
 

@@ -53,43 +53,27 @@ from elasticdl_tpu.parallel.spmd_trainer import SpmdTrainer
 logger = _logger_factory("elasticdl_tpu.parallel.multihost_trainer")
 
 
-class MultiHostSpmdTrainer(SpmdTrainer):
-    """SpmdTrainer whose mesh spans every jax process."""
+class LockstepMixin:
+    """The cross-process lockstep runtime shared by the dense
+    (MultiHostSpmdTrainer) and sparse (MultiHostSparseSpmdTrainer,
+    train/sparse_spmd.py) multi-host trainers: the consensus
+    collective, global-array plumbing, and the make_array-aware
+    checkpoint surface. Hosts must call ``_init_lockstep()`` after
+    ``self.mesh`` exists; ``self._state_shardings`` is owned by the
+    concrete trainer."""
 
-    # explicit signature (not *args/**kwargs): the Worker feeds
-    # sharding_rules/batch_spec/mesh_config by inspecting the factory's
-    # parameters (worker.py), which a splat signature would hide
-    def __init__(
-        self,
-        model,
-        loss_fn,
-        optimizer,
-        compute_dtype=None,
-        seed=0,
-        mesh=None,
-        mesh_config=None,
-        sharding_rules=None,
-        batch_spec=None,
-        grad_accum_steps=1,
-    ):
-        super().__init__(
-            model,
-            loss_fn,
-            optimizer,
-            compute_dtype=compute_dtype,
-            seed=seed,
-            mesh=mesh,
-            mesh_config=mesh_config,
-            sharding_rules=sharding_rules,
-            batch_spec=batch_spec,
-            grad_accum_steps=grad_accum_steps,
-        )
+    def _init_lockstep(self):
         self._process_count = jax.process_count()
         self._replicated = NamedSharding(self.mesh, P())
         self._consensus = jax.jit(
-            lambda flags: jnp.sum(flags), out_shardings=self._replicated
+            lambda flags: jnp.sum(flags, axis=0),
+            out_shardings=self._replicated,
         )
         self._consensus_sharding = NamedSharding(self.mesh, P("dp"))
+
+    @property
+    def process_count(self):
+        return self._process_count
 
     # -- global array plumbing -----------------------------------------
     def _put_global(self, tree, shardings):
@@ -105,45 +89,34 @@ class MultiHostSpmdTrainer(SpmdTrainer):
 
         return jax.tree_util.tree_map(put, tree, shardings)
 
-    def create_state(self, sample_features):
-        # The sharded jit init (SpmdTrainer.create_state) runs as one
-        # SPMD program over the process-spanning mesh — no process ever
-        # materializes the full state. Features are zeroed first: a jit
-        # under a multi-process mesh implicitly replicates host
-        # operands, which ASSUMES identical values on every process;
-        # zeros make that true (flax init derives parameter values from
-        # the rng — shared seed — not from the batch).
-        zeros = jax.tree_util.tree_map(
-            lambda leaf: np.zeros_like(np.asarray(leaf)), sample_features
-        )
-        return super().create_state(zeros)
-
-    def shard_batch(self, local_batch):
-        """This process's batch is its shard of the global batch: the
-        global batch dim is process_count * local rows."""
-        return jax.tree_util.tree_map(
-            lambda leaf: jax.make_array_from_process_local_data(
-                self._leaf_sharding(leaf), np.asarray(leaf)
-            ),
-            local_batch,
-        )
-
     # -- lockstep consensus --------------------------------------------
-    def consensus(self, have_data):
-        """Global count of processes that still have real batches; a
-        collective — every process must call it once per loop
-        iteration."""
+    def consensus(self, have_data, stream_ended=False):
+        """Returns (alive, ended): how many processes hold a real batch
+        this round, and how many have PERMANENTLY exhausted their task
+        stream. A collective — every process must call it once per loop
+        iteration. The two bits are distinct because batch acquisition
+        is a non-blocking poll (worker.py _BatchPoller): ``not
+        have_data`` can mean "nothing this round" (master said WAIT),
+        which must not be mistaken for "done" — a worker exiting on a
+        transient all-idle round would strand its peers' next
+        consensus forever."""
         flags = jax.make_array_from_process_local_data(
             self._consensus_sharding,
-            np.full(
-                (jax.local_device_count(),),
-                1.0 if have_data else 0.0,
-                np.float32,
+            np.tile(
+                np.array(
+                    [[1.0 if have_data else 0.0,
+                      1.0 if stream_ended else 0.0]],
+                    np.float32,
+                ),
+                (jax.local_device_count(), 1),
             ),
         )
-        # flags are per-device; normalize to per-process count
-        return int(
-            round(float(self._consensus(flags)) / jax.local_device_count())
+        # flags are per-device; normalize to per-process counts
+        sums = np.asarray(self._consensus(flags))
+        per = jax.local_device_count()
+        return (
+            int(round(float(sums[0]) / per)),
+            int(round(float(sums[1]) / per)),
         )
 
     # -- checkpoint surface (make_array-aware, v2) ---------------------
@@ -215,9 +188,6 @@ class MultiHostSpmdTrainer(SpmdTrainer):
         restored = jax.tree_util.tree_map(np.asarray, restored)
         return self._put_global(restored, self._state_shardings)
 
-    # abstract_state: inherited — the eval_shape skeleton +
-    # infer_state_shardings logic is identical to SpmdTrainer's.
-
     @property
     def restore_shardings(self):
         """Restore directly into the current mesh's global shardings
@@ -227,6 +197,66 @@ class MultiHostSpmdTrainer(SpmdTrainer):
         implicitly because orbax materializes into these shardings,
         not the save-time layout."""
         return self._state_shardings
+
+
+class MultiHostSpmdTrainer(LockstepMixin, SpmdTrainer):
+    """SpmdTrainer whose mesh spans every jax process."""
+
+    # explicit signature (not *args/**kwargs): the Worker feeds
+    # sharding_rules/batch_spec/mesh_config by inspecting the factory's
+    # parameters (worker.py), which a splat signature would hide
+    def __init__(
+        self,
+        model,
+        loss_fn,
+        optimizer,
+        compute_dtype=None,
+        seed=0,
+        mesh=None,
+        mesh_config=None,
+        sharding_rules=None,
+        batch_spec=None,
+        grad_accum_steps=1,
+    ):
+        super().__init__(
+            model,
+            loss_fn,
+            optimizer,
+            compute_dtype=compute_dtype,
+            seed=seed,
+            mesh=mesh,
+            mesh_config=mesh_config,
+            sharding_rules=sharding_rules,
+            batch_spec=batch_spec,
+            grad_accum_steps=grad_accum_steps,
+        )
+        self._init_lockstep()
+
+    def create_state(self, sample_features):
+        # The sharded jit init (SpmdTrainer.create_state) runs as one
+        # SPMD program over the process-spanning mesh — no process ever
+        # materializes the full state. Features are zeroed first: a jit
+        # under a multi-process mesh implicitly replicates host
+        # operands, which ASSUMES identical values on every process;
+        # zeros make that true (flax init derives parameter values from
+        # the rng — shared seed — not from the batch).
+        zeros = jax.tree_util.tree_map(
+            lambda leaf: np.zeros_like(np.asarray(leaf)), sample_features
+        )
+        return super().create_state(zeros)
+
+    def shard_batch(self, local_batch):
+        """This process's batch is its shard of the global batch: the
+        global batch dim is process_count * local rows."""
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.make_array_from_process_local_data(
+                self._leaf_sharding(leaf), np.asarray(leaf)
+            ),
+            local_batch,
+        )
+
+    # abstract_state: inherited — the eval_shape skeleton +
+    # infer_state_shardings logic is identical to SpmdTrainer's.
 
     # -- eval: local compute on the pulled replica ---------------------
     def eval_step(self, state, batch):

@@ -258,6 +258,22 @@ class SparseBatchPreparer:
         if self._cache is not None:
             self._cache.advance()
         features = dict(batch["features"])
+        # Zero-padded batch rows (lockstep padding, SPMD batch-multiple
+        # padding — data/pipeline.pad_batch) must be invisible to the
+        # PS: their ids (all 0) would otherwise join the unique-id set,
+        # creating/pulling a row the real data never asked for. Beyond
+        # waste, that breaks run-to-run comparability: the store's lazy
+        # row init draws from a sequential per-table RNG stream, so an
+        # extra early row creation shifts every later row's init values.
+        # The mask path engages UNCONDITIONALLY whenever the batch has a
+        # mask (even all-ones): under multi-process lockstep every
+        # worker must compile the SAME program, and a dried-up worker's
+        # zero-masked batch growing extra __slotmask features while its
+        # peer's full batch lacks them would deadlock the mesh on
+        # mismatched collectives.
+        batch_mask = None
+        if MASK_KEY in batch:
+            batch_mask = np.asarray(batch[MASK_KEY]) > 0
         pull_info = {}
         consumed = set()
         plans = []
@@ -273,6 +289,14 @@ class SparseBatchPreparer:
                 and spec.mask_feature_key in features
             ):
                 mask = np.asarray(features[spec.mask_feature_key], bool)
+            if batch_mask is not None:
+                rows_real = np.broadcast_to(
+                    batch_mask.reshape(
+                        (-1,) + (1,) * (ids.ndim - 1)
+                    ),
+                    ids.shape,
+                )
+                mask = rows_real if mask is None else (mask & rows_real)
             if mask is not None:
                 unique, inv_real = np.unique(
                     ids[mask], return_inverse=True
@@ -327,7 +351,7 @@ class SparseBatchPreparer:
         return out, pull_info
 
     def push_gradients(self, row_grads, pull_info, model_version=0,
-                       only_shards=None):
+                       only_shards=None, force_empty=False):
         grads_by_table = {}
         for name, (unique, n) in pull_info.items():
             if n == 0:
@@ -339,6 +363,13 @@ class SparseBatchPreparer:
         kwargs = {"model_version": model_version}
         if only_shards is not None:
             kwargs["only_shards"] = only_shards
+        if force_empty:
+            # lockstep: EVERY shard must receive this worker's round —
+            # a shard whose id-mod slice happens to be empty this round
+            # (or a fully-masked batch) still counts toward the sync
+            # PS's grads_to_wait, else that shard's apply cadence
+            # drifts behind its peers' (see PSClient.push_gradients)
+            kwargs["force_empty"] = True
         return _normalize_push_result(
             self._ps.push_gradients(grads_by_table, **kwargs),
             model_version,
@@ -473,6 +504,17 @@ class SparseTrainer:
     # the reference retried a rejected minibatch up to 64 times against
     # the sync PS (worker/worker.py:49,608)
     MAX_PUSH_RETRIES = 64
+    # lockstep trainers set True: fully-masked batches still push (the
+    # sync PS counts pushes, not gradients, toward grads_to_wait)
+    FORCE_EMPTY_PUSH = False
+    # False (lockstep trainers): a version-rejected push is RESENT
+    # as-is with the corrected version instead of re-pulling rows and
+    # recomputing grads. Sound there because every lockstep round pulls
+    # fresh rows — a rejection can only mean the version TAG was stale
+    # (e.g. a relaunched worker's counter), not the gradients. The
+    # recompute would also be a cross-process collective that a
+    # single process must not run alone.
+    RETRY_RECOMPUTES = True
 
     def __init__(
         self,
@@ -499,19 +541,21 @@ class SparseTrainer:
             self._specs, ps_client, cache=cache
         )
         compute_dtype = resolve_dtype(compute_dtype)
-        self._train_step = jax.jit(
+        from elasticdl_tpu.train.step_fns import make_eval_step
+
+        # subclass hook: the SPMD trainers (train/sparse_spmd.py) defer
+        # jitting to the first batch so they can attach mesh shardings
+        self._jit_steps(
             make_sparse_train_step(
                 model, loss_fn, optimizer, self._specs, compute_dtype
             ),
-            donate_argnums=(0,),
+            make_row_grads_fn(model, loss_fn, self._specs, compute_dtype),
+            make_eval_step(model, compute_dtype),
         )
-        self._row_grads = jax.jit(
-            make_row_grads_fn(model, loss_fn, self._specs, compute_dtype)
-        )
-        from elasticdl_tpu.train.step_fns import make_eval_step
-
-        self._eval_step = jax.jit(make_eval_step(model, compute_dtype))
         self._version = 0
+        # observability: total sync-PS version rejections this trainer
+        # has retried through (tests assert the race really raced)
+        self.push_rejections = 0
         # memo of the last prepared batch, so ensure_state followed by
         # eval_step/train_step on the same batch pulls rows once
         self._prep_memo = None
@@ -535,6 +579,19 @@ class SparseTrainer:
             # its presence as an importable package is the tell
             and importlib.util.find_spec("axon") is None
         )
+
+    def _jit_steps(self, train_step_fn, row_grads_fn, eval_step_fn):
+        """Compile the three step callables; single-device default."""
+        self._train_step = jax.jit(train_step_fn, donate_argnums=(0,))
+        self._row_grads = jax.jit(row_grads_fn)
+        self._eval_step = jax.jit(eval_step_fn)
+
+    def _fetch_row_grads(self, row_grads):
+        """Bring the step's row gradients to per-table host-pushable
+        arrays. Single-device (and replicated-SPMD) outputs are plain
+        fully-addressable arrays — pass through; the multi-host trainer
+        overrides this to extract its process's dp shard."""
+        return row_grads
 
     def create_state(self, sample_features):
         init_rng, self._rng = jax.random.split(self._rng)
@@ -567,17 +624,20 @@ class SparseTrainer:
         self._prep_memo = None
         t0 = self.timing.start()
         state, loss, row_grads = self._train_step(state, prepared)
+        row_grads = self._fetch_row_grads(row_grads)
         self.timing.end_record_sync("batch_process", t0, loss)
         with self.timing.timeit("sparse_push"):
             accepted, version, rejected = self.preparer.push_gradients(
-                row_grads, pull_info, model_version=self._version
+                row_grads,
+                pull_info,
+                model_version=self._version,
+                force_empty=self.FORCE_EMPTY_PUSH,
             )
         retries = 0
         while not accepted and retries < self.MAX_PUSH_RETRIES:
-            # sync PS rejected the push as stale: pull fresh rows and
-            # recompute row grads at current params, then push again —
-            # ONLY to the shards that rejected (the others already
-            # applied this minibatch's contribution)
+            # sync PS rejected the push as stale — retry ONLY to the
+            # shards that rejected (the others already buffered this
+            # minibatch's contribution)
             if rejected is None and self.preparer.ps_num > 1:
                 # a multi-shard client MUST report which shards rejected,
                 # or a blanket retry would double-apply on the others
@@ -586,9 +646,17 @@ class SparseTrainer:
                     "reporting rejected_shards; cannot retry safely"
                 )
             self._version = version
-            with self.timing.timeit("sparse_pull"):
-                prepared, pull_info = self.preparer.prepare(batch)
-            row_grads = self._row_grads(state, prepared)
+            if self.RETRY_RECOMPUTES:
+                # pull fresh rows and recompute row grads at current
+                # params (reference worker.py:597-649 re-ran the whole
+                # minibatch; dense params here already updated locally)
+                with self.timing.timeit("sparse_pull"):
+                    prepared, pull_info = self.preparer.prepare(batch)
+                row_grads = self._fetch_row_grads(
+                    self._row_grads(state, prepared)
+                )
+            # else: resend the SAME grads with the corrected version —
+            # see RETRY_RECOMPUTES
             with self.timing.timeit("sparse_push"):
                 accepted, version, rejected = (
                     self.preparer.push_gradients(
@@ -596,12 +664,15 @@ class SparseTrainer:
                         pull_info,
                         model_version=self._version,
                         only_shards=rejected,
+                        force_empty=self.FORCE_EMPTY_PUSH,
                     )
                 )
             retries += 1
+            self.push_rejections += 1
         if not accepted:
             raise RuntimeError(
-                "sync PS rejected gradients %d times in a row"
+                "sync PS rejected gradients %d times in a row; check "
+                "that the PS grads_to_wait matches the worker count"
                 % self.MAX_PUSH_RETRIES
             )
         self._version = version
@@ -688,7 +759,7 @@ class SparseTrainer:
             in_flight = None
             fetched = {
                 name: np.asarray(value)
-                for name, value in row_grads.items()
+                for name, value in self._fetch_row_grads(row_grads).items()
             }
             for name, (unique, n) in flight_info.items():
                 if n == 0:

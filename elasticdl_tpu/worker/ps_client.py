@@ -157,7 +157,7 @@ class PSClient:
         return rows
 
     def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0,
-                       only_shards=None):
+                       only_shards=None, force_empty=False):
         """grads_by_table: {name: (values [n,dim], ids [n])}; dedups then
         scatters per-PS. Returns (accepted, max version, rejected shard
         ids) — a sync-mode PS may reject a stale push (per shard), and a
@@ -168,6 +168,12 @@ class PSClient:
         rate (e.g. a worker-side schedule); 0 means "no scaling".
         ``only_shards``: iterable of shard indices to push to (None =
         all; the retry path passes the previously rejected set).
+        ``force_empty``: send table-less pushes too, to EVERY shard — a
+        lockstep worker must be counted by each shard's sync
+        grads_to_wait round even when its batch is fully masked (task
+        stream ran dry) or its unique ids happened to miss a shard's
+        id-mod slice; otherwise that shard's apply cadence drifts
+        behind its peers' (ps/servicer.py sync mode).
         """
         shard_filter = (
             None if only_shards is None else set(int(s) for s in only_shards)
@@ -197,7 +203,7 @@ class PSClient:
                 )
         futures = []
         for shard, (stub, request) in enumerate(zip(self._stubs, per_ps)):
-            if not request.gradients.embedding_tables:
+            if not request.gradients.embedding_tables and not force_empty:
                 continue
             if shard_filter is not None and shard not in shard_filter:
                 continue

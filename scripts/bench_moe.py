@@ -1,0 +1,216 @@
+"""MoE-transformer single-chip step bench vs dense at matched ACTIVE
+FLOPs (round-4 VERDICT item 5: every capability ships a measured
+number; MoE had correctness only).
+
+Two arms, same embed/attention dims, full train step (fwd+bwd+AdamW)
+under one jit'd lax.scan:
+
+- moe:   MoeTransformerLM, E experts, top-k=2, capacity_factor cf —
+         every token's FFN compute is k*cf x the dense block's
+         (static-capacity GShard dispatch runs every slot, full or
+         not), plus the dispatch/combine einsums (O(S * E*C * M) —
+         the real price of the einsum-dispatch formulation).
+- dense: TransformerLM with mlp_ratio scaled by ~k*cf so its FFN FLOPs
+         match the MoE arm's ACTIVE FFN FLOPs.
+
+Model FLOPs are counted exactly per arm (routing + dispatch included
+for moe), so the reported MFUs are comparable and honest. Prints one
+JSON line with both arms + the relative step-time overhead of the MoE
+machinery at equal active compute.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v4": 275e12, "TPU v5p": 459e12}
+
+
+def dense_flops(d, layers, seq, batch, vocab, mlp_ratio):
+    tokens = batch * seq
+    proj = 2 * tokens * ((4 + 2 * mlp_ratio) * d * d) * layers
+    attn = 2 * (2 * batch * seq * seq * d) * layers / 2
+    head = 2 * tokens * d * vocab
+    return 3 * (proj + attn + head)
+
+
+def moe_flops(d, layers, seq, batch, vocab, mlp_ratio, num_experts, k,
+              capacity_factor):
+    """Exact matmul FLOPs of MoeTransformerLM: MoE FFN in every other
+    block (models/moe_transformer.py), static capacity C per group."""
+    from elasticdl_tpu.ops.moe import expert_capacity
+
+    tokens = batch * seq
+    moe_layers = layers // 2
+    dense_layers = layers - moe_layers
+    capacity = expert_capacity(seq, num_experts, k, capacity_factor)
+    ff = mlp_ratio * d
+    # attention + out-proj + qkv in EVERY block
+    proj_attn = 2 * tokens * (4 * d * d) * layers
+    attn = 2 * (2 * batch * seq * seq * d) * layers / 2
+    # dense-block FFNs
+    ffn_dense = 2 * tokens * (2 * mlp_ratio * d * d) * dense_layers
+    # expert FFNs: every (expert, slot) computes, full or not
+    slots = batch * num_experts * capacity
+    ffn_moe = 2 * slots * (2 * d * ff) * moe_layers
+    # router + dispatch/combine einsums (gsec,gsm->egcm and back)
+    router = 2 * tokens * d * num_experts * moe_layers
+    dispatch = 2 * 2 * batch * seq * num_experts * capacity * d * moe_layers
+    head = 2 * tokens * d * vocab
+    return 3 * (proj_attn + attn + ffn_dense + ffn_moe + router
+                + dispatch + head)
+
+
+def run_arm(model, loss_fn, flops, batch_tokens, args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from elasticdl_tpu.train.optimizers import create_optimizer
+    from elasticdl_tpu.train.step_fns import make_train_step
+    from elasticdl_tpu.train.train_state import create_train_state
+
+    tx = create_optimizer("AdamW", learning_rate=3e-4, weight_decay=0.01)
+    train_step = make_train_step(
+        model, loss_fn, tx, compute_dtype=jnp.bfloat16
+    )
+
+    def run_steps(state, batch, n):
+        def body(state, _):
+            state, loss = train_step(state, batch)
+            return state, loss
+
+        return jax.lax.scan(body, state, None, length=n)
+
+    run = jax.jit(run_steps, static_argnums=(2,), donate_argnums=(0,))
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(
+        rng.randint(0, args.vocab, size=(args.batch, args.seq)), jnp.int32
+    )
+    batch = {
+        "features": tokens,
+        "labels": tokens,
+        "_mask": jnp.ones((args.batch,), jnp.float32),
+    }
+    state = create_train_state(
+        model, tx, jax.random.PRNGKey(0), batch["features"]
+    )
+    n_params = sum(
+        x.size for x in jax.tree_util.tree_leaves(state.params)
+    )
+    state, losses = run(state, batch, args.steps)  # compile + warmup
+    float(losses[-1])
+    start = time.perf_counter()
+    state, losses = run(state, batch, args.steps)
+    final_loss = float(losses[-1])
+    elapsed = time.perf_counter() - start
+    assert np.isfinite(final_loss), final_loss
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind, 197e12)
+    step = elapsed / args.steps
+    return {
+        "params_m": round(n_params / 1e6, 1),
+        "step_ms": round(step * 1e3, 2),
+        "tokens_per_sec": round(batch_tokens / step, 1),
+        "model_tflop_per_step": round(flops / 1e12, 3),
+        "mfu": round(flops / step / peak, 4),
+        "device": kind,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--d", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=8)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--mlp_ratio", type=int, default=4)
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--top_k", type=int, default=2)
+    p.add_argument("--capacity_factor", type=float, default=1.25)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--attn", default="pallas")
+    args = p.parse_args()
+
+    import jax
+
+    # the container's sitecustomize pins the axon platform at
+    # interpreter start; honor an explicit JAX_PLATFORMS (e.g. the CPU
+    # smoke run) through jax.config, which wins over that registration
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from elasticdl_tpu.models import moe_transformer, transformer
+
+    batch_tokens = args.batch * args.seq
+    moe_model = moe_transformer.MoeTransformerLM(
+        vocab_size=args.vocab,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        embed_dim=args.d,
+        mlp_ratio=args.mlp_ratio,
+        num_experts=args.experts,
+        top_k=args.top_k,
+        capacity_factor=args.capacity_factor,
+        attention_impl=args.attn,
+    )
+    moe = run_arm(
+        moe_model,
+        moe_transformer.loss,
+        moe_flops(args.d, args.layers, args.seq, args.batch, args.vocab,
+                  args.mlp_ratio, args.experts, args.top_k,
+                  args.capacity_factor),
+        batch_tokens,
+        args,
+    )
+    # dense arm at matched ACTIVE FFN FLOPs: half the blocks carry
+    # k*cf-times the FFN (the other half already match), i.e. mean
+    # mlp_ratio = r * (1 + k*cf) / 2
+    dense_ratio = max(
+        1, round(args.mlp_ratio * (1 + args.top_k * args.capacity_factor)
+                 / 2)
+    )
+    dense_model = transformer.TransformerLM(
+        vocab_size=args.vocab,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        embed_dim=args.d,
+        mlp_ratio=dense_ratio,
+        attention_impl=args.attn,
+    )
+    dense = run_arm(
+        dense_model,
+        transformer.loss,
+        dense_flops(args.d, args.layers, args.seq, args.batch,
+                    args.vocab, dense_ratio),
+        batch_tokens,
+        args,
+    )
+    print(json.dumps({
+        "config": {
+            "d": args.d, "layers": args.layers, "seq": args.seq,
+            "batch": args.batch, "experts": args.experts,
+            "top_k": args.top_k,
+            "capacity_factor": args.capacity_factor,
+            "moe_mlp_ratio": args.mlp_ratio,
+            "dense_mlp_ratio_matched": dense_ratio,
+            "attn": args.attn,
+        },
+        "moe": moe,
+        "dense_matched_active": dense,
+        "moe_step_overhead_vs_dense": round(
+            moe["step_ms"] / dense["step_ms"], 3
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Test model-zoo module: deepfm + a per-worker dense-state dumper.
+
+The N-worker lockstep sparse test asserts dense params are
+BIT-IDENTICAL across workers at job end (the shared-model property the
+reference bought with per-step get_model RPCs,
+/root/reference/elasticdl/python/worker/worker.py:297-336). Each worker
+snapshots its dense params after every batch (overwriting), so the last
+file per worker reflects its final state; lockstep ends all workers at
+the same version, making the files directly comparable.
+"""
+
+import os
+
+import numpy as np
+
+from elasticdl_tpu.models.deepfm import (  # noqa: F401
+    custom_model,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+    sparse_embedding_specs,
+)
+from elasticdl_tpu.train.callbacks import Callback
+
+
+class DenseDumper(Callback):
+    def on_batch_end(self, step, loss):
+        directory = os.environ.get("EDL_DENSE_DUMP_DIR")
+        if not directory or self.worker is None:
+            return
+        state = self.worker.state
+        if state is None:
+            return
+        trainer = self.worker.trainer
+        if hasattr(trainer, "local_state"):
+            state = trainer.local_state(state)
+        import jax
+
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            state.params
+        )[0]:
+            flat[jax.tree_util.keystr(path)] = np.asarray(leaf)
+        flat["__step"] = np.asarray(int(state.step))
+        out = os.path.join(
+            directory, "worker%s.npz" % self.worker._mc.worker_id
+        )
+        tmp = out + ".tmp.npz"
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, out)
+
+
+def callbacks():
+    return [DenseDumper()]

@@ -1,0 +1,23 @@
+"""Test model-zoo module: mnist + a table-landing prediction processor
+(the reference's ODPS prediction flow, driven by the PREDICTION_ONLY
+job e2e in tests/test_eval_predict_jobs.py)."""
+
+from elasticdl_tpu.data.table_writer import (
+    InMemoryWritableTable,
+    TablePredictionOutputsProcessor,
+)
+from elasticdl_tpu.models.mnist import (  # noqa: F401
+    custom_model,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+
+# module-level sink: the in-process e2e reads it back after the job
+SINK = InMemoryWritableTable()
+
+
+class PredictionOutputsProcessor(TablePredictionOutputsProcessor):
+    def make_sink(self):
+        return SINK

@@ -23,6 +23,14 @@ setup). CI wires this as an optional, non-blocking tier.
 The docker zoo-build gate (`edl zoo init/build` against a local
 daemon, reference .travis.yml:77-98) is its own env gate:
 EDL_DOCKER_TESTS=True.
+
+Execution attempts on record (the tier needs a container runtime to
+stand a cluster up): 2026-07-31 (round 4) — probed for docker / kind /
+minikube / kubectl binaries and /var/run/docker.sock in the build
+container; none exist (and the environment is zero-egress, so none
+can be installed), so the tier remains validated against the fake
+clientset only. First environment with a docker daemon: run the
+command block above and commit the pod-lifecycle log as an artifact.
 """
 
 import os

@@ -50,3 +50,56 @@ def create_ctr_recordio(path, num_records=256, num_features=10, vocab=1000, seed
         payloads.append(encode_example({"ids": ids, "label": label}))
     write_records(path, payloads)
     return path
+
+
+def spawn_ps_process(ps_id=0, num_ps_pods=1, opt_type="adam",
+                     opt_args="lr=0.01", use_async=True, grads_to_wait=1,
+                     log_path=None, extra=(), startup_timeout=120):
+    """Launch a live ``elasticdl_tpu.ps.server`` subprocess on a free
+    port and wait for it to accept connections.
+
+    The one PS-spawner for every test that needs a real PS process
+    (in-process servicers share the caller's GIL and invert pipelined
+    perf comparisons). Returns (proc, port); caller terminates."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    probe = socket.socket()
+    probe.bind(("", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    if log_path:
+        out = open(log_path, "ab")
+        err = subprocess.STDOUT
+    else:
+        out = subprocess.DEVNULL
+        err = subprocess.DEVNULL
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "elasticdl_tpu.ps.server",
+         "--ps_id", str(ps_id), "--num_ps_pods", str(num_ps_pods),
+         "--port", str(port),
+         "--opt_type", opt_type, "--opt_args", opt_args,
+         "--use_async", "1" if use_async else "0",
+         "--grads_to_wait", str(grads_to_wait), *extra],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=repo,
+        stdout=out,
+        stderr=err,
+    )
+    deadline = time.time() + startup_timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError("PS process died on startup")
+        try:
+            s = socket.socket()
+            s.connect(("127.0.0.1", port))
+            s.close()
+            return proc, port
+        except OSError:
+            time.sleep(0.3)
+    proc.kill()
+    raise TimeoutError("PS process never opened its port")
