@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
 from elasticdl_tpu.data.pipeline import MASK_KEY
 from elasticdl_tpu.train.losses import masked_mean
@@ -30,6 +31,8 @@ from elasticdl_tpu.train.train_state import (
     create_train_state,
     resolve_dtype,
 )
+
+logger = _logger_factory("elasticdl_tpu.train.sparse")
 
 ROWS_SUFFIX = "__rows"
 INDICES_SUFFIX = "__indices"
@@ -760,6 +763,7 @@ class SparseTrainer:
         pull_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="sparse-lookahead"
         )
+        next_prep_future = None
         acc = {}  # table -> (values, ids) accumulated since last push
         acc_steps = 0
         push_rpc = self.preparer._ps.push_gradients
@@ -808,7 +812,7 @@ class SparseTrainer:
                 # lookahead pull
                 yield state, loss, batch
                 next_batch = next(it, sentinel)
-                next_prep_future = None
+                next_prep_future = None  # collected or abandoned below
                 if next_batch is not sentinel:
                     next_prep_future = pull_pool.submit(
                         self.preparer.prepare, next_batch
@@ -832,6 +836,7 @@ class SparseTrainer:
                 # above is critical path; time exactly that remainder
                 with self.timing.timeit("sparse_pull"):
                     prepared, pull_info = next_prep_future.result()
+                next_prep_future = None
                 batch = next_batch
             if push_future is not None:
                 with self.timing.timeit("sparse_push"):
@@ -859,6 +864,23 @@ class SparseTrainer:
             except Exception:
                 pass  # the original exception matters more
             push_pool.shutdown(wait=True)
+            if next_prep_future is not None:
+                # exception unwound between submit and collect: cancel
+                # if not started; if already running, the shutdown below
+                # must drain it (a late prepare mutating the HotRowCache
+                # under a successor stream would race) — say so, since
+                # a downed PS keeps the pull in its retry budget for up
+                # to ~2 min and this wait would otherwise look like a
+                # silent hang. Surface the pull's own error too.
+                if not next_prep_future.cancel():
+                    logger.warning(
+                        "draining an in-flight lookahead pull before "
+                        "stream teardown (PS retry budget bounds this)"
+                    )
+                    try:
+                        next_prep_future.result()
+                    except Exception:
+                        logger.exception("abandoned lookahead pull failed")
             pull_pool.shutdown(wait=True)
 
     def _finish_push(self, result):
