@@ -835,8 +835,13 @@ class SparseTrainer:
                 # only the pull latency NOT hidden under the fetch/push
                 # above is critical path; time exactly that remainder
                 with self.timing.timeit("sparse_pull"):
-                    prepared, pull_info = next_prep_future.result()
-                next_prep_future = None
+                    try:
+                        prepared, pull_info = next_prep_future.result()
+                    finally:
+                        # clear even when result() raises: the future
+                        # is consumed either way, and teardown must not
+                        # re-drain it (double-logging its error)
+                        next_prep_future = None
                 batch = next_batch
             if push_future is not None:
                 with self.timing.timeit("sparse_push"):
@@ -850,7 +855,17 @@ class SparseTrainer:
                 acc = {}
         finally:
             if push_future is not None:
-                push_future.result()
+                # only reachable while unwinding (clean exits collect
+                # it inside the try block) — surface the push's fate
+                # without masking the original exception or aborting
+                # the teardown below
+                try:
+                    push_future.result()
+                except Exception:
+                    logger.exception(
+                        "in-flight gradient push failed during stream "
+                        "teardown"
+                    )
             # closed mid-stream (stop_training, exception unwinding): a
             # dispatched step's grads and any short accumulation would
             # otherwise be silently dropped — flush best-effort
@@ -873,10 +888,11 @@ class SparseTrainer:
                 # to ~2 min and this wait would otherwise look like a
                 # silent hang. Surface the pull's own error too.
                 if not next_prep_future.cancel():
-                    logger.warning(
-                        "draining an in-flight lookahead pull before "
-                        "stream teardown (PS retry budget bounds this)"
-                    )
+                    if not next_prep_future.done():
+                        logger.warning(
+                            "draining an in-flight lookahead pull before "
+                            "stream teardown (PS retry budget bounds this)"
+                        )
                     try:
                         next_prep_future.result()
                     except Exception:

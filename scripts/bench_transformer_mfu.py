@@ -28,6 +28,30 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v4": 275e12, "TPU v5p": 459e12}
 
 
+def xla_memory_fields(compiled):
+    """Best-effort XLA buffer-assignment sizes as a JSON-ready dict.
+
+    Empty on backends whose compiled executables expose no memory
+    analysis (some CPU/GPU jaxlib builds return None or raise).
+    """
+    try:
+        ma = compiled.memory_analysis()
+        return {
+            "xla_args_gb": round(ma.argument_size_in_bytes / 1e9, 2),
+            "xla_temp_gb": round(ma.temp_size_in_bytes / 1e9, 2),
+            "xla_aliased_gb": round(ma.alias_size_in_bytes / 1e9, 2),
+            # what the program needs resident: args + temps + outputs,
+            # minus the donated-argument buffers outputs reuse
+            "xla_peak_gb": round(
+                (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                 + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+                / 1e9, 2
+            ),
+        }
+    except Exception:
+        return {}
+
+
 def model_train_flops(d, layers, seq, batch, vocab, mlp_ratio=4):
     """Exact matmul FLOPs for one train step (fwd + bwd = 3x fwd)."""
     tokens = batch * seq
@@ -57,12 +81,31 @@ def main():
         "--attn", choices=["auto", "pallas", "xla"], default="pallas"
     )
     p.add_argument("--opt", default="AdamW")
+    p.add_argument(
+        "--grad_accum_steps", type=int, default=1,
+        help="split the batch into k sequential microbatches "
+             "(exact semantics, train/step_fns.py) — lifts the HBM "
+             "ceiling: activations are materialized for batch/k rows "
+             "at a time while the optimizer still sees the full-batch "
+             "gradient",
+    )
     p.add_argument("--profile", default=None, help="trace output dir")
+    p.add_argument(
+        "--compile_only", action="store_true",
+        help="report XLA's buffer-assignment memory analysis without "
+             "executing — documents WHY an over-HBM config cannot run",
+    )
     args = p.parse_args()
 
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    # The container's sitecustomize imports jax at interpreter start
+    # with platforms "axon,cpu", so the env var alone cannot force a
+    # backend — re-apply it here (same pattern as tests/conftest.py).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -89,7 +132,8 @@ def main():
     from elasticdl_tpu.models.transformer import loss as loss_fn
 
     train_step = make_train_step(
-        model, loss_fn, tx, compute_dtype=jnp.bfloat16
+        model, loss_fn, tx, compute_dtype=jnp.bfloat16,
+        grad_accum_steps=args.grad_accum_steps,
     )
 
     def run_steps(state, batch, n):
@@ -117,13 +161,31 @@ def main():
         x.size for x in jax.tree_util.tree_leaves(state.params)
     )
 
+    # AOT compile so XLA's buffer-assignment peak is available even
+    # where the runtime's memory_stats() is unsupported (the axon
+    # tunnel returns {}): arguments + temps - aliased(donated) bounds
+    # the peak HBM the program needs.
     t0 = time.perf_counter()
-    state, losses = run(state, batch, args.steps)
+    compiled = run.lower(state, batch, args.steps).compile()
+    config = {
+        "d": args.d, "layers": args.layers, "heads": args.heads,
+        "seq": args.seq, "batch": args.batch, "vocab": args.vocab,
+        "remat": args.remat, "attn": args.attn, "opt": args.opt,
+        "grad_accum_steps": args.grad_accum_steps,
+    }
+    if args.compile_only:
+        print(json.dumps({
+            "config": config,
+            **xla_memory_fields(compiled),
+        }))
+        return
+    state, losses = compiled(state, batch)
     float(losses[-1])
     compile_s = time.perf_counter() - t0
+    run = compiled
 
     start = time.perf_counter()
-    state, losses = run(state, batch, args.steps)
+    state, losses = run(state, batch)
     final_loss = float(losses[-1])
     elapsed = time.perf_counter() - start
     assert np.isfinite(final_loss), final_loss
@@ -140,22 +202,17 @@ def main():
 
     mem = {}
     try:
-        stats = jax.devices()[0].memory_stats()
-        mem = {
-            "hbm_peak_gb": round(
-                stats.get("peak_bytes_in_use", 0) / 1e9, 2
-            ),
-            "hbm_live_gb": round(stats.get("bytes_in_use", 0) / 1e9, 2),
-        }
+        stats = jax.devices()[0].memory_stats() or {}
+        if stats.get("peak_bytes_in_use"):
+            mem["hbm_peak_gb"] = round(
+                stats["peak_bytes_in_use"] / 1e9, 2
+            )
     except Exception:
         pass
+    mem.update(xla_memory_fields(compiled))
 
     print(json.dumps({
-        "config": {
-            "d": args.d, "layers": args.layers, "heads": args.heads,
-            "seq": args.seq, "batch": args.batch, "vocab": args.vocab,
-            "remat": args.remat, "attn": args.attn, "opt": args.opt,
-        },
+        "config": config,
         "params_m": round(n_params / 1e6, 1),
         "device": kind,
         "peak_tflops": peak / 1e12,
@@ -171,7 +228,7 @@ def main():
         from scripts.trace_summary import summarize_trace
 
         jax.profiler.start_trace(args.profile)
-        state, losses = run(state, batch, args.steps)
+        state, losses = run(state, batch)
         float(losses[-1])
         jax.profiler.stop_trace()
         summarize_trace(args.profile, args.steps)
