@@ -128,6 +128,14 @@ def add_train_arguments(parser):
     parser.add_argument("--checkpoint_dir_for_init", default="")
     parser.add_argument("--output", default="")
     parser.add_argument("--compute_dtype", default="bfloat16")
+    # sparse host-PS mode (reference client flags,
+    # /root/reference/elasticdl_client/common/args.py: use_async,
+    # grads_to_wait, lr_staleness_modulation, sync_version_tolerance);
+    # forwarded to the master, which marshals them into PS pod commands
+    parser.add_argument("--use_async", type=int, default=1)
+    parser.add_argument("--grads_to_wait", type=int, default=1)
+    parser.add_argument("--sync_version_tolerance", type=int, default=0)
+    parser.add_argument("--lr_staleness_modulation", type=int, default=1)
 
 
 def add_evaluate_arguments(parser):
@@ -185,7 +193,10 @@ def build_master_arguments(parsed):
     for key, value in sorted(vars(parsed).items()):
         if key in _CLIENT_ONLY or key in ("command", "zoo_command", "func"):
             continue
-        if value in ("", None, False) or value == []:
+        # identity check for False: `0 in ("", None, False)` is True
+        # (0 == False), which would silently drop meaningful zeros like
+        # --use_async=0 and leave the master on its own default
+        if value is None or value == "" or value is False or value == []:
             continue
         if value is True:
             parts.append("--%s" % key)

@@ -54,6 +54,13 @@ def parse_master_args(argv=None):
     parser.add_argument("--keep_checkpoint_max", type=int, default=3)
     parser.add_argument("--checkpoint_dir_for_init", default="")
     parser.add_argument("--consensus_interval", type=int, default=1)
+    # sparse host-PS mode, marshalled into PS pod command lines by the
+    # pod manager (reference: client flags forwarded Go-PS style,
+    # /root/reference/elasticdl/python/master/master.py:392-539)
+    parser.add_argument("--use_async", type=int, default=1)
+    parser.add_argument("--grads_to_wait", type=int, default=1)
+    parser.add_argument("--sync_version_tolerance", type=int, default=0)
+    parser.add_argument("--lr_staleness_modulation", type=int, default=1)
     # flags the client CLI forwards (client/args.py); consumed when the
     # master provisions pods via the instance manager
     parser.add_argument("--job_name", default="")

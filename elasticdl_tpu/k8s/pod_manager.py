@@ -79,6 +79,17 @@ def build_ps_command(args, master_addr, num_ps, ps_optimizer=None):
         value = getattr(args, flag, "")
         if value not in ("", None, 0):
             command.append("--%s=%s" % (flag, value))
+    # PS mode flags: always forwarded — 0 is meaningful (sync mode,
+    # modulation off), so the skip-empty filter above must not apply
+    for flag in (
+        "use_async",
+        "grads_to_wait",
+        "sync_version_tolerance",
+        "lr_staleness_modulation",
+    ):
+        value = getattr(args, flag, None)
+        if value is not None:
+            command.append("--%s=%s" % (flag, value))
     return command
 
 

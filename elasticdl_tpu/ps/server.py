@@ -44,6 +44,11 @@ def parse_ps_args(argv=None):
     parser.add_argument("--use_async", type=int, default=1)
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
+    # async-mode staleness LR modulation lr /= max(1, version_diff)
+    # (reference go/cmd/elasticdl_ps/main.go lr_staleness_modulation)
+    parser.add_argument(
+        "--lr_staleness_modulation", type=int, default=1
+    )
     # benchmarking knob: sleep this long at the top of every RPC handler
     # to emulate network RTT between worker and PS pods (the
     # controlled-latency experiment behind docs/PERF_SPARSE.md — a
@@ -113,6 +118,7 @@ class ParameterServer:
             use_async=bool(args.use_async),
             grads_to_wait=args.grads_to_wait,
             sync_version_tolerance=args.sync_version_tolerance,
+            staleness_modulation=bool(args.lr_staleness_modulation),
         )
         if args.checkpoint_dir_for_init:
             SparseCheckpointSaver(

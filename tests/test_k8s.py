@@ -234,6 +234,8 @@ def test_cli_dry_run_roundtrips_master_args(tmp_path, capsys):
             "--checkpoint_steps=50",
             "--tpu_resource=google.com/tpu=8",
             "--mesh=dp=4,fsdp=2",
+            "--use_async=0",
+            "--grads_to_wait=2",
             "--volume=claim_name=data-pvc,mount_path=/data",
             "--envs=A=1,B=x",
             "--yaml=%s" % out_yaml,
@@ -253,9 +255,38 @@ def test_cli_dry_run_roundtrips_master_args(tmp_path, capsys):
     assert master_parsed.minibatch_size == 128
     assert master_parsed.checkpoint_steps == 50
     assert master_parsed.mesh == "dp=4,fsdp=2"
+    # a meaningful zero must survive the round trip: 0 == False in
+    # Python, so a naive empty-value filter drops --use_async=0 and the
+    # master silently runs the async PS
+    assert master_parsed.use_async == 0
+    assert master_parsed.grads_to_wait == 2
     # volume landed in the pod spec
     mounts = manifest["spec"]["containers"][0]["volumeMounts"]
     assert mounts[0]["mountPath"] == "/data"
+
+
+def test_ps_command_forwards_mode_flags():
+    from elasticdl_tpu.k8s.pod_manager import build_ps_command
+    from elasticdl_tpu.ps.server import parse_ps_args
+
+    master_args = parse_master_args(
+        [
+            "--model_zoo=elasticdl_tpu.models.deepfm",
+            "--use_async=0",
+            "--grads_to_wait=3",
+            "--sync_version_tolerance=1",
+            "--lr_staleness_modulation=0",
+        ]
+    )
+    command = build_ps_command(master_args, "master:50001", num_ps=2)
+    rendered = [c.format(ps_id=1) for c in command]
+    # the PS binary must parse the marshalled command with values intact
+    # (reference marshals these Go-PS style, master.py:392-539)
+    ps_parsed = parse_ps_args(rendered[3:])
+    assert ps_parsed.use_async == 0
+    assert ps_parsed.grads_to_wait == 3
+    assert ps_parsed.sync_version_tolerance == 1
+    assert ps_parsed.lr_staleness_modulation == 0
 
 
 def test_cli_zoo_init(tmp_path, monkeypatch):
