@@ -136,6 +136,8 @@ def add_train_arguments(parser):
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
     parser.add_argument("--lr_staleness_modulation", type=int, default=1)
+    # lockstep consensus cadence; forwarded master -> worker pods
+    parser.add_argument("--consensus_interval", type=int, default=1)
 
 
 def add_evaluate_arguments(parser):
