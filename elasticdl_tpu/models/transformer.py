@@ -145,7 +145,10 @@ class TransformerLM(nn.Module):
     # remat policy: "full" recomputes everything (min memory, ~1/3 extra
     # FLOPs); "dots" saves matmul outputs and recomputes only elementwise
     # ops (LayerNorm/GELU/residual) — near-zero extra MXU work, which is
-    # what keeps MFU high on memory-tight configs (docs/PERF_TRANSFORMER.md)
+    # what keeps MFU high on memory-tight configs; "flash" saves only the
+    # attention kernel's (o, lse) outputs — between the two: projections
+    # recompute, the O(S^2) attention forward does not, for lengths where
+    # "dots" exceeds HBM (docs/PERF_TRANSFORMER.md)
     remat_policy: str = "full"
 
     @nn.compact
@@ -161,26 +164,49 @@ class TransformerLM(nn.Module):
                 FLASH_OUT_NAME,
             )
 
-            if self.remat_policy not in ("full", "dots"):
+            if self.remat_policy not in ("full", "dots", "flash"):
                 raise ValueError(
-                    "remat_policy must be 'full' or 'dots', got %r"
-                    % (self.remat_policy,)
+                    "remat_policy must be 'full', 'dots' or 'flash', "
+                    "got %r" % (self.remat_policy,)
                 )
             # "dots" also saves the flash kernel's (o, lse) named
             # outputs: without them remat re-runs the forward flash
             # pass inside every block's backward (flash_attention.py
-            # "custom_vjp wrapper" note)
-            policy = (
-                jax.checkpoint_policies.save_from_both_policies(
+            # "custom_vjp wrapper" note). "flash" saves ONLY those
+            # named outputs — the projections/mlp recompute like
+            # "full", but the O(S^2) attention forward never re-runs —
+            # the middle ground for lengths where "dots" exceeds HBM
+            # (docs/PERF_TRANSFORMER.md, S=16k).
+            if self.remat_policy == "dots":
+                policy = jax.checkpoint_policies.save_from_both_policies(
                     jax.checkpoint_policies
                     .dots_with_no_batch_dims_saveable,
                     jax.checkpoint_policies.save_only_these_names(
                         FLASH_OUT_NAME, FLASH_LSE_NAME
                     ),
                 )
-                if self.remat_policy == "dots"
-                else None
-            )
+            elif self.remat_policy == "flash":
+                # only the pallas flash kernel tags its outputs with
+                # these checkpoint_names (flash_attention.py:522-523);
+                # under any other attention impl the policy would match
+                # nothing and silently degrade to "full" — reject the
+                # contradiction instead. "auto" stays allowed: it
+                # resolves to pallas on TPU (the regime this policy
+                # exists for) and its CPU fallback to xla is the
+                # documented degradation for tests.
+                if self.attention_impl not in ("auto", "pallas"):
+                    raise ValueError(
+                        'remat_policy="flash" saves the pallas flash '
+                        "kernel's named outputs; attention_impl=%r "
+                        "never produces them (the policy would match "
+                        "nothing and degrade to \"full\")"
+                        % (self.attention_impl,)
+                    )
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    FLASH_OUT_NAME, FLASH_LSE_NAME
+                )
+            else:
+                policy = None
             block_cls = nn.remat(Block, static_argnums=(2,), policy=policy)
         else:
             block_cls = Block

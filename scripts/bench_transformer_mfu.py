@@ -75,7 +75,8 @@ def main():
     p.add_argument("--mlp_ratio", type=int, default=4)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument(
-        "--remat", choices=["none", "full", "dots"], default="dots"
+        "--remat", choices=["none", "full", "dots", "flash"],
+        default="dots",
     )
     p.add_argument(
         "--attn", choices=["auto", "pallas", "xla"], default="pallas"

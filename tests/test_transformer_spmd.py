@@ -104,7 +104,7 @@ def test_model_contract_loads():
     assert spec.batch_spec is not None
 
 
-@pytest.mark.parametrize("remat_policy", ["full", "dots"])
+@pytest.mark.parametrize("remat_policy", ["full", "dots", "flash"])
 @pytest.mark.parametrize("attention_impl", ["xla", "pallas"])
 def test_remat_policies_match_no_remat(remat_policy, attention_impl,
                                        monkeypatch):
@@ -119,6 +119,12 @@ def test_remat_policies_match_no_remat(remat_policy, attention_impl,
     import numpy as np
 
     from elasticdl_tpu.models import transformer
+
+    if remat_policy == "flash" and attention_impl == "xla":
+        pytest.skip(
+            'remat_policy="flash" rejects non-pallas attention '
+            "(covered by test_remat_policy_validated)"
+        )
 
     if attention_impl == "pallas":
         orig = transformer.dot_product_attention
@@ -175,4 +181,14 @@ def test_remat_policy_validated():
         attention_impl="xla", remat=True, remat_policy="Dots",
     )
     with _pytest.raises(ValueError, match="remat_policy"):
+        model.init(jax.random.PRNGKey(0), tokens)
+
+    # "flash" saves the pallas kernel's named outputs; under xla
+    # attention the policy would match nothing and silently run as
+    # "full" — the model must reject the contradiction loudly
+    model = transformer.TransformerLM(
+        vocab_size=64, num_layers=1, num_heads=2, embed_dim=32,
+        attention_impl="xla", remat=True, remat_policy="flash",
+    )
+    with _pytest.raises(ValueError, match="flash"):
         model.init(jax.random.PRNGKey(0), tokens)
