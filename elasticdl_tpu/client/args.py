@@ -143,6 +143,9 @@ def add_train_arguments(parser):
 def add_evaluate_arguments(parser):
     parser.add_argument("--model_zoo", required=True)
     parser.add_argument("--model_def", default="")
+    # must match the train-time binding or checkpoint restore sees a
+    # different architecture
+    parser.add_argument("--model_params", default="")
     parser.add_argument("--validation_data", required=True)
     parser.add_argument("--data_reader_params", default="")
     parser.add_argument("--minibatch_size", type=int, default=64)
@@ -154,6 +157,9 @@ def add_evaluate_arguments(parser):
 def add_predict_arguments(parser):
     parser.add_argument("--model_zoo", required=True)
     parser.add_argument("--model_def", default="")
+    # must match the train-time binding or checkpoint restore sees a
+    # different architecture
+    parser.add_argument("--model_params", default="")
     parser.add_argument("--prediction_data", required=True)
     parser.add_argument("--data_reader_params", default="")
     parser.add_argument("--minibatch_size", type=int, default=64)

@@ -31,6 +31,11 @@ def _add_common(parser):
         help="Computation dtype for the jitted step (e.g. bfloat16); "
         "params stay float32",
     )
+    # reference: --model_def picks the module (and optionally the model
+    # factory) inside a model-zoo DIRECTORY; --model_params is k=v;k=v
+    # kwargs for custom_model (model_utils.py:79-94,139-198)
+    parser.add_argument("--model_def", default="")
+    parser.add_argument("--model_params", default="")
 
 
 def parse_master_args(argv=None):
@@ -71,8 +76,6 @@ def parse_master_args(argv=None):
     parser.add_argument(
         "--mesh", default="", help='axis sizes, e.g. "dp=4,fsdp=2"'
     )
-    parser.add_argument("--model_def", default="")
-    parser.add_argument("--model_params", default="")
     parser.add_argument("--envs", default="")
     parser.add_argument("--tensorboard_log_dir", default="")
     return parser.parse_args(argv)

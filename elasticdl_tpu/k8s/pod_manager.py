@@ -16,6 +16,8 @@ logger = _logger_factory("elasticdl_tpu.k8s.pod_manager")
 
 _FORWARDED_WORKER_FLAGS = (
     "model_zoo",
+    "model_def",
+    "model_params",
     "training_data",
     "validation_data",
     "prediction_data",

@@ -31,6 +31,8 @@ def main(argv=None):
         saved_model_path=args.output,
         task_timeout_secs=args.task_timeout_secs,
         tensorboard_log_dir=args.tensorboard_log_dir or None,
+        model_def=args.model_def,
+        model_params=args.model_params,
     )
     if args.job_name and os.environ.get("KUBERNETES_SERVICE_HOST"):
         # in-cluster: provision and heal worker/PS pods

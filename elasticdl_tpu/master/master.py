@@ -44,8 +44,13 @@ class Master:
         task_timeout_secs=30.0,
         seed=None,
         tensorboard_log_dir=None,
+        model_def="",
+        model_params="",
     ):
-        self.spec = get_model_spec(model_zoo_module)
+        self.spec = get_model_spec(
+            model_zoo_module, model_def=model_def,
+            model_params=model_params,
+        )
         reader_params = data_reader_params or {}
 
         def shards_of(origin):

@@ -93,10 +93,71 @@ def _resolve(module, name, default_name=None, required=True):
     return target
 
 
-def get_model_spec(module_path_or_name) -> ModelSpec:
-    module = load_module(module_path_or_name)
+def get_model_spec(
+    module_path_or_name, model_def="", model_params=""
+) -> ModelSpec:
+    """Resolve the model-zoo contract.
+
+    ``model_def`` (reference --model_def, model_utils.py:139-198 via
+    get_module_file_path): when ``module_path_or_name`` is a DIRECTORY,
+    a dotted path inside it selecting the module file — optionally with
+    a trailing segment naming the model factory, e.g.
+    ``mnist.mnist_functional_api`` or
+    ``mnist.mnist_functional_api.custom_model``.
+
+    ``model_params`` (reference --model_params, model_utils.py:79-94):
+    a ``k=v;k=v`` string of kwargs bound onto ``custom_model`` — the
+    reference calls ``custom_model(**model_params)``; here the binding
+    is a functools.partial so every call site (worker, executor,
+    handler) inherits it.
+    """
+    import functools
+
+    factory_name = None
+    target = module_path_or_name
+    if model_def:
+        if not os.path.isdir(module_path_or_name):
+            raise ValueError(
+                "--model_def requires --model_zoo to be a directory, "
+                "got %r" % (module_path_or_name,)
+            )
+        parts = model_def.split(".")
+        candidate = os.path.join(module_path_or_name, *parts) + ".py"
+        if os.path.exists(candidate):
+            target = candidate
+        elif len(parts) >= 2:
+            # last segment names the model factory inside the module
+            target = (
+                os.path.join(module_path_or_name, *parts[:-1]) + ".py"
+            )
+            if not os.path.exists(target):
+                raise ValueError(
+                    "--model_def %r resolves to neither %s nor %s under "
+                    "%s" % (
+                        model_def, candidate, target, module_path_or_name,
+                    )
+                )
+            factory_name = parts[-1]
+        else:
+            # a single segment has no module to fall back to — joining
+            # parts[:-1] (empty) would probe '<zoo>.py' OUTSIDE the zoo
+            raise ValueError(
+                "--model_def %r resolves to no module file (%s) under %s"
+                % (model_def, candidate, module_path_or_name)
+            )
+    module = load_module(target)
+    custom_model = _resolve(
+        module, factory_name or "custom_model",
+        None if factory_name else "model",
+    )
+    if model_params:
+        from elasticdl_tpu.common.args import parse_params_string
+
+        custom_model = functools.partial(
+            custom_model, **parse_params_string(model_params)
+        )
     return ModelSpec(
-        custom_model=_resolve(module, "custom_model", "model"),
+        custom_model=custom_model,
         loss=_resolve(module, "loss"),
         optimizer=_resolve(module, "optimizer"),
         dataset_fn=_resolve(module, "dataset_fn"),

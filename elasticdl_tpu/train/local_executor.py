@@ -33,8 +33,13 @@ class LocalExecutor:
         data_reader_params=None,
         compute_dtype=None,
         seed=0,
+        model_def="",
+        model_params="",
     ):
-        self.spec = get_model_spec(model_zoo_module)
+        self.spec = get_model_spec(
+            model_zoo_module, model_def=model_def,
+            model_params=model_params,
+        )
         self._minibatch_size = minibatch_size
         self._num_epochs = num_epochs
         reader_params = data_reader_params or {}

@@ -108,6 +108,8 @@ def main(argv=None):
         sparse_pipeline=bool(args.sparse_pipeline),
         sparse_cache_staleness=args.sparse_cache_staleness,
         sparse_push_interval=args.sparse_push_interval,
+        model_def=args.model_def,
+        model_params=args.model_params,
         consensus_interval=args.consensus_interval,
         # the elastic fallback dir is empty on first launch; only an
         # explicit operator resume request is strict

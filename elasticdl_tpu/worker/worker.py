@@ -123,9 +123,14 @@ class Worker:
         sparse_cache_staleness=0,
         sparse_push_interval=1,
         consensus_interval=1,
+        model_def="",
+        model_params="",
     ):
         self._mc = master_client
-        self.spec = get_model_spec(model_zoo_module)
+        self.spec = get_model_spec(
+            model_zoo_module, model_def=model_def,
+            model_params=model_params,
+        )
         self._reader = data_reader
         self._minibatch_size = minibatch_size
         self._mode = mode
