@@ -103,6 +103,7 @@ def _submit_job(parsed, job_kind):
         restart_policy=parsed.restart_policy,
         priority_class=parsed.master_pod_priority or None,
         volumes=client_args.parse_volume_string(parsed.volume),
+        image_pull_policy=parsed.image_pull_policy or None,
     )
     if parsed.dry_run or parsed.yaml:
         text = yaml.safe_dump(manifest, sort_keys=False)

@@ -171,26 +171,19 @@ def add_predict_arguments(parser):
 # flags that belong to the client only and must NOT be forwarded to the
 # master process command line
 _CLIENT_ONLY = {
-    "image_name",
     "namespace",
     "dry_run",
     "yaml",
     "docker_base_url",
     "docker_tlscert",
     "docker_tlskey",
-    "worker_resource_request",
-    "worker_resource_limit",
-    "ps_resource_request",
-    "ps_resource_limit",
+    # the master pod's own spec is built by the client; everything the
+    # MASTER needs to build worker/PS pod specs (image, resources,
+    # priorities, volume, tpu_resource, pull/restart policy) is
+    # forwarded — reference master.py:392-539 re-emits these
     "master_resource_request",
     "master_resource_limit",
     "master_pod_priority",
-    "worker_pod_priority",
-    "ps_pod_priority",
-    "volume",
-    "image_pull_policy",
-    "restart_policy",
-    "tpu_resource",
 }
 
 

@@ -69,6 +69,24 @@ def parse_master_args(argv=None):
     # flags the client CLI forwards (client/args.py); consumed when the
     # master provisions pods via the instance manager
     parser.add_argument("--job_name", default="")
+    # pod-spec flags for the worker/PS pods the master creates
+    # (reference: the master re-emits these into pod specs,
+    # master.py:392-539; k8s_resource/k8s_volume string formats)
+    parser.add_argument("--image_name", default="")
+    parser.add_argument("--image_pull_policy", default="")
+    parser.add_argument("--restart_policy", default="Never")
+    parser.add_argument("--worker_resource_request", default="")
+    parser.add_argument("--worker_resource_limit", default="")
+    parser.add_argument("--ps_resource_request", default="")
+    parser.add_argument("--ps_resource_limit", default="")
+    parser.add_argument("--worker_pod_priority", default="")
+    parser.add_argument("--ps_pod_priority", default="")
+    parser.add_argument("--volume", default="")
+    parser.add_argument(
+        "--tpu_resource",
+        default="",
+        help='TPU chips per worker pod, e.g. "google.com/tpu=8"',
+    )
     parser.add_argument(
         "--distribution_strategy", default="AllreduceStrategy"
     )

@@ -132,6 +132,7 @@ class Client:
         priority_class=None,
         volumes=None,
         owner=None,
+        image_pull_policy=None,
     ):
         resources = {
             "requests": dict(resource_requests or {}),
@@ -150,6 +151,8 @@ class Client:
                 for k, v in (env or {}).items()
             ],
         }
+        if image_pull_policy:
+            container["imagePullPolicy"] = image_pull_policy
         spec = {
             "containers": [container],
             "restartPolicy": restart_policy,

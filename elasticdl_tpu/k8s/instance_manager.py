@@ -37,6 +37,12 @@ class InstanceManager:
         ps_resources=None,
         tpu_resource=None,
         restart_policy="Never",
+        worker_resource_limits=None,
+        ps_resource_limits=None,
+        worker_priority=None,
+        ps_priority=None,
+        volumes=None,
+        image_pull_policy=None,
         task_dispatcher=None,
         rendezvous=None,
         envs=None,
@@ -50,6 +56,12 @@ class InstanceManager:
         self._ps_resources = ps_resources or {}
         self._tpu_resource = tpu_resource
         self._restart_policy = restart_policy
+        self._worker_resource_limits = worker_resource_limits
+        self._ps_resource_limits = ps_resource_limits
+        self._worker_priority = worker_priority
+        self._ps_priority = ps_priority
+        self._volumes = volumes
+        self._image_pull_policy = image_pull_policy
         self._task_d = task_dispatcher
         self._rendezvous = rendezvous
         self._envs = envs or {}
@@ -80,8 +92,12 @@ class InstanceManager:
             worker_id,
             command,
             resource_requests=self._worker_resources,
+            resource_limits=self._worker_resource_limits,
             tpu_resource=self._tpu_resource,
             restart_policy=self._restart_policy,
+            priority_class=self._worker_priority,
+            volumes=self._volumes,
+            image_pull_policy=self._image_pull_policy,
             env=dict(self._envs, WORKER_ID=str(worker_id)),
         )
         name = self._client.get_worker_pod_name(worker_id)
@@ -106,7 +122,11 @@ class InstanceManager:
             ps_id,
             command,
             resource_requests=self._ps_resources,
+            resource_limits=self._ps_resource_limits,
             restart_policy=self._restart_policy,
+            priority_class=self._ps_priority,
+            volumes=self._volumes,
+            image_pull_policy=self._image_pull_policy,
             env=dict(self._envs, PS_ID=str(ps_id)),
         )
         name = self._client.get_ps_pod_name(ps_id)

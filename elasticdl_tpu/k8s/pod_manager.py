@@ -105,15 +105,29 @@ class K8sPodManager:
         task_dispatcher,
         rendezvous,
         api=None,
-        worker_resources=None,
-        ps_resources=None,
-        tpu_resource=None,
         envs=None,
     ):
         if api is None:
             from elasticdl_tpu.k8s.api import K8sApi
 
             api = K8sApi()
+        # pod-spec strings ride the forwarded master args (reference
+        # master.py:392-539)
+        from elasticdl_tpu.client.args import (
+            parse_resource_string,
+            parse_volume_string,
+        )
+
+        def _arg(name, default=""):
+            return getattr(args, name, default) or default
+
+        worker_resources = parse_resource_string(
+            _arg("worker_resource_request")
+        )
+        ps_resources = parse_resource_string(_arg("ps_resource_request"))
+        tpu_resource = (
+            parse_resource_string(_arg("tpu_resource")) or None
+        )
         self._client = Client(
             api,
             args.job_name,
@@ -136,6 +150,19 @@ class K8sPodManager:
             worker_resources=worker_resources,
             ps_resources=ps_resources,
             tpu_resource=tpu_resource,
+            worker_resource_limits=parse_resource_string(
+                _arg("worker_resource_limit")
+            )
+            or None,
+            ps_resource_limits=parse_resource_string(
+                _arg("ps_resource_limit")
+            )
+            or None,
+            worker_priority=_arg("worker_pod_priority") or None,
+            ps_priority=_arg("ps_pod_priority") or None,
+            volumes=parse_volume_string(_arg("volume")),
+            image_pull_policy=_arg("image_pull_policy") or None,
+            restart_policy=_arg("restart_policy", "Never"),
             task_dispatcher=task_dispatcher,
             rendezvous=rendezvous,
             envs=envs,
