@@ -44,15 +44,33 @@ def init_zoo(parsed):
 
 
 def build_zoo(parsed):
-    _docker("build", "-t", parsed.image, parsed.path)
+    _docker(parsed, "build", "-t", parsed.image, parsed.path)
 
 
 def push_zoo(parsed):
-    _docker("push", parsed.image)
+    _docker(parsed, "push", parsed.image)
 
 
-def _docker(*args):
-    command = ["docker", *args]
+def _docker(parsed, *args):
+    """Shell out to the docker CLI, honoring the daemon-connection
+    flags (reference drives the docker SDK with base_url/tls,
+    elasticdl_client/api.py:93-113)."""
+    command = ["docker"]
+    base_url = getattr(parsed, "docker_base_url", "")
+    if base_url:
+        command += ["--host", base_url]
+    tlscert = getattr(parsed, "docker_tlscert", "")
+    tlskey = getattr(parsed, "docker_tlskey", "")
+    if bool(tlscert) != bool(tlskey):
+        raise ValueError(
+            "--docker_tlscert and --docker_tlskey are both required "
+            "for a TLS daemon connection (got only one)"
+        )
+    if tlscert:
+        # --tls (not --tlsverify): client-cert auth without requiring a
+        # CA file, matching the reference SDK's TLSConfig(client_cert=)
+        command += ["--tls", "--tlscert", tlscert, "--tlskey", tlskey]
+    command += args
     logger.info("Running: %s", " ".join(shlex.quote(a) for a in command))
     subprocess.run(command, check=True)
 

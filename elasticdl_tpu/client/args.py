@@ -31,18 +31,24 @@ def add_zoo_init_arguments(parser):
     )
 
 
-def add_zoo_build_arguments(parser):
-    parser.add_argument("path", help="model zoo directory")
-    parser.add_argument(
-        "--image", required=True, help="tag for the built image"
-    )
+def _add_docker_connection_arguments(parser):
     parser.add_argument("--docker_base_url", default="")
     parser.add_argument("--docker_tlscert", default="")
     parser.add_argument("--docker_tlskey", default="")
 
 
+def add_zoo_build_arguments(parser):
+    parser.add_argument("path", help="model zoo directory")
+    parser.add_argument(
+        "--image", required=True, help="tag for the built image"
+    )
+    _add_docker_connection_arguments(parser)
+
+
 def add_zoo_push_arguments(parser):
     parser.add_argument("image", help="image tag to push")
+    # push must reach the same daemon the image was built on
+    _add_docker_connection_arguments(parser)
 
 
 def add_common_arguments(parser):

@@ -371,3 +371,44 @@ def test_pod_manager_applies_pod_spec_flags_from_args():
     # TPU chips belong to worker pods only
     assert "google.com/tpu" not in ps_container["resources"]["limits"]
     assert "priorityClassName" not in ps["spec"]
+
+
+def test_zoo_build_honors_docker_connection_flags(monkeypatch):
+    """--docker_base_url / --docker_tlscert/key were parsed but never
+    reached the docker invocation (reference drives the docker SDK with
+    them, elasticdl_client/api.py:93-113)."""
+    from elasticdl_tpu.client import api as client_api
+
+    calls = []
+    monkeypatch.setattr(
+        client_api.subprocess, "run",
+        lambda command, check: calls.append(command),
+    )
+    client_main.main([
+        "zoo", "build", ".", "--image=r/edl:v1",
+        "--docker_base_url=tcp://build-host:2376",
+        "--docker_tlscert=/certs/cert.pem",
+        "--docker_tlskey=/certs/key.pem",
+    ])
+    (command,) = calls
+    assert command[:2] == ["docker", "--host"]
+    assert "tcp://build-host:2376" in command
+    assert "--tls" in command and "/certs/key.pem" in command
+    assert command[-4:] == ["build", "-t", "r/edl:v1", "."]
+
+    # push reaches the same daemon
+    calls.clear()
+    client_main.main([
+        "zoo", "push", "r/edl:v1",
+        "--docker_base_url=tcp://build-host:2376",
+    ])
+    (command,) = calls
+    assert command[:3] == ["docker", "--host", "tcp://build-host:2376"]
+    assert command[-2:] == ["push", "r/edl:v1"]
+
+    # one-of-two TLS flags is a loud error, not silent plaintext
+    with pytest.raises(ValueError, match="both required"):
+        client_main.main([
+            "zoo", "build", ".", "--image=r/edl:v1",
+            "--docker_tlscert=/certs/cert.pem",
+        ])
