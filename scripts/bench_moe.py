@@ -65,7 +65,7 @@ def moe_flops(d, layers, seq, batch, vocab, mlp_ratio, num_experts, k,
                 + dispatch + head)
 
 
-def run_arm(model, loss_fn, flops, batch_tokens, args):
+def run_arm(model, loss_fn, flops, batch_tokens, args, profile_dir=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -109,6 +109,14 @@ def run_arm(model, loss_fn, flops, batch_tokens, args):
     final_loss = float(losses[-1])
     elapsed = time.perf_counter() - start
     assert np.isfinite(final_loss), final_loss
+    if profile_dir:
+        from scripts.trace_summary import capture_trace
+
+        def _once():
+            _, traced_losses = run(state, batch, args.steps)
+            float(traced_losses[-1])
+
+        capture_trace(_once, profile_dir, args.steps)
     kind = jax.devices()[0].device_kind
     peak = PEAK_FLOPS.get(kind, 197e12)
     step = elapsed / args.steps
@@ -136,6 +144,10 @@ def main():
     p.add_argument("--capacity_factor", type=float, default=1.25)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--attn", default="pallas")
+    p.add_argument(
+        "--profile", default=None,
+        help="trace dir for the MoE arm (HLO-category summary printed)",
+    )
     args = p.parse_args()
 
     import jax
@@ -170,6 +182,7 @@ def main():
                   args.capacity_factor),
         batch_tokens,
         args,
+        profile_dir=args.profile,
     )
     # dense arm at matched ACTIVE FFN FLOPs: half the blocks carry
     # k*cf-times the FFN (the other half already match), i.e. mean

@@ -226,13 +226,13 @@ def main():
     }))
 
     if args.profile:
-        from scripts.trace_summary import summarize_trace
+        from scripts.trace_summary import capture_trace
 
-        jax.profiler.start_trace(args.profile)
-        state, losses = run(state, batch)
-        float(losses[-1])
-        jax.profiler.stop_trace()
-        summarize_trace(args.profile, args.steps)
+        def _once():
+            _, traced_losses = run(state, batch)
+            float(traced_losses[-1])
+
+        capture_trace(_once, args.profile, args.steps)
 
 
 if __name__ == "__main__":

@@ -59,14 +59,13 @@ def main():
     state, losses = run(state, batch, args.steps)
     float(losses[-1])  # fence warmup
 
-    jax.profiler.start_trace(args.out)
-    state, losses = run(state, batch, args.steps)
-    float(losses[-1])  # device->host fetch fences remote execution
-    jax.profiler.stop_trace()
+    from scripts.trace_summary import capture_trace
 
-    from scripts.trace_summary import summarize_trace
+    def _once():
+        _, traced_losses = run(state, batch, args.steps)
+        float(traced_losses[-1])  # fetch fences remote execution
 
-    summarize_trace(args.out, args.steps)
+    capture_trace(_once, args.out, args.steps)
 
 
 if __name__ == "__main__":

@@ -17,6 +17,19 @@ def latest_trace_path(trace_dir):
     )[-1]
 
 
+def capture_trace(run_once, trace_dir, steps):
+    """Profile one invocation of ``run_once`` (which must fence device
+    execution itself, e.g. by fetching a scalar loss) and print the
+    per-HLO-category summary. The single capture protocol shared by the
+    bench scripts."""
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    run_once()
+    jax.profiler.stop_trace()
+    return summarize_trace(trace_dir, steps)
+
+
 def summarize_trace(trace_dir, steps, top=14):
     """Print device time / bytes / bandwidth / flops by HLO category for
     the newest trace under ``trace_dir``; returns the trace path."""
