@@ -91,7 +91,21 @@ def predict(parsed):
 def _submit_job(parsed, job_kind):
     """Build the master pod manifest; submit it or dump YAML
     (api.py:193-248)."""
-    master_args = client_args.build_master_arguments(parsed)
+    if getattr(parsed, "cluster_spec", ""):
+        # the master runs inside the zoo image, where `zoo init` placed
+        # the cluster-spec module under /cluster_spec/ — forward THAT
+        # path, not the client-local one (which does not exist in the
+        # container); the client-side master-pod hook below still loads
+        # the local file
+        import argparse as _argparse
+
+        forwarded = _argparse.Namespace(**vars(parsed))
+        forwarded.cluster_spec = "/cluster_spec/%s" % os.path.basename(
+            parsed.cluster_spec
+        )
+        master_args = client_args.build_master_arguments(forwarded)
+    else:
+        master_args = client_args.build_master_arguments(parsed)
     command = [
         "python",
         "-m",
@@ -101,7 +115,12 @@ def _submit_job(parsed, job_kind):
     from elasticdl_tpu.k8s.client import Client
 
     api = _make_api(parsed)
-    client = Client(api, parsed.job_name, image_name=parsed.image_name)
+    client = Client(
+        api,
+        parsed.job_name,
+        image_name=parsed.image_name,
+        cluster_spec=getattr(parsed, "cluster_spec", ""),
+    )
     manifest = client.build_pod_manifest(
         client.get_master_pod_name(),
         "master",

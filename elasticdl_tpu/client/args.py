@@ -54,6 +54,14 @@ def add_zoo_push_arguments(parser):
 def add_common_arguments(parser):
     parser.add_argument("--job_name", required=True)
     parser.add_argument("--image_name", default="")
+    parser.add_argument(
+        "--cluster_spec",
+        default="",
+        help="python module exporting `cluster` with "
+        "with_pod/with_service manifest hooks; applied to every pod "
+        "and service this job creates (in-cluster, the zoo image "
+        "carries it under /cluster_spec/)",
+    )
     parser.add_argument("--namespace", default="default")
     parser.add_argument(
         "--distribution_strategy",

@@ -87,6 +87,7 @@ def parse_master_args(argv=None):
         default="",
         help='TPU chips per worker pod, e.g. "google.com/tpu=8"',
     )
+    parser.add_argument("--cluster_spec", default="")
     parser.add_argument(
         "--distribution_strategy", default="AllreduceStrategy"
     )

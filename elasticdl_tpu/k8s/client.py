@@ -31,15 +31,50 @@ MASTER_PORT = 50001
 
 
 class Client:
-    def __init__(self, api, job_name, image_name="", event_callback=None):
+    def __init__(
+        self,
+        api,
+        job_name,
+        image_name="",
+        event_callback=None,
+        cluster_spec="",
+    ):
         self._api = api
         self.job_name = job_name
         self._image = image_name
         self._event_cb = event_callback
         self._watch_thread = None
         self._stopped = threading.Event()
+        # cluster customization plugin (reference: a module exporting
+        # ``cluster`` with with_pod/with_service hooks applied to every
+        # manifest, elasticdl_client/common/k8s_client.py:98-100,184,
+        # elasticdl/python/common/k8s_client.py:293-294). Here the
+        # hooks receive and return plain manifest DICTS, not kubernetes
+        # client objects.
+        self._cluster = None
+        if cluster_spec:
+            from elasticdl_tpu.models.registry import load_module
+
+            self._cluster = getattr(
+                load_module(cluster_spec), "cluster", None
+            )
+            if self._cluster is None:
+                raise ValueError(
+                    "cluster_spec module %r exports no `cluster` object"
+                    % (cluster_spec,)
+                )
         if event_callback:
             self.start_watch()
+
+    def _with_pod(self, manifest):
+        if self._cluster and hasattr(self._cluster, "with_pod"):
+            return self._cluster.with_pod(manifest) or manifest
+        return manifest
+
+    def _with_service(self, manifest):
+        if self._cluster and hasattr(self._cluster, "with_service"):
+            return self._cluster.with_service(manifest) or manifest
+        return manifest
 
     # ------------------------------------------------------------------
     def start_watch(self):
@@ -184,7 +219,7 @@ class Client:
                     "blockOwnerDeletion": True,
                 }
             ]
-        return manifest
+        return self._with_pod(manifest)
 
     def _service_manifest(self, name, port, replica_type, replica_index,
                           service_type=None):
@@ -196,12 +231,12 @@ class Client:
             spec["clusterIP"] = "None"  # headless: DNS -> pod IP
         else:
             spec["type"] = service_type
-        return {
+        return self._with_service({
             "apiVersion": "v1",
             "kind": "Service",
             "metadata": {"name": name},
             "spec": spec,
-        }
+        })
 
     # ------------------------------------------------------------------
     def create_worker(self, worker_id, command, **kwargs):

@@ -133,6 +133,7 @@ class K8sPodManager:
             args.job_name,
             image_name=getattr(args, "image_name", ""),
             event_callback=self._event_cb,
+            cluster_spec=_arg("cluster_spec"),
         )
         master_addr = self._client.get_master_service_address()
         num_ps = getattr(args, "num_ps_pods", 0)

@@ -412,3 +412,75 @@ def test_zoo_build_honors_docker_connection_flags(monkeypatch):
             "zoo", "build", ".", "--image=r/edl:v1",
             "--docker_tlscert=/certs/cert.pem",
         ])
+
+
+def test_cluster_spec_hooks_apply_to_all_manifests(tmp_path):
+    """Reference parity: --cluster_spec names a module exporting
+    `cluster` whose with_pod/with_service hooks customize every
+    pod/service manifest (elasticdl_client/common/k8s_client.py:98-100,
+    :184; elasticdl/python/common/k8s_client.py:293-294). Previously the
+    file was only COPY'd into the zoo image and never loaded."""
+    from elasticdl_tpu.client.args import build_master_arguments
+    from elasticdl_tpu.client.main import build_parser
+    from elasticdl_tpu.k8s.pod_manager import K8sPodManager
+
+    spec_py = tmp_path / "my_cluster.py"
+    spec_py.write_text(
+        "class _C:\n"
+        "    def with_pod(self, pod):\n"
+        "        pod['spec'].setdefault('tolerations', []).append(\n"
+        "            {'key': 'tpu', 'operator': 'Exists'})\n"
+        "        return pod\n"
+        "    def with_service(self, service):\n"
+        "        service['metadata'].setdefault('labels', {})[\n"
+        "            'team'] = 'ads'\n"
+        "        return service\n"
+        "cluster = _C()\n"
+    )
+
+    parsed = build_parser().parse_args([
+        "train",
+        "--job_name=cs1",
+        "--image_name=img:1",
+        "--model_zoo=elasticdl_tpu.models.mnist",
+        "--cluster_spec=%s" % spec_py,
+        "--num_workers=1",
+    ])
+    master_args = parse_master_args(build_master_arguments(parsed))
+    api = FakeApi()
+    pm = K8sPodManager(
+        master_args, FakeDispatcher(), rendezvous=None, api=api
+    )
+    pm._manager.start_workers()
+    worker = api.pods["elasticdl-cs1-worker-0"]
+    assert worker["spec"]["tolerations"] == [
+        {"key": "tpu", "operator": "Exists"}
+    ]
+    service = api.services["elasticdl-cs1-worker-0"]
+    assert service["metadata"]["labels"]["team"] == "ads"
+
+    # the client-side master pod gets the hook too
+    from elasticdl_tpu.client import main as cm
+
+    manifest = cm.main([
+        "train", "--job_name=cs2", "--image_name=img:1",
+        "--model_zoo=elasticdl_tpu.models.mnist",
+        "--cluster_spec=%s" % spec_py, "--dry_run",
+    ])
+    assert manifest["spec"]["tolerations"] == [
+        {"key": "tpu", "operator": "Exists"}
+    ]
+    # the master command carries the IN-IMAGE path (zoo init COPYs the
+    # module to /cluster_spec/), not the client-local one
+    command = manifest["spec"]["containers"][0]["command"]
+    assert "--cluster_spec=/cluster_spec/my_cluster.py" in command
+
+    # a module without a `cluster` export fails loudly
+    bad = tmp_path / "bad_cluster.py"
+    bad.write_text("x = 1\n")
+    with pytest.raises(ValueError, match="cluster"):
+        cm.main([
+            "train", "--job_name=cs3", "--image_name=img:1",
+            "--model_zoo=elasticdl_tpu.models.mnist",
+            "--cluster_spec=%s" % bad, "--dry_run",
+        ])
