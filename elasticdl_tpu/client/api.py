@@ -24,7 +24,7 @@ logger = _logger_factory("elasticdl_tpu.client.api")
 _DOCKERFILE_TEMPLATE = """\
 FROM {base_image}
 
-RUN pip install elasticdl_tpu {extra_packages}
+RUN pip install {index_args}elasticdl_tpu {extra_packages}
 COPY . /model_zoo
 ENV PYTHONPATH=/model_zoo:$PYTHONPATH
 """
@@ -33,8 +33,11 @@ ENV PYTHONPATH=/model_zoo:$PYTHONPATH
 def init_zoo(parsed):
     """Render a Dockerfile into the current directory (api.py:52-90)."""
     extra = " ".join(parsed.extra_pypi_package)
+    index = getattr(parsed, "extra_pypi_index", "")
     content = _DOCKERFILE_TEMPLATE.format(
-        base_image=parsed.base_image, extra_packages=extra
+        base_image=parsed.base_image,
+        extra_packages=extra,
+        index_args="--extra-index-url %s " % index if index else "",
     )
     if parsed.cluster_spec:
         content += "COPY %s /cluster_spec/\n" % parsed.cluster_spec
@@ -91,12 +94,13 @@ def predict(parsed):
 def _submit_job(parsed, job_kind):
     """Build the master pod manifest; submit it or dump YAML
     (api.py:193-248)."""
-    if getattr(parsed, "cluster_spec", ""):
-        # the master runs inside the zoo image, where `zoo init` placed
-        # the cluster-spec module under /cluster_spec/ — forward THAT
-        # path, not the client-local one (which does not exist in the
-        # container); the client-side master-pod hook below still loads
-        # the local file
+    if os.path.exists(getattr(parsed, "cluster_spec", "") or ""):
+        # a cluster_spec FILE path is client-local; the master runs
+        # inside the zoo image, where `zoo init` placed the module
+        # under /cluster_spec/ — forward THAT path (the client-side
+        # master-pod hook below still loads the local file). A dotted
+        # module name passes through untouched: it resolves by import
+        # inside the image.
         import argparse as _argparse
 
         forwarded = _argparse.Namespace(**vars(parsed))

@@ -25,6 +25,11 @@ def add_zoo_init_arguments(parser):
         help="extra pip packages baked into the image",
     )
     parser.add_argument(
+        "--extra_pypi_index",
+        default="",
+        help="extra pip index URL for the image's installs",
+    )
+    parser.add_argument(
         "--cluster_spec",
         default="",
         help="python file customizing pod specs for your cluster",
@@ -152,6 +157,22 @@ def add_train_arguments(parser):
     parser.add_argument("--lr_staleness_modulation", type=int, default=1)
     # lockstep consensus cadence; forwarded master -> worker pods
     parser.add_argument("--consensus_interval", type=int, default=1)
+    parser.add_argument("--tensorboard_log_dir", default="")
+    parser.add_argument(
+        "--num_minibatches_per_task", type=int, default=0
+    )
+    parser.add_argument("--log_loss_steps", type=int, default=100)
+    _add_model_symbol_and_log_arguments(parser)
+
+
+def _add_model_symbol_and_log_arguments(parser):
+    # contract symbol-name overrides + logging (reference
+    # model_utils.py:139-150, client args :369,392)
+    from elasticdl_tpu.common.args import add_symbol_override_arguments
+
+    add_symbol_override_arguments(parser)
+    parser.add_argument("--log_level", default="")
+    parser.add_argument("--log_file_path", default="")
 
 
 def add_evaluate_arguments(parser):
@@ -166,6 +187,7 @@ def add_evaluate_arguments(parser):
     parser.add_argument("--records_per_task", type=int, default=1024)
     parser.add_argument("--checkpoint_dir_for_init", required=True)
     parser.add_argument("--compute_dtype", default="bfloat16")
+    _add_model_symbol_and_log_arguments(parser)
 
 
 def add_predict_arguments(parser):
@@ -180,6 +202,7 @@ def add_predict_arguments(parser):
     parser.add_argument("--records_per_task", type=int, default=1024)
     parser.add_argument("--checkpoint_dir_for_init", required=True)
     parser.add_argument("--compute_dtype", default="bfloat16")
+    _add_model_symbol_and_log_arguments(parser)
 
 
 # flags that belong to the client only and must NOT be forwarded to the

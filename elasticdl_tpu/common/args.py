@@ -36,6 +36,12 @@ def _add_common(parser):
     # kwargs for custom_model (model_utils.py:79-94,139-198)
     parser.add_argument("--model_def", default="")
     parser.add_argument("--model_params", default="")
+    # contract symbol-name overrides (reference model_utils.py:139-150:
+    # every contract part is addressable by name); empty = default name
+    add_symbol_override_arguments(parser)
+    # logging controls (reference elasticdl_client args :369,392)
+    parser.add_argument("--log_level", default="")
+    parser.add_argument("--log_file_path", default="")
 
 
 def parse_master_args(argv=None):
@@ -43,6 +49,14 @@ def parse_master_args(argv=None):
     _add_common(parser)
     parser.add_argument("--port", type=int, default=50001)
     parser.add_argument("--records_per_task", type=int, default=1024)
+    # reference alternative task sizing: records_per_task =
+    # minibatch_size * num_minibatches_per_task (master.py:152)
+    parser.add_argument(
+        "--num_minibatches_per_task", type=int, default=0
+    )
+    # accepted on the master so the client can forward it; consumed by
+    # the workers the master launches
+    parser.add_argument("--log_loss_steps", type=int, default=100)
     parser.add_argument("--num_epochs", type=int, default=1)
     parser.add_argument("--evaluation_steps", type=int, default=0)
     parser.add_argument("--evaluation_throttle_secs", type=int, default=0)
@@ -116,6 +130,8 @@ def parse_worker_args(argv=None):
         choices=["training", "evaluation", "prediction"],
     )
     parser.add_argument("--report_version_steps", type=int, default=10)
+    # log the training loss every N batches (reference --log_loss_steps)
+    parser.add_argument("--log_loss_steps", type=int, default=100)
     # async dense checkpointing: the save's file writes ride orbax's
     # background machinery instead of blocking the training loop
     # (single-process workers only; lockstep multi-host stays sync)
@@ -157,6 +173,38 @@ def parse_worker_args(argv=None):
         default=int(os.environ.get("EDL_CONSENSUS_INTERVAL", "1")),
     )
     return parser.parse_args(argv)
+
+
+# the contract symbol-name override flags (reference
+# model_utils.py:139-150) — ONE list consumed by every parser that
+# defines them, symbol_overrides_from_args, and the pod manager's
+# forwarded-flags set, so a new override cannot be added to one surface
+# and silently dropped by another
+SYMBOL_OVERRIDE_KEYS = (
+    "loss",
+    "optimizer",
+    "dataset_fn",
+    "eval_metrics_fn",
+    "callbacks",
+    "prediction_outputs_processor",
+)
+
+
+def add_symbol_override_arguments(parser):
+    for key in SYMBOL_OVERRIDE_KEYS:
+        parser.add_argument("--%s" % key, default="")
+
+
+def symbol_overrides_from_args(args):
+    """Collect the non-empty contract symbol-name flags into the
+    ``symbol_overrides`` dict ``get_model_spec`` takes (None if all
+    default)."""
+    overrides = {
+        k: getattr(args, k)
+        for k in SYMBOL_OVERRIDE_KEYS
+        if getattr(args, k, "")
+    }
+    return overrides or None
 
 
 def parse_params_string(params: str) -> dict:

@@ -5,6 +5,9 @@ import logging
 _DEFAULT_FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 
 _initialized = False
+# set by configure(); wins over the per-call default so loggers created
+# AFTER --log_level is applied still honor it
+_configured_level = None
 
 
 def default_logger(name: str = "elasticdl_tpu", level: int = logging.INFO):
@@ -13,9 +16,35 @@ def default_logger(name: str = "elasticdl_tpu", level: int = logging.INFO):
         logging.basicConfig(format=_DEFAULT_FMT)
         _initialized = True
     logger = logging.getLogger(name)
-    logger.setLevel(level)
+    logger.setLevel(
+        _configured_level if _configured_level is not None else level
+    )
     return logger
 
 
 def get_logger(name: str, level: int = logging.INFO):
     return default_logger(name, level)
+
+
+def configure(log_level: str = "", log_file_path: str = ""):
+    """Apply the --log_level / --log_file_path flags (reference:
+    elasticdl_client/common/args.py:369,392) to every elasticdl_tpu
+    logger: the package root's level, plus an optional file handler."""
+    global _configured_level
+    if log_level:
+        level = getattr(logging, log_level.upper(), None)
+        if not isinstance(level, int):
+            raise ValueError("unknown --log_level %r" % (log_level,))
+        _configured_level = level
+        # re-level every already-created elasticdl_tpu logger (they get
+        # explicit levels from default_logger)
+        for name, logger in logging.root.manager.loggerDict.items():
+            if name.startswith("elasticdl_tpu") and isinstance(
+                logger, logging.Logger
+            ):
+                logger.setLevel(level)
+        logging.getLogger("elasticdl_tpu").setLevel(level)
+    if log_file_path:
+        handler = logging.FileHandler(log_file_path)
+        handler.setFormatter(logging.Formatter(_DEFAULT_FMT))
+        logging.getLogger().addHandler(handler)

@@ -8,6 +8,7 @@ worker/PS command marshalling from the parsed master args, and the
 status label patch PS pods poll for exit.
 """
 
+from elasticdl_tpu.common.args import SYMBOL_OVERRIDE_KEYS
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.k8s.client import Client
 from elasticdl_tpu.k8s.instance_manager import InstanceManager
@@ -32,7 +33,13 @@ _FORWARDED_WORKER_FLAGS = (
     "checkpoint_dir_for_init",
     "mesh",
     "consensus_interval",
-)
+    "log_level",
+    "log_file_path",
+) + SYMBOL_OVERRIDE_KEYS
+
+# forwarded even when falsy: 0 is meaningful (--log_loss_steps=0
+# disables loss logging) and must not be eaten by the skip-empty filter
+_ALWAYS_FORWARDED_WORKER_FLAGS = ("log_loss_steps",)
 
 
 def build_worker_command(args, master_addr, ps_addrs=()):
@@ -48,6 +55,10 @@ def build_worker_command(args, master_addr, ps_addrs=()):
     for flag in _FORWARDED_WORKER_FLAGS:
         value = getattr(args, flag, "")
         if value not in ("", None, 0):  # 0 = disabled for *_steps/max
+            command.append("--%s=%s" % (flag, value))
+    for flag in _ALWAYS_FORWARDED_WORKER_FLAGS:
+        value = getattr(args, flag, None)
+        if value is not None:
             command.append("--%s=%s" % (flag, value))
     if ps_addrs:
         command.append("--ps_addrs=%s" % ",".join(ps_addrs))

@@ -17,12 +17,23 @@ def main(argv=None):
 
     apply_platform_overrides()
     args = parse_master_args(argv)
+
+    from elasticdl_tpu.common.args import symbol_overrides_from_args
+    from elasticdl_tpu.common.log_utils import configure
+
+    configure(args.log_level, args.log_file_path)
+    records_per_task = args.records_per_task
+    if args.num_minibatches_per_task > 0:
+        # reference task sizing (master.py:152)
+        records_per_task = (
+            args.minibatch_size * args.num_minibatches_per_task
+        )
     master = Master(
         model_zoo_module=args.model_zoo,
         training_data=args.training_data,
         validation_data=args.validation_data,
         prediction_data=args.prediction_data,
-        records_per_task=args.records_per_task,
+        records_per_task=records_per_task,
         num_epochs=args.num_epochs,
         port=args.port,
         eval_steps=args.evaluation_steps,
@@ -33,6 +44,7 @@ def main(argv=None):
         tensorboard_log_dir=args.tensorboard_log_dir or None,
         model_def=args.model_def,
         model_params=args.model_params,
+        symbol_overrides=symbol_overrides_from_args(args),
     )
     if args.job_name and os.environ.get("KUBERNETES_SERVICE_HOST"):
         # in-cluster: provision and heal worker/PS pods

@@ -46,10 +46,12 @@ class Master:
         tensorboard_log_dir=None,
         model_def="",
         model_params="",
+        symbol_overrides=None,
     ):
         self.spec = get_model_spec(
             model_zoo_module, model_def=model_def,
             model_params=model_params,
+            symbol_overrides=symbol_overrides,
         )
         reader_params = data_reader_params or {}
 

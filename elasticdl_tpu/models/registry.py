@@ -94,7 +94,10 @@ def _resolve(module, name, default_name=None, required=True):
 
 
 def get_model_spec(
-    module_path_or_name, model_def="", model_params=""
+    module_path_or_name,
+    model_def="",
+    model_params="",
+    symbol_overrides=None,
 ) -> ModelSpec:
     """Resolve the model-zoo contract.
 
@@ -110,6 +113,13 @@ def get_model_spec(
     reference calls ``custom_model(**model_params)``; here the binding
     is a functools.partial so every call site (worker, executor,
     handler) inherits it.
+
+    ``symbol_overrides`` (reference --loss/--optimizer/--dataset_fn/
+    --eval_metrics_fn/--callbacks/--prediction_outputs_processor,
+    model_utils.py:139-150): {contract key: module attribute name} for
+    modules whose exports use non-default names. An overridden name
+    that the module does not define is an error even for otherwise
+    optional contract parts — the user asked for it by name.
     """
     import functools
 
@@ -156,15 +166,27 @@ def get_model_spec(
         custom_model = functools.partial(
             custom_model, **parse_params_string(model_params)
         )
+    overrides = symbol_overrides or {}
+
+    def _contract(key, default_name, required=True):
+        name = overrides.get(key) or default_name
+        return _resolve(
+            module, name, required=required or key in overrides
+        )
+
     return ModelSpec(
         custom_model=custom_model,
-        loss=_resolve(module, "loss"),
-        optimizer=_resolve(module, "optimizer"),
-        dataset_fn=_resolve(module, "dataset_fn"),
-        eval_metrics_fn=_resolve(module, "eval_metrics_fn", required=False),
-        callbacks=_resolve(module, "callbacks", required=False),
-        prediction_outputs_processor=_resolve(
-            module, "PredictionOutputsProcessor", required=False
+        loss=_contract("loss", "loss"),
+        optimizer=_contract("optimizer", "optimizer"),
+        dataset_fn=_contract("dataset_fn", "dataset_fn"),
+        eval_metrics_fn=_contract(
+            "eval_metrics_fn", "eval_metrics_fn", required=False
+        ),
+        callbacks=_contract("callbacks", "callbacks", required=False),
+        prediction_outputs_processor=_contract(
+            "prediction_outputs_processor",
+            "PredictionOutputsProcessor",
+            required=False,
         ),
         sharding_rules=_resolve(module, "sharding_rules", required=False),
         sparse_embedding_specs=_resolve(

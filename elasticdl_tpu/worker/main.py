@@ -8,7 +8,12 @@ Usage: python -m elasticdl_tpu.worker.main --master_addr=... --worker_id=0 \
 import os
 import sys
 
-from elasticdl_tpu.common.args import parse_params_string, parse_worker_args
+from elasticdl_tpu.common.args import (
+    parse_params_string,
+    parse_worker_args,
+    symbol_overrides_from_args,
+)
+from elasticdl_tpu.common.log_utils import configure as configure_logging
 from elasticdl_tpu.data.readers import create_data_reader
 from elasticdl_tpu.worker.master_client import MasterClient
 from elasticdl_tpu.worker.worker import Worker
@@ -28,6 +33,7 @@ def main(argv=None):
     import jax
 
     args = parse_worker_args(argv)
+    configure_logging(args.log_level, args.log_file_path)
     master_client = MasterClient(
         args.master_addr,
         worker_id=args.worker_id,
@@ -110,6 +116,8 @@ def main(argv=None):
         sparse_push_interval=args.sparse_push_interval,
         model_def=args.model_def,
         model_params=args.model_params,
+        symbol_overrides=symbol_overrides_from_args(args),
+        log_loss_steps=args.log_loss_steps,
         consensus_interval=args.consensus_interval,
         # the elastic fallback dir is empty on first launch; only an
         # explicit operator resume request is strict

@@ -125,12 +125,16 @@ class Worker:
         consensus_interval=1,
         model_def="",
         model_params="",
+        symbol_overrides=None,
+        log_loss_steps=100,
     ):
         self._mc = master_client
         self.spec = get_model_spec(
             model_zoo_module, model_def=model_def,
             model_params=model_params,
+            symbol_overrides=symbol_overrides,
         )
+        self._log_loss_steps = log_loss_steps
         self._reader = data_reader
         self._minibatch_size = minibatch_size
         self._mode = mode
@@ -380,6 +384,15 @@ class Worker:
         ):
             self._mc.report_version(self._version)
         self._check_mesh_epoch()
+        if (
+            self._log_loss_steps
+            and self._version % self._log_loss_steps == 0
+        ):
+            # reference --log_loss_steps; the float() fetch only syncs
+            # on these steps
+            logger.info(
+                "step %d loss %.6f", self._version, float(loss)
+            )
         for cb in self._callbacks:
             cb.on_batch_end(self._version, loss)
 

@@ -380,3 +380,36 @@ def test_model_def_single_segment_stays_inside_zoo(tmp_path):
     (tmp_path / "models.py").write_text("custom_model = None\n")
     with pytest.raises(ValueError, match="no module file"):
         get_model_spec(str(zoo), model_def="custom_model")
+
+
+def test_symbol_overrides(tmp_path):
+    """Reference parity: every contract part is addressable by name
+    (--loss/--optimizer/... , model_utils.py:139-150)."""
+    from elasticdl_tpu.models.registry import get_model_spec
+
+    mod = tmp_path / "named.py"
+    mod.write_text(
+        "import flax.linen as nn\n"
+        "import optax\n"
+        "def custom_model():\n"
+        "    return nn.Dense(2)\n"
+        "def loss(labels, predictions):\n"
+        "    return ((predictions - labels) ** 2).mean(axis=-1)\n"
+        "def my_loss(labels, predictions):\n"
+        "    return ((predictions - labels) ** 2).mean(axis=-1) * 2\n"
+        "def optimizer():\n"
+        "    return optax.sgd(0.1)\n"
+        "def dataset_fn(dataset, mode, metadata):\n"
+        "    return dataset\n"
+    )
+    spec = get_model_spec(
+        str(mod), symbol_overrides={"loss": "my_loss"}
+    )
+    assert spec.loss.__name__ == "my_loss"
+
+    # an explicitly named symbol that is missing errors even for
+    # otherwise-optional parts
+    with pytest.raises(ValueError, match="my_callbacks"):
+        get_model_spec(
+            str(mod), symbol_overrides={"callbacks": "my_callbacks"}
+        )
