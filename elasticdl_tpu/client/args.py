@@ -13,6 +13,12 @@ path has no PS).
 
 import argparse
 
+from elasticdl_tpu.common.args import (
+    LOG_LOSS_STEPS_DEFAULT,
+    add_logging_arguments,
+    add_symbol_override_arguments,
+)
+
 
 def add_zoo_init_arguments(parser):
     parser.add_argument(
@@ -161,18 +167,18 @@ def add_train_arguments(parser):
     parser.add_argument(
         "--num_minibatches_per_task", type=int, default=0
     )
-    parser.add_argument("--log_loss_steps", type=int, default=100)
+    parser.add_argument(
+        "--log_loss_steps", type=int, default=LOG_LOSS_STEPS_DEFAULT
+    )
     _add_model_symbol_and_log_arguments(parser)
 
 
 def _add_model_symbol_and_log_arguments(parser):
     # contract symbol-name overrides + logging (reference
-    # model_utils.py:139-150, client args :369,392)
-    from elasticdl_tpu.common.args import add_symbol_override_arguments
-
+    # model_utils.py:139-150, client args :369,392) — shared helpers so
+    # the client surface cannot drift from the master/worker parsers
     add_symbol_override_arguments(parser)
-    parser.add_argument("--log_level", default="")
-    parser.add_argument("--log_file_path", default="")
+    add_logging_arguments(parser)
 
 
 def add_evaluate_arguments(parser):

@@ -40,8 +40,7 @@ def _add_common(parser):
     # every contract part is addressable by name); empty = default name
     add_symbol_override_arguments(parser)
     # logging controls (reference elasticdl_client args :369,392)
-    parser.add_argument("--log_level", default="")
-    parser.add_argument("--log_file_path", default="")
+    add_logging_arguments(parser)
 
 
 def parse_master_args(argv=None):
@@ -56,7 +55,9 @@ def parse_master_args(argv=None):
     )
     # accepted on the master so the client can forward it; consumed by
     # the workers the master launches
-    parser.add_argument("--log_loss_steps", type=int, default=100)
+    parser.add_argument(
+        "--log_loss_steps", type=int, default=LOG_LOSS_STEPS_DEFAULT
+    )
     parser.add_argument("--num_epochs", type=int, default=1)
     parser.add_argument("--evaluation_steps", type=int, default=0)
     parser.add_argument("--evaluation_throttle_secs", type=int, default=0)
@@ -131,7 +132,9 @@ def parse_worker_args(argv=None):
     )
     parser.add_argument("--report_version_steps", type=int, default=10)
     # log the training loss every N batches (reference --log_loss_steps)
-    parser.add_argument("--log_loss_steps", type=int, default=100)
+    parser.add_argument(
+        "--log_loss_steps", type=int, default=LOG_LOSS_STEPS_DEFAULT
+    )
     # async dense checkpointing: the save's file writes ride orbax's
     # background machinery instead of blocking the training loop
     # (single-process workers only; lockstep multi-host stays sync)
@@ -193,6 +196,17 @@ SYMBOL_OVERRIDE_KEYS = (
 def add_symbol_override_arguments(parser):
     for key in SYMBOL_OVERRIDE_KEYS:
         parser.add_argument("--%s" % key, default="")
+
+
+LOG_LOSS_STEPS_DEFAULT = 100
+
+
+def add_logging_arguments(parser):
+    """--log_level / --log_file_path, shared by every parser that
+    exposes them (client train/evaluate/predict, master, worker) so a
+    default or validation change cannot drift between surfaces."""
+    parser.add_argument("--log_level", default="")
+    parser.add_argument("--log_file_path", default="")
 
 
 def symbol_overrides_from_args(args):

@@ -1,0 +1,43 @@
+"""Logging-control tests (common/log_utils.py: the --log_level /
+--log_file_path surface, reference client args :369,392)."""
+
+import logging
+
+import pytest
+
+from elasticdl_tpu.common import log_utils
+
+
+def test_log_configure_level_and_file(tmp_path):
+    """configure() re-levels existing AND future package loggers and
+    adds a file handler; bad levels error loudly."""
+    existing = log_utils.default_logger("elasticdl_tpu.test_existing")
+    assert existing.level == logging.INFO
+    log_file = tmp_path / "edl.log"
+    log_utils.configure("DEBUG", str(log_file))
+    try:
+        assert existing.level == logging.DEBUG
+        created_after = log_utils.default_logger(
+            "elasticdl_tpu.test_after"
+        )
+        assert created_after.level == logging.DEBUG
+        created_after.debug("hello-from-configure-test")
+        for h in logging.getLogger().handlers:
+            h.flush()
+        assert "hello-from-configure-test" in log_file.read_text()
+        with pytest.raises(ValueError, match="log_level"):
+            log_utils.configure("NOISY")
+    finally:
+        # configure() re-leveled EVERY existing elasticdl_tpu logger —
+        # restore them all, or the rest of the session runs at DEBUG
+        log_utils._configured_level = None
+        for name, logger in logging.root.manager.loggerDict.items():
+            if name.startswith("elasticdl_tpu") and isinstance(
+                logger, logging.Logger
+            ):
+                logger.setLevel(logging.INFO)
+        logging.getLogger("elasticdl_tpu").setLevel(logging.INFO)
+        for h in list(logging.getLogger().handlers):
+            if isinstance(h, logging.FileHandler):
+                h.close()
+                logging.getLogger().removeHandler(h)

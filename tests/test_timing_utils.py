@@ -102,3 +102,4 @@ def test_sparse_trainer_phases_recorded(monkeypatch, tmp_path):
     trainer.train_step(None, batch)
     summary = trainer.timing.summary()
     assert {"sparse_pull", "batch_process", "sparse_push"} <= set(summary)
+
