@@ -52,5 +52,13 @@ def main(argv=None):
     return parsed.func(parsed)
 
 
+def cli(argv=None):
+    """Process entry point: command handlers return their result object
+    (tests consume it — e.g. the dry-run manifest), which must NOT
+    become the exit code (sys.exit(dict) exits 1)."""
+    main(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(cli())

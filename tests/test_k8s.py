@@ -484,3 +484,21 @@ def test_cluster_spec_hooks_apply_to_all_manifests(tmp_path):
             "--model_zoo=elasticdl_tpu.models.mnist",
             "--cluster_spec=%s" % bad, "--dry_run",
         ])
+
+
+def test_cli_dry_run_exit_code_is_zero():
+    """`edl train --dry_run` must exit 0: main() returns the manifest
+    for tests, and sys.exit(<dict>) would turn that into exit code 1 —
+    the process entry point (cli) discards the return value."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [_sys.executable, "-m", "elasticdl_tpu.client.main", "train",
+         "--job_name=rc0", "--image_name=i", "--model_zoo=m",
+         "--dry_run"],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
