@@ -35,10 +35,12 @@ class LocalExecutor:
         seed=0,
         model_def="",
         model_params="",
+        symbol_overrides=None,
     ):
         self.spec = get_model_spec(
             model_zoo_module, model_def=model_def,
             model_params=model_params,
+            symbol_overrides=symbol_overrides,
         )
         self._minibatch_size = minibatch_size
         self._num_epochs = num_epochs
