@@ -6,8 +6,7 @@ cd "$(dirname "$0")/.."
 
 echo "== tier 1a: native store build + TSAN race stress =="
 make -C elasticdl_tpu/native
-make -C elasticdl_tpu/native stress_tsan
-./elasticdl_tpu/native/store_stress_tsan
+make -C elasticdl_tpu/native tsan
 
 echo "== tier 1b: unit suite (8-virtual-device CPU mesh) =="
 python -m pytest tests/ -x -q
