@@ -17,6 +17,7 @@ from elasticdl_tpu.common.args import (
     LOG_LOSS_STEPS_DEFAULT,
     add_logging_arguments,
     add_symbol_override_arguments,
+    bool_flag,
 )
 
 
@@ -157,10 +158,12 @@ def add_train_arguments(parser):
     # /root/reference/elasticdl_client/common/args.py: use_async,
     # grads_to_wait, lr_staleness_modulation, sync_version_tolerance);
     # forwarded to the master, which marshals them into PS pod commands
-    parser.add_argument("--use_async", type=int, default=1)
+    parser.add_argument("--use_async", type=bool_flag, default=1)
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
-    parser.add_argument("--lr_staleness_modulation", type=int, default=1)
+    parser.add_argument(
+        "--lr_staleness_modulation", type=bool_flag, default=1
+    )
     # lockstep consensus cadence; forwarded master -> worker pods
     parser.add_argument("--consensus_interval", type=int, default=1)
     parser.add_argument("--tensorboard_log_dir", default="")
@@ -193,6 +196,12 @@ def add_evaluate_arguments(parser):
     parser.add_argument("--records_per_task", type=int, default=1024)
     parser.add_argument("--checkpoint_dir_for_init", required=True)
     parser.add_argument("--compute_dtype", default="bfloat16")
+    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--evaluation_steps", type=int, default=0)
+    parser.add_argument("--tensorboard_log_dir", default="")
+    parser.add_argument(
+        "--num_minibatches_per_task", type=int, default=0
+    )
     _add_model_symbol_and_log_arguments(parser)
 
 
@@ -208,6 +217,9 @@ def add_predict_arguments(parser):
     parser.add_argument("--records_per_task", type=int, default=1024)
     parser.add_argument("--checkpoint_dir_for_init", required=True)
     parser.add_argument("--compute_dtype", default="bfloat16")
+    parser.add_argument(
+        "--num_minibatches_per_task", type=int, default=0
+    )
     _add_model_symbol_and_log_arguments(parser)
 
 

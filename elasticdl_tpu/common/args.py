@@ -77,10 +77,12 @@ def parse_master_args(argv=None):
     # sparse host-PS mode, marshalled into PS pod command lines by the
     # pod manager (reference: client flags forwarded Go-PS style,
     # /root/reference/elasticdl/python/master/master.py:392-539)
-    parser.add_argument("--use_async", type=int, default=1)
+    parser.add_argument("--use_async", type=bool_flag, default=1)
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
-    parser.add_argument("--lr_staleness_modulation", type=int, default=1)
+    parser.add_argument(
+        "--lr_staleness_modulation", type=bool_flag, default=1
+    )
     # flags the client CLI forwards (client/args.py); consumed when the
     # master provisions pods via the instance manager
     parser.add_argument("--job_name", default="")
@@ -199,6 +201,19 @@ def add_symbol_override_arguments(parser):
 
 
 LOG_LOSS_STEPS_DEFAULT = 100
+
+
+def bool_flag(value):
+    """Accept the reference's bool spellings (--use_async=True,
+    scripts/client_test.sh:46) alongside 0/1."""
+    lowered = str(value).strip().lower()
+    if lowered in ("true", "yes", "1"):
+        return 1
+    if lowered in ("false", "no", "0"):
+        return 0
+    raise argparse.ArgumentTypeError(
+        "expected a boolean (true/false/1/0), got %r" % (value,)
+    )
 
 
 def add_logging_arguments(parser):

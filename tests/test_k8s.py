@@ -502,3 +502,61 @@ def test_cli_dry_run_exit_code_is_zero():
         capture_output=True, text=True, cwd=repo,
     )
     assert proc.returncode == 0, proc.stderr[-500:]
+
+
+def test_reference_ci_command_lines_parse():
+    """The reference's own CI submissions (scripts/client_test.sh:24-90)
+    must parse against this client verbatim — including bool spellings
+    (--use_async=True) and the evaluate/predict flag groups."""
+    from elasticdl_tpu.client.main import build_parser
+
+    p = build_parser()
+    train = p.parse_args([
+        "train", "--image_name=elasticdl:ci", "--model_zoo=model_zoo",
+        "--model_def=deepfm_functional_api.deepfm_functional_api"
+        ".custom_model",
+        "--training_data=/data/frappe/train",
+        "--validation_data=/data/frappe/test", "--num_epochs=1",
+        "--master_resource_request=cpu=0.2,memory=1024Mi",
+        "--master_resource_limit=cpu=1,memory=2048Mi",
+        "--worker_resource_request=cpu=0.4,memory=2048Mi",
+        "--worker_resource_limit=cpu=1,memory=3072Mi",
+        "--ps_resource_request=cpu=0.2,memory=1024Mi",
+        "--ps_resource_limit=cpu=1,memory=2048Mi",
+        "--minibatch_size=64", "--num_minibatches_per_task=2",
+        "--num_workers=2", "--num_ps_pods=2", "--checkpoint_steps=500",
+        "--evaluation_steps=500",
+        "--tensorboard_log_dir=/tmp/tensorboard-log",
+        "--grads_to_wait=1", "--use_async=True",
+        "--job_name=test-train", "--log_level=INFO",
+        "--image_pull_policy=Never",
+        "--output=/data/saved_model/model_output",
+        "--volume=host_path=/d,mount_path=/data",
+    ])
+    assert train.use_async == 1  # "True" -> 1
+
+    evaluate = p.parse_args([
+        "evaluate", "--image_name=elasticdl:ci",
+        "--model_zoo=model_zoo",
+        "--model_def=mnist.mnist_functional_api.custom_model",
+        "--checkpoint_dir_for_init=/ckpt/version-100",
+        "--validation_data=/data/mnist/test", "--num_epochs=1",
+        "--minibatch_size=64", "--num_minibatches_per_task=2",
+        "--num_workers=2", "--num_ps_pods=2", "--evaluation_steps=15",
+        "--tensorboard_log_dir=/tmp/tensorboard-log",
+        "--job_name=test-evaluate", "--log_level=INFO",
+        "--image_pull_policy=Never",
+        "--volume=host_path=/d,mount_path=/data",
+    ])
+    assert evaluate.num_minibatches_per_task == 2
+
+    predict = p.parse_args([
+        "predict", "--image_name=elasticdl:ci",
+        "--model_zoo=model_zoo",
+        "--model_def=mnist.mnist_functional_api.custom_model",
+        "--checkpoint_dir_for_init=/ckpt/version-100",
+        "--prediction_data=/data/mnist/test", "--minibatch_size=64",
+        "--num_minibatches_per_task=2", "--num_workers=2",
+        "--num_ps_pods=2", "--job_name=test-predict",
+    ])
+    assert predict.prediction_data == "/data/mnist/test"
