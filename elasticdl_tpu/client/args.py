@@ -15,9 +15,9 @@ import argparse
 
 from elasticdl_tpu.common.args import (
     LOG_LOSS_STEPS_DEFAULT,
+    add_bool_argument,
     add_logging_arguments,
     add_symbol_override_arguments,
-    bool_flag,
 )
 
 
@@ -158,12 +158,10 @@ def add_train_arguments(parser):
     # /root/reference/elasticdl_client/common/args.py: use_async,
     # grads_to_wait, lr_staleness_modulation, sync_version_tolerance);
     # forwarded to the master, which marshals them into PS pod commands
-    parser.add_argument("--use_async", type=bool_flag, default=1)
+    add_bool_argument(parser, "--use_async", default=0)
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
-    parser.add_argument(
-        "--lr_staleness_modulation", type=bool_flag, default=1
-    )
+    add_bool_argument(parser, "--lr_staleness_modulation", default=0)
     # lockstep consensus cadence; forwarded master -> worker pods
     parser.add_argument("--consensus_interval", type=int, default=1)
     parser.add_argument("--tensorboard_log_dir", default="")

@@ -77,12 +77,10 @@ def parse_master_args(argv=None):
     # sparse host-PS mode, marshalled into PS pod command lines by the
     # pod manager (reference: client flags forwarded Go-PS style,
     # /root/reference/elasticdl/python/master/master.py:392-539)
-    parser.add_argument("--use_async", type=bool_flag, default=1)
+    add_bool_argument(parser, "--use_async", default=0)
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
-    parser.add_argument(
-        "--lr_staleness_modulation", type=bool_flag, default=1
-    )
+    add_bool_argument(parser, "--lr_staleness_modulation", default=0)
     # flags the client CLI forwards (client/args.py); consumed when the
     # master provisions pods via the instance manager
     parser.add_argument("--job_name", default="")
@@ -213,6 +211,23 @@ def bool_flag(value):
         return 0
     raise argparse.ArgumentTypeError(
         "expected a boolean (true/false/1/0), got %r" % (value,)
+    )
+
+
+def add_bool_argument(parser, name, default=0, help=None):
+    """Register a bool flag the way the reference's ``add_bool_param``
+    does (/root/reference/elasticdl_client/common/args.py:532-540):
+    ``nargs="?"`` with ``const=not default`` so the bare spelling
+    (``--use_async`` with no value) flips the default, while the
+    explicit spellings (``--use_async=True``, ``--use_async 1``) still
+    parse via ``bool_flag``."""
+    parser.add_argument(
+        name,
+        nargs="?",
+        const=0 if default else 1,
+        type=bool_flag,
+        default=default,
+        help=help,
     )
 
 

@@ -8,6 +8,10 @@ _initialized = False
 # set by configure(); wins over the per-call default so loggers created
 # AFTER --log_level is applied still honor it
 _configured_level = None
+# the FileHandler installed by configure(); re-configure replaces it
+# instead of stacking a second one (LocalExecutor and tests call
+# configure() more than once per process)
+_file_handler = None
 
 
 def default_logger(name: str = "elasticdl_tpu", level: int = logging.INFO):
@@ -45,6 +49,11 @@ def configure(log_level: str = "", log_file_path: str = ""):
                 logger.setLevel(level)
         logging.getLogger("elasticdl_tpu").setLevel(level)
     if log_file_path:
-        handler = logging.FileHandler(log_file_path)
-        handler.setFormatter(logging.Formatter(_DEFAULT_FMT))
-        logging.getLogger().addHandler(handler)
+        global _file_handler
+        root = logging.getLogger()
+        if _file_handler is not None:
+            root.removeHandler(_file_handler)
+            _file_handler.close()
+        _file_handler = logging.FileHandler(log_file_path)
+        _file_handler.setFormatter(logging.Formatter(_DEFAULT_FMT))
+        root.addHandler(_file_handler)

@@ -12,7 +12,7 @@ import time
 
 import grpc
 
-from elasticdl_tpu.common.args import bool_flag
+from elasticdl_tpu.common.args import add_bool_argument
 from elasticdl_tpu.common.grpc_utils import build_server
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
@@ -42,14 +42,12 @@ def parse_ps_args(argv=None):
     parser.add_argument("--seed", type=int, default=0)
     # sync-SGD controls (reference go/cmd/elasticdl_ps/main.go flags
     # use_async/grads_to_wait/sync_version_tolerance)
-    parser.add_argument("--use_async", type=bool_flag, default=1)
+    add_bool_argument(parser, "--use_async", default=0)
     parser.add_argument("--grads_to_wait", type=int, default=1)
     parser.add_argument("--sync_version_tolerance", type=int, default=0)
     # async-mode staleness LR modulation lr /= max(1, version_diff)
     # (reference go/cmd/elasticdl_ps/main.go lr_staleness_modulation)
-    parser.add_argument(
-        "--lr_staleness_modulation", type=bool_flag, default=1
-    )
+    add_bool_argument(parser, "--lr_staleness_modulation", default=0)
     # benchmarking knob: sleep this long at the top of every RPC handler
     # to emulate network RTT between worker and PS pods (the
     # controlled-latency experiment behind docs/PERF_SPARSE.md — a

@@ -41,6 +41,11 @@ class PserverServicer:
         checkpoint_saver=None,
         checkpoint_steps=0,
         master_client=None,
+        # async SGD for the bare constructor (the embedded-PS test
+        # surface); the FLAG default is sync=reference parity — the
+        # server entry always passes use_async explicitly
+        # (ps/server.py:117), so this Python default never reaches a
+        # CLI-launched PS
         use_async=True,
         grads_to_wait=1,
         sync_version_tolerance=0,

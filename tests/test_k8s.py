@@ -560,3 +560,39 @@ def test_reference_ci_command_lines_parse():
         "--num_ps_pods=2", "--job_name=test-predict",
     ])
     assert predict.prediction_data == "/data/mnist/test"
+
+
+def test_bool_flag_defaults_and_bare_spelling_match_reference():
+    """--use_async / --lr_staleness_modulation default to False like
+    the reference (elasticdl_client/common/args.py:151-163), and the
+    bare spelling (no value) flips the default the way the reference's
+    add_bool_param (nargs="?", const=not default) does."""
+    from elasticdl_tpu.client.main import build_parser
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.ps.server import parse_ps_args
+
+    base = [
+        "train", "--image_name=i", "--model_zoo=m", "--job_name=j",
+    ]
+    p = build_parser()
+    omitted = p.parse_args(base)
+    assert omitted.use_async == 0
+    assert omitted.lr_staleness_modulation == 0
+
+    bare = p.parse_args(
+        base + ["--use_async", "--lr_staleness_modulation"]
+    )
+    assert bare.use_async == 1
+    assert bare.lr_staleness_modulation == 1
+
+    explicit = p.parse_args(
+        base + ["--use_async=False", "--lr_staleness_modulation=0"]
+    )
+    assert explicit.use_async == 0
+    assert explicit.lr_staleness_modulation == 0
+
+    # same semantics on the master and PS surfaces
+    m = parse_master_args(["--model_zoo=m"])
+    assert m.use_async == 0 and m.lr_staleness_modulation == 0
+    ps = parse_ps_args(["--use_async"])
+    assert ps.use_async == 1 and ps.lr_staleness_modulation == 0
