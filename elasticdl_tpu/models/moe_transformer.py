@@ -21,8 +21,11 @@ from elasticdl_tpu.models.transformer import Attention, Block
 from elasticdl_tpu.ops.moe import (
     expert_capacity,
     moe_combine,
+    moe_combine_compact,
     moe_dispatch,
+    moe_dispatch_compact,
     top_k_routing,
+    top_k_routing_compact,
 )
 from elasticdl_tpu.parallel.mesh import DATA_AXES
 from elasticdl_tpu.parallel.sharding import ShardingRules
@@ -41,13 +44,34 @@ def _constrain(x, mesh, spec):
 
 
 class MoeMlp(nn.Module):
-    """Top-k routed expert FFN (GShard dispatch, Switch aux loss)."""
+    """Top-k routed expert FFN (GShard dispatch, Switch aux loss).
+
+    Two dispatch implementations with identical semantics
+    (``tests/test_moe.py::test_compact_dispatch_matches_onehot``):
+
+    - ``"onehot"`` (= ``"auto"``, the measured default) — GShard
+      dispatch/combine einsums. The one-hot contraction is MXU work,
+      so it scales with batch (59.8% MFU at the docs/PERF_MOE.md
+      B=16 config), and under GSPMD with tokens dp-sharded and
+      experts ep-sharded these einsums ARE the dp→ep all-to-alls.
+    - ``"compact"`` — slot-index gathers with gather-only custom
+      backwards (ops/moe.py). No (G, S, E, C) one-hots and ~10% fewer
+      executed FLOPs, but XLA lowers TPU row-gathers at ~200 GB/s, so
+      it measured SLOWER end-to-end than the einsums at every batch
+      tried — kept as an explicit option and a measured negative
+      (docs/PERF_MOE.md round 5); a Pallas gather kernel is the known
+      path to make it win.
+    """
 
     num_experts: int
     mlp_ratio: int = 4
     top_k: int = 2
     capacity_factor: float = 1.25
+    dispatch_impl: str = "auto"
     mesh: Optional[Any] = None
+
+    def _use_compact(self):
+        return self.dispatch_impl == "compact"
 
     @nn.compact
     def __call__(self, x):
@@ -59,12 +83,20 @@ class MoeMlp(nn.Module):
         router_logits = nn.Dense(
             self.num_experts, use_bias=False, name="router"
         )(x)
-        combine, dispatch, aux_loss = top_k_routing(
-            router_logits, self.top_k, capacity
-        )
-
-        # (E, G, C, M): the dispatch einsum is the dp→ep all-to-all.
-        expert_in = moe_dispatch(x, dispatch)
+        compact = self._use_compact()
+        if compact:
+            gates, slot, aux_loss = top_k_routing_compact(
+                router_logits, self.top_k, capacity
+            )
+            expert_in = moe_dispatch_compact(
+                x, slot, self.num_experts, capacity
+            )
+        else:
+            combine, dispatch, aux_loss = top_k_routing(
+                router_logits, self.top_k, capacity
+            )
+            # (E, G, C, M): the dispatch einsum is the dp→ep all-to-all.
+            expert_in = moe_dispatch(x, dispatch)
         expert_in = _constrain(
             expert_in, self.mesh, P("ep", DATA_AXES, None, None)
         )
@@ -84,7 +116,10 @@ class MoeMlp(nn.Module):
         out = _constrain(
             out, self.mesh, P("ep", DATA_AXES, None, None)
         )
-        y = moe_combine(out, combine)  # ep→dp all-to-all back
+        if compact:
+            y = moe_combine_compact(out, slot, gates)
+        else:
+            y = moe_combine(out, combine)  # ep→dp all-to-all back
         return y, aux_loss
 
 
@@ -95,6 +130,7 @@ class MoeBlock(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     attention_impl: str = "auto"
+    dispatch_impl: str = "auto"
     mesh: Optional[Any] = None
 
     @nn.compact
@@ -112,6 +148,7 @@ class MoeBlock(nn.Module):
             mlp_ratio=self.mlp_ratio,
             top_k=self.top_k,
             capacity_factor=self.capacity_factor,
+            dispatch_impl=self.dispatch_impl,
             mesh=self.mesh,
             name="moe_mlp",
         )(h)
@@ -136,6 +173,7 @@ class MoeTransformerLM(nn.Module):
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     attention_impl: str = "auto"
+    dispatch_impl: str = "auto"
     mesh: Optional[Any] = None
 
     @nn.compact
@@ -153,6 +191,7 @@ class MoeTransformerLM(nn.Module):
                     top_k=self.top_k,
                     capacity_factor=self.capacity_factor,
                     attention_impl=self.attention_impl,
+                    dispatch_impl=self.dispatch_impl,
                     mesh=self.mesh,
                     name="block_%d" % i,
                 )(x, training)

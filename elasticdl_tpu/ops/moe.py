@@ -88,6 +88,222 @@ def top_k_routing(router_logits, k, capacity):
     return combine, dispatch, aux_loss
 
 
+def top_k_routing_compact(router_logits, k, capacity):
+    """Slot-index routing: the same assignment policy as
+    ``top_k_routing`` (choice-rank-major priority, cumsum order within
+    a rank, capacity overflow dropped) but WITHOUT materializing the
+    (G, S, E, C) one-hot tensors — it returns flat slot ids instead.
+
+    The on-chip trace of the einsum formulation
+    (docs/traces/moe_v5e_summary.txt) showed the one-hot dispatch/
+    combine einsums and their (G, S, E, C) operands dragging the
+    matmul-fusion bandwidth to 404 GB/s; this form replaces them with
+    O(S·k) index arithmetic so dispatch/combine become gathers.
+
+    Returns:
+      gates: (G, k, S) float32, rank-major combine weights (zero is
+        NOT forced for dropped tokens — the combine gather reads a
+        zero row for them instead).
+      slot: (G, k*S) int32 — flat ``expert * capacity + position``
+        slot id per (rank, token), rank-major; dropped tokens get the
+        out-of-range id ``E * capacity`` (the zero-pad row).
+      aux_loss: identical to ``top_k_routing``.
+    """
+    num_groups, seq, num_experts = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gates, indices = jax.lax.top_k(probs, k)  # (G, S, k)
+    gates = gates / (gates.sum(axis=-1, keepdims=True) + 1e-9)
+
+    first_choice = jax.nn.one_hot(indices[..., 0], num_experts)
+    tokens_per_expert = first_choice.mean(axis=(0, 1))
+    prob_per_expert = probs.mean(axis=(0, 1))
+    aux_loss = num_experts * jnp.sum(tokens_per_expert * prob_per_expert)
+
+    # Rank-major flat order (rank 0 of every token precedes rank 1, and
+    # within a rank earlier tokens win) — the priority top_k_routing's
+    # per-rank cumsum loop implements. Position = number of prior
+    # assignments to the same expert in this order; counting dropped
+    # priors too is equivalent (a prior overflow forces >= C either
+    # way), so no per-rank clamped-occupancy carry is needed.
+    e_flat = indices.transpose(0, 2, 1).reshape(
+        num_groups, k * seq
+    )  # (G, kS)
+    onehot = jax.nn.one_hot(e_flat, num_experts, dtype=jnp.int32)
+    prior = jnp.cumsum(onehot, axis=1) - onehot  # (G, kS, E)
+    position = jnp.take_along_axis(
+        prior, e_flat[:, :, None], axis=2
+    )[..., 0]  # (G, kS)
+    slot = jnp.where(
+        position < capacity,
+        e_flat * capacity + position,
+        num_experts * capacity,
+    ).astype(jnp.int32)
+    return gates.transpose(0, 2, 1), slot, aux_loss
+
+
+def _invert_slots(slot, n_slots):
+    """(G, kS) slot ids → (G, n_slots) flat FILLER index per slot
+    (sentinel kS for empty slots). Valid slot ids are unique by
+    construction; only the dummy slot n_slots collides, and that
+    column is sliced off. This tiny int32 scatter is the ONLY scatter
+    in the compact formulation — because the slot mapping is
+    invertible, every M-wide data movement (including both autodiff
+    backwards, see the custom VJPs below) is a gather, which the TPU
+    streams at memory bandwidth where XLA's scatter-add lowering was
+    measured at 93 GB/s (docs/PERF_MOE.md trace)."""
+    num_groups, flat = slot.shape
+    j_ids = jnp.broadcast_to(
+        jnp.arange(flat, dtype=jnp.int32), (num_groups, flat)
+    )
+    j_for_slot = jnp.full(
+        (num_groups, n_slots + 1), flat, dtype=jnp.int32
+    )
+    return j_for_slot.at[
+        jnp.arange(num_groups)[:, None], slot
+    ].set(j_ids)[:, :n_slots]
+
+
+@jax.custom_vjp
+def _dispatch_gather(x, slot, j_for_slot):
+    num_groups, seq, dim = x.shape
+    flat = slot.shape[1]
+    token = jnp.where(j_for_slot == flat, seq, j_for_slot % seq)
+    x_pad = jnp.concatenate(
+        [x, jnp.zeros((num_groups, 1, dim), x.dtype)], axis=1
+    )
+    return jnp.take_along_axis(
+        x_pad, token[:, :, None], axis=1
+    )  # (G, E*C, M)
+
+
+def _dispatch_gather_fwd(x, slot, j_for_slot):
+    return _dispatch_gather(x, slot, j_for_slot), (slot, x.shape)
+
+
+def _dispatch_gather_bwd(res, d_out):
+    """dx[g,s] = Σ_r d_out[g, slot[g, r·S+s]] — a GATHER through the
+    forward index (dropped ranks hit the zero pad row), where plain
+    autodiff of take_along_axis would emit a scatter-add."""
+    slot, (num_groups, seq, dim) = res
+    k = slot.shape[1] // seq
+    d_out_pad = jnp.concatenate(
+        [d_out, jnp.zeros((num_groups, 1, dim), d_out.dtype)], axis=1
+    )
+    rows = jnp.take_along_axis(d_out_pad, slot[:, :, None], axis=1)
+    dx = rows.reshape(num_groups, k, seq, dim).sum(axis=1)
+    return (dx, None, None)
+
+
+_dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
+
+
+def moe_dispatch_compact(x, slot, num_experts, capacity):
+    """Token stream → per-expert buffers via an inverse-permutation
+    gather (no (G, S, E, C) one-hot, no dispatch matmul FLOPs).
+
+    x: (G, S, M); slot: (G, k*S) from ``top_k_routing_compact``
+    → (E, G, C, M). Same semantics as ``moe_dispatch(x, dispatch)``:
+    a slot holds its token's embedding, empty slots are zero.
+    """
+    num_groups, _, dim = x.shape
+    j_for_slot = _invert_slots(slot, num_experts * capacity)
+    out = _dispatch_gather(x, slot, j_for_slot)
+    return out.reshape(
+        num_groups, num_experts, capacity, dim
+    ).transpose(1, 0, 2, 3)
+
+
+@jax.custom_vjp
+def _combine_gather(eo_flat, gates, slot, j_for_slot):
+    """eo_flat: (G, E*C, M); gates: (G, k, S) → y (G, S, M)."""
+    num_groups, _, dim = eo_flat.shape
+    k = gates.shape[1]
+    seq = slot.shape[1] // k
+    eo_pad = jnp.concatenate(
+        [eo_flat, jnp.zeros((num_groups, 1, dim), eo_flat.dtype)],
+        axis=1,
+    )
+    rows = jnp.take_along_axis(eo_pad, slot[:, :, None], axis=1)
+    rows = rows.reshape(num_groups, k, seq, dim)
+    return (rows * gates[..., None].astype(rows.dtype)).sum(axis=1)
+
+
+def _combine_gather_fwd(eo_flat, gates, slot, j_for_slot):
+    return (
+        _combine_gather(eo_flat, gates, slot, j_for_slot),
+        (eo_flat, gates, slot, j_for_slot),
+    )
+
+
+def _combine_gather_bwd(res, dy):
+    """Both cotangents are gathers:
+    d_eo[g,n] = gate_of_filler(n) · dy[g, token_of_filler(n)] (each
+    slot has at most ONE filler — the inverse index j_for_slot), and
+    d_gates[g,r,s] = <dy[g,s], eo[g, slot[g,r·S+s]]> (re-gather of the
+    forward rows). Plain autodiff would scatter-add gate-weighted dy
+    rows into the expert buffers instead."""
+    eo_flat, gates, slot, j_for_slot = res
+    num_groups, _, dim = eo_flat.shape
+    k = gates.shape[1]
+    flat = slot.shape[1]
+    seq = flat // k
+
+    # d_gates: recompute the forward row gather (cheap; saves keeping
+    # the (G, kS, M) rows tensor alive as a residual)
+    eo_pad = jnp.concatenate(
+        [eo_flat, jnp.zeros((num_groups, 1, dim), eo_flat.dtype)],
+        axis=1,
+    )
+    rows = jnp.take_along_axis(eo_pad, slot[:, :, None], axis=1)
+    rows = rows.reshape(num_groups, k, seq, dim)
+    d_gates = (
+        rows.astype(jnp.float32) * dy[:, None].astype(jnp.float32)
+    ).sum(axis=-1).astype(gates.dtype)
+
+    # d_eo: gather dy by each slot's filler token, weighted by the
+    # filler's gate (empty slots: sentinel j = kS hits the zero pads)
+    token = jnp.where(j_for_slot == flat, seq, j_for_slot % seq)
+    dy_pad = jnp.concatenate(
+        [dy, jnp.zeros((num_groups, 1, dim), dy.dtype)], axis=1
+    )
+    gate_flat_pad = jnp.concatenate(
+        [
+            gates.reshape(num_groups, flat),
+            jnp.zeros((num_groups, 1), gates.dtype),
+        ],
+        axis=1,
+    )
+    d_rows = jnp.take_along_axis(dy_pad, token[:, :, None], axis=1)
+    gate_for_slot = jnp.take_along_axis(
+        gate_flat_pad, j_for_slot, axis=1
+    )
+    d_eo = (
+        d_rows * gate_for_slot[:, :, None].astype(d_rows.dtype)
+    ).astype(eo_flat.dtype)
+    return (d_eo, d_gates, None, None)
+
+
+_combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
+
+
+def moe_combine_compact(expert_out, slot, gates):
+    """Per-expert buffers → token stream: gather each (rank, token)'s
+    slot row back and sum over ranks weighted by the gates.
+
+    expert_out: (E, G, C, M); slot: (G, k*S); gates: (G, k, S)
+    → (G, S, M). Dropped tokens point at the zero pad row, so their
+    contribution is zero — identical to ``moe_combine``'s zero combine
+    weights (including the zero gate-gradient for dropped tokens:
+    d(gate) = <dy, zero row> = 0 on both paths).
+    """
+    num_experts, num_groups, capacity, dim = expert_out.shape
+    eo_flat = expert_out.transpose(1, 0, 2, 3).reshape(
+        num_groups, num_experts * capacity, dim
+    )
+    j_for_slot = _invert_slots(slot, num_experts * capacity)
+    return _combine_gather(eo_flat, gates, slot, j_for_slot)
+
+
 def moe_dispatch(x, dispatch):
     """Token stream → per-expert buffers.
 

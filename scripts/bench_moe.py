@@ -39,9 +39,13 @@ def dense_flops(d, layers, seq, batch, vocab, mlp_ratio):
 
 
 def moe_flops(d, layers, seq, batch, vocab, mlp_ratio, num_experts, k,
-              capacity_factor):
+              capacity_factor, compact_dispatch):
     """Exact matmul FLOPs of MoeTransformerLM: MoE FFN in every other
-    block (models/moe_transformer.py), static capacity C per group."""
+    block (models/moe_transformer.py), static capacity C per group.
+
+    The compact (slot-index gather) dispatch executes NO dispatch/
+    combine matmuls — those terms only exist on the one-hot einsum
+    path, so each arm's MFU divides by the FLOPs it actually runs."""
     from elasticdl_tpu.ops.moe import expert_capacity
 
     tokens = batch * seq
@@ -57,9 +61,15 @@ def moe_flops(d, layers, seq, batch, vocab, mlp_ratio, num_experts, k,
     # expert FFNs: every (expert, slot) computes, full or not
     slots = batch * num_experts * capacity
     ffn_moe = 2 * slots * (2 * d * ff) * moe_layers
-    # router + dispatch/combine einsums (gsec,gsm->egcm and back)
+    # router; dispatch/combine einsums (gsec,gsm->egcm and back) are
+    # matmuls only on the one-hot path — the compact path gathers
     router = 2 * tokens * d * num_experts * moe_layers
-    dispatch = 2 * 2 * batch * seq * num_experts * capacity * d * moe_layers
+    if compact_dispatch:
+        dispatch = 0
+    else:
+        dispatch = (
+            2 * 2 * batch * seq * num_experts * capacity * d * moe_layers
+        )
     head = 2 * tokens * d * vocab
     return 3 * (proj_attn + attn + ffn_dense + ffn_moe + router
                 + dispatch + head)
@@ -74,7 +84,12 @@ def run_arm(model, loss_fn, flops, batch_tokens, args, profile_dir=None):
     from elasticdl_tpu.train.step_fns import make_train_step
     from elasticdl_tpu.train.train_state import create_train_state
 
-    tx = create_optimizer("AdamW", learning_rate=3e-4, weight_decay=0.01)
+    if args.opt == "AdamW":
+        tx = create_optimizer(
+            "AdamW", learning_rate=3e-4, weight_decay=0.01
+        )
+    else:  # decomposition arm: no m/v state traffic (docs/PERF_MOE.md)
+        tx = create_optimizer(args.opt, learning_rate=3e-4)
     train_step = make_train_step(
         model, loss_fn, tx, compute_dtype=jnp.bfloat16
     )
@@ -145,6 +160,17 @@ def main():
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--attn", default="pallas")
     p.add_argument(
+        "--opt", default="AdamW",
+        help="optimizer for BOTH arms (SGD isolates the optimizer-"
+             "state-traffic share of the MoE step premium)",
+    )
+    p.add_argument(
+        "--dispatch", default="auto",
+        choices=["auto", "compact", "onehot"],
+        help="MoE dispatch impl (auto = the one-hot einsums, the "
+             "measured default; compact = the slot-index gather path)",
+    )
+    p.add_argument(
         "--profile", default=None,
         help="trace dir for the MoE arm (HLO-category summary printed)",
     )
@@ -173,13 +199,18 @@ def main():
         top_k=args.top_k,
         capacity_factor=args.capacity_factor,
         attention_impl=args.attn,
+        dispatch_impl=args.dispatch,
     )
+    # "auto" resolves to the one-hot einsums (models/moe_transformer.py:
+    # the measured default); only an explicit --dispatch compact drops
+    # the dispatch-einsum FLOPs from the count
+    compact = args.dispatch == "compact"
     moe = run_arm(
         moe_model,
         moe_transformer.loss,
         moe_flops(args.d, args.layers, args.seq, args.batch, args.vocab,
                   args.mlp_ratio, args.experts, args.top_k,
-                  args.capacity_factor),
+                  args.capacity_factor, compact),
         batch_tokens,
         args,
         profile_dir=args.profile,
@@ -216,6 +247,7 @@ def main():
             "moe_mlp_ratio": args.mlp_ratio,
             "dense_mlp_ratio_matched": dense_ratio,
             "attn": args.attn,
+            "dispatch": args.dispatch,
         },
         "moe": moe,
         "dense_matched_active": dense,
