@@ -67,9 +67,16 @@ class PserverServicer:
         self._grads_to_wait = max(1, grads_to_wait)
         self._sync_tolerance = max(0, sync_version_tolerance)
         self._push_lock = threading.Lock()
-        self._grad_buffer = {}  # name -> ([values...], [ids...])
-        self._buffer_count = 0
-        self._buffer_scale_sum = 0.0  # sum of per-push lr_scale
+        # The round buffer is keyed by WORKER identity (anonymous
+        # pushes get a unique sequence key = the reference's plain
+        # counting): a second push from the same worker inside one
+        # unapplied round replaces its first — a worker killed
+        # mid-round would otherwise leave an orphaned half-round that
+        # pairs its round-k grads with peers' round-k+1 grads forever
+        # after, costing one spurious version rejection every round
+        # (observed in the SIGKILL chaos test before this keying).
+        self._round_buffer = {}  # worker key -> ({name: (vals, ids)}, scale)
+        self._anon_seq = 0
 
     # ------------------------------------------------------------------
     def push_model(self, request, context=None):
@@ -167,32 +174,45 @@ class PserverServicer:
             # at apply time (workers in a sync round share one schedule,
             # so the mean is the schedule value).
             push_scale = request.lr_scale if request.lr_scale > 0 else 1.0
+            if request.HasField("worker_id"):
+                key = ("worker", request.worker_id)
+                if key in self._round_buffer:
+                    logger.warning(
+                        "sync PS: worker %d re-pushed within one round "
+                        "at version %d — replacing its buffered "
+                        "half-round (previous incarnation died "
+                        "mid-round)", request.worker_id, version,
+                    )
+            else:
+                key = ("anon", self._anon_seq)
+                self._anon_seq += 1
+            tables = {}
             for name, slices in request.gradients.embedding_tables.items():
-                values, ids = deserialize_indexed_slices(slices)
-                bucket = self._grad_buffer.setdefault(name, ([], [], []))
-                bucket[0].append(values)
-                bucket[1].append(ids)
-                bucket[2].append(push_scale)
-            self._buffer_count += 1
-            self._buffer_scale_sum += push_scale
-            if self._buffer_count < self._grads_to_wait:
+                tables[name] = deserialize_indexed_slices(slices)
+            self._round_buffer[key] = (tables, push_scale)
+            if len(self._round_buffer) < self._grads_to_wait:
                 return pb.PushGradientsResponse(
                     accepted=True, version=version
                 )
-            apply_scale = self._buffer_scale_sum / self._buffer_count
-            for name, (values_list, ids_list, scales) in (
-                self._grad_buffer.items()
-            ):
-                # Unequal per-push scales (e.g. a late joiner mid-warmup
-                # admitted by sync_version_tolerance) can't be expressed
-                # exactly in one adaptive-optimizer apply; re-weight each
-                # push by scale/apply_scale — exact for SGD, and for
-                # slot-state optimizers the ratio is 1 in the common
-                # equal-schedule case so no corruption is introduced.
-                values_list = [
-                    v * (s / apply_scale) if s != apply_scale else v
-                    for v, s in zip(values_list, scales)
-                ]
+            scales = [s for _, s in self._round_buffer.values()]
+            apply_scale = sum(scales) / len(scales)
+            merged = {}  # name -> ([values...], [ids...])
+            for tables, scale in self._round_buffer.values():
+                for name, (values, ids) in tables.items():
+                    # Unequal per-push scales (e.g. a late joiner
+                    # mid-warmup admitted by sync_version_tolerance)
+                    # can't be expressed exactly in one
+                    # adaptive-optimizer apply; re-weight each push by
+                    # scale/apply_scale — exact for SGD, and for
+                    # slot-state optimizers the ratio is 1 in the
+                    # common equal-schedule case so no corruption is
+                    # introduced.
+                    if scale != apply_scale:
+                        values = values * (scale / apply_scale)
+                    bucket = merged.setdefault(name, ([], []))
+                    bucket[0].append(values)
+                    bucket[1].append(ids)
+            for name, (values_list, ids_list) in merged.items():
                 values = np.concatenate(values_list, axis=0)
                 ids = np.concatenate(ids_list, axis=0)
                 # merge duplicate ids across workers into one apply
@@ -200,9 +220,7 @@ class PserverServicer:
                 self._store.push_gradients(
                     name, ids, values, lr_scale=apply_scale
                 )
-            self._grad_buffer = {}
-            self._buffer_count = 0
-            self._buffer_scale_sum = 0.0
+            self._round_buffer = {}
             self._store.bump_version()
             version = self._store.version
         self._maybe_checkpoint(version)

@@ -68,10 +68,14 @@ class PushResult(NamedTuple):
 
 
 class PSClient:
-    def __init__(self, ps_addrs):
+    def __init__(self, ps_addrs, worker_id=None):
         if isinstance(ps_addrs, str):
             ps_addrs = [a for a in ps_addrs.split(",") if a]
         self._stubs = [PserverStub(build_channel(a)) for a in ps_addrs]
+        # identity stamped onto pushes so the sync PS can key its round
+        # buffer per worker (orphaned-half-round recovery after a
+        # mid-round kill, ps/servicer.py); None = anonymous
+        self._worker_id = worker_id
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(4, len(self._stubs))
         )
@@ -182,6 +186,8 @@ class PSClient:
         for request in per_ps:
             request.gradients.version = model_version
             request.lr_scale = lr_scale
+            if self._worker_id is not None:
+                request.worker_id = self._worker_id
         for name, (values, ids) in grads_by_table.items():
             values, ids = deduplicate_indexed_slices(
                 np.asarray(values), np.asarray(ids, dtype=np.int64)

@@ -174,7 +174,9 @@ class Worker:
             trainer_kwargs["specs"] = self.spec.sparse_embedding_specs(
                 batch_size=minibatch_size
             )
-            trainer_kwargs["ps_client"] = PSClient(ps_addrs)
+            trainer_kwargs["ps_client"] = PSClient(
+                ps_addrs, worker_id=self._mc.worker_id
+            )
             if sparse_cache_staleness > 0:
                 trainer_kwargs["cache_staleness"] = sparse_cache_staleness
         else:

@@ -43,6 +43,11 @@ class DenseDumper(Callback):
         )[0]:
             flat[jax.tree_util.keystr(path)] = np.asarray(leaf)
         flat["__step"] = np.asarray(int(state.step))
+        # sync-PS retry pressure: the chaos test asserts a relaunched
+        # worker doesn't enter a version-rejection storm
+        flat["__push_rejections"] = np.asarray(
+            int(getattr(trainer, "push_rejections", 0))
+        )
         out = os.path.join(
             directory, "worker%s.npz" % self.worker._mc.worker_id
         )

@@ -438,3 +438,70 @@ def test_force_empty_push_reaches_every_shard():
     finally:
         for server in servers:
             server.stop(None)
+
+
+def _worker_push(name, values, ids, version, worker_id):
+    request = _push_request(name, values, ids, version)
+    request.worker_id = worker_id
+    return request
+
+
+def test_orphaned_half_round_replaced_on_worker_relaunch():
+    """A worker killed after pushing its half of a sync round must not
+    poison every later round: its relaunched incarnation's push (same
+    worker_id) REPLACES the orphaned buffer entry, so pairing realigns
+    immediately instead of applying round k against round k+1 forever
+    (the failure mode the SIGKILL chaos test measured as one spurious
+    rejection per round)."""
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([7], np.int64)).copy()
+
+    # worker 0 pushes round 0 then dies; worker 1's round-0 push never
+    # happened (it was mid-step at the kill)
+    r = servicer.push_gradients(
+        _worker_push("t", [[9.0, 9.0]], [7], 0, worker_id=0)
+    )
+    assert r.accepted and r.version == 0
+
+    # both workers relaunch from the checkpoint and replay round 0:
+    # worker 0's NEW push replaces its orphan (not: completes the pair)
+    r = servicer.push_gradients(
+        _worker_push("t", [[1.0, 0.0]], [7], 0, worker_id=0)
+    )
+    assert r.accepted and r.version == 0  # still buffered — no apply
+    np.testing.assert_array_equal(
+        store.lookup("t", np.array([7], np.int64)), before
+    )
+
+    # worker 1's push completes the round; the applied grads are the
+    # REPLAYED pair, not the orphan
+    r = servicer.push_gradients(
+        _worker_push("t", [[0.0, 1.0]], [7], 1, worker_id=1)
+    )
+    assert r.accepted and r.version == 1
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([7], np.int64)),
+        before - np.array([[1.0, 1.0]]),
+        rtol=1e-6,
+    )
+
+    # next round pairs cleanly — no rejection skew
+    r = servicer.push_gradients(
+        _worker_push("t", [[1.0, 0.0]], [7], 1, worker_id=0)
+    )
+    assert r.accepted and r.version == 1
+    r = servicer.push_gradients(
+        _worker_push("t", [[0.0, 1.0]], [7], 1, worker_id=1)
+    )
+    assert r.accepted and r.version == 2
+
+
+def test_anonymous_pushes_keep_counting_semantics():
+    """Pushes without worker_id count like the reference's Go PS:
+    two anonymous pushes complete a grads_to_wait=2 round even though
+    they came from 'the same' client object."""
+    servicer, store = _servicer(grads_to_wait=2)
+    r = servicer.push_gradients(_push_request("t", [[1.0, 0.0]], [2], 0))
+    assert r.accepted and r.version == 0
+    r = servicer.push_gradients(_push_request("t", [[0.0, 1.0]], [2], 0))
+    assert r.accepted and r.version == 1
