@@ -21,6 +21,26 @@ import numpy as np
 MASK_KEY = "_mask"
 
 
+class _Flush:
+    """Stream-control sentinel: "no more records are coming for now —
+    emit what you are holding". The elastic training stream WAIT-loops
+    on the master instead of ending (task_data_service
+    .training_record_stream), so a tail of records smaller than one
+    minibatch would otherwise sit in ``batch()``'s buffer forever
+    while the master waits for their task to be reported — a mutual
+    wait that hangs the job whenever dataset_size % minibatch != 0
+    (found by the co-location harness, round 5). The built-in
+    combinators pass FLUSH through untouched (map/filter/take), drain
+    their buffers on it (shuffle), or consume it by emitting the
+    pending partial padded batch (batch)."""
+
+    def __repr__(self):
+        return "<FLUSH>"
+
+
+FLUSH = _Flush()
+
+
 class Dataset:
     """A re-iterable stream of examples with functional combinators."""
 
@@ -42,14 +62,14 @@ class Dataset:
     def map(self, fn):
         def gen():
             for item in self._source_fn():
-                yield fn(item)
+                yield item if item is FLUSH else fn(item)
 
         return Dataset(gen)
 
     def filter(self, predicate):
         def gen():
             for item in self._source_fn():
-                if predicate(item):
+                if item is FLUSH or predicate(item):
                     yield item
 
         return Dataset(gen)
@@ -59,6 +79,12 @@ class Dataset:
             rng = random.Random(seed)
             buf = []
             for item in self._source_fn():
+                if item is FLUSH:
+                    rng.shuffle(buf)
+                    yield from buf
+                    buf = []
+                    yield item
+                    continue
                 buf.append(item)
                 if len(buf) >= buffer_size:
                     idx = rng.randrange(len(buf))
@@ -73,21 +99,32 @@ class Dataset:
         """Collate examples into stacked-numpy batches.
 
         The tail batch is padded (repeating the last example) with a
-        ``_mask`` array marking real rows, unless dropped.
+        ``_mask`` array marking real rows, unless dropped. A FLUSH
+        sentinel forces the pending partial batch out the same way
+        (and is consumed here — batches flow downstream, not
+        sentinels).
         """
+
+        def emit_partial(buf):
+            real = len(buf)
+            if pad_remainder:
+                buf = buf + [buf[-1]] * (batch_size - real)
+            return _collate(buf, len(buf), real=real)
 
         def gen():
             buf = []
             for item in self._source_fn():
+                if item is FLUSH:
+                    if buf and not drop_remainder:
+                        yield emit_partial(buf)
+                        buf = []
+                    continue
                 buf.append(item)
                 if len(buf) == batch_size:
                     yield _collate(buf, batch_size, real=batch_size)
                     buf = []
             if buf and not drop_remainder:
-                real = len(buf)
-                if pad_remainder:
-                    buf = buf + [buf[-1]] * (batch_size - real)
-                yield _collate(buf, len(buf), real=real)
+                yield emit_partial(buf)
 
         return Dataset(gen)
 
@@ -120,9 +157,14 @@ class Dataset:
 
     def take(self, n):
         def gen():
-            for i, item in enumerate(self._source_fn()):
-                if i >= n:
+            taken = 0
+            for item in self._source_fn():
+                if item is FLUSH:
+                    yield item
+                    continue
+                if taken >= n:
                     return
+                taken += 1
                 yield item
 
         return Dataset(gen)

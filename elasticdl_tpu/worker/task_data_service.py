@@ -45,10 +45,20 @@ class TaskDataService:
         Non-training tasks (evaluation/prediction) that the master hands
         us are parked on ``out_of_band_tasks`` for the worker to process;
         TRAIN_END_CALLBACK is remembered on ``train_end_task``.
+
+        A WAIT from the master after records were yielded emits a
+        pipeline.FLUSH sentinel first: the batcher downstream may be
+        holding a sub-minibatch tail whose task the master is waiting
+        on — without the flush, worker and master deadlock whenever the
+        records available to one stream aren't a multiple of the
+        minibatch (pipeline.py _Flush docstring has the full story).
         """
+        from elasticdl_tpu.data.pipeline import FLUSH
+
         with self._lock:
             self._stream_gen += 1
             my_gen = self._stream_gen
+        dirty = False  # records yielded since the last flush
         while True:
             with self._lock:
                 if self._stream_gen != my_gen:
@@ -56,6 +66,9 @@ class TaskDataService:
             task = self._mc.get_task()
             if task.task_id == 0:
                 if task.type == pb.WAIT:
+                    if dirty:
+                        dirty = False
+                        yield FLUSH
                     time.sleep(self._wait_sleep_secs)
                     continue
                 self.job_over = True
@@ -93,6 +106,7 @@ class TaskDataService:
                 )
                 return
             yield from self._reader.read_records(task)
+            dirty = True
 
     def report_record_done(self, count):
         """Account ``count`` consumed records to the oldest pending tasks;

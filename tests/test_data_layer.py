@@ -240,3 +240,40 @@ def test_report_parked_failed_hands_back_oob_tasks():
     assert sorted(t for t, _ in mc.reported) == [7, 9]
     assert all(err == "fatal" for _, err in mc.reported)
     assert not tds.out_of_band_tasks and tds.train_end_task is None
+
+
+def test_flush_sentinel_forces_partial_batches_through():
+    """pipeline.FLUSH passes through map/filter/take, drains shuffle,
+    and makes batch() emit its pending partial padded batch — the
+    mechanism that unjams sub-minibatch record tails on the
+    never-ending elastic training stream."""
+    from elasticdl_tpu.data.pipeline import FLUSH, Dataset, batch_real_count
+
+    def source():
+        yield from range(5)
+        yield FLUSH
+        yield from range(5, 11)
+        yield FLUSH
+        yield FLUSH  # consecutive flush with empty buffer: no-op
+
+    dataset = (
+        Dataset(source)
+        .map(lambda x: x * 2)
+        .filter(lambda x: x != 4)
+        .shuffle(buffer_size=2, seed=0)
+        .batch(4)
+    )
+    batches = list(dataset)
+    # segment 1: {0,2,6,8} (4 filtered out) -> one full batch of 4;
+    # segment 2: {10,12,14,16,18,20} -> one full batch + partial of 2
+    reals = [batch_real_count(b) for b in batches]
+    assert reals == [4, 4, 2], reals
+    seen = sorted(
+        v
+        for b in batches
+        for v, m in zip(b["features"], b["_mask"])
+        if m
+    )
+    assert seen == [0, 2, 6, 8, 10, 12, 14, 16, 18, 20]
+    # padded rows replicate the last real example
+    assert batches[-1]["_mask"].tolist() == [1.0, 1.0, 0.0, 0.0]

@@ -356,3 +356,65 @@ def test_stateless_worker_restores_checkpoint_for_export(tmp_path):
         assert step > 0
     finally:
         server.stop(None)
+
+
+def test_job_completes_when_dataset_not_batch_divisible(tmp_path):
+    """Regression: a record tail smaller than one minibatch used to
+    deadlock the job — the elastic stream WAIT-loops (never "ends"),
+    so batch() held the tail forever while the master waited for its
+    task to be reported. The WAIT now emits a pipeline.FLUSH that
+    forces the partial (masked) batch out. Found by the co-location
+    harness (scripts/bench_utilization.py), whose digits dataset is
+    1,797 records."""
+    train_dir = tmp_path / "train"
+    valid_dir = tmp_path / "valid"
+    train_dir.mkdir()
+    valid_dir.mkdir()
+    # 70 records, tasks of 32, minibatch 64: the last stream segment
+    # is 6 records — strictly smaller than one minibatch
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=70, seed=0)
+    create_mnist_recordio(str(valid_dir / "f0.rec"), num_records=64, seed=1)
+
+    train_reader = RecordIODataReader(data_dir=str(train_dir))
+    valid_reader = RecordIODataReader(data_dir=str(valid_dir))
+    dispatcher = TaskDispatcher(
+        training_shards=train_reader.create_shards(),
+        evaluation_shards=valid_reader.create_shards(),
+        records_per_task=32,
+        num_epochs=1,
+        seed=0,
+    )
+    evals = EvaluationService(
+        dispatcher, lambda: {"accuracy": Accuracy()}, eval_steps=0
+    )
+    servicer = MasterServicer(dispatcher, evals)
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    try:
+        worker = Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "tests.models.mnist_with_export",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=64,
+            report_version_steps=4,
+            wait_sleep_secs=0.1,
+        )
+        done = {}
+
+        def run():
+            worker.run()
+            done["ok"] = True
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout=120)
+        assert done.get("ok"), (
+            "job hung: worker never drained the sub-minibatch tail"
+        )
+        assert dispatcher.finished()
+        assert not dispatcher.job_failed()
+    finally:
+        server.stop(None)
