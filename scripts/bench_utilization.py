@@ -1,0 +1,365 @@
+#!/usr/bin/env python
+"""Resource-utilization analogue of the reference's §B cluster
+experiment (/root/reference/docs/benchmark/report_cn.md:90-104): the
+reference co-located ElasticDL training with an autoscaling NGINX
+deployment and measured >90% sustained cluster CPU utilization —
+elastic training backfills whatever capacity the foreground service
+isn't using, and yields it back when demand returns.
+
+This is the one-box miniature that environment can run (no cluster,
+no container runtime, **nproc=1** — see the honesty notes at the
+bottom of docs/UTILIZATION.md):
+
+- A FOREGROUND SERVICE process whose CPU demand oscillates
+  sinusoidally (duty-cycled busy loop, period --period_secs),
+  standing in for the autoscaling NGINX deployment.
+- A real training job — master task queue + `worker.main`
+  subprocess(es) training the mnist zoo CNN on generated digits
+  RecordIO — co-located under one of two policies:
+
+  * **elastic**: workers run at `nice 19`, always schedulable — the
+    kernel gives them exactly the cycles the foreground leaves idle
+    (the priority mechanics the reference delegated to K8s
+    preemption; SURVEY.md §2.10).
+  * **gang**: the job runs only when its full share is available —
+    whenever foreground demand exceeds --gang_threshold the WHOLE
+    worker group is SIGSTOPped (a gang-scheduled job cannot run
+    degraded), SIGCONTed when demand falls.
+
+Measured per arm, from /proc/stat and the service's own counters:
+
+- box CPU utilization (mean over the job's lifetime),
+- training makespan (task-queue drain time),
+- foreground service throughput (work quanta/s — interference probe).
+
+Prints one JSON line; `--write_doc` refreshes docs/UTILIZATION.md.
+Smoke-tested in CI (tests/test_utilization.py) with a tiny job.
+"""
+
+import argparse
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# --------------------------------------------------------------------
+# foreground service: oscillating duty-cycled busy loop
+# --------------------------------------------------------------------
+
+FOREGROUND_SRC = r"""
+import math, os, sys, time
+period = float(sys.argv[1])
+out_path = sys.argv[2]
+window = 0.1
+quanta = 0
+start = time.time()
+while True:
+    t = time.time() - start
+    duty = 0.5 + 0.45 * math.sin(2 * math.pi * t / period)
+    busy_until = time.time() + window * duty
+    while time.time() < busy_until:
+        quanta += 1
+        x = 1.0
+        for _ in range(2000):
+            x = x * 1.0000001 + 1e-9
+    time.sleep(max(0.0, window * (1.0 - duty)))
+    # progress counter, atomically replaced (throughput probe)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("%d %f" % (quanta, t))
+    os.replace(tmp, out_path)
+"""
+
+
+def read_proc_stat():
+    with open("/proc/stat") as f:
+        fields = f.readline().split()[1:]
+    values = [int(v) for v in fields]
+    idle = values[3] + values[4]  # idle + iowait
+    return sum(values), idle
+
+
+def foreground_demand(t, period):
+    return 0.5 + 0.45 * math.sin(2 * math.pi * t / period)
+
+
+# --------------------------------------------------------------------
+# the training job: real master + worker.main subprocess
+# --------------------------------------------------------------------
+
+
+def make_digits_data(root):
+    import numpy as np
+    from sklearn import datasets
+
+    from elasticdl_tpu.data.example import encode_example
+    from elasticdl_tpu.data.recordio import write_records
+
+    digits = datasets.load_digits()
+    os.makedirs(root, exist_ok=True)
+    payloads = []
+    for image, label in zip(digits.images, digits.target):
+        big = np.kron(image, np.ones((4, 4)))[2:30, 2:30]
+        big = (big / 16.0 * 255.0).clip(0, 255)
+        payloads.append(encode_example({
+            "image": big.astype(np.uint8), "label": np.int64(label),
+        }))
+    write_records(os.path.join(root, "f0.rec"), payloads)
+
+
+def run_arm(policy, args, train_dir, scratch):
+    """One co-located run; returns the measured dict."""
+    from elasticdl_tpu.common.grpc_utils import build_server, find_free_port
+    from elasticdl_tpu.data.readers import RecordIODataReader
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto.services import add_master_servicer_to_server
+
+    reader = RecordIODataReader(data_dir=train_dir)
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(),
+        records_per_task=args.records_per_task,
+        num_epochs=args.num_epochs,
+        seed=0,
+    )
+    servicer = MasterServicer(dispatcher, None)
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+
+    fg_progress = os.path.join(scratch, "fg_%s.txt" % policy)
+    fg = subprocess.Popen(
+        [sys.executable, "-c", FOREGROUND_SRC,
+         str(args.period_secs), fg_progress],
+    )
+    # the gang controller must track the FOREGROUND's sinusoid phase —
+    # its clock starts at the fg process spawn, NOT at the measurement
+    # window onset (which resets `start` below at the first step log)
+    fg_start = time.time()
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    worker_cmd = [
+        sys.executable, "-m", "elasticdl_tpu.worker.main",
+        "--master_addr", "localhost:%d" % port,
+        "--worker_id", "0",
+        "--model_zoo", "elasticdl_tpu.models.mnist",
+        "--training_data", train_dir,
+        "--minibatch_size", "64",
+        # early + frequent step logs: the first "step" line is the
+        # steady-state trigger that starts the measurement window
+        "--log_loss_steps", "5",
+    ]
+    if policy == "elastic":
+        worker_cmd = ["nice", "-n", "19"] + worker_cmd
+    log = open(os.path.join(scratch, "worker_%s.log" % policy), "wb")
+    worker = subprocess.Popen(
+        worker_cmd, env=env, stdout=log, stderr=subprocess.STDOUT,
+        cwd=REPO,
+    )
+
+    total0, idle0 = read_proc_stat()
+    start = time.time()
+    stopped = False
+    measuring = False  # util window starts at the first training step
+    deadline = start + args.timeout_secs
+    try:
+        while not dispatcher.finished() and time.time() < deadline:
+            if worker.poll() is not None:
+                raise RuntimeError(
+                    "worker died rc=%s; log: %s" % (
+                        worker.returncode,
+                        open(log.name, "rb").read()[-1500:],
+                    )
+                )
+            if not measuring and b"step" in open(log.name, "rb").read():
+                # exclude worker startup (imports + jit compile, ~60 s
+                # on this box) from the utilization window: the
+                # reference's claim is about STEADY-STATE backfill
+                total0, idle0 = read_proc_stat()
+                start = time.time()
+                measuring = True
+            if policy == "gang":
+                demand = foreground_demand(
+                    time.time() - fg_start, args.period_secs
+                )
+                if demand > args.gang_threshold and not stopped:
+                    os.kill(worker.pid, signal.SIGSTOP)
+                    stopped = True
+                elif demand <= args.gang_threshold and stopped:
+                    os.kill(worker.pid, signal.SIGCONT)
+                    stopped = False
+            time.sleep(0.25)
+        finished = dispatcher.finished()
+        makespan = time.time() - start
+        total1, idle1 = read_proc_stat()
+        quanta, fg_secs = 0, makespan
+        if os.path.exists(fg_progress):
+            parts = open(fg_progress).read().split()
+            quanta, fg_secs = int(parts[0]), float(parts[1])
+        busy = (total1 - total0) - (idle1 - idle0)
+        return {
+            "finished": finished,
+            "makespan_s": round(makespan, 1),
+            "box_cpu_util": round(busy / max(1, total1 - total0), 4),
+            "fg_quanta_per_s": round(quanta / max(1e-6, fg_secs), 1),
+        }
+    finally:
+        if stopped:
+            os.kill(worker.pid, signal.SIGCONT)
+        for proc in (worker, fg):
+            if proc.poll() is None:
+                proc.kill()
+        server.stop(0)
+
+
+def fg_baseline(args, scratch):
+    """Foreground alone: its unimpeded throughput + the box utilization
+    its oscillating demand leaves on the table."""
+    fg_progress = os.path.join(scratch, "fg_alone.txt")
+    fg = subprocess.Popen(
+        [sys.executable, "-c", FOREGROUND_SRC,
+         str(args.period_secs), fg_progress],
+    )
+    total0, idle0 = read_proc_stat()
+    time.sleep(args.baseline_secs)
+    total1, idle1 = read_proc_stat()
+    fg.kill()
+    quanta, fg_secs = 0, args.baseline_secs
+    if os.path.exists(fg_progress):
+        parts = open(fg_progress).read().split()
+        quanta, fg_secs = int(parts[0]), float(parts[1])
+    busy = (total1 - total0) - (idle1 - idle0)
+    return {
+        "box_cpu_util": round(busy / max(1, total1 - total0), 4),
+        "fg_quanta_per_s": round(quanta / max(1e-6, fg_secs), 1),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--period_secs", type=float, default=20.0)
+    p.add_argument("--gang_threshold", type=float, default=0.5)
+    p.add_argument("--records_per_task", type=int, default=256)
+    p.add_argument("--num_epochs", type=int, default=2)
+    p.add_argument("--timeout_secs", type=float, default=900.0)
+    p.add_argument("--baseline_secs", type=float, default=30.0)
+    p.add_argument("--scratch", default="/tmp/edl_utilization")
+    p.add_argument("--write_doc", action="store_true")
+    args = p.parse_args()
+
+    os.makedirs(args.scratch, exist_ok=True)
+    train_dir = os.path.join(args.scratch, "train")
+    if not os.path.exists(os.path.join(train_dir, "f0.rec")):
+        make_digits_data(train_dir)
+
+    baseline = fg_baseline(args, args.scratch)
+    results = {"foreground_alone": baseline}
+    for policy in ("elastic", "gang"):
+        results[policy] = run_arm(
+            policy, args, train_dir, args.scratch
+        )
+    results["config"] = {
+        "period_secs": args.period_secs,
+        "gang_threshold": args.gang_threshold,
+        "records_per_task": args.records_per_task,
+        "num_epochs": args.num_epochs,
+        "nproc": os.cpu_count(),
+    }
+    print(json.dumps(results))
+    if args.write_doc:
+        write_doc(results)
+
+
+def write_doc(results):
+    doc = os.path.join(REPO, "docs", "UTILIZATION.md")
+    cfg = results["config"]
+    base = results["foreground_alone"]
+    elastic = results["elastic"]
+    gang = results["gang"]
+    text = """# Resource utilization under co-located load (§B analogue)
+
+Miniature of the reference's cluster-utilization experiment
+(`/root/reference/docs/benchmark/report_cn.md:90-104`,
+`docs/benchmark/data/2.csv`): there, ElasticDL training co-located
+with an autoscaling NGINX deployment kept cluster CPU >90 percent
+busy. Here, a real training job (master task queue + `worker.main`
+subprocess, mnist zoo CNN on digits RecordIO) is co-located with a
+foreground service whose CPU demand oscillates sinusoidally
+(period {period:.0f} s), under two policies:
+
+- **elastic** - workers niced to 19: the kernel hands them exactly
+  the cycles the service leaves idle, and hands them back on demand
+  (the preemption mechanics the reference delegated to K8s priority).
+- **gang** - the whole worker group is SIGSTOPped whenever
+  foreground demand exceeds {thresh:.0f} percent (a gang-scheduled
+  job cannot run degraded) and resumed below it.
+
+Harness: `scripts/bench_utilization.py` (CI smoke:
+`tests/test_utilization.py`).
+
+## Measured ({date}, nproc={nproc})
+
+| arm | box CPU util | train makespan | fg throughput (quanta/s) |
+|---|---|---|---|
+| foreground alone | {base_util:.1f} percent | - | {base_fg} |
+| + elastic training | {e_util:.1f} percent | {e_mk:.0f} s | {e_fg} |
+| + gang training | {g_util:.1f} percent | {g_mk:.0f} s | {g_fg} |
+
+Reading: the oscillating service alone leaves ~{idle:.0f} percent of
+the box idle; co-locating elastic training lifts utilization to
+~{e_util:.0f} percent (the reference's headline effect) while the
+service keeps {fg_keep:.0f} percent of its solo throughput (values
+near or above 100 are run-to-run variance: the niced trainer is
+invisible to it). The gang
+policy forfeits the trough capacity it is stopped through - same box,
+{mk_ratio:.2f}x the makespan.
+
+## Honesty notes
+
+- **nproc=1 in this container**: every process time-slices one core,
+  so "utilization" measures how completely the policies fill ONE
+  core's idle gaps, not multi-core packing; the foreground and the
+  trainer contend for the same caches as well. The shape of the
+  result (elastic fills troughs, gang forfeits them) is the part
+  that transfers; the absolute percentages are not cluster numbers.
+- The gang arm's SIGSTOP policy is a stand-in for gang scheduling's
+  all-or-nothing property, not a real scheduler: a cluster gang job
+  would also pay queue/restart latency this model omits (it is
+  GENEROUS to gang).
+- The elastic arm uses OS priorities where the reference used K8s
+  priorities + pod preemption; the task queue (master/task
+  dispatcher) is identical to the one the cluster path uses.
+""".format(
+        period=cfg["period_secs"],
+        thresh=100 * cfg["gang_threshold"],
+        date=time.strftime("%Y-%m-%d"),
+        nproc=cfg["nproc"],
+        base_util=100 * base["box_cpu_util"],
+        base_fg=base["fg_quanta_per_s"],
+        e_util=100 * elastic["box_cpu_util"],
+        e_mk=elastic["makespan_s"],
+        e_fg=elastic["fg_quanta_per_s"],
+        g_util=100 * gang["box_cpu_util"],
+        g_mk=gang["makespan_s"],
+        g_fg=gang["fg_quanta_per_s"],
+        idle=100 * (1 - base["box_cpu_util"]),
+        fg_keep=100 * elastic["fg_quanta_per_s"]
+        / max(1e-9, base["fg_quanta_per_s"]),
+        mk_ratio=gang["makespan_s"] / max(1e-9, elastic["makespan_s"]),
+    )
+    with open(doc, "w") as f:
+        f.write(text)
+    print("wrote " + doc)
+
+
+if __name__ == "__main__":
+    main()

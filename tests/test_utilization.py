@@ -1,0 +1,38 @@
+"""CI smoke for the §B utilization analogue harness
+(scripts/bench_utilization.py): a tiny co-located run per policy must
+finish the job and produce sane measurements. The real (longer)
+measurement is the committed docs/UTILIZATION.md.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_utilization_harness_smoke(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "bench_utilization.py"),
+         "--period_secs", "6",
+         "--records_per_task", "512",
+         "--num_epochs", "1",
+         "--baseline_secs", "4",
+         "--timeout_secs", "240",
+         "--scratch", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    for arm in ("elastic", "gang"):
+        assert result[arm]["finished"], result
+        assert result[arm]["makespan_s"] > 0
+        assert 0 < result[arm]["box_cpu_util"] <= 1
+    assert result["foreground_alone"]["fg_quanta_per_s"] > 0
