@@ -1,15 +1,27 @@
 #!/usr/bin/env bash
 # One-command local reproduction of CI tiers 1-2
 # (.github/workflows/ci.yml; reference pipeline: .travis.yml:30-98).
+#
+# Lanes (reference parity: the travis fast/slow tier split):
+#   scripts/ci.sh        — fast lane: unit suite minus @slow (<5 min)
+#   scripts/ci.sh full   — everything, incl. multi-minute live-process
+#                          e2es (chaos, multi-worker sparse, convergence)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+LANE="${1:-fast}"
 
 echo "== tier 1a: native store build + TSAN race stress =="
 make -C elasticdl_tpu/native
 make -C elasticdl_tpu/native tsan
 
-echo "== tier 1b: unit suite (8-virtual-device CPU mesh) =="
-python -m pytest tests/ -x -q
+if [ "$LANE" = "full" ]; then
+  echo "== tier 1b: FULL unit suite (8-virtual-device CPU mesh) =="
+  python -m pytest tests/ -x -q
+else
+  echo "== tier 1b: fast-lane unit suite (pytest -m 'not slow') =="
+  python -m pytest tests/ -x -q -m "not slow"
+fi
 
 echo "== tier 2a: multi-chip SPMD dryrun (dp/fsdp, tp/sp, ep, pp, pp x tp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

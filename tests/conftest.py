@@ -23,7 +23,108 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# Suite tiering (reference parity: the two-tier travis split,
+# /root/reference/.travis.yml:30-98). Multi-minute live-process e2es carry
+# @pytest.mark.slow in their files; the list below additionally demotes the
+# heaviest convergence/SPMD tests (measured full-suite --durations, round 5)
+# so `pytest -m "not slow"` — the scripts/ci.sh fast lane — stays under
+# 5 minutes as the suite grows. Criterion: >=8 s/test on the round-5 box.
+# ---------------------------------------------------------------------------
+
+SLOW_BY_DURATION = {
+    "test_model_zoo.py": (
+        "test_vision_family_learns",        # 97 s + 42 s params
+        "test_ctr_family_learns",
+        "test_census_wide_deep_learns",
+        "test_census_sqlflow_wide_deep_learns",
+        "test_census_dnn_learns",
+    ),
+    "test_pipeline.py": (
+        "test_device_major_layout_matches_chunk_major",  # 67 s
+        "test_pipelined_lm_matches_sequential_fallback",
+        "test_pipelined_lm_trains_on_pp_mesh",
+    ),
+    "test_dense_checkpoint.py": (
+        "test_resume_onto_different_mesh",
+        "test_roundtrip_includes_optimizer_state",
+        "test_spmd_checkpoint_restores_on_single_chip",
+    ),
+    "test_transformer_spmd.py": (
+        "test_remat_policies_match_no_remat",
+        "test_spmd_tp_sp_matches_single_device",
+        "test_spmd_fsdp_transformer_runs",
+    ),
+    "test_resnet_dtypes.py": ("test_bf16_stream_f32_stats",),
+    "test_moe.py": (
+        "test_expert_parallel_matches_single_device",
+        "test_expert_balance_holds_over_a_real_run",
+        "test_moe_eval_returns_bare_logits",
+        "test_moe_lm_compact_matches_onehot_losses",
+        "test_compact_dispatch_under_dp_mesh_matches_single_device",
+    ),
+    "test_sparse_spmd.py": (
+        "test_sparse_spmd_matches_single_device",
+        "test_sparse_spmd_pads_ragged_batches",
+    ),
+    "test_sync_ps.py": ("test_two_live_sparse_trainers_race_sync_ps",),
+    "test_eval_predict_jobs.py": (
+        "test_evaluation_only_job_end_to_end",
+        "test_prediction_only_job_end_to_end",
+    ),
+    "test_local_executor.py": ("test_mnist_local_training_converges",),
+    "test_chaos.py": (
+        "test_ps_crash_restart_job_completes",
+        "test_worker_crash_recovers_and_job_completes",
+    ),
+    "test_grad_accum.py": ("test_accum_with_dropout_still_trains",),
+    "test_worker_distributed.py": (
+        "test_two_workers_share_the_queue",
+        "test_worker_checkpoint_resume_and_fatal_restore",
+    ),
+    "test_spmd_trainer.py": (
+        "test_dp8_matches_single_device_semantics",
+    ),
+    "test_sparse_pipeline.py": (
+        "test_train_stream_matches_sequential_on_disjoint_ids",
+    ),
+    "test_data_gen.py": ("test_generated_census_is_learnable",),
+    "test_tensorboard_service.py": (
+        "test_event_roundtrip_via_tensorboard_reader",
+    ),
+    "test_tutorials.py": (
+        "test_local_quickstart_runs",
+        "test_model_contract_example_satisfies_loader",
+    ),
+}
+
+
+@pytest.hookimpl(tryfirst=True)  # before -k/-m deselection filters
+def pytest_collection_modifyitems(items):
+    matched = {}  # file -> set of listed names that matched something
+    collected_files = set()
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        names = SLOW_BY_DURATION.get(fname)
+        if not names:
+            continue
+        collected_files.add(fname)
+        for name in names:
+            if item.name == name or item.name.startswith(name + "["):
+                item.add_marker(pytest.mark.slow)
+                matched.setdefault(fname, set()).add(name)
+    # staleness guard: a renamed/removed slow test must not silently
+    # re-enter the fast lane — fail collection loudly instead
+    for fname in collected_files:
+        missing = set(SLOW_BY_DURATION[fname]) - matched.get(fname, set())
+        assert not missing, (
+            "conftest SLOW_BY_DURATION lists tests that no longer exist "
+            "in %s: %s — update the list" % (fname, sorted(missing))
+        )
