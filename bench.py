@@ -177,33 +177,121 @@ def bench_deepfm():
     }
 
 
-def bench_transformer_mfu():
-    """TransformerLM training MFU, best measured single-chip config
-    (docs/PERF_TRANSFORMER.md). Runs in a subprocess so its ~10 GB of
-    device state never coexists with the ResNet bench's."""
+def bench_deepfm_latency_ab(delay_ms=50.0, steps=60):
+    """The injected-PS-latency A/B that shows WHY the pipelined stream
+    is the deployment default (docs/PERF_SPARSE.md: on this tunneled
+    box the ~230 ms device leg hides the win at 0 ms RTT; at 50-100 ms
+    emulated worker<->PS RTT the pipeline's pull-hiding is worth
+    ~1.2x). Captured so the claim has a driver artifact."""
+    sequential = deepfm_run(
+        pipelined=False, inject_rpc_delay_ms=delay_ms, steps=steps
+    )
+    pipelined = deepfm_run(
+        pipelined=True, inject_rpc_delay_ms=delay_ms, steps=steps
+    )
+    return {
+        "deepfm_pipelined_latency_speedup": round(
+            pipelined / sequential, 3
+        ),
+        "deepfm_latency_ab_delay_ms": delay_ms,
+        "deepfm_latency_ab_steps_per_sec_sequential": round(
+            sequential, 2
+        ),
+        "deepfm_latency_ab_steps_per_sec_pipelined": round(
+            pipelined, 2
+        ),
+    }
+
+
+def _run_json_script(argv, timeout=900):
+    """Run a bench script in a subprocess (the chip is exclusive on
+    single-process libtpu runtimes — the parent must not have touched
+    JAX-on-TPU yet) and return its one JSON line."""
     import os
     import subprocess
 
     out = subprocess.run(
-        [sys.executable, "scripts/bench_transformer_mfu.py",
-         "--d", "2048", "--layers", "10", "--heads", "8",
-         "--seq", "1024", "--batch", "12", "--remat", "none"],
-        capture_output=True, text=True, timeout=900,
+        [sys.executable] + argv,
+        capture_output=True, text=True, timeout=timeout,
         cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
     )
     for line in out.stdout.splitlines():
         if line.startswith("{"):
-            r = json.loads(line)
-            return {
-                "transformer_mfu": r["mfu"],
-                "transformer_tokens_per_sec": r["tokens_per_sec"],
-                "transformer_params_m": r["params_m"],
-                "transformer_step_ms": r["step_ms"],
-            }
+            return json.loads(line)
     raise RuntimeError(
-        "no JSON line from bench_transformer_mfu.py: %s"
-        % (out.stderr[-500:],)
+        "no JSON line from %s: %s" % (argv[0], out.stderr[-500:])
     )
+
+
+def bench_transformer_mfu():
+    """TransformerLM training MFU, best measured single-chip config
+    (docs/PERF_TRANSFORMER.md). Runs in a subprocess so its ~10 GB of
+    device state never coexists with the ResNet bench's."""
+    r = _run_json_script(
+        ["scripts/bench_transformer_mfu.py",
+         "--d", "2048", "--layers", "10", "--heads", "8",
+         "--seq", "1024", "--batch", "12", "--remat", "none"],
+    )
+    return {
+        "transformer_mfu": r["mfu"],
+        "transformer_tokens_per_sec": r["tokens_per_sec"],
+        "transformer_params_m": r["params_m"],
+        "transformer_step_ms": r["step_ms"],
+    }
+
+
+def bench_gradaccum_mfu():
+    """The 735M L=12 model past the HBM ceiling via grad accumulation
+    k=4 (docs/PERF_TRANSFORMER.md "Past the HBM ceiling": 63% MFU; k<4
+    documented infeasible by XLA's own buffer assignment)."""
+    r = _run_json_script(
+        ["scripts/bench_transformer_mfu.py",
+         "--d", "2048", "--layers", "12", "--heads", "16",
+         "--seq", "2048", "--batch", "8", "--remat", "dots",
+         "--grad_accum_steps", "4"],
+    )
+    return {
+        "l12_gradaccum_mfu": r["mfu"],
+        "l12_gradaccum_params_m": r["params_m"],
+        "l12_gradaccum_step_ms": r["step_ms"],
+    }
+
+
+def bench_s16k_flash_mfu():
+    """16k-token context on ONE chip under the "flash" remat policy
+    (docs/PERF_TRANSFORMER.md S=16384 row: 53.9% MFU — saves only the
+    flash kernel's (o, lse) outputs so the O(S²) forward never
+    re-runs)."""
+    r = _run_json_script(
+        ["scripts/bench_transformer_mfu.py",
+         "--d", "2048", "--layers", "10", "--heads", "8",
+         "--seq", "16384", "--batch", "1", "--remat", "flash"],
+    )
+    return {
+        "s16k_flash_mfu": r["mfu"],
+        "s16k_tokens_per_sec": r["tokens_per_sec"],
+        "s16k_step_ms": r["step_ms"],
+    }
+
+
+def bench_moe_mfu():
+    """MoE vs dense-at-matched-active-FLOPs single-chip MFUs
+    (docs/PERF_MOE.md config: d=1024 L=8 E=8 k=2 cf=1.25, S=1024 B=16
+    — the measured batch sweet spot, one-hot einsum dispatch; full
+    AdamW step, bf16, pallas attention)."""
+    r = _run_json_script(
+        ["scripts/bench_moe.py",
+         "--d", "1024", "--layers", "8", "--seq", "1024",
+         "--batch", "16", "--experts", "8"],
+        timeout=1200,
+    )
+    return {
+        "moe_mfu": r["moe"]["mfu"],
+        "moe_dense_matched_mfu": r["dense_matched_active"]["mfu"],
+        "moe_step_overhead_vs_dense": r["moe_step_overhead_vs_dense"],
+        "moe_step_ms": r["moe"]["step_ms"],
+        "moe_dispatch_impl": r["config"].get("dispatch", "auto"),
+    }
 
 
 def _probe_once(timeout):
@@ -288,14 +376,24 @@ def main():
     # bench: it is latency-sensitive (live PS round trips) and measures
     # noticeably slower after the ResNet bench's large device state.
     extra = {}
-    try:
-        extra.update(bench_transformer_mfu())
-    except Exception as e:  # the headline metric must survive
-        extra["transformer_error"] = repr(e)
+    for name, fn in (
+        ("transformer", bench_transformer_mfu),
+        ("l12_gradaccum", bench_gradaccum_mfu),
+        ("s16k_flash", bench_s16k_flash_mfu),
+        ("moe", bench_moe_mfu),
+    ):
+        try:  # the headline metric must survive any sub-bench failure
+            extra.update(fn())
+        except Exception as e:
+            extra["%s_error" % name] = repr(e)
     try:
         extra.update(bench_deepfm())
     except Exception as e:
         extra["deepfm_error"] = repr(e)
+    try:
+        extra.update(bench_deepfm_latency_ab())
+    except Exception as e:
+        extra["deepfm_latency_ab_error"] = repr(e)
     from elasticdl_tpu.models import resnet
     from elasticdl_tpu.train.optimizers import create_optimizer
     from elasticdl_tpu.train.step_fns import make_train_step
@@ -303,7 +401,12 @@ def main():
 
     batch_size = 256
     image_size = 224
-    bench_steps = 20
+    # 100 steps/window: the tunnel charges ~135 ms of fixed
+    # dispatch+fetch per window (measured by the round-5 window-length
+    # sweep, docs/PERF_RESNET.md "Window-length decomposition") — at 20
+    # steps that inflated the step by ~6.8 ms and under-reported the
+    # device's sustained img/s by ~5%
+    bench_steps = 100
 
     # MLPerf-style space_to_depth stem (models/resnet.py): the 7x7/2
     # conv over 3 channels is the one MXU-hostile conv in the model;

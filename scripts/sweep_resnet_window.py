@@ -1,0 +1,57 @@
+"""ResNet bench window-length sweep: wall-clock per window = device
+time (N steps) + fixed dispatch/fetch overhead. Fitting two window
+lengths separates sustained device throughput from tunnel overhead."""
+import json
+import os
+import sys
+import time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import numpy as np
+import jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from elasticdl_tpu.models import resnet
+from elasticdl_tpu.train.optimizers import create_optimizer
+from elasticdl_tpu.train.step_fns import make_train_step
+from elasticdl_tpu.train.train_state import create_train_state
+
+batch_size, image_size = 256, 224
+model = resnet.resnet50(num_classes=1000, stem="space_to_depth")
+tx = create_optimizer("Momentum", learning_rate=0.1, momentum=0.9, nesterov=True)
+train_step = make_train_step(model, resnet.loss, tx, compute_dtype=jnp.bfloat16)
+
+def run_steps(state, batch, n):
+    def body(state, _):
+        state, loss = train_step(state, batch)
+        return state, loss
+    return jax.lax.scan(body, state, None, length=n)
+
+run = jax.jit(run_steps, static_argnums=(2,), donate_argnums=(0,))
+rng = np.random.RandomState(0)
+batch = {
+    "features": jnp.asarray(rng.rand(batch_size, image_size, image_size, 3), jnp.float32),
+    "labels": jnp.asarray(rng.randint(0, 1000, size=batch_size), jnp.int32),
+    "_mask": jnp.ones((batch_size,), jnp.float32),
+}
+state = create_train_state(model, tx, jax.random.PRNGKey(0), batch["features"])
+
+results = {}
+for n in (20, 60):
+    state, losses = run(state, batch, n)  # warmup+compile this length
+    float(losses[-1])
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        state, losses = run(state, batch, n)
+        float(losses[-1])
+        best = min(best, time.perf_counter() - t0)
+    results[n] = {"window_s": best, "ms_per_step": 1e3 * best / n,
+                  "img_per_s": batch_size * n / best}
+# overhead model: window = a + b*n  ->  b = device ms/step, a = fixed
+b = (results[60]["window_s"] - results[20]["window_s"]) / 40
+a = results[20]["window_s"] - 20 * b
+results["fit"] = {"device_ms_per_step": 1e3 * b,
+                  "fixed_overhead_ms_per_window": 1e3 * a,
+                  "device_img_per_s": batch_size / b}
+print(json.dumps(results, indent=1))

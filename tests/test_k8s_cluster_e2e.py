@@ -29,8 +29,11 @@ stand a cluster up): 2026-07-31 (round 4) — probed for docker / kind /
 minikube / kubectl binaries and /var/run/docker.sock in the build
 container; none exist (and the environment is zero-egress, so none
 can be installed), so the tier remains validated against the fake
-clientset only. First environment with a docker daemon: run the
-command block above and commit the pod-lifecycle log as an artifact.
+clientset only. 2026-07-31 (round 5) — re-probed: docker / podman /
+nerdctl / k3s / minikube / kind / crictl all absent, no
+/var/run/docker.sock or /run/containerd; unchanged. First environment
+with a docker daemon: run the command block above and commit the
+pod-lifecycle log as an artifact.
 """
 
 import os
