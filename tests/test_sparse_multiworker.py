@@ -46,13 +46,6 @@ from tests.test_utils import create_ctr_recordio, spawn_ps_process
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn_ps(ps_id, num_pods, use_async, grads_to_wait, log_path):
-    return spawn_ps_process(
-        ps_id=ps_id, num_ps_pods=num_pods, use_async=use_async,
-        grads_to_wait=grads_to_wait, log_path=log_path,
-    )
-
-
 def _spawn_worker(idx, master_port, coordinator_port, train_dir,
                   ps_addrs, dump_dir, ckpt_dir, log_path):
     env = dict(
@@ -149,7 +142,8 @@ def _read_dump_step(path):
 
 
 def _run_two_worker_job(tmp_path, use_async, grads_to_wait,
-                        kill_worker_after_step=None, deadline_secs=420):
+                        kill_worker_after_step=None, deadline_secs=420,
+                        kill_ps_after_step=None):
     """Drive the 2-worker lockstep sparse job to completion and return
     (dispatcher, evals, dump_dir, relaunches, logs, auc_single).
 
@@ -159,6 +153,13 @@ def _run_two_worker_job(tmp_path, use_async, grads_to_wait,
     killed worker pods,
     /root/reference/elasticdl/python/master/k8s_instance_manager.py:282-328).
     The supervisor then relaunches exactly as the pod manager would.
+
+    With ``kill_ps_after_step=k``: once worker 1's dump shows step >= k
+    AND PS shard 0 has committed a sparse checkpoint, SIGKILL PS 0 and
+    relaunch it on the SAME port with ``--checkpoint_dir_for_init`` —
+    the stable-Service PS relaunch (reference: same-id PS pod behind a
+    per-pod Service). Both workers' PS clients must bridge the outage
+    inside their retry budgets; no worker restart should be needed.
     """
     train_dir = tmp_path / "train"
     valid_dir = tmp_path / "valid"
@@ -205,13 +206,25 @@ def _run_two_worker_job(tmp_path, use_async, grads_to_wait,
     master_server.start()
     monitor.start()
 
-    ps_procs, ps_addrs = [], []
+    ps_ckpt = tmp_path / "ps_ckpt"
+    ps_extra = ()
+    if kill_ps_after_step is not None:
+        # BOTH shards must checkpoint into the shared dir: a version is
+        # only restorable once every shard's file exists
+        # (SparseCheckpointSaver._complete — no silent partial restore)
+        ps_extra = (
+            "--checkpoint_dir", str(ps_ckpt), "--checkpoint_steps", "2",
+        )
+    ps_procs, ps_addrs, ps_ports = [], [], []
     for ps_id in range(2):
-        proc, port = _spawn_ps(
-            ps_id, 2, use_async, grads_to_wait,
-            str(tmp_path / ("ps%d.log" % ps_id)),
+        proc, port = spawn_ps_process(
+            ps_id=ps_id, num_ps_pods=2, use_async=use_async,
+            grads_to_wait=grads_to_wait,
+            log_path=str(tmp_path / ("ps%d.log" % ps_id)),
+            extra=ps_extra,
         )
         ps_procs.append(proc)
+        ps_ports.append(port)
         ps_addrs.append("localhost:%d" % port)
     coordinator_port = find_free_port()
     workers = {}
@@ -256,10 +269,38 @@ def _run_two_worker_job(tmp_path, use_async, grads_to_wait,
                 os.kill(workers[1].pid, 9)
                 chaos["killed"] = True
 
+        def maybe_kill_ps():
+            if kill_ps_after_step is None or chaos.get("ps_killed"):
+                return
+            step = _read_dump_step(dump_dir / "worker1.npz")
+            if step is None or step < kill_ps_after_step:
+                return
+            # gate on a COMPLETE (all-shards, fully written) version —
+            # a bare directory listing would pass on a mid-write save
+            # and the SIGKILL could then corrupt the restore source
+            from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
+
+            if SparseCheckpointSaver.latest_version(str(ps_ckpt)) is None:
+                return
+            os.kill(ps_procs[0].pid, 9)
+            ps_procs[0].wait(timeout=30)
+            time.sleep(1.5)  # let both workers hit the outage window
+            ps_procs[0], _ = spawn_ps_process(
+                ps_id=0, num_ps_pods=2, use_async=use_async,
+                grads_to_wait=grads_to_wait,
+                log_path=str(tmp_path / "ps0.log"),
+                extra=ps_extra + (
+                    "--checkpoint_dir_for_init", str(ps_ckpt),
+                ),
+                port=ps_ports[0],
+            )
+            chaos["ps_killed"] = True
+
         deadline = time.time() + deadline_secs
         while time.time() < deadline and not dispatcher.finished():
             supervise()
             maybe_kill()
+            maybe_kill_ps()
             time.sleep(0.5)
         assert dispatcher.finished(), (
             "job never finished; worker0 log tail: %s"
@@ -272,6 +313,12 @@ def _run_two_worker_job(tmp_path, use_async, grads_to_wait,
                 "job finished before the chaos kill could fire "
                 "(worker1 never reached step %d with a checkpoint)"
                 % kill_worker_after_step
+            )
+        if kill_ps_after_step is not None:
+            assert chaos.get("ps_killed"), (
+                "job finished before the PS chaos kill could fire "
+                "(PS 0 never checkpointed by worker step %d)"
+                % kill_ps_after_step
             )
         return dispatcher, evals, dump_dir, relaunches, logs, auc_single
     finally:
@@ -355,6 +402,36 @@ def test_sigkill_worker_mid_training_recovers(
         kill_worker_after_step=3, deadline_secs=600,
     )
     assert relaunches[1] >= 1  # the kill really forced a relaunch
+    _assert_shared_model(
+        dump_dir, evals, auc_single, max_push_rejections=16
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "use_async,grads_to_wait", [(True, 1), (False, 2)],
+    ids=["async_ps", "sync_ps_wait2"],
+)
+def test_sigkill_ps_mid_training_recovers(
+    tmp_path, use_async, grads_to_wait
+):
+    """The other half of the chaos matrix for the flagship scenario:
+    SIGKILL a PS SHARD (not a worker) mid-training once it has
+    committed a sparse checkpoint, relaunch it on the same port with
+    checkpoint restore, and require the 2-worker lockstep job to
+    complete with the shared-model guarantees intact — both workers'
+    PS clients bridging the outage inside their retry budgets, no
+    worker restart required. Single-worker precedent:
+    tests/test_chaos.py::test_ps_crash_restart_job_completes."""
+    _, evals, dump_dir, relaunches, _, auc_single = _run_two_worker_job(
+        tmp_path, use_async, grads_to_wait,
+        kill_ps_after_step=3, deadline_secs=600,
+    )
+    # the relaunched shard really restored (not an empty-store restart:
+    # SparseCheckpointSaver.restore logs this only on success)
+    assert "Restored sparse checkpoint" in open(
+        tmp_path / "ps0.log"
+    ).read()
     _assert_shared_model(
         dump_dir, evals, auc_single, max_push_rejections=16
     )

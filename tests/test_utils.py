@@ -54,9 +54,12 @@ def create_ctr_recordio(path, num_records=256, num_features=10, vocab=1000, seed
 
 def spawn_ps_process(ps_id=0, num_ps_pods=1, opt_type="adam",
                      opt_args="lr=0.01", use_async=True, grads_to_wait=1,
-                     log_path=None, extra=(), startup_timeout=120):
+                     log_path=None, extra=(), startup_timeout=120,
+                     port=None):
     """Launch a live ``elasticdl_tpu.ps.server`` subprocess on a free
-    port and wait for it to accept connections.
+    port (or a pinned ``port`` — chaos tests relaunch a killed shard on
+    the SAME address, the stable-Service behavior of the pod manager)
+    and wait for it to accept connections.
 
     The one PS-spawner for every test that needs a real PS process
     (in-process servicers share the caller's GIL and invert pipelined
@@ -68,10 +71,11 @@ def spawn_ps_process(ps_id=0, num_ps_pods=1, opt_type="adam",
     import time
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    probe = socket.socket()
-    probe.bind(("", 0))
-    port = probe.getsockname()[1]
-    probe.close()
+    if port is None:
+        probe = socket.socket()
+        probe.bind(("", 0))
+        port = probe.getsockname()[1]
+        probe.close()
     if log_path:
         out = open(log_path, "ab")
         err = subprocess.STDOUT
