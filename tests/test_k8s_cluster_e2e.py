@@ -1,4 +1,4 @@
-"""Real-cluster integration tier (env-gated).
+r"""Real-cluster integration tier (env-gated).
 
 Reference parity: the reference's minikube CI tier submitted a train
 job and validated the pod lifecycle
