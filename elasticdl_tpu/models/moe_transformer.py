@@ -20,6 +20,7 @@ from elasticdl_tpu.data.example import decode_example
 from elasticdl_tpu.models.transformer import Attention, Block
 from elasticdl_tpu.ops.moe import (
     expert_capacity,
+    invert_slots,
     moe_combine,
     moe_combine_compact,
     moe_dispatch,
@@ -88,8 +89,13 @@ class MoeMlp(nn.Module):
             gates, slot, aux_loss = top_k_routing_compact(
                 router_logits, self.top_k, capacity
             )
+            # one inversion scatter shared by dispatch AND combine
+            j_for_slot = invert_slots(
+                slot, self.num_experts * capacity
+            )
             expert_in = moe_dispatch_compact(
-                x, slot, self.num_experts, capacity
+                x, slot, self.num_experts, capacity,
+                j_for_slot=j_for_slot,
             )
         else:
             combine, dispatch, aux_loss = top_k_routing(
@@ -117,7 +123,9 @@ class MoeMlp(nn.Module):
             out, self.mesh, P("ep", DATA_AXES, None, None)
         )
         if compact:
-            y = moe_combine_compact(out, slot, gates)
+            y = moe_combine_compact(
+                out, slot, gates, j_for_slot=j_for_slot
+            )
         else:
             y = moe_combine(out, combine)  # ep→dp all-to-all back
         return y, aux_loss

@@ -141,7 +141,7 @@ def top_k_routing_compact(router_logits, k, capacity):
     return gates.transpose(0, 2, 1), slot, aux_loss
 
 
-def _invert_slots(slot, n_slots):
+def invert_slots(slot, n_slots):
     """(G, kS) slot ids → (G, n_slots) flat FILLER index per slot
     (sentinel kS for empty slots). Valid slot ids are unique by
     construction; only the dummy slot n_slots collides, and that
@@ -197,16 +197,20 @@ def _dispatch_gather_bwd(res, d_out):
 _dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
 
 
-def moe_dispatch_compact(x, slot, num_experts, capacity):
+def moe_dispatch_compact(x, slot, num_experts, capacity,
+                         j_for_slot=None):
     """Token stream → per-expert buffers via an inverse-permutation
     gather (no (G, S, E, C) one-hot, no dispatch matmul FLOPs).
 
     x: (G, S, M); slot: (G, k*S) from ``top_k_routing_compact``
     → (E, G, C, M). Same semantics as ``moe_dispatch(x, dispatch)``:
     a slot holds its token's embedding, empty slots are zero.
+    ``j_for_slot``: pass ``invert_slots(slot, E*C)`` when the caller
+    also combines (MoeMlp does) so the inversion scatter runs once.
     """
     num_groups, _, dim = x.shape
-    j_for_slot = _invert_slots(slot, num_experts * capacity)
+    if j_for_slot is None:
+        j_for_slot = invert_slots(slot, num_experts * capacity)
     out = _dispatch_gather(x, slot, j_for_slot)
     return out.reshape(
         num_groups, num_experts, capacity, dim
@@ -286,7 +290,7 @@ def _combine_gather_bwd(res, dy):
 _combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
 
 
-def moe_combine_compact(expert_out, slot, gates):
+def moe_combine_compact(expert_out, slot, gates, j_for_slot=None):
     """Per-expert buffers → token stream: gather each (rank, token)'s
     slot row back and sum over ranks weighted by the gates.
 
@@ -294,13 +298,15 @@ def moe_combine_compact(expert_out, slot, gates):
     → (G, S, M). Dropped tokens point at the zero pad row, so their
     contribution is zero — identical to ``moe_combine``'s zero combine
     weights (including the zero gate-gradient for dropped tokens:
-    d(gate) = <dy, zero row> = 0 on both paths).
+    d(gate) = <dy, zero row> = 0 on both paths). ``j_for_slot`` as in
+    ``moe_dispatch_compact``.
     """
     num_experts, num_groups, capacity, dim = expert_out.shape
     eo_flat = expert_out.transpose(1, 0, 2, 3).reshape(
         num_groups, num_experts * capacity, dim
     )
-    j_for_slot = _invert_slots(slot, num_experts * capacity)
+    if j_for_slot is None:
+        j_for_slot = invert_slots(slot, num_experts * capacity)
     return _combine_gather(eo_flat, gates, slot, j_for_slot)
 
 

@@ -67,16 +67,19 @@ class PserverServicer:
         self._grads_to_wait = max(1, grads_to_wait)
         self._sync_tolerance = max(0, sync_version_tolerance)
         self._push_lock = threading.Lock()
-        # The round buffer is keyed by WORKER identity (anonymous
-        # pushes get a unique sequence key = the reference's plain
-        # counting): a second push from the same worker inside one
-        # unapplied round replaces its first — a worker killed
-        # mid-round would otherwise leave an orphaned half-round that
-        # pairs its round-k grads with peers' round-k+1 grads forever
-        # after, costing one spurious version rejection every round
-        # (observed in the SIGKILL chaos test before this keying).
-        self._round_buffer = {}  # worker key -> ({name: (vals, ids)}, scale)
-        self._anon_seq = 0
+        # Round buffer: a LIST of buffered pushes, each tagged with the
+        # pusher's (worker_id, incarnation) when identified. Cleanup
+        # rule: a push whose worker_id matches a buffered entry with a
+        # DIFFERENT incarnation evicts that entry — the previous
+        # incarnation died mid-round, and its orphaned half-round would
+        # otherwise pair its round-k grads with peers' round-k+1 grads
+        # forever after (one spurious version rejection every round,
+        # observed in the SIGKILL chaos test). Same-incarnation and
+        # anonymous pushes always APPEND (the reference's counting
+        # semantics): a live straggler's double push keeps both
+        # gradients, and a lone survivor still completes a
+        # grads_to_wait=N round by itself instead of livelocking.
+        self._round_buffer = []  # [(worker_key, {name: (vals, ids)}, scale)]
 
     # ------------------------------------------------------------------
     def push_model(self, request, context=None):
@@ -174,30 +177,64 @@ class PserverServicer:
             # at apply time (workers in a sync round share one schedule,
             # so the mean is the schedule value).
             push_scale = request.lr_scale if request.lr_scale > 0 else 1.0
+            key = None
             if request.HasField("worker_id"):
-                key = ("worker", request.worker_id)
-                if key in self._round_buffer:
+                # Incarnations are MONOTONIC (worker process start
+                # time): evict only buffered entries from OLDER
+                # incarnations of this worker (dead predecessors'
+                # orphaned half-rounds), and symmetric protection — an
+                # in-flight push from a dead predecessor delivered
+                # AFTER the relaunch's push must not evict the live
+                # entry: it is itself the orphan, so it is dropped
+                # (accepted=True keeps the dead sender's socket happy;
+                # nothing retries it). A push with worker_id but NO
+                # incarnation (older client) falls back to the
+                # replace-by-worker_id semantics.
+                incarnation = (
+                    request.incarnation
+                    if request.HasField("incarnation")
+                    else None
+                )
+                key = (request.worker_id, incarnation)
+                same_worker = [
+                    entry for entry in self._round_buffer
+                    if entry[0] is not None
+                    and entry[0][0] == request.worker_id
+                    and (incarnation is None
+                         or entry[0][1] != incarnation)
+                ]
+                if incarnation is not None and any(
+                    e[0][1] is not None and e[0][1] > incarnation
+                    for e in same_worker
+                ):
                     logger.warning(
-                        "sync PS: worker %d re-pushed within one round "
-                        "at version %d — replacing its buffered "
-                        "half-round (previous incarnation died "
-                        "mid-round)", request.worker_id, version,
+                        "sync PS: dropping a delayed push from worker "
+                        "%d's dead incarnation (a newer incarnation "
+                        "already holds this round)", request.worker_id,
                     )
-            else:
-                key = ("anon", self._anon_seq)
-                self._anon_seq += 1
+                    return pb.PushGradientsResponse(
+                        accepted=True, version=version
+                    )
+                for entry in same_worker:
+                    self._round_buffer.remove(entry)
+                    logger.warning(
+                        "sync PS: worker %d re-pushed at version %d "
+                        "under a new incarnation — dropping its dead "
+                        "predecessor's buffered half-round",
+                        request.worker_id, version,
+                    )
             tables = {}
             for name, slices in request.gradients.embedding_tables.items():
                 tables[name] = deserialize_indexed_slices(slices)
-            self._round_buffer[key] = (tables, push_scale)
+            self._round_buffer.append((key, tables, push_scale))
             if len(self._round_buffer) < self._grads_to_wait:
                 return pb.PushGradientsResponse(
                     accepted=True, version=version
                 )
-            scales = [s for _, s in self._round_buffer.values()]
+            scales = [s for _, _, s in self._round_buffer]
             apply_scale = sum(scales) / len(scales)
             merged = {}  # name -> ([values...], [ids...])
-            for tables, scale in self._round_buffer.values():
+            for _, tables, scale in self._round_buffer:
                 for name, (values, ids) in tables.items():
                     # Unequal per-push scales (e.g. a late joiner
                     # mid-warmup admitted by sync_version_tolerance)
@@ -220,7 +257,7 @@ class PserverServicer:
                 self._store.push_gradients(
                     name, ids, values, lr_scale=apply_scale
                 )
-            self._round_buffer = {}
+            self._round_buffer = []
             self._store.bump_version()
             version = self._store.version
         self._maybe_checkpoint(version)

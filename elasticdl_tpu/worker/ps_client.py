@@ -72,10 +72,18 @@ class PSClient:
         if isinstance(ps_addrs, str):
             ps_addrs = [a for a in ps_addrs.split(",") if a]
         self._stubs = [PserverStub(build_channel(a)) for a in ps_addrs]
-        # identity stamped onto pushes so the sync PS can key its round
-        # buffer per worker (orphaned-half-round recovery after a
-        # mid-round kill, ps/servicer.py); None = anonymous
+        # identity stamped onto pushes so the sync PS can clean its
+        # round buffer per worker (orphaned-half-round recovery after a
+        # mid-round kill, ps/servicer.py); None = anonymous. The
+        # incarnation distinguishes a relaunched worker (whose dead
+        # predecessor's buffered half-round must be dropped) from a
+        # live straggler-round double push (which must be counted).
+        # MONOTONIC (process construction time, ns) so the PS can order
+        # incarnations — a delayed in-flight push from a dead
+        # predecessor must never evict the relaunch's live entry — and
+        # seed-proof (time_ns is immune to user random.seed calls).
         self._worker_id = worker_id
+        self._incarnation = time.time_ns()
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(4, len(self._stubs))
         )
@@ -188,6 +196,7 @@ class PSClient:
             request.lr_scale = lr_scale
             if self._worker_id is not None:
                 request.worker_id = self._worker_id
+                request.incarnation = self._incarnation
         for name, (values, ids) in grads_by_table.items():
             values, ids = deduplicate_indexed_slices(
                 np.asarray(values), np.asarray(ids, dtype=np.int64)

@@ -106,24 +106,41 @@ SLOW_BY_DURATION = {
 }
 
 
+def _test_names_defined_in(path):
+    """Every test function name defined in a test file, including
+    methods inside Test* classes (AST walk — so the staleness guard
+    below sees what EXISTS, independent of how many items this
+    particular invocation collected; a single-node-ID rerun must not
+    trip it)."""
+    import ast
+
+    return {
+        node.name
+        for node in ast.walk(ast.parse(open(path).read()))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and node.name.startswith("test")
+    }
+
+
 @pytest.hookimpl(tryfirst=True)  # before -k/-m deselection filters
 def pytest_collection_modifyitems(items):
-    matched = {}  # file -> set of listed names that matched something
-    collected_files = set()
+    checked_files = {}
     for item in items:
         fname = os.path.basename(str(item.fspath))
         names = SLOW_BY_DURATION.get(fname)
         if not names:
             continue
-        collected_files.add(fname)
+        if fname not in checked_files:
+            checked_files[fname] = str(item.fspath)
         for name in names:
             if item.name == name or item.name.startswith(name + "["):
                 item.add_marker(pytest.mark.slow)
-                matched.setdefault(fname, set()).add(name)
     # staleness guard: a renamed/removed slow test must not silently
     # re-enter the fast lane — fail collection loudly instead
-    for fname in collected_files:
-        missing = set(SLOW_BY_DURATION[fname]) - matched.get(fname, set())
+    for fname, path in checked_files.items():
+        missing = set(SLOW_BY_DURATION[fname]) - _test_names_defined_in(
+            path
+        )
         assert not missing, (
             "conftest SLOW_BY_DURATION lists tests that no longer exist "
             "in %s: %s — update the list" % (fname, sorted(missing))

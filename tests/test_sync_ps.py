@@ -440,33 +440,37 @@ def test_force_empty_push_reaches_every_shard():
             server.stop(None)
 
 
-def _worker_push(name, values, ids, version, worker_id):
+def _worker_push(name, values, ids, version, worker_id, incarnation=1):
     request = _push_request(name, values, ids, version)
     request.worker_id = worker_id
+    request.incarnation = incarnation
     return request
 
 
-def test_orphaned_half_round_replaced_on_worker_relaunch():
+def test_orphaned_half_round_dropped_on_worker_relaunch():
     """A worker killed after pushing its half of a sync round must not
-    poison every later round: its relaunched incarnation's push (same
-    worker_id) REPLACES the orphaned buffer entry, so pairing realigns
-    immediately instead of applying round k against round k+1 forever
-    (the failure mode the SIGKILL chaos test measured as one spurious
-    rejection per round)."""
+    poison every later round: a push from the same worker_id under a
+    NEW incarnation evicts the dead predecessor's buffered entry, so
+    pairing realigns immediately instead of applying round k against
+    round k+1 forever (the failure mode the SIGKILL chaos test
+    measured as one spurious rejection per round)."""
     servicer, store = _servicer(grads_to_wait=2)
     before = store.lookup("t", np.array([7], np.int64)).copy()
 
-    # worker 0 pushes round 0 then dies; worker 1's round-0 push never
-    # happened (it was mid-step at the kill)
+    # worker 0 (incarnation 1) pushes round 0 then dies; worker 1's
+    # round-0 push never happened (it was mid-step at the kill)
     r = servicer.push_gradients(
-        _worker_push("t", [[9.0, 9.0]], [7], 0, worker_id=0)
+        _worker_push("t", [[9.0, 9.0]], [7], 0, worker_id=0,
+                     incarnation=1)
     )
     assert r.accepted and r.version == 0
 
-    # both workers relaunch from the checkpoint and replay round 0:
-    # worker 0's NEW push replaces its orphan (not: completes the pair)
+    # worker 0 relaunches (incarnation 2) and replays round 0: its
+    # push EVICTS the dead incarnation's orphan (not: completes the
+    # pair with it)
     r = servicer.push_gradients(
-        _worker_push("t", [[1.0, 0.0]], [7], 0, worker_id=0)
+        _worker_push("t", [[1.0, 0.0]], [7], 0, worker_id=0,
+                     incarnation=2)
     )
     assert r.accepted and r.version == 0  # still buffered — no apply
     np.testing.assert_array_equal(
@@ -487,13 +491,62 @@ def test_orphaned_half_round_replaced_on_worker_relaunch():
 
     # next round pairs cleanly — no rejection skew
     r = servicer.push_gradients(
-        _worker_push("t", [[1.0, 0.0]], [7], 1, worker_id=0)
+        _worker_push("t", [[1.0, 0.0]], [7], 1, worker_id=0,
+                     incarnation=2)
     )
     assert r.accepted and r.version == 1
     r = servicer.push_gradients(
         _worker_push("t", [[0.0, 1.0]], [7], 1, worker_id=1)
     )
     assert r.accepted and r.version == 2
+
+
+def test_straggler_double_push_keeps_both_gradients():
+    """A LIVE worker that pushes twice inside one unapplied round
+    (non-lockstep pacing against a straggling peer) must have BOTH
+    pushes applied — same-incarnation pushes accumulate; only a dead
+    incarnation's entry is evicted. (Round-5 high-effort review
+    finding: the first worker-keyed buffer silently replaced the
+    earlier accepted push.)"""
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([3], np.int64)).copy()
+
+    r = servicer.push_gradients(
+        _worker_push("t", [[1.0, 0.0]], [3], 0, worker_id=0,
+                     incarnation=5)
+    )
+    assert r.accepted and r.version == 0
+    r = servicer.push_gradients(
+        _worker_push("t", [[10.0, 0.0]], [3], 0, worker_id=0,
+                     incarnation=5)
+    )
+    # second same-incarnation push COMPLETES the round (counted)
+    assert r.accepted and r.version == 1
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([3], np.int64)),
+        before - np.array([[11.0, 0.0]]),
+        rtol=1e-6,
+    )
+
+
+def test_lone_survivor_completes_round_without_livelock():
+    """grads_to_wait=2 with ONE live identified worker (peer
+    OOM-killed and deliberately not relaunched): the survivor's
+    repeated pushes must keep completing rounds — the buffer counts
+    same-incarnation pushes, so the store version advances instead of
+    livelocking with every push accepted and nothing ever applied.
+    (Round-5 high-effort review finding.)"""
+    servicer, store = _servicer(grads_to_wait=2)
+    versions = []
+    for step in range(4):
+        r = servicer.push_gradients(
+            _worker_push("t", [[1.0, 1.0]], [9], step // 2,
+                         worker_id=0, incarnation=7)
+        )
+        assert r.accepted
+        versions.append(r.version)
+    # two applies happened: versions advanced 0 -> 1 -> 2
+    assert versions == [0, 1, 1, 2], versions
 
 
 def test_anonymous_pushes_keep_counting_semantics():
@@ -505,3 +558,69 @@ def test_anonymous_pushes_keep_counting_semantics():
     assert r.accepted and r.version == 0
     r = servicer.push_gradients(_push_request("t", [[0.0, 1.0]], [2], 0))
     assert r.accepted and r.version == 1
+
+
+def test_delayed_dead_incarnation_push_cannot_evict_live_entry():
+    """The eviction is ORDERED: a push from an older incarnation
+    arriving AFTER its successor's push (the kill left it in flight)
+    is dropped — it must not evict the live worker's buffered entry
+    and re-install the orphan."""
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([4], np.int64)).copy()
+
+    # relaunched worker 0 (incarnation 20) pushes its replay first
+    r = servicer.push_gradients(
+        _worker_push("t", [[1.0, 0.0]], [4], 0, worker_id=0,
+                     incarnation=20)
+    )
+    assert r.accepted and r.version == 0
+
+    # the dead predecessor's (incarnation 10) in-flight push lands late
+    r = servicer.push_gradients(
+        _worker_push("t", [[9.0, 9.0]], [4], 0, worker_id=0,
+                     incarnation=10)
+    )
+    assert r.accepted  # socket kept happy; content discarded
+    assert r.version == 0  # and it did NOT complete the round
+
+    # worker 1 completes the round: the live pair applies, orphan gone
+    r = servicer.push_gradients(
+        _worker_push("t", [[0.0, 1.0]], [4], 1, worker_id=1)
+    )
+    assert r.accepted and r.version == 1
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([4], np.int64)),
+        before - np.array([[1.0, 1.0]]),
+        rtol=1e-6,
+    )
+
+
+def test_identified_push_without_incarnation_replaces_by_worker_id():
+    """Mixed-version rollout: an older client stamps worker_id but no
+    incarnation — it falls back to the replace-by-worker_id semantics
+    (orphan recovery still works, at the cost of the straggler
+    double-count; upgrading the client restores full semantics)."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb_mod
+
+    def old_client_push(values, version):
+        request = _push_request("t", values, [6], version)
+        request.worker_id = 0  # no incarnation field set
+        return request
+
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([6], np.int64)).copy()
+    assert servicer.push_gradients(
+        old_client_push([[9.0, 9.0]], 0)
+    ).accepted
+    # second identified-but-incarnationless push REPLACES (old rule)
+    r = servicer.push_gradients(old_client_push([[1.0, 0.0]], 0))
+    assert r.accepted and r.version == 0
+    r = servicer.push_gradients(
+        _worker_push("t", [[0.0, 1.0]], [6], 1, worker_id=1)
+    )
+    assert r.accepted and r.version == 1
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([6], np.int64)),
+        before - np.array([[1.0, 1.0]]),
+        rtol=1e-6,
+    )
