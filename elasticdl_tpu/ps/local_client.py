@@ -38,7 +38,11 @@ class LocalPSClient:
     def pull_embedding_vectors(self, name, ids):
         return self.store.lookup(name, np.asarray(ids, dtype=np.int64))
 
-    def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0):
+    def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0,
+                       only_shards=None, force_empty=False,
+                       round_scoped=False):
+        # single in-process store: apply immediately — the sync-mode
+        # pairing kwargs are accepted for interface parity and ignored
         # lr_scale multiplies the store optimizer's configured LR; 0
         # means "no scaling" (mirrors PSClient/the wire field).
         lr_scale = lr_scale if lr_scale > 0 else 1.0

@@ -80,6 +80,10 @@ class PserverServicer:
         # gradients, and a lone survivor still completes a
         # grads_to_wait=N round by itself instead of livelocking.
         self._round_buffer = []  # [(worker_key, {name: (vals, ids)}, scale)]
+        # round-scoped pairing (lockstep pushers): tag -> entries; a
+        # round applies only when its OWN tag's group fills — see
+        # _push_gradients_sync
+        self._round_groups = {}
 
     # ------------------------------------------------------------------
     def push_model(self, request, context=None):
@@ -161,7 +165,22 @@ class PserverServicer:
         """Sync SGD: accumulate grads_to_wait pushes, reject stale ones
         (reference ps/servicer.py:166-236; sparse grads are summed, as
         there — each worker contributes disjoint-sign updates to the
-        rows it touched)."""
+        rows it touched).
+
+        Two pairing disciplines:
+
+        - counting (default, reference semantics): the first
+          grads_to_wait accepted pushes form a round, whoever sent
+          them — right for free-running workers.
+        - round-scoped (``request.round_scoped``, set by lockstep
+          trainers whose tags are exact global round counters): pushes
+          are grouped BY TAG and a round applies only when its own
+          tag's group fills. Counting applied to lockstep traffic lets
+          one worker's round-r and round-r+1 pushes pair with each
+          other whenever its pushes lag its rounds (host contention),
+          which drives the store version ahead of the laggard and
+          causes chronic spurious rejections.
+        """
         grad_version = request.gradients.version
         with self._push_lock:
             version = self._store.version
@@ -197,7 +216,7 @@ class PserverServicer:
                 )
                 key = (request.worker_id, incarnation)
                 same_worker = [
-                    entry for entry in self._round_buffer
+                    entry for entry in self._buffered_entries()
                     if entry[0] is not None
                     and entry[0][0] == request.worker_id
                     and (incarnation is None
@@ -216,7 +235,7 @@ class PserverServicer:
                         accepted=True, version=version
                     )
                 for entry in same_worker:
-                    self._round_buffer.remove(entry)
+                    self._remove_buffered(entry)
                     logger.warning(
                         "sync PS: worker %d re-pushed at version %d "
                         "under a new incarnation — dropping its dead "
@@ -226,43 +245,94 @@ class PserverServicer:
             tables = {}
             for name, slices in request.gradients.embedding_tables.items():
                 tables[name] = deserialize_indexed_slices(slices)
-            self._round_buffer.append((key, tables, push_scale))
-            if len(self._round_buffer) < self._grads_to_wait:
-                return pb.PushGradientsResponse(
-                    accepted=True, version=version
-                )
-            scales = [s for _, _, s in self._round_buffer]
-            apply_scale = sum(scales) / len(scales)
-            merged = {}  # name -> ([values...], [ids...])
-            for _, tables, scale in self._round_buffer:
-                for name, (values, ids) in tables.items():
-                    # Unequal per-push scales (e.g. a late joiner
-                    # mid-warmup admitted by sync_version_tolerance)
-                    # can't be expressed exactly in one
-                    # adaptive-optimizer apply; re-weight each push by
-                    # scale/apply_scale — exact for SGD, and for
-                    # slot-state optimizers the ratio is 1 in the
-                    # common equal-schedule case so no corruption is
-                    # introduced.
-                    if scale != apply_scale:
-                        values = values * (scale / apply_scale)
-                    bucket = merged.setdefault(name, ([], []))
-                    bucket[0].append(values)
-                    bucket[1].append(ids)
-            for name, (values_list, ids_list) in merged.items():
-                values = np.concatenate(values_list, axis=0)
-                ids = np.concatenate(ids_list, axis=0)
-                # merge duplicate ids across workers into one apply
-                values, ids = deduplicate_indexed_slices(values, ids)
-                self._store.push_gradients(
-                    name, ids, values, lr_scale=apply_scale
-                )
-            self._round_buffer = []
+            entry = (key, tables, push_scale)
+            if request.round_scoped:
+                group = self._round_groups.setdefault(grad_version, [])
+                if key is not None:
+                    # tag + (worker_id, incarnation) uniquely identify
+                    # a logical lockstep push: a transport-level
+                    # re-send (the response was lost after the server
+                    # buffered — the at-least-once window in
+                    # ps_client's retry) must REPLACE, not count twice
+                    group[:] = [e for e in group if e[0] != key]
+                group.append(entry)
+                if len(group) < self._grads_to_wait:
+                    return pb.PushGradientsResponse(
+                        accepted=True, version=version
+                    )
+                del self._round_groups[grad_version]
+                self._apply_round(group)
+            else:
+                self._round_buffer.append(entry)
+                if len(self._round_buffer) < self._grads_to_wait:
+                    return pb.PushGradientsResponse(
+                        accepted=True, version=version
+                    )
+                self._apply_round(self._round_buffer)
+                self._round_buffer = []
             self._store.bump_version()
             version = self._store.version
         self._maybe_checkpoint(version)
         self._maybe_report_version(version)
         return pb.PushGradientsResponse(accepted=True, version=version)
+
+    def _buffered_entries(self):
+        for entry in self._round_buffer:
+            yield entry
+        for group in self._round_groups.values():
+            yield from group
+
+    def _remove_buffered(self, entry):
+        if entry in self._round_buffer:
+            self._round_buffer.remove(entry)
+            return
+        for tag, group in list(self._round_groups.items()):
+            if entry in group:
+                group.remove(entry)
+                if not group:
+                    del self._round_groups[tag]
+                return
+
+    def _apply_round(self, entries):
+        """Merge and apply one completed round's buffered pushes.
+        Caller holds the push lock and bumps the store version."""
+        scales = [s for _, _, s in entries]
+        apply_scale = sum(scales) / len(scales)
+        merged = {}  # name -> ([values...], [ids...])
+        for _, tables, scale in entries:
+            for name, (values, ids) in tables.items():
+                # Unequal per-push scales (e.g. a late joiner
+                # mid-warmup admitted by sync_version_tolerance)
+                # can't be expressed exactly in one
+                # adaptive-optimizer apply; re-weight each push by
+                # scale/apply_scale — exact for SGD, and for
+                # slot-state optimizers the ratio is 1 in the
+                # common equal-schedule case so no corruption is
+                # introduced.
+                if scale != apply_scale:
+                    values = values * (scale / apply_scale)
+                bucket = merged.setdefault(name, ([], []))
+                bucket[0].append(values)
+                bucket[1].append(ids)
+        for name, (values_list, ids_list) in merged.items():
+            values = np.concatenate(values_list, axis=0)
+            ids = np.concatenate(ids_list, axis=0)
+            # merge duplicate ids across workers into one apply
+            values, ids = deduplicate_indexed_slices(values, ids)
+            self._store.push_gradients(
+                name, ids, values, lr_scale=apply_scale
+            )
+        # GC scoped groups that can never fill: their tag is already
+        # older than anything the stale check would admit (the check
+        # rejects tags < version - tolerance, and version only grows)
+        floor = self._store.version - self._sync_tolerance
+        for tag in [t for t in self._round_groups if t < floor]:
+            logger.warning(
+                "sync PS: dropping %d unfillable buffered push(es) at "
+                "stale round tag %d",
+                len(self._round_groups[tag]), tag,
+            )
+            del self._round_groups[tag]
 
     def _maybe_checkpoint(self, version):
         if (

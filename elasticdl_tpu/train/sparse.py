@@ -354,7 +354,8 @@ class SparseBatchPreparer:
         return out, pull_info
 
     def push_gradients(self, row_grads, pull_info, model_version=0,
-                       only_shards=None, force_empty=False):
+                       only_shards=None, force_empty=False,
+                       round_scoped=False):
         grads_by_table = {}
         for name, (unique, n) in pull_info.items():
             if n == 0:
@@ -373,6 +374,11 @@ class SparseBatchPreparer:
             # PS's grads_to_wait, else that shard's apply cadence
             # drifts behind its peers' (see PSClient.push_gradients)
             kwargs["force_empty"] = True
+        if round_scoped:
+            # lockstep tags are exact global round counters: tell the
+            # sync PS to pair by TAG, not arrival order (proto
+            # round_scoped field)
+            kwargs["round_scoped"] = True
         return _normalize_push_result(
             self._ps.push_gradients(grads_by_table, **kwargs),
             model_version,
@@ -510,6 +516,13 @@ class SparseTrainer:
     # lockstep trainers set True: fully-masked batches still push (the
     # sync PS counts pushes, not gradients, toward grads_to_wait)
     FORCE_EMPTY_PUSH = False
+    # lockstep trainers set True: their version tags are exact global
+    # round counters, so the sync PS pairs their pushes BY TAG instead
+    # of arrival order (a worker whose pushes lag its rounds under
+    # host contention must not have its round-r and round-r+1 pushes
+    # paired with each other — the version-skew churn measured in the
+    # SIGKILL chaos tests under full-suite load)
+    ROUND_SCOPED_PUSH = False
     # False (lockstep trainers): a version-rejected push is RESENT
     # as-is with the corrected version instead of re-pulling rows and
     # recomputing grads. Sound there because every lockstep round pulls
@@ -635,6 +648,7 @@ class SparseTrainer:
                 pull_info,
                 model_version=self._version,
                 force_empty=self.FORCE_EMPTY_PUSH,
+                round_scoped=self.ROUND_SCOPED_PUSH,
             )
         retries = 0
         while not accepted and retries < self.MAX_PUSH_RETRIES:
@@ -667,6 +681,7 @@ class SparseTrainer:
                         pull_info,
                         model_version=self._version,
                         only_shards=rejected,
+                        round_scoped=self.ROUND_SCOPED_PUSH,
                         force_empty=self.FORCE_EMPTY_PUSH,
                     )
                 )

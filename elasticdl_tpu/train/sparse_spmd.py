@@ -331,6 +331,11 @@ class MultiHostSparseSpmdTrainer(LockstepMixin, SparseSpmdTrainer):
     MAX_PUSH_RETRIES = 8
     FORCE_EMPTY_PUSH = True
     RETRY_RECOMPUTES = False
+    # lockstep version tags are exact global round counters: have the
+    # sync PS pair pushes by tag instead of arrival order, so a worker
+    # whose pushes lag its rounds (host contention) can never have its
+    # round-r and round-r+1 pushes paired with each other
+    ROUND_SCOPED_PUSH = True
 
     def __init__(
         self,

@@ -169,7 +169,8 @@ class PSClient:
         return rows
 
     def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0,
-                       only_shards=None, force_empty=False):
+                       only_shards=None, force_empty=False,
+                       round_scoped=False):
         """grads_by_table: {name: (values [n,dim], ids [n])}; dedups then
         scatters per-PS. Returns (accepted, max version, rejected shard
         ids) — a sync-mode PS may reject a stale push (per shard), and a
@@ -197,6 +198,10 @@ class PSClient:
             if self._worker_id is not None:
                 request.worker_id = self._worker_id
                 request.incarnation = self._incarnation
+            if round_scoped:
+                # lockstep tags are exact global round counters — the
+                # sync PS pairs these pushes by tag, not arrival order
+                request.round_scoped = True
         for name, (values, ids) in grads_by_table.items():
             values, ids = deduplicate_indexed_slices(
                 np.asarray(values), np.asarray(ids, dtype=np.int64)

@@ -403,7 +403,7 @@ def test_sigkill_worker_mid_training_recovers(
     )
     assert relaunches[1] >= 1  # the kill really forced a relaunch
     _assert_shared_model(
-        dump_dir, evals, auc_single, max_push_rejections=16,
+        dump_dir, evals, auc_single, max_push_rejections=8,
         # a mid-round kill can cost up to a round of progress on this
         # tiny dataset; the absolute floor above still binds
         auc_slack=0.05,
@@ -436,7 +436,7 @@ def test_sigkill_ps_mid_training_recovers(
         tmp_path / "ps0.log"
     ).read()
     _assert_shared_model(
-        dump_dir, evals, auc_single, max_push_rejections=16,
+        dump_dir, evals, auc_single, max_push_rejections=8,
         # the PS outage + restore-from-checkpoint can replay/lose a
         # couple of sparse applies; the absolute floor still binds
         auc_slack=0.05,

@@ -624,3 +624,111 @@ def test_identified_push_without_incarnation_replaces_by_worker_id():
         before - np.array([[1.0, 1.0]]),
         rtol=1e-6,
     )
+
+
+def _scoped_push(name, values, ids, version, worker_id, incarnation=1):
+    request = _worker_push(name, values, ids, version, worker_id,
+                           incarnation)
+    request.round_scoped = True
+    return request
+
+
+def test_round_scoped_pushes_pair_by_tag_not_arrival_order():
+    """Lockstep pushers tag pushes with exact global round counters;
+    the PS must pair round r with round r, even when one worker's
+    pushes lag its rounds (host contention) and arrive out of phase.
+    Counting semantics would pair worker 0's rounds r and r+1 with
+    each other, driving the version ahead of the laggard — the
+    chronic-rejection churn measured in the chaos tests under
+    full-suite load."""
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([8], np.int64)).copy()
+
+    # worker 0 races ahead: pushes round 0 AND round 1 before worker 1
+    # pushes anything
+    r = servicer.push_gradients(
+        _scoped_push("t", [[1.0, 0.0]], [8], 0, worker_id=0)
+    )
+    assert r.accepted and r.version == 0  # round 0: 1/2
+    r = servicer.push_gradients(
+        _scoped_push("t", [[2.0, 0.0]], [8], 1, worker_id=0)
+    )
+    assert r.accepted and r.version == 0  # round 1: 1/2 — NO self-pair
+
+    # worker 1 catches up: round 0 completes with the matching tags
+    r = servicer.push_gradients(
+        _scoped_push("t", [[0.0, 1.0]], [8], 0, worker_id=1)
+    )
+    assert r.accepted and r.version == 1
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([8], np.int64)),
+        before - np.array([[1.0, 1.0]]),
+        rtol=1e-6,
+    )
+    # then round 1
+    r = servicer.push_gradients(
+        _scoped_push("t", [[0.0, 2.0]], [8], 1, worker_id=1)
+    )
+    assert r.accepted and r.version == 2
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([8], np.int64)),
+        before - np.array([[3.0, 3.0]]),
+        rtol=1e-6,
+    )
+
+
+def test_round_scoped_orphan_eviction_spans_groups():
+    """Incarnation eviction reaches into scoped groups: a dead
+    predecessor's buffered round-tag entry is dropped when the
+    relaunched worker pushes (under any tag)."""
+    servicer, store = _servicer(grads_to_wait=2)
+    # dead incarnation 1 left an orphan at tag 5
+    r = servicer.push_gradients(
+        _scoped_push("t", [[9.0, 9.0]], [2], 5, worker_id=0,
+                     incarnation=1)
+    )
+    assert r.accepted
+    # relaunch (incarnation 2) replays from tag 5
+    r = servicer.push_gradients(
+        _scoped_push("t", [[1.0, 0.0]], [2], 5, worker_id=0,
+                     incarnation=2)
+    )
+    assert r.accepted and r.version == 0  # orphan evicted, 1/2 again
+    before = store.lookup("t", np.array([2], np.int64)).copy()
+    r = servicer.push_gradients(
+        _scoped_push("t", [[0.0, 1.0]], [2], 5, worker_id=1)
+    )
+    assert r.accepted and r.version == 1
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([2], np.int64)),
+        before - np.array([[1.0, 1.0]]),
+        rtol=1e-6,
+    )
+
+
+def test_round_scoped_transport_resend_is_idempotent():
+    """At-least-once delivery: a transport-level re-send of the SAME
+    logical push (same worker, same incarnation, same round tag —
+    the response was lost after the server buffered) replaces the
+    buffered entry instead of counting twice; the round still waits
+    for the real peer."""
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([1], np.int64)).copy()
+    for _ in range(3):  # original + two re-sends
+        r = servicer.push_gradients(
+            _scoped_push("t", [[1.0, 0.0]], [1], 0, worker_id=0,
+                         incarnation=9)
+        )
+        assert r.accepted and r.version == 0  # never self-completes
+    np.testing.assert_array_equal(
+        store.lookup("t", np.array([1], np.int64)), before
+    )
+    r = servicer.push_gradients(
+        _scoped_push("t", [[0.0, 1.0]], [1], 0, worker_id=1)
+    )
+    assert r.accepted and r.version == 1
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([1], np.int64)),
+        before - np.array([[1.0, 1.0]]),  # counted ONCE
+        rtol=1e-6,
+    )
