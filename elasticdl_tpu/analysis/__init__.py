@@ -1,0 +1,31 @@
+"""edlint: framework-aware static analysis for elasticdl_tpu.
+
+Four rule packs, each encoding a failure class this codebase has paid
+for (or refuses to pay for):
+
+- ``lock-discipline``     — attributes mutated under a class's
+  ``threading.Lock``/``Condition`` must never be mutated off-lock
+  (the sync-PS pairing race class).
+- ``jax-hot-path``        — no silent host-device syncs
+  (``device_get``/``.item()``/``float``/``np.asarray``), host RNG, or
+  wall-clock reads inside jit/pjit-compiled or ``@hot_path`` functions.
+- ``ft-swallowed-except`` / ``ft-grpc-timeout`` — fault-tolerance
+  hygiene: no broad except that swallows without logging/re-raising,
+  no gRPC stub call without a deadline.
+- ``xhost-determinism``   — no set-ordered or filesystem-ordered
+  iteration in checkpoint/export/gradient-aggregation paths, where
+  ordering must match across hosts.
+
+Run ``python -m elasticdl_tpu.analysis elasticdl_tpu/``. See
+docs/STATIC_ANALYSIS.md for suppressions (``# edlint: disable=<rule>``)
+and the baseline workflow.
+"""
+
+from elasticdl_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RULE_NAMES,
+    analyze_paths,
+    analyze_sources,
+    load_baseline,
+    split_baselined,
+)

@@ -1,0 +1,133 @@
+"""edlint CLI: ``python -m elasticdl_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (or everything baselined/suppressed), 1 findings,
+2 usage/parse error.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from elasticdl_tpu.analysis.core import (
+    RULE_NAMES,
+    analyze_paths,
+    baseline_dict,
+    load_baseline,
+    split_baselined,
+)
+
+DEFAULT_BASELINE = ".edlint-baseline.json"
+
+
+def _discover_baseline(paths):
+    """cwd first, then upward from the first scanned path — so the gate
+    works both from the repo root and from a subdir."""
+    candidates = [os.path.join(os.getcwd(), DEFAULT_BASELINE)]
+    if paths:
+        probe = os.path.abspath(paths[0])
+        for _ in range(6):
+            probe = os.path.dirname(probe)
+            if not probe or probe == os.path.dirname(probe):
+                break
+            candidates.append(os.path.join(probe, DEFAULT_BASELINE))
+    for candidate in candidates:
+        if os.path.isfile(candidate):
+            return candidate
+    return None
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.analysis",
+        description="edlint: framework-aware static analysis "
+                    "(lock discipline, JAX hot-path, fault-tolerance "
+                    "hygiene, cross-host determinism)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["elasticdl_tpu"],
+        help="files or directories to analyze (default: elasticdl_tpu)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated subset of rules (default: all)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline JSON (default: auto-discover %s)" % DEFAULT_BASELINE,
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline file (report everything)",
+    )
+    parser.add_argument(
+        "--write-baseline", metavar="PATH", default=None,
+        help="write current findings as a baseline to PATH and exit 0 "
+             "(justifications start as TODO — fill them in)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name in RULE_NAMES:
+            print(name)
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        findings, errors = analyze_paths(args.paths, rules=rules)
+    except (FileNotFoundError, ValueError) as e:
+        print("edlint: error: %s" % e, file=sys.stderr)
+        return 2
+    for path, message in errors:
+        print("edlint: parse error in %s: %s" % (path, message),
+              file=sys.stderr)
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(baseline_dict(findings), f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(
+            "edlint: wrote %d baseline entr%s to %s"
+            % (len(findings), "y" if len(findings) == 1 else "ies",
+               args.write_baseline)
+        )
+        return 0
+
+    baseline = None
+    if not args.no_baseline:
+        baseline_path = args.baseline or _discover_baseline(args.paths)
+        if baseline_path:
+            try:
+                baseline = load_baseline(baseline_path)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(
+                    "edlint: bad baseline %s: %s" % (baseline_path, e),
+                    file=sys.stderr,
+                )
+                return 2
+
+    new, baselined, unused = split_baselined(findings, baseline)
+    for finding in new:
+        print(finding.render())
+    for entry in unused:
+        print(
+            "edlint: note: unused baseline entry %s:%s (%s) — remove it"
+            % (entry.get("path"), entry.get("symbol"), entry.get("rule")),
+            file=sys.stderr,
+        )
+    print(
+        "edlint: %d finding(s), %d baselined"
+        % (len(new), len(baselined))
+    )
+    if errors:
+        return 2
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

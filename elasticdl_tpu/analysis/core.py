@@ -1,0 +1,342 @@
+"""edlint core: source units, suppressions, baseline, rule runner.
+
+Analysis is whole-program: every rule receives ALL parsed units at
+once, because the hot-path rule resolves jit-wrapped factories across
+module boundaries (worker/trainer.py jits a factory defined in
+train/step_fns.py).
+"""
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+_DISABLE_RE = re.compile(r"edlint:\s*disable=([\w\-,\s]+)")
+
+# statement kinds whose leading-line suppression comment covers the
+# whole block (a ``# edlint: disable=`` on a ``def`` line suppresses
+# the entire function)
+_BLOCK_NODES = (
+    ast.FunctionDef,
+    ast.AsyncFunctionDef,
+    ast.ClassDef,
+    ast.With,
+    ast.Try,
+    ast.For,
+    ast.While,
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str       # rule name, the suppression/baseline key
+    path: str       # path as scanned (display)
+    line: int
+    symbol: str     # enclosing qualname ("Class.method", "<module>")
+    code: str       # short machine code ("np.asarray", "unlocked: _todo")
+    message: str
+
+    def fingerprint(self):
+        """Line-number-free identity used for baseline matching."""
+        return (self.rule, package_relative(self.path), self.symbol,
+                self.code)
+
+    def render(self):
+        return "%s:%d: [%s] %s (%s)" % (
+            self.path, self.line, self.rule, self.message, self.symbol
+        )
+
+
+def package_relative(path):
+    """Normalize a path for baseline matching: the trailing part from
+    the ``elasticdl_tpu`` package component on, posix-separated; else
+    the basename. Keeps baselines valid from any CWD."""
+    parts = path.replace(os.sep, "/").split("/")
+    if "elasticdl_tpu" in parts:
+        return "/".join(parts[parts.index("elasticdl_tpu"):])
+    return parts[-1]
+
+
+class Unit:
+    """One parsed source file plus its suppression map."""
+
+    def __init__(self, path, source):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.module = self._dotted_module(path)
+        self.suppressed = self._suppressions(source, self.tree)
+
+    @staticmethod
+    def _dotted_module(path):
+        parts = path.replace(os.sep, "/").split("/")
+        if "elasticdl_tpu" in parts:
+            parts = parts[parts.index("elasticdl_tpu"):]
+        name = "/".join(parts)[: -len(".py")] if path.endswith(".py") else (
+            "/".join(parts)
+        )
+        return name.replace("/", ".").removesuffix(".__init__")
+
+    @staticmethod
+    def _suppressions(source, tree):
+        """line -> set(rule names) suppressed there. A comment on (or
+        immediately above) a line covers that line; on a block-opening
+        statement it covers the whole block."""
+        per_line = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                match = _DISABLE_RE.search(tok.string)
+                if not match:
+                    continue
+                rules = {
+                    r.strip() for r in match.group(1).split(",") if r.strip()
+                }
+                per_line.setdefault(tok.start[0], set()).update(rules)
+        except tokenize.TokenError:
+            pass
+        if not per_line:
+            return {}
+        # a comment-only line suppresses the line below it too
+        lines = source.splitlines()
+        expanded = dict(per_line)
+        for lineno, rules in per_line.items():
+            text = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+            if text.lstrip().startswith("#"):
+                expanded.setdefault(lineno + 1, set()).update(rules)
+        # block-opening statements extend their suppression to end_lineno
+        for node in ast.walk(tree):
+            if not isinstance(node, _BLOCK_NODES):
+                continue
+            rules = expanded.get(node.lineno)
+            if not rules:
+                continue
+            for line in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+                expanded.setdefault(line, set()).update(rules)
+        return expanded
+
+    def is_suppressed(self, finding):
+        return finding.rule in self.suppressed.get(finding.line, set())
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+
+def attr_chain(node):
+    """Dotted-name string of a Name/Attribute chain ("jax.device_get",
+    "self._stub.get_task"); None when the chain has calls/subscripts.
+    Subscripts collapse ("self._stubs[0].pull" -> "self._stubs.pull")
+    so index variants match the same patterns."""
+    parts = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        else:
+            return None
+
+
+def self_attr_target(node):
+    """Attribute name X when ``node`` writes ``self.X`` (directly or
+    through any subscript chain: ``self.X[k] = ..``, ``self.X[k][i] = ..``);
+    else None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def walk_with_scope(tree):
+    """Yield (node, qualname) for every node: qualname is the dotted
+    def/class chain enclosing the node ("Class.method" for nodes inside
+    a method, the def's own chain for the def node itself, "<module>"
+    at top level)."""
+
+    def rec(node, scope):
+        for child in ast.iter_child_nodes(node):
+            child_scope = scope
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                child_scope = scope + [child.name]
+            yield child, (".".join(child_scope) or "<module>")
+            yield from rec(child, child_scope)
+
+    yield from rec(tree, [])
+
+
+# ---------------------------------------------------------------------------
+# runner
+
+def _iter_py_files(paths):
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "node_modules")
+                )
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        yield os.path.join(root, fname)
+        else:
+            raise FileNotFoundError(path)
+
+
+def _load_units(paths):
+    units = []
+    errors = []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            units.append(Unit(path, source))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append((path, str(e)))
+    return units, errors
+
+
+def _rules_by_name(names=None):
+    # imported here to avoid a cycle (rule modules import core helpers)
+    from elasticdl_tpu.analysis import (
+        determinism,
+        fault_tolerance,
+        hot_path,
+        lock_discipline,
+    )
+
+    registry = {
+        "lock-discipline": lock_discipline.run,
+        "jax-hot-path": hot_path.run,
+        "ft-swallowed-except": fault_tolerance.run_swallowed_except,
+        "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
+        "xhost-determinism": determinism.run,
+    }
+    if names is None:
+        return registry
+    unknown = set(names) - set(registry)
+    if unknown:
+        raise ValueError("unknown edlint rule(s): %s" % sorted(unknown))
+    return {name: registry[name] for name in names}
+
+
+RULE_NAMES = (
+    "lock-discipline",
+    "jax-hot-path",
+    "ft-swallowed-except",
+    "ft-grpc-timeout",
+    "xhost-determinism",
+)
+
+
+def analyze_units(units, rules=None):
+    findings = []
+    for name, run in _rules_by_name(rules).items():
+        findings.extend(run(units))
+    by_path = {unit.path: unit for unit in units}
+    kept = [
+        f for f in findings
+        if not by_path[f.path].is_suppressed(f)
+    ]
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.code))
+    return kept
+
+
+def analyze_sources(sources, rules=None):
+    """sources: iterable of (path, source_text). Returns findings with
+    suppressions applied (baseline is the caller's business)."""
+    units = [Unit(path, text) for path, text in sources]
+    return analyze_units(units, rules)
+
+
+def analyze_paths(paths, rules=None):
+    """Returns (findings, parse_errors)."""
+    units, errors = _load_units(paths)
+    return analyze_units(units, rules), errors
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+@dataclass
+class Baseline:
+    entries: list = field(default_factory=list)
+
+    def match(self, finding):
+        fp = finding.fingerprint()
+        for entry in self.entries:
+            if (
+                entry.get("rule") == fp[0]
+                and entry.get("path") == fp[1]
+                and entry.get("symbol") == fp[2]
+                and entry.get("code") == fp[3]
+            ):
+                return entry
+        return None
+
+
+def load_baseline(path):
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("findings", [])
+    for entry in entries:
+        if not entry.get("justification"):
+            raise ValueError(
+                "baseline entry without a justification: %r" % (entry,)
+            )
+    return Baseline(entries)
+
+
+def split_baselined(findings, baseline):
+    """-> (new_findings, baselined_findings, unused_entries)."""
+    if baseline is None:
+        return list(findings), [], []
+    new, matched = [], []
+    used = []
+    for finding in findings:
+        entry = baseline.match(finding)
+        if entry is None:
+            new.append(finding)
+        else:
+            matched.append(finding)
+            used.append(id(entry))
+    unused = [e for e in baseline.entries if id(e) not in used]
+    return new, matched, unused
+
+
+def baseline_dict(findings, justification="TODO: justify or fix"):
+    """Serializable baseline content for --write-baseline."""
+    entries = []
+    seen = set()
+    for finding in findings:
+        fp = finding.fingerprint()
+        if fp in seen:
+            continue
+        seen.add(fp)
+        entries.append(
+            {
+                "rule": fp[0],
+                "path": fp[1],
+                "symbol": fp[2],
+                "code": fp[3],
+                "justification": justification,
+            }
+        )
+    return {"findings": entries}

@@ -1,0 +1,197 @@
+"""xhost-determinism: order-sensitive paths must iterate in fixed order.
+
+Checkpoint save/restore, model export, and gradient aggregation must
+produce identical results on every host: a ``for`` over a ``set``
+iterates in hash order (which varies per process under hash
+randomization and across hosts), and ``os.listdir``/``glob.glob``
+return filesystem order (which varies across filesystems and even
+across runs). Either one in these paths yields checkpoints whose shard
+contents, export layouts, or aggregation order silently differ between
+hosts.
+
+Scope: this rule only runs on files on the order-sensitive paths —
+any file whose path mentions checkpoint/export, plus the explicit
+aggregation modules (``ps/servicer.py``, ``train/callbacks.py``).
+Elsewhere, set iteration is normal Python and flagging it would be
+noise.
+
+Flagged:
+- ``for x in <set>`` / comprehensions over sets, where <set> is a set
+  literal, ``set()``/``frozenset()`` call, a set comprehension, or a
+  local name assigned one of those in the same scope;
+- ``os.listdir`` / ``glob.glob`` / ``glob.iglob`` / ``os.scandir`` /
+  ``Path.iterdir`` results consumed without a wrapping ``sorted()``.
+
+Not flagged: dict iteration (insertion-ordered since 3.7 — determinism
+follows from the insertion order, which these paths derive from sorted
+or wire-ordered inputs).
+"""
+
+import ast
+import re
+
+from elasticdl_tpu.analysis.core import Finding, walk_with_scope
+
+RULE = "xhost-determinism"
+
+_SCOPE_PATTERN = re.compile(r"(checkpoint|export)", re.IGNORECASE)
+_SCOPE_EXTRAS = (
+    "ps/servicer.py",      # sync-round gradient aggregation
+    "train/callbacks.py",  # train-end export callbacks
+)
+
+_FS_ORDER_CALLS = {
+    "os.listdir": "os.listdir",
+    "listdir": "os.listdir",
+    "glob.glob": "glob.glob",
+    "glob.iglob": "glob.iglob",
+    "os.scandir": "os.scandir",
+    "scandir": "os.scandir",
+}
+
+
+def in_scope(path):
+    posix = path.replace("\\", "/")
+    if _SCOPE_PATTERN.search(posix):
+        return True
+    return any(posix.endswith(extra) for extra in _SCOPE_EXTRAS)
+
+
+def _set_valued(node, set_names):
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("set", "frozenset")
+    if isinstance(node, ast.Name):
+        return node.id in set_names
+    return False
+
+
+def _local_set_names(func_node):
+    """Names assigned a set literal/comprehension/set() call anywhere in
+    the function (coarse single-pass flow — good enough at this rule's
+    file scope)."""
+    names = set()
+    for node in ast.walk(func_node):
+        if isinstance(node, ast.Assign) and _set_valued(node.value, names):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    names.add(target.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub)
+        ):
+            if isinstance(node.target, ast.Name) and _set_valued(
+                node.value, names
+            ):
+                names.add(node.target.id)
+    return names
+
+
+def _fs_order_call(node):
+    """Canonical name when ``node`` is a filesystem-order call."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "iterdir":
+            return "Path.iterdir"
+        value = func.value
+        prefix = value.id if isinstance(value, ast.Name) else None
+        dotted = "%s.%s" % (prefix, func.attr) if prefix else func.attr
+        return _FS_ORDER_CALLS.get(dotted)
+    if isinstance(func, ast.Name):
+        return _FS_ORDER_CALLS.get(func.id)
+    return None
+
+
+def _sorted_ancestors(tree):
+    """Set of node ids that appear anywhere inside a sorted(...) call."""
+    inside = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "sorted"
+        ):
+            for sub in ast.walk(node):
+                inside.add(id(sub))
+    return inside
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        if not in_scope(unit.path):
+            continue
+        sorted_scope = _sorted_ancestors(unit.tree)
+        # per-function set-name tables
+        set_names_by_func = {}
+        for node, _scope in walk_with_scope(unit.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                set_names_by_func[id(node)] = _local_set_names(node)
+
+        # walk tracking the innermost function for set-name lookup
+        def visit(node, scope, current_sets):
+            for child in ast.iter_child_nodes(node):
+                child_scope = scope
+                child_sets = current_sets
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    child_scope = (
+                        scope + "." + child.name
+                        if scope != "<module>" else child.name
+                    )
+                    child_sets = set_names_by_func[id(child)]
+                elif isinstance(child, ast.ClassDef):
+                    child_scope = (
+                        scope + "." + child.name
+                        if scope != "<module>" else child.name
+                    )
+                _check(child, child_scope, child_sets)
+                visit(child, child_scope, child_sets)
+
+        def _check(node, scope, current_sets):
+            iters = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(gen.iter for gen in node.generators)
+            for it in iters:
+                if _set_valued(it, current_sets) and id(it) not in (
+                    sorted_scope
+                ):
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=unit.path,
+                            line=it.lineno,
+                            symbol=scope,
+                            code="set-iteration",
+                            message=(
+                                "iteration over a set in an "
+                                "order-sensitive path: set order varies "
+                                "across hosts — wrap in sorted()"
+                            ),
+                        )
+                    )
+            fs_call = _fs_order_call(node)
+            if fs_call and id(node) not in sorted_scope:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.path,
+                        line=node.lineno,
+                        symbol=scope,
+                        code=fs_call,
+                        message=(
+                            "%s returns filesystem order, which varies "
+                            "across hosts/runs — wrap in sorted()"
+                            % fs_call
+                        ),
+                    )
+                )
+
+        visit(unit.tree, "<module>", set())
+    return findings

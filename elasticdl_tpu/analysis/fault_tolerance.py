@@ -1,0 +1,125 @@
+"""Fault-tolerance hygiene: swallowed exceptions and deadline-less RPCs.
+
+``ft-swallowed-except`` — a bare ``except:`` or broad
+``except Exception/BaseException`` whose body neither re-raises nor
+logs hides the failure from the fault-tolerance machinery: the task
+isn't reported failed, the pod isn't relaunched, the job wedges
+silently. Narrow excepts (``except KeyError``) are a handled case, not
+a swallow, and are not flagged.
+
+``ft-grpc-timeout`` — a gRPC stub call without ``timeout=`` blocks
+forever when the peer hangs (a half-dead PS pod holds its socket open
+without serving); every stub call must carry a deadline so the retry/
+recovery path gets control. Framework-aware heuristic: a call
+``<recv>.<method>(...)`` counts as a stub call when the receiver
+name chain contains "stub" (``self._stub.get_task``,
+``stub.push_gradients``, ``self._stubs[i].pull``) — the naming
+convention this repo uses for every generated-client handle.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, attr_chain, walk_with_scope
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGING_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log",
+}
+
+
+def _is_broad_handler(handler):
+    if handler.type is None:
+        return True
+
+    def broad(node):
+        chain = attr_chain(node)
+        return chain is not None and chain.split(".")[-1] in _BROAD
+
+    if isinstance(handler.type, ast.Tuple):
+        return any(broad(elt) for elt in handler.type.elts)
+    return broad(handler.type)
+
+
+def _body_surfaces_error(handler):
+    """True if the handler re-raises, logs, or prints."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Name) and func.id == "print":
+                return True
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _LOGGING_METHODS
+            ):
+                return True
+    return False
+
+
+def run_swallowed_except(units):
+    findings = []
+    for unit in units:
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad_handler(node):
+                continue
+            if _body_surfaces_error(node):
+                continue
+            caught = (
+                "bare except" if node.type is None
+                else "except %s" % (attr_chain(node.type) or "Exception")
+                if not isinstance(node.type, ast.Tuple)
+                else "broad except tuple"
+            )
+            findings.append(
+                Finding(
+                    rule="ft-swallowed-except",
+                    path=unit.path,
+                    line=node.lineno,
+                    symbol=scope,
+                    code=caught,
+                    message=(
+                        "%s swallows the error without logging or "
+                        "re-raising; fault tolerance never hears about "
+                        "it — log-and-degrade or re-raise" % caught
+                    ),
+                )
+            )
+    return findings
+
+
+def run_grpc_timeout(units):
+    findings = []
+    for unit in units:
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            receiver = attr_chain(func.value)
+            if receiver is None or "stub" not in receiver.lower():
+                continue
+            # constructor / channel plumbing, not an RPC
+            if func.attr.startswith("_") or func.attr in ("close",):
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            findings.append(
+                Finding(
+                    rule="ft-grpc-timeout",
+                    path=unit.path,
+                    line=node.lineno,
+                    symbol=scope,
+                    code="%s.%s" % (receiver, func.attr),
+                    message=(
+                        "gRPC call %s.%s() has no timeout=; a hung peer "
+                        "blocks this caller forever — add a deadline"
+                        % (receiver, func.attr)
+                    ),
+                )
+            )
+    return findings

@@ -1,0 +1,245 @@
+"""jax-hot-path: host syncs / host RNG / wall clock inside compiled code.
+
+A function is **hot** when any of:
+
+- it is decorated ``@jax.jit`` / ``@jit`` / ``@pjit`` /
+  ``@partial(jax.jit, ...)`` or ``@hot_path``
+  (``elasticdl_tpu.common.annotations.hot_path`` — the zero-cost marker
+  for functions that run on the step path but are compiled indirectly);
+- its NAME is passed to a ``jax.jit(...)``/``pjit(...)`` call;
+- it is returned by a factory whose call result is jitted
+  (``jax.jit(make_train_step(...))`` marks ``make_train_step``'s
+  returned inner function) — resolved across modules through
+  ``from x import y`` imports, because trainers jit factories defined
+  in train/step_fns.py;
+- it is a lambda passed to ``jax.jit`` directly;
+- a ``@hot_path``-decorated factory's returned inner functions.
+
+Inside a hot function (nested defs included) these calls are flagged —
+each forces a device fence, host transfer, or per-trace host effect:
+
+- ``jax.device_get`` / ``.item()`` / ``float(...)`` /
+  ``np.asarray(...)`` — host-device syncs
+- ``.block_until_ready()`` — explicit fence
+- ``np.random.*`` — host RNG baked in at trace time (use jax.random)
+- ``time.time()`` / ``time.perf_counter()`` / ``time.monotonic()`` —
+  wall clock frozen at trace time
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import (
+    Finding,
+    attr_chain,
+    walk_with_scope,
+)
+
+RULE = "jax-hot-path"
+
+_JIT_NAMES = {"jit", "pjit"}
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
+_SYNC_CALLS = {"jax.device_get", "np.asarray", "numpy.asarray"}
+# int() stays legal: hot functions routinely int() static config
+# (grad_accum_steps, capacity factors); float() has no such static use
+# in step code and is the classic accidental concretization
+_CAST_CALLS = {"float"}
+_SYNC_METHODS = {"item", "block_until_ready"}
+
+
+def _is_jit_callee(func):
+    """True for jit / pjit / jax.jit / jax.experimental.pjit.pjit."""
+    if isinstance(func, ast.Name):
+        return func.id in _JIT_NAMES
+    chain = attr_chain(func)
+    return chain is not None and chain.split(".")[-1] in _JIT_NAMES
+
+
+def _is_hot_decorator(dec):
+    """@jax.jit, @jit, @pjit, @hot_path, @partial(jax.jit, ...)."""
+    if isinstance(dec, ast.Call):
+        func = dec.func
+        callee = attr_chain(func)
+        if callee and callee.split(".")[-1] == "partial" and dec.args:
+            return _is_jit_callee(dec.args[0])
+        return _is_jit_callee(func)
+    chain = attr_chain(dec)
+    if chain is None:
+        return False
+    leaf = chain.split(".")[-1]
+    return leaf in _JIT_NAMES or leaf == "hot_path"
+
+
+def _returned_inner_functions(factory):
+    """Nested FunctionDefs of ``factory`` that a ``return`` statement
+    returns by name, plus returned lambdas."""
+    inner = {
+        node.name: node
+        for node in factory.body
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    returned = []
+    for node in ast.walk(factory):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        if isinstance(node.value, ast.Name) and node.value.id in inner:
+            returned.append(inner[node.value.id])
+        elif isinstance(node.value, ast.Lambda):
+            returned.append(node.value)
+    return returned
+
+
+class _ModuleIndex:
+    """Per-unit symbol tables needed for cross-module resolution."""
+
+    def __init__(self, unit):
+        self.unit = unit
+        # top-level (incl. class-nested) function defs by name; names are
+        # unique enough for resolution purposes
+        self.functions = {}
+        # local name -> (module_dotted, original_name) for ``from m import n``
+        self.imports = {}
+        for node, scope in walk_with_scope(unit.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, (node, scope))
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = (
+                        node.module, alias.name
+                    )
+
+
+def _resolve(index_by_module, index, name):
+    """(unit, func_node, scope) for ``name`` in ``index``'s module,
+    following one from-import hop; None when unresolvable."""
+    if name in index.functions:
+        node, scope = index.functions[name]
+        return index.unit, node, scope
+    if name in index.imports:
+        module, original = index.imports[name]
+        target = index_by_module.get(module)
+        if target and original in target.functions:
+            node, scope = target.functions[original]
+            return target.unit, node, scope
+    return None
+
+
+def _collect_hot(units):
+    """-> list of (unit, func_or_lambda_node, symbol)."""
+    indexes = [_ModuleIndex(unit) for unit in units]
+    index_by_module = {idx.unit.module: idx for idx in indexes}
+    hot = []
+    seen = set()
+
+    def mark(unit, node, symbol):
+        key = (unit.path, id(node))
+        if key not in seen:
+            seen.add(key)
+            hot.append((unit, node, symbol))
+
+    def mark_factory(unit, node, scope):
+        for inner in _returned_inner_functions(node):
+            name = getattr(inner, "name", "<lambda>")
+            mark(unit, inner, "%s.%s" % (scope, name))
+
+    for idx in indexes:
+        for node, scope in walk_with_scope(idx.unit.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_hot_decorator(d) for d in node.decorator_list):
+                    # a factory's product is the hot code; the factory
+                    # body itself is once-per-program setup and marking
+                    # it too would double-report every inner hit and
+                    # false-positive on host-side preamble
+                    inner = _returned_inner_functions(node)
+                    if inner:
+                        mark_factory(idx.unit, node, scope)
+                    else:
+                        mark(idx.unit, node, scope)
+            elif isinstance(node, ast.Call) and _is_jit_callee(node.func):
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Lambda):
+                    mark(idx.unit, arg, scope + ".<lambda>")
+                elif isinstance(arg, ast.Name):
+                    resolved = _resolve(index_by_module, idx, arg.id)
+                    if resolved:
+                        unit, fn, fn_scope = resolved
+                        mark(unit, fn, fn_scope)
+                elif isinstance(arg, ast.Call):
+                    callee = arg.func
+                    if isinstance(callee, ast.Name):
+                        resolved = _resolve(
+                            index_by_module, idx, callee.id
+                        )
+                        if resolved:
+                            unit, fn, fn_scope = resolved
+                            mark_factory(unit, fn, fn_scope)
+    return hot
+
+
+def _scan_hot_function(unit, node, symbol, findings):
+    body = node.body if isinstance(node.body, list) else [node.body]
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            chain = attr_chain(func)
+            code = None
+            if isinstance(func, ast.Name) and func.id in _CAST_CALLS:
+                code = "%s()" % func.id
+                detail = (
+                    "%s() on a traced value forces a host sync at run "
+                    "time (concretization error or silent device fence)"
+                    % func.id
+                )
+            elif chain in _SYNC_CALLS:
+                code = chain
+                detail = (
+                    "%s inside compiled code pulls the value to host "
+                    "every step" % chain
+                )
+            elif chain in _TIME_CALLS:
+                code = chain
+                detail = (
+                    "%s is evaluated once at trace time, not per step; "
+                    "pass times in as arguments" % chain
+                )
+            elif chain and (
+                chain.startswith("np.random.")
+                or chain.startswith("numpy.random.")
+            ):
+                code = "np.random"
+                detail = (
+                    "host RNG inside compiled code is baked in at trace "
+                    "time and differs across hosts; use jax.random"
+                )
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr in _SYNC_METHODS
+                and not sub.args
+            ):
+                code = ".%s()" % func.attr
+                detail = (
+                    ".%s() forces a blocking device-to-host transfer"
+                    % func.attr
+                )
+            if code is None:
+                continue
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.path,
+                    line=sub.lineno,
+                    symbol=symbol,
+                    code=code,
+                    message="hot path: " + detail,
+                )
+            )
+
+
+def run(units):
+    findings = []
+    for unit, node, symbol in _collect_hot(units):
+        _scan_hot_function(unit, node, symbol, findings)
+    return findings

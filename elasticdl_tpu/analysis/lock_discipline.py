@@ -1,0 +1,233 @@
+"""lock-discipline: off-lock mutation of lock-protected attributes.
+
+For every class that creates a ``threading.Lock``/``RLock``/``Condition``
+in ``__init__``, infer the set of attributes that class mutates while
+holding each lock, then flag any method that mutates one of those
+attributes without holding it.
+
+Lock-held regions are:
+
+- the body of ``with self.<lock>:`` (any of the with's items);
+- the body of a method whose name ends in ``_locked`` — this repo's
+  caller-holds-the-lock convention (task_dispatcher, ps/servicer).
+  With several locks in a class, a ``_locked`` method counts as
+  holding ALL of them for checking and contributes to inference only
+  when the class has exactly one lock (otherwise the association is
+  ambiguous).
+
+A nested ``def`` inside a lock-held region is NOT lock-held: closures
+outlive the with-block (deferred callbacks are exactly how the
+reference leaked unlocked mutations). Suppress deliberate ones with
+``# edlint: disable=lock-discipline`` on the inner ``def`` line.
+
+Known blind spots (documented, not worth the alias analysis): local
+aliases (``queue = self._todo; queue.pop()``) and mutations through
+``self.<attr>`` element objects.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, self_attr_target
+
+RULE = "lock-discipline"
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# method names on self.<attr> that mutate the container in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "remove", "pop", "popleft", "popitem", "clear",
+    "add", "discard", "update", "setdefault", "sort", "reverse",
+}
+
+
+def _lock_attrs(class_node):
+    """Lock attribute names assigned in __init__ (``self._lock =
+    threading.Lock()`` or bare ``Lock()``)."""
+    locks = set()
+    for item in class_node.body:
+        if not (
+            isinstance(item, ast.FunctionDef) and item.name == "__init__"
+        ):
+            continue
+        for node in ast.walk(item):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            func = value.func
+            name = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name)
+                else None
+            )
+            if name not in _LOCK_FACTORIES:
+                continue
+            for target in node.targets:
+                attr = self_attr_target(target)
+                if attr is not None:
+                    locks.add(attr)
+    return locks
+
+
+def _is_lock_with(node, locks):
+    """Lock names this ``with`` statement acquires (subset of locks)."""
+    held = set()
+    for item in node.items:
+        expr = item.context_expr
+        # ``with self._lock:`` — also accept ``self._lock.acquire()``-less
+        # Condition use: ``with self._cv:``
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in locks
+        ):
+            held.add(expr.attr)
+    return held
+
+
+def _mutated_attrs(node):
+    """Yield (attr, line) for each ``self.<attr>`` mutation directly in
+    ``node`` (single statement or expression)."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for target in targets:
+            # unpack tuple/list targets: ``a, self._x = ...``
+            elts = (
+                target.elts
+                if isinstance(target, (ast.Tuple, ast.List))
+                else [target]
+            )
+            for elt in elts:
+                attr = self_attr_target(elt)
+                if attr is not None:
+                    yield attr, node.lineno
+    elif isinstance(node, ast.Delete):
+        for target in node.targets:
+            attr = self_attr_target(target)
+            if attr is not None:
+                yield attr, node.lineno
+    elif isinstance(node, ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            attr = self_attr_target(func.value)
+            if attr is not None:
+                yield attr, node.lineno
+
+
+class _MethodScanner:
+    """Walks one method body tracking which locks are held lexically.
+    Every node is visited exactly once with the correct held-set."""
+
+    def __init__(self, locks, holds_all):
+        self.locks = locks
+        self.holds_all = holds_all
+        # list of (attr, line, frozenset(held_locks), in_nested_def)
+        self.mutations = []
+
+    def scan(self, method):
+        initial = frozenset(self.locks) if self.holds_all else frozenset()
+        for stmt in method.body:
+            self._visit(stmt, held=initial, nested=False)
+
+    def _visit(self, node, held, nested):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            # closures/lambdas escape the lock scope: deferred execution
+            # does not inherit the with-block's lock
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for child in body:
+                self._visit(child, held=frozenset(), nested=True)
+            return
+        if isinstance(node, ast.With):
+            newly = _is_lock_with(node, self.locks)
+            for item in node.items:
+                self._visit(item.context_expr, held, nested)
+            for child in node.body:
+                self._visit(child, held | newly, nested)
+            return
+        for attr, line in _mutated_attrs(node):
+            self.mutations.append((attr, line, held, nested))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, nested)
+
+
+def _scan_class(unit, class_node, findings):
+    locks = _lock_attrs(class_node)
+    if not locks:
+        return
+    single_lock = len(locks) == 1
+    methods = [
+        item for item in class_node.body
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    # pass 1: infer protected attrs per lock
+    protected = {lock: set() for lock in locks}
+    scans = {}
+    for method in methods:
+        if method.name == "__init__":
+            continue
+        holds_all = method.name.endswith("_locked")
+        scanner = _MethodScanner(locks, holds_all)
+        scanner.scan(method)
+        # keyed by node, not name: a property getter/setter pair shares
+        # a name, and a name key would both skip the getter in pass 2
+        # and double-report the setter
+        scans[id(method)] = scanner
+        for attr, _line, held, nested in scanner.mutations:
+            if nested:
+                continue  # closures don't prove protection
+            if holds_all:
+                if single_lock:
+                    protected[next(iter(locks))].add(attr)
+                continue
+            for lock in held:
+                protected[lock].add(attr)
+    # the lock attributes themselves are infrastructure, not state
+    for lock in locks:
+        for attrs in protected.values():
+            attrs.discard(lock)
+    # pass 2: flag mutations of protected attrs made without the lock
+    for method in methods:
+        if method.name == "__init__":
+            continue  # construction happens-before publication
+        scanner = scans[id(method)]
+        if scanner.holds_all:
+            continue
+        for attr, line, held, _nested in scanner.mutations:
+            owners = [
+                lock for lock, attrs in protected.items() if attr in attrs
+            ]
+            if not owners:
+                continue
+            if any(lock in held for lock in owners):
+                continue
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.path,
+                    line=line,
+                    symbol="%s.%s" % (class_node.name, method.name),
+                    code="unlocked: %s" % attr,
+                    message=(
+                        "self.%s is mutated under self.%s elsewhere in "
+                        "%s but mutated here without holding it"
+                        % (attr, "/self.".join(sorted(owners)),
+                           class_node.name)
+                    ),
+                )
+            )
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.ClassDef):
+                _scan_class(unit, node, findings)
+    return findings

@@ -1,0 +1,16 @@
+"""Zero-cost source annotations consumed by static analysis (edlint).
+
+``@hot_path`` marks a function as part of the per-step compiled/driving
+path even when edlint cannot prove it from jit plumbing alone: apply it
+to step-function factories (whose returned closure is what gets
+jitted) and to host-side functions that run once per training step.
+edlint's ``jax-hot-path`` rule then flags host-device syncs, host RNG,
+and wall-clock reads inside them. Runtime cost: nothing — it returns
+the function unchanged.
+"""
+
+
+def hot_path(fn):
+    """Identity decorator: marks ``fn`` (or the closures a factory
+    returns) as step-path code for edlint's jax-hot-path rule."""
+    return fn

@@ -9,6 +9,13 @@ class GRPC:
     # limit to 256 MB on both sides: common/constants.py:15-19).
     MAX_SEND_MESSAGE_LENGTH = 256 * 1024 * 1024
     MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
+    # Per-RPC deadline. No unary call in this system legitimately runs
+    # longer: get_task answers WAIT instead of blocking, and the big
+    # pull/push payloads (256 MB cap) clear in seconds on pod networks.
+    # A hung half-dead peer then surfaces as DEADLINE_EXCEEDED — which
+    # the PS client's retry loop treats as retryable — instead of
+    # blocking the caller forever (edlint: ft-grpc-timeout).
+    DEFAULT_RPC_TIMEOUT_SECS = 60.0
 
 
 class WorkerEnv:

@@ -138,7 +138,8 @@ class Dataset:
                 try:
                     for item in self._source_fn():
                         q.put(item)
-                except BaseException as e:  # propagate to consumer
+                # propagated: the consumer loop re-raises error[0]
+                except BaseException as e:  # edlint: disable=ft-swallowed-except
                     error.append(e)
                 finally:
                     q.put(sentinel)

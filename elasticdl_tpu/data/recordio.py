@@ -190,9 +190,9 @@ def RecordReader(path, prefer_mmap=True):
     if prefer_mmap:
         try:
             return MmapRecordReader(path)
-        except ValueError:
-            raise
-        except Exception:
+        except OSError:
+            # mmap-hostile filesystem: the buffered reader serves the
+            # same bytes; anything else propagates
             pass
     return _PyRecordReader(path)
 

@@ -202,7 +202,8 @@ class ParallelTableDataReader(TableDataReader):
                     return
                 rows = list(self._client.read_rows(lo, hi, self._columns))
                 done.put((index, rows, None))
-            except Exception as e:  # surfaced to the consumer below
+            # surfaced: the consumer re-raises it off the done queue
+            except Exception as e:  # edlint: disable=ft-swallowed-except
                 done.put((index, None, e))
             finally:
                 sem.release()

@@ -237,8 +237,12 @@ class InstanceManager:
             logger.warning("Relaunching PS %d", ps_id)
             try:
                 self._client.delete_ps(ps_id)
-            except Exception:
-                pass
+            except Exception as e:
+                # log-and-degrade: the pod being already gone is the
+                # common case here (we are reacting to its death event)
+                logger.warning(
+                    "pre-relaunch delete of PS %d failed: %s", ps_id, e
+                )
             self._start_ps(ps_id)
 
     # ------------------------------------------------------------------
@@ -258,13 +262,16 @@ class InstanceManager:
         for wid in worker_ids:
             try:
                 self._client.delete_worker(wid)
-            except Exception:
-                pass
+            except Exception as e:
+                # log-and-degrade: stop_all is best-effort teardown, but
+                # a pod we failed to delete will outlive the job — the
+                # operator needs to hear about it
+                logger.warning("delete of worker %d failed: %s", wid, e)
         for ps_id in range(self._num_ps):
             try:
                 self._client.delete_ps(ps_id)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.warning("delete of PS %d failed: %s", ps_id, e)
 
 
 def _start_time_of(pod):

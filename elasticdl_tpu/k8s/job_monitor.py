@@ -24,7 +24,11 @@ class PodMonitor:
     def pod_phase(self):
         try:
             pod = self._api.get_pod(self._pod_name)
-        except Exception:
+        except Exception as e:
+            # log-and-degrade: a vanished pod legitimately reads as
+            # finished, but an API outage masquerading as "finished"
+            # must leave a trace
+            logger.warning("get_pod(%s) failed: %s", self._pod_name, e)
             return None  # gone counts as finished for exit purposes
         return pod.get("status", {}).get("phase")
 
@@ -33,7 +37,8 @@ class PodMonitor:
         matching the Go PS's check — carries a `status: Finished` label."""
         try:
             pod = self._api.get_pod(self._pod_name)
-        except Exception:
+        except Exception as e:
+            logger.warning("get_pod(%s) failed: %s", self._pod_name, e)
             return True
         phase = pod.get("status", {}).get("phase")
         if phase in FINISHED_PHASES:

@@ -146,7 +146,9 @@ class TaskDispatcher:
         export). Reference: task_dispatcher.py:219-254.
         """
 
-        def _create():
+        # deferred closure: runs via _fire_deferred_locked, which holds
+        # the lock — edlint can't see through the deferred call
+        def _create():  # edlint: disable=lock-discipline
             task = pb.Task(
                 task_id=self._next_task_id,
                 type=pb.TRAIN_END_CALLBACK,

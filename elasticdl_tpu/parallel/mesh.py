@@ -23,6 +23,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.parallel.mesh")
+
 AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")
 # Batch is sharded over both flavors of data parallelism.
 DATA_AXES = ("dp", "fsdp")
@@ -122,9 +126,11 @@ def build_mesh(config: MeshConfig = None, num_devices=None) -> Mesh:
         device_array = mesh_utils.create_device_mesh(
             shape, devices=devices
         )
-    except Exception:
+    except Exception as e:
         # Fallback (virtual CPU devices, unusual shapes): enumeration
-        # order — correct, just not topology-optimal.
+        # order — correct, just not topology-optimal. Routine on CPU
+        # meshes, so log-and-degrade at debug.
+        logger.debug("topology-aware device mesh unavailable: %s", e)
         device_array = np.array(devices).reshape(shape)
     return Mesh(device_array, AXES)
 

@@ -144,8 +144,12 @@ class MultiHostRuntime:
                     )
                     try:
                         self._distributed.shutdown()
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # expected: the failed half-joined runtime often
+                        # has nothing to shut down
+                        logger.debug(
+                            "pre-retry distributed shutdown failed: %s", e
+                        )
                     info = self._wait_admitted(
                         wait_sleep_secs, max_wait_secs, start
                     )

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from elasticdl_tpu.common.annotations import hot_path
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.parallel.spmd_trainer import SpmdTrainer
 
@@ -90,6 +91,7 @@ class LockstepMixin:
         return jax.tree_util.tree_map(put, tree, shardings)
 
     # -- lockstep consensus --------------------------------------------
+    @hot_path
     def consensus(self, have_data, stream_ended=False):
         """Returns (alive, ended): how many processes hold a real batch
         this round, and how many have PERMANENTLY exhausted their task

@@ -60,7 +60,7 @@ class SparseCheckpointSaver:
     def _complete(self, vdir):
         """A version dir is valid when all N shard files exist
         (reference validity check: save_utils.py:211-227)."""
-        files = [f for f in os.listdir(vdir) if _FILE_RE.search(f)]
+        files = [f for f in sorted(os.listdir(vdir)) if _FILE_RE.search(f)]
         if not files:
             return False
         total = int(_FILE_RE.search(files[0]).group(2))
@@ -118,7 +118,9 @@ class SparseCheckpointSaver:
                 for key in data.files
                 if key.startswith("ids/")
             }
-            for name in tables:
+            # sorted: table creation order must match across hosts —
+            # set order varies per process under hash randomization
+            for name in sorted(tables):
                 dim = int(data["dim/" + name])
                 store.create_table(name, dim)
                 saved_opt = (

@@ -465,7 +465,7 @@ class NumpyEmbeddingStore:
             bad = np.abs(row) > 2 * scale
         return row.astype(np.float32)
 
-    def _row(self, name, id_):
+    def _row_locked(self, name, id_):
         table = self._tables[name]
         if id_ not in table:
             dim, scale, kind = self._meta[name]
@@ -481,7 +481,9 @@ class NumpyEmbeddingStore:
         if name not in self._meta:
             raise KeyError(name)
         with self._lock:
-            return np.stack([self._row(name, int(i)).copy() for i in ids])
+            return np.stack([
+                self._row_locked(name, int(i)).copy() for i in ids
+            ])
 
     def push_gradients(self, name, ids, grads, lr_scale=1.0):
         if name not in self._meta:
@@ -491,7 +493,7 @@ class NumpyEmbeddingStore:
         with self._lock:
             for i, grad in zip(ids, np.asarray(grads, dtype=np.float32)):
                 i = int(i)
-                w = self._row(name, i)
+                w = self._row_locked(name, i)
                 slots = self._slots[name][i]
                 self._steps[name][i] += 1
                 step = self._steps[name][i]
@@ -524,7 +526,8 @@ class NumpyEmbeddingStore:
         return len(self._tables.get(name, {}))
 
     def bump_version(self):
-        self.version += 1
+        with self._lock:
+            self.version += 1
 
     def table_names(self):
         return list(self._meta)
@@ -551,7 +554,7 @@ class NumpyEmbeddingStore:
                 i = int(i)
                 if shard_num > 0 and i % shard_num != shard_id:
                     continue
-                self._row(name, i)[:] = row
+                self._row_locked(name, i)[:] = row
 
     @property
     def opt_type(self):
@@ -597,7 +600,7 @@ class NumpyEmbeddingStore:
                 i = int(i)
                 if shard_num > 0 and i % shard_num != shard_id:
                     continue
-                self._row(name, i)[:] = rows[idx][:dim]
+                self._row_locked(name, i)[:] = rows[idx][:dim]
                 if exact:
                     self._slots[name][i][:] = rows[idx][dim:].reshape(
                         slots, dim

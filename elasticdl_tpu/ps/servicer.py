@@ -235,7 +235,7 @@ class PserverServicer:
                         accepted=True, version=version
                     )
                 for entry in same_worker:
-                    self._remove_buffered(entry)
+                    self._remove_buffered_locked(entry)
                     logger.warning(
                         "sync PS: worker %d re-pushed at version %d "
                         "under a new incarnation — dropping its dead "
@@ -261,14 +261,14 @@ class PserverServicer:
                         accepted=True, version=version
                     )
                 del self._round_groups[grad_version]
-                self._apply_round(group)
+                self._apply_round_locked(group)
             else:
                 self._round_buffer.append(entry)
                 if len(self._round_buffer) < self._grads_to_wait:
                     return pb.PushGradientsResponse(
                         accepted=True, version=version
                     )
-                self._apply_round(self._round_buffer)
+                self._apply_round_locked(self._round_buffer)
                 self._round_buffer = []
             self._store.bump_version()
             version = self._store.version
@@ -282,7 +282,7 @@ class PserverServicer:
         for group in self._round_groups.values():
             yield from group
 
-    def _remove_buffered(self, entry):
+    def _remove_buffered_locked(self, entry):
         if entry in self._round_buffer:
             self._round_buffer.remove(entry)
             return
@@ -293,7 +293,7 @@ class PserverServicer:
                     del self._round_groups[tag]
                 return
 
-    def _apply_round(self, entries):
+    def _apply_round_locked(self, entries):
         """Merge and apply one completed round's buffered pushes.
         Caller holds the push lock and bumps the store version."""
         scales = [s for _, _, s in entries]

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticdl_tpu.common.annotations import hot_path
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
 from elasticdl_tpu.data.pipeline import MASK_KEY
@@ -430,6 +431,7 @@ def _split_batch(batch, row_keys):
     return features, labels, mask, rows
 
 
+@hot_path
 def make_sparse_train_step(model, loss_fn, tx, specs, compute_dtype=None):
     """Train step that also returns d(loss)/d(embedding rows)."""
     row_keys = [spec.name + ROWS_SUFFIX for spec in specs]
@@ -475,6 +477,7 @@ def make_sparse_train_step(model, loss_fn, tx, specs, compute_dtype=None):
     return train_step
 
 
+@hot_path
 def make_row_grads_fn(model, loss_fn, specs, compute_dtype=None):
     """d(loss)/d(rows) at FIXED params — the sync-PS retry path: when a
     push is rejected as stale, fresh rows are pulled and only the row
@@ -891,7 +894,7 @@ class SparseTrainer:
                     self._finish_push(
                         push_rpc(acc, model_version=self._version)
                     )
-            except Exception:
+            except Exception:  # edlint: disable=ft-swallowed-except
                 pass  # the original exception matters more
             push_pool.shutdown(wait=True)
             if next_prep_future is not None:

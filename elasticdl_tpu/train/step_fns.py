@@ -11,6 +11,7 @@ collectives on ICI automatically.
 import jax
 import jax.numpy as jnp
 
+from elasticdl_tpu.common.annotations import hot_path
 from elasticdl_tpu.data.pipeline import MASK_KEY
 from elasticdl_tpu.train.train_state import TrainState, cast_floating
 
@@ -35,6 +36,7 @@ def _apply_model(model, params, model_state, features, training, rngs):
     return outputs, model_state
 
 
+@hot_path
 def make_train_step(model, loss_fn, tx, compute_dtype=None,
                     grad_accum_steps=1):
     """Returns train_step(state, batch) -> (new_state, loss).
@@ -188,6 +190,7 @@ def make_train_step(model, loss_fn, tx, compute_dtype=None,
     return train_step
 
 
+@hot_path
 def make_eval_step(model, compute_dtype=None):
     """Returns eval_step(state, features) -> outputs."""
 

@@ -9,6 +9,7 @@ import socket
 
 import grpc
 
+from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.common.grpc_utils import build_channel
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import ndarray_to_blob
@@ -34,15 +35,39 @@ class MasterClient:
     def worker_id(self):
         return self._worker_id
 
+    # get_task deadline misses tolerated before concluding job-over: an
+    # empty Task makes the worker EXIT, so a single slow call (master
+    # under API-server pressure, long dispatcher-lock hold during a
+    # recovery sweep) must not end training. Connection errors don't
+    # get this grace — a dead master fails fast, as before.
+    GET_TASK_DEADLINE_RETRIES = 3
+
     def get_task(self, task_type=None):
         request = pb.GetTaskRequest(worker_id=self._worker_id)
         if task_type is not None:
             request.task_type = task_type
-        try:
-            return self._stub.get_task(request)
-        except grpc.RpcError:
-            # Master gone: treat as job over (reference behavior).
-            return pb.Task()
+        deadline_misses = 0
+        while True:
+            try:
+                return self._stub.get_task(
+                    request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                )
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if (
+                    code == grpc.StatusCode.DEADLINE_EXCEEDED
+                    and deadline_misses < self.GET_TASK_DEADLINE_RETRIES
+                ):
+                    deadline_misses += 1
+                    logger.warning(
+                        "get_task deadline exceeded (%d/%d); master "
+                        "slow — retrying",
+                        deadline_misses, self.GET_TASK_DEADLINE_RETRIES,
+                    )
+                    continue
+                # Master gone (or slow past every grace deadline):
+                # treat as job over (reference behavior).
+                return pb.Task()
 
     def report_task_result(self, task_id, err_message="", exec_counters=None):
         request = pb.ReportTaskResultRequest(
@@ -53,7 +78,9 @@ class MasterClient:
         for key, value in (exec_counters or {}).items():
             request.exec_counters[key] = str(value)
         try:
-            self._stub.report_task_result(request)
+            self._stub.report_task_result(
+                request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+            )
         except grpc.RpcError:
             logger.warning("report_task_result(%s) failed", task_id)
 
@@ -65,14 +92,17 @@ class MasterClient:
             ndarray_to_blob(array, request.model_outputs[name])
         ndarray_to_blob(labels, request.labels)
         try:
-            self._stub.report_evaluation_metrics(request)
+            self._stub.report_evaluation_metrics(
+                request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+            )
         except grpc.RpcError:
             logger.warning("report_evaluation_metrics failed")
 
     def report_version(self, model_version):
         try:
             self._stub.report_version(
-                pb.ReportVersionRequest(model_version=model_version)
+                pb.ReportVersionRequest(model_version=model_version),
+                timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS,
             )
         except grpc.RpcError:
             logger.warning("report_version(%s) failed", model_version)
@@ -83,7 +113,8 @@ class MasterClient:
         holds. Call once at startup (servicer.reset_worker)."""
         try:
             self._stub.reset_worker(
-                pb.GetTaskRequest(worker_id=self._worker_id)
+                pb.GetTaskRequest(worker_id=self._worker_id),
+                timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS,
             )
         except grpc.RpcError:
             logger.warning("reset_worker failed")
@@ -93,7 +124,8 @@ class MasterClient:
             return self._stub.get_comm_info(
                 pb.GetCommInfoRequest(
                     worker_id=self._worker_id, worker_host=self._worker_host
-                )
+                ),
+                timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS,
             )
         except grpc.RpcError:
             return pb.CommInfo(rank=-1, world_size=0, mesh_epoch=-1)

@@ -15,6 +15,7 @@ from typing import NamedTuple, Tuple
 import grpc
 import numpy as np
 
+from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.common.grpc_utils import build_channel
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import (
@@ -103,7 +104,9 @@ class PSClient:
         list(
             self._pool.map(
                 lambda stub: _call_with_retry(
-                    lambda: stub.push_embedding_table_infos(request),
+                    lambda: stub.push_embedding_table_infos(
+                        request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                    ),
                     "push_embedding_table_infos",
                 ),
                 self._stubs,
@@ -114,12 +117,20 @@ class PSClient:
         request = pb.Model(version=version)
         for name, array in params.items():
             ndarray_to_blob(np.asarray(array), request.dense_parameters[name])
-        list(self._pool.map(lambda s: s.push_model(request), self._stubs))
+        list(
+            self._pool.map(
+                lambda stub: stub.push_model(
+                    request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                ),
+                self._stubs,
+            )
+        )
 
     def pull_dense_init(self, version=-1):
         """Returns (initialized, version, params) from PS 0."""
         response = self._stubs[0].pull_dense_parameters(
-            pb.PullDenseParametersRequest(version=version)
+            pb.PullDenseParametersRequest(version=version),
+            timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS,
         )
         params = {
             name: blob_to_ndarray(blob)
@@ -138,7 +149,9 @@ class PSClient:
                 name=name, ids=ids.tolist()
             )
             blob = _call_with_retry(
-                lambda: self._stubs[0].pull_embedding_vectors(request),
+                lambda: self._stubs[0].pull_embedding_vectors(
+                    request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                ),
                 "pull_embedding_vectors",
             )
             return blob_to_ndarray(blob)
@@ -155,7 +168,9 @@ class PSClient:
             futures[int(shard)] = self._pool.submit(
                 _call_with_retry,
                 lambda stub=stub, request=request:
-                    stub.pull_embedding_vectors(request),
+                    stub.pull_embedding_vectors(
+                        request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                    ),
                 "pull_embedding_vectors",
             )
         dim = None
@@ -231,12 +246,21 @@ class PSClient:
             # applied the push but the connection died before the
             # response, the retry re-applies it (async-PS semantics
             # tolerate this; the reference's gRPC retries had the same
-            # window)
+            # window). The deadline is the WHOLE retry budget, not the
+            # default RPC timeout: push_gradients is the one
+            # non-idempotent RPC here (counting-mode sync rounds append
+            # same-incarnation pushes by design), so a deadline must
+            # only fire when the budget is exhausted anyway — a shorter
+            # deadline would make DEADLINE_EXCEEDED re-send a push the
+            # stalled server may still apply, double-counting the
+            # minibatch.
             futures.append(
                 (shard, self._pool.submit(
                     _call_with_retry,
                     lambda stub=stub, request=request:
-                        stub.push_gradients(request),
+                        stub.push_gradients(
+                            request, timeout=PS_RETRY_BUDGET_SECS
+                        ),
                     "push_gradients",
                 ))
             )

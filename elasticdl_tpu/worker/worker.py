@@ -75,7 +75,9 @@ class _BatchPoller:
             for batch in batches:
                 self._queue.put(batch)
             self._queue.put(self._END)
-        except BaseException as e:  # surface on the consumer side
+        # the error IS surfaced: poll() re-raises it on the consumer
+        # thread, where the task-failure machinery runs
+        except BaseException as e:  # edlint: disable=ft-swallowed-except
             self._queue.put(e)
 
     def poll(self, timeout):

@@ -14,6 +14,14 @@ LANE="${1:-fast}"
 echo "== tier 1a: native store build + TSAN race stress =="
 make -C elasticdl_tpu/native
 make -C elasticdl_tpu/native tsan
+make -C elasticdl_tpu/native asan
+
+echo "== tier 1c: edlint static analysis =="
+# zero-findings gate (both lanes): new findings are fixed, suppressed
+# with a comment, or baselined with a justification — never ignored.
+# Also runs inside the fast suite as tests/test_static_analysis.py
+# (-m lint selects just the gate).
+python -m elasticdl_tpu.analysis elasticdl_tpu/
 
 if [ "$LANE" = "full" ]; then
   echo "== tier 1b: FULL unit suite (8-virtual-device CPU mesh) =="

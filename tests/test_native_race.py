@@ -1,11 +1,14 @@
-"""ThreadSanitizer gate for the native embedding store.
+"""Sanitizer gates for the native embedding store.
 
 The reference ran its Go PS tests without -race (SURVEY.md §5 "race
 detection: none"); the rebuilt C++ store is raced-checked here: 8
 threads hammer lookup (lazy row creation) / push_gradients / full
 export / version bumps across 2 tables under TSAN, halt_on_error=1.
+The same stress also runs under ASan+UBSan (heap misuse across the
+ctypes ABI, UB in the kernels) — races are TSAN's job, memory is ASan's.
 """
 
+import functools
 import os
 import shutil
 import subprocess
@@ -17,9 +20,13 @@ NATIVE_DIR = os.path.join(
 )
 
 
-def _tsan_available():
-    """g++ alone is not enough — libtsan ships separately on minimal
-    images; probe with a tiny -fsanitize=thread link."""
+@functools.lru_cache(maxsize=None)
+def _sanitizer_available(flag):
+    """g++ alone is not enough — libtsan/libasan ship separately on
+    minimal images; probe with a tiny link. Memoized for the whole test
+    session: the probe spawns a compiler, and re-probing per collected
+    test (or per sanitizer retry) multiplies that cost for the same
+    answer."""
     if shutil.which("g++") is None:
         return False
     import tempfile
@@ -29,23 +36,46 @@ def _tsan_available():
         with open(src_path, "w") as f:
             f.write("int main() { return 0; }\n")
         probe = subprocess.run(
-            ["g++", "-fsanitize=thread", "-o",
-             os.path.join(tmp, "probe"), src_path],
+            ["g++", flag, "-o", os.path.join(tmp, "probe"), src_path],
             capture_output=True,
         )
         return probe.returncode == 0
 
 
-def test_store_survives_tsan_stress():
-    if not _tsan_available():
-        pytest.skip("no C++ toolchain with libtsan")
+def _tsan_available():
+    return _sanitizer_available("-fsanitize=thread")
+
+
+def _asan_available():
+    return _sanitizer_available("-fsanitize=address,undefined")
+
+
+def _run_sanitized_stress(target):
     result = subprocess.run(
-        ["make", "-s", "tsan"],
+        ["make", "-s", target],
         cwd=os.path.abspath(NATIVE_DIR),
         capture_output=True,
         text=True,
         timeout=300,
     )
+    return result
+
+
+def test_store_survives_tsan_stress():
+    if not _tsan_available():
+        pytest.skip("no C++ toolchain with libtsan")
+    result = _run_sanitized_stress("tsan")
     assert result.returncode == 0, result.stdout + result.stderr
     assert "STRESS-OK" in result.stdout
     assert "WARNING: ThreadSanitizer" not in result.stdout + result.stderr
+
+
+def test_store_survives_asan_ubsan_stress():
+    if not _asan_available():
+        pytest.skip("no C++ toolchain with libasan/libubsan")
+    result = _run_sanitized_stress("asan")
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "STRESS-OK" in result.stdout
+    combined = result.stdout + result.stderr
+    assert "ERROR: AddressSanitizer" not in combined
+    assert "runtime error:" not in combined  # UBSan's report prefix

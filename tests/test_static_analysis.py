@@ -1,0 +1,495 @@
+"""edlint (elasticdl_tpu.analysis) rule tests + the zero-findings gate.
+
+Every rule gets a positive fixture (a small snippet containing the bug
+— the rule must fire) and a clean twin (the rule must stay quiet), plus
+suppression/baseline mechanics and the tier-1 gate: the whole package
+analyzes clean against the checked-in baseline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from elasticdl_tpu.analysis import (
+    analyze_paths,
+    analyze_sources,
+    load_baseline,
+    split_baselined,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO_ROOT, ".edlint-baseline.json")
+
+
+def findings_for(source, path="fixture.py", rules=None):
+    return analyze_sources(
+        [(path, textwrap.dedent(source))], rules=rules
+    )
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+
+LOCKED_CLASS = """
+    import threading
+
+    class Dispatcher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._todo = []
+
+        def add(self, task):
+            with self._lock:
+                self._todo.append(task)
+
+        def drain(self):
+            self._todo.clear()   # BUG: no lock
+"""
+
+
+def test_lock_discipline_flags_unlocked_mutation():
+    findings = findings_for(LOCKED_CLASS)
+    assert any(
+        f.rule == "lock-discipline" and "_todo" in f.code
+        and f.symbol == "Dispatcher.drain"
+        for f in findings
+    ), findings
+
+
+def test_lock_discipline_quiet_on_clean_twin():
+    clean = LOCKED_CLASS.replace(
+        "            self._todo.clear()   # BUG: no lock",
+        "            with self._lock:\n"
+        "                self._todo.clear()",
+    )
+    assert not findings_for(clean)
+
+
+def test_lock_discipline_locked_suffix_is_caller_holds_lock():
+    source = LOCKED_CLASS.replace("def drain(self):", "def drain_locked(self):")
+    assert not findings_for(source)
+
+
+def test_lock_discipline_subscript_chain_counts_as_mutation():
+    findings = findings_for("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._slots = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._slots[k] = v
+
+            def put_racy(self, k, i, v):
+                self._slots[k][i] = v   # BUG
+    """)
+    assert any(
+        f.rule == "lock-discipline" and f.symbol == "Store.put_racy"
+        for f in findings
+    )
+
+
+def test_lock_discipline_same_named_methods_checked_independently():
+    # property getter/setter share a name: the racy getter must still
+    # be flagged (and the clean setter must not mask it)
+    findings = findings_for("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def push(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            @property
+            def items(self):
+                self._items.append(None)   # BUG: off-lock
+                return list(self._items)
+
+            @items.setter
+            def items(self, value):
+                with self._lock:
+                    self._items.clear()
+                    self._items.extend(value)
+    """)
+    flagged = [f for f in findings if f.rule == "lock-discipline"]
+    assert len(flagged) == 1 and flagged[0].symbol == "Box.items", findings
+
+
+def test_lock_discipline_nested_def_does_not_inherit_lock():
+    findings = findings_for("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def push(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def deferred(self, x):
+                with self._lock:
+                    def later():
+                        self._items.append(x)   # deferred: lock is gone
+                    return later
+    """)
+    assert any(
+        f.rule == "lock-discipline" and "deferred" in f.symbol
+        for f in findings
+    )
+
+
+# ---------------------------------------------------------------------------
+# jax-hot-path
+
+def test_hot_path_flags_decorated_jit():
+    findings = findings_for("""
+        import time
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            t = time.time()           # BUG: frozen at trace time
+            r = np.random.uniform()   # BUG: host RNG
+            return float(x) + t + r   # BUG: host sync
+    """)
+    codes = {f.code for f in findings if f.rule == "jax-hot-path"}
+    assert {"time.time", "np.random", "float()"} <= codes, findings
+
+
+def test_hot_path_flags_jitted_factory_product_cross_module():
+    factory = """
+        def make_step(cfg):
+            def step(x):
+                return x.item()    # BUG: device fence every step
+            return step
+    """
+    user = """
+        import jax
+        from elasticdl_tpu.fake.steps import make_step
+
+        train = jax.jit(make_step(None))
+    """
+    findings = analyze_sources([
+        ("elasticdl_tpu/fake/steps.py", textwrap.dedent(factory)),
+        ("elasticdl_tpu/fake/user.py", textwrap.dedent(user)),
+    ])
+    assert any(
+        f.rule == "jax-hot-path" and f.code == ".item()"
+        and f.path == "elasticdl_tpu/fake/steps.py"
+        for f in findings
+    ), findings
+
+
+def test_hot_path_annotation_marks_function_and_factory():
+    findings = findings_for("""
+        import numpy as np
+        from elasticdl_tpu.common.annotations import hot_path
+
+        @hot_path
+        def make_step():
+            def step(x):
+                return np.asarray(x)   # BUG
+            return step
+
+        @hot_path
+        def consensus(flags):
+            return float(flags)        # BUG
+    """)
+    assert {"np.asarray", "float()"} <= {
+        f.code for f in findings if f.rule == "jax-hot-path"
+    }
+
+
+def test_hot_path_quiet_on_host_code_and_clean_jit():
+    assert not findings_for("""
+        import time
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def host_loop(batches):
+            start = time.time()            # host code: fine
+            return np.asarray(batches[0])  # host code: fine
+
+        @jax.jit
+        def step(x):
+            return jnp.sum(x) + int(3)     # int() on static: fine
+    """)
+
+
+# ---------------------------------------------------------------------------
+# ft-swallowed-except
+
+def test_swallowed_except_flags_silent_broad_handler():
+    findings = findings_for("""
+        def poll(client):
+            try:
+                client.ping()
+            except Exception:
+                pass   # BUG: swallowed
+    """)
+    assert rules_of(findings) == {"ft-swallowed-except"}
+
+
+def test_swallowed_except_quiet_when_logged_raised_or_narrow():
+    assert not findings_for("""
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def a(client):
+            try:
+                client.ping()
+            except Exception:
+                logger.exception("ping failed")
+
+        def b(client):
+            try:
+                client.ping()
+            except Exception as e:
+                raise RuntimeError("ping") from e
+
+        def c(client):
+            try:
+                client.ping()
+            except ConnectionError:
+                pass   # narrow: a handled case, not a swallow
+    """)
+
+
+# ---------------------------------------------------------------------------
+# ft-grpc-timeout
+
+def test_grpc_timeout_flags_deadline_less_stub_call():
+    findings = findings_for("""
+        class Client:
+            def __init__(self, stub):
+                self._stub = stub
+
+            def get(self, request):
+                return self._stub.get_task(request)   # BUG: no deadline
+    """)
+    assert rules_of(findings) == {"ft-grpc-timeout"}
+
+
+def test_grpc_timeout_quiet_with_deadline_or_non_stub():
+    assert not findings_for("""
+        class Client:
+            def __init__(self, stub, helper):
+                self._stub = stub
+                self._helper = helper
+
+            def get(self, request):
+                return self._stub.get_task(request, timeout=60.0)
+
+            def local(self, request):
+                return self._helper.get_task(request)  # not a stub
+
+            def teardown(self):
+                self._stub.close()  # channel plumbing, not an RPC
+    """)
+
+
+# ---------------------------------------------------------------------------
+# xhost-determinism
+
+def test_determinism_flags_set_iteration_in_checkpoint_path():
+    findings = findings_for("""
+        def restore(data):
+            tables = {k.split("/")[1] for k in data}
+            out = []
+            for name in tables:        # BUG: hash order
+                out.append(name)
+            return out
+    """, path="fake_checkpoint.py")
+    assert any(
+        f.rule == "xhost-determinism" and f.code == "set-iteration"
+        for f in findings
+    )
+
+
+def test_determinism_flags_unsorted_listdir():
+    findings = findings_for("""
+        import os
+
+        def shards(d):
+            return [f for f in os.listdir(d)]   # BUG: fs order
+    """, path="fake_export.py")
+    assert any(f.code == "os.listdir" for f in findings)
+
+
+def test_determinism_quiet_when_sorted_or_out_of_scope():
+    clean = """
+        import os
+
+        def shards(d):
+            extra = {1, 2}
+            return sorted(os.listdir(d)) + [x for x in sorted(extra)]
+    """
+    assert not findings_for(clean, path="fake_checkpoint.py")
+    # same set iteration outside checkpoint/export scope: not this
+    # rule's business
+    racy = """
+        def f():
+            s = {1, 2}
+            return [x for x in s]
+    """
+    assert not findings_for(racy, path="ordinary_module.py")
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline mechanics
+
+def test_inline_suppression_silences_one_rule_on_one_line():
+    findings = findings_for("""
+        def poll(client):
+            try:
+                client.ping()
+            except Exception:  # edlint: disable=ft-swallowed-except
+                pass
+    """)
+    assert not findings
+
+
+def test_def_line_suppression_covers_the_whole_function():
+    findings = findings_for("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def push(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            # runs under the caller's lock via a path edlint can't see
+            def helper(self):  # edlint: disable=lock-discipline
+                self._items.clear()
+                self._items.append(None)
+    """)
+    assert not findings
+
+
+def test_baseline_filters_matching_findings_and_requires_justification(
+    tmp_path,
+):
+    findings = findings_for(LOCKED_CLASS, path="elasticdl_tpu/fake/d.py")
+    assert findings
+    entry = {
+        "rule": findings[0].rule,
+        "path": "elasticdl_tpu/fake/d.py",
+        "symbol": findings[0].symbol,
+        "code": findings[0].code,
+        "justification": "test entry",
+    }
+    baseline_file = tmp_path / "base.json"
+    baseline_file.write_text(json.dumps({"findings": [entry]}))
+    baseline = load_baseline(str(baseline_file))
+    new, matched, unused = split_baselined(findings, baseline)
+    assert not new and matched and not unused
+
+    entry.pop("justification")
+    baseline_file.write_text(json.dumps({"findings": [entry]}))
+    with pytest.raises(ValueError):
+        load_baseline(str(baseline_file))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "elasticdl_tpu.analysis"] + args,
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        timeout=120,
+    )
+
+
+_CLI_POSITIVE_FIXTURES = {
+    "lock-discipline": ("bad_locks.py", LOCKED_CLASS),
+    "jax-hot-path": ("bad_step.py", """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + time.time()
+    """),
+    "ft-swallowed-except": ("bad_except.py", """
+        def poll(client):
+            try:
+                client.ping()
+            except Exception:
+                pass
+    """),
+    "ft-grpc-timeout": ("bad_rpc.py", """
+        def call(stub, request):
+            return stub.get_task(request)
+    """),
+    "xhost-determinism": ("bad_checkpoint.py", """
+        def restore(names):
+            return [n for n in set(names)]
+    """),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_CLI_POSITIVE_FIXTURES))
+def test_cli_exits_nonzero_on_each_rules_positive_fixture(rule, tmp_path):
+    fname, source = _CLI_POSITIVE_FIXTURES[rule]
+    bad = tmp_path / fname
+    bad.write_text(textwrap.dedent(source))
+    result = _run_cli([str(bad), "--no-baseline"], cwd=str(tmp_path))
+    assert result.returncode == 1, result.stdout + result.stderr
+    assert rule in result.stdout
+
+
+def test_cli_exits_zero_on_clean_file(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("def f():\n    return 1\n")
+    result = _run_cli([str(good), "--no-baseline"], cwd=str(tmp_path))
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+# ---------------------------------------------------------------------------
+# the gate
+
+@pytest.mark.lint
+def test_package_has_zero_non_baselined_findings():
+    """Tier-1 gate: the whole package analyzes clean against the
+    checked-in baseline. A new finding means: fix it, suppress it with
+    a justification comment, or baseline it with a justification."""
+    findings, errors = analyze_paths(
+        [os.path.join(REPO_ROOT, "elasticdl_tpu")]
+    )
+    assert not errors, errors
+    baseline = load_baseline(BASELINE_PATH)
+    new, _matched, unused = split_baselined(findings, baseline)
+    assert not new, "new edlint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert not unused, (
+        "stale baseline entries (the finding no longer exists — remove "
+        "them):\n%s" % json.dumps(unused, indent=2)
+    )
