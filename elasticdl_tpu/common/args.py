@@ -112,6 +112,9 @@ def parse_master_args(argv=None):
     )
     parser.add_argument("--envs", default="")
     parser.add_argument("--tensorboard_log_dir", default="")
+    # observability: /metrics + /healthz + /readyz on this port
+    # (0/unset = disabled; falls back to EDL_METRICS_PORT)
+    parser.add_argument("--metrics_port", type=int, default=0)
     return parser.parse_args(argv)
 
 
@@ -175,6 +178,9 @@ def parse_worker_args(argv=None):
         type=int,
         default=int(os.environ.get("EDL_CONSENSUS_INTERVAL", "1")),
     )
+    # observability: /metrics + /healthz + /readyz on this port
+    # (0/unset = disabled; falls back to EDL_METRICS_PORT)
+    parser.add_argument("--metrics_port", type=int, default=0)
     return parser.parse_args(argv)
 
 

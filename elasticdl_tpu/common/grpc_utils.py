@@ -20,10 +20,22 @@ def build_channel(addr: str) -> grpc.Channel:
     return grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
 
 
-def build_server(max_workers: int = 64) -> grpc.Server:
+def build_server(max_workers: int = 64, instrument: bool = True) -> grpc.Server:
+    """gRPC server with the metrics interceptor installed when metrics
+    collection is enabled (observability/grpc_metrics.py); with the
+    knobs unset ``server_interceptors()`` is empty and the call path is
+    identical to an uninstrumented server."""
+    interceptors = ()
+    if instrument:
+        from elasticdl_tpu.observability.grpc_metrics import (
+            server_interceptors,
+        )
+
+        interceptors = server_interceptors()
     return grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
+        interceptors=interceptors,
     )
 
 

@@ -9,7 +9,13 @@ TPU additions the reference lacks (SURVEY.md §5 "tracing: minimal"):
 - `device_sync` blocks on the last JAX output so a phase that launched
   async device work is charged its real duration, not dispatch time
 - `trace()` wraps a region in jax.profiler for TensorBoard's trace
-  viewer when EDL_PROFILE_DIR is set.
+  viewer when EDL_PROFILE_DIR is set
+- a metrics bridge: every recorded phase also feeds the observability
+  registry (``edl_phase_seconds`` histogram + ``edl_step_time_seconds``
+  gauge for the step phase), so live dashboards see the SAME clock the
+  DEBUG dump uses — no second timing source. The bridge measures
+  whenever either EDL_TIMING or metrics collection is on, and costs
+  nothing when both are off.
 """
 
 import contextlib
@@ -17,10 +23,15 @@ import os
 import time
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import metrics as obs_metrics
 
 logger = _logger_factory("elasticdl_tpu.common.timing_utils")
 
 PROFILE_DIR_ENV = "EDL_PROFILE_DIR"
+
+# the phase whose duration is "the step" for the step-time gauge and
+# derived rates (examples/sec, MFU)
+STEP_PHASE = "batch_process"
 
 
 class Timing:
@@ -30,26 +41,47 @@ class Timing:
         self._enabled = enabled
         self._totals = {}
         self._counts = {}
+        # phase -> duration of the most recent record; consumers derive
+        # rates (worker examples/sec) without running a second clock
+        self.last_seconds = {}
+        self._metrics_on = obs_metrics.metrics_enabled()
+        if self._metrics_on:
+            self._phase_hist = obs_metrics.histogram(
+                "edl_phase_seconds",
+                "Wall-clock per training-loop phase (timing_utils bridge)",
+                ("phase",),
+            )
+            self._step_gauge = obs_metrics.gauge(
+                "edl_step_time_seconds",
+                "Duration of the most recent train step",
+            )
+        self._measure = self._enabled or self._metrics_on
 
     @property
     def enabled(self):
         return self._enabled
 
     def start(self):
-        return time.time() if self._enabled else 0.0
+        return time.time() if self._measure else 0.0
 
     def end_record(self, phase, start):
+        if not self._measure:
+            return
+        elapsed = time.time() - start
+        self.last_seconds[phase] = elapsed
+        if self._metrics_on:
+            self._phase_hist.labels(phase).observe(elapsed)
+            if phase == STEP_PHASE:
+                self._step_gauge.set(elapsed)
         if not self._enabled:
             return
-        self._totals[phase] = self._totals.get(phase, 0.0) + (
-            time.time() - start
-        )
+        self._totals[phase] = self._totals.get(phase, 0.0) + elapsed
         self._counts[phase] = self._counts.get(phase, 0) + 1
 
     def end_record_sync(self, phase, start, result=None):
         """Block on a JAX array (if given) before recording, so async
         dispatch doesn't make device phases look free."""
-        if not self._enabled:
+        if not self._measure:
             return
         if result is not None:
             try:
@@ -69,7 +101,7 @@ class Timing:
         try:
             yield
         finally:
-            if self._enabled and sync_result is not None:
+            if self._measure and sync_result is not None:
                 result = sync_result()
                 if result is not None:
                     try:

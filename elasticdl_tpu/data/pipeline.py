@@ -117,7 +117,14 @@ class Dataset:
                 if item is FLUSH:
                     if buf and not drop_remainder:
                         yield emit_partial(buf)
-                        buf = []
+                    # drop_remainder: the pending partial is CLEARED,
+                    # not retained — these records would be dropped at
+                    # end-of-stream anyway, and holding them past a
+                    # FLUSH recreates the worker/master mutual-wait the
+                    # sentinel exists to break (their task is never
+                    # reported consumed while the master WAIT-loops;
+                    # ADVICE round 5 #3)
+                    buf = []
                     continue
                 buf.append(item)
                 if len(buf) == batch_size:

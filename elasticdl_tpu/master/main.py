@@ -22,6 +22,12 @@ def main(argv=None):
     from elasticdl_tpu.common.log_utils import configure
 
     configure(args.log_level, args.log_file_path)
+    if args.metrics_port:
+        # publish the knob before any instrument is constructed: the
+        # registry decides enabled/no-op at first touch
+        from elasticdl_tpu.observability.http_server import PORT_ENV
+
+        os.environ[PORT_ENV] = str(args.metrics_port)
     records_per_task = args.records_per_task
     if args.num_minibatches_per_task > 0:
         # reference task sizing (master.py:152)
@@ -45,6 +51,7 @@ def main(argv=None):
         model_def=args.model_def,
         model_params=args.model_params,
         symbol_overrides=symbol_overrides_from_args(args),
+        metrics_port=args.metrics_port,
     )
     if args.job_name and os.environ.get("KUBERNETES_SERVICE_HOST"):
         # in-cluster: provision and heal worker/PS pods

@@ -20,6 +20,7 @@ from elasticdl_tpu.master.servicer import MasterServicer
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.master.task_monitor import TaskMonitor
 from elasticdl_tpu.models.registry import get_model_spec
+from elasticdl_tpu.observability import http_server, trace
 from elasticdl_tpu.proto.services import add_master_servicer_to_server
 
 logger = _logger_factory("elasticdl_tpu.master.master")
@@ -47,6 +48,7 @@ class Master:
         model_def="",
         model_params="",
         symbol_overrides=None,
+        metrics_port=0,
     ):
         self.spec = get_model_spec(
             model_zoo_module, model_def=model_def,
@@ -111,6 +113,79 @@ class Master:
         )
         self._port = port
         self._server = None
+        self._metrics_port = metrics_port
+        self._serving = False
+        self.observability = None
+        if metrics_port:
+            # programmatic construction (no CLI entry ran): publish the
+            # knob before the first instrument, or the process-global
+            # registry freezes disabled and /metrics serves empty
+            import os
+
+            os.environ.setdefault(http_server.PORT_ENV,
+                                  str(metrics_port))
+        self._register_domain_gauges()
+
+    def _register_domain_gauges(self):
+        """Master-side gauges: pending/doing/done task counts, per-stage
+        queue depth, and worker relaunches — callback-fed from the
+        dispatcher/servicer so a scrape always reads live state. All
+        no-op instruments when metrics collection is off."""
+        from elasticdl_tpu.observability import metrics as obs_metrics
+
+        dispatcher = self.task_dispatcher
+        # one dispatcher.stats() snapshot per scrape, not one per
+        # series: each stats() is an O(tasks) scan under the dispatcher
+        # lock the RPC handlers contend on, and a scrape reads 12
+        # series (a benign data race on the cache dict is fine — a
+        # scrape may read a snapshot up to 1 s old either way)
+        cache = {"at": 0.0, "stats": None}
+
+        def stats():
+            now = time.monotonic()
+            if cache["stats"] is None or now - cache["at"] > 1.0:
+                cache["stats"] = dispatcher.stats()
+                cache["at"] = now
+            return cache["stats"]
+
+        tasks = obs_metrics.gauge(
+            "edl_master_tasks",
+            "Task counts by lifecycle state and task type",
+            ("state", "type"),
+        )
+        for type_name in ("training", "evaluation", "prediction"):
+            for state in ("pending", "doing", "done"):
+                tasks.labels(state=state, type=type_name).set_function(
+                    lambda state=state, type_name=type_name: stats()[
+                        state
+                    ].get(type_name, 0)
+                )
+        depth = obs_metrics.gauge(
+            "edl_master_queue_depth",
+            "Tasks queued per dispatch stage (training includes the "
+            "train-end callback task)",
+            ("queue",),
+        )
+        for queue in ("training", "evaluation"):
+            depth.labels(queue=queue).set_function(
+                lambda queue=queue: stats()["queue_depth"][queue]
+            )
+        obs_metrics.gauge(
+            "edl_master_epochs_left", "Training epochs not yet created"
+        ).set_function(lambda: stats()["epochs_left"])
+        servicer = self.servicer
+        # no _total suffix: exposed as a gauge (callback-fed snapshot
+        # that resets with the master), and the counter-marking suffix
+        # would invite rate()/increase() misuse in PromQL
+        obs_metrics.gauge(
+            "edl_master_worker_relaunches",
+            "Worker relaunches observed (reset_worker beyond a "
+            "worker_id's first)",
+        ).set_function(servicer.worker_relaunch_count)
+        obs_metrics.gauge(
+            "edl_master_live_workers",
+            "Workers with a liveness entry (heartbeating recently)",
+        ).set_function(lambda: len(servicer.worker_liveness()))
 
     @staticmethod
     def _infer_job_type(training_data, validation_data, prediction_data):
@@ -138,6 +213,17 @@ class Master:
         add_master_servicer_to_server(self.servicer, self._server)
         self._server.add_insecure_port("[::]:%d" % self._port)
         self._server.start()
+        self._serving = True
+        trace.configure("master")
+        self.observability = http_server.maybe_start(
+            "master", cli_port=self._metrics_port
+        )
+        if self.observability is not None:
+            # readiness milestone: the gRPC servicer is started — a
+            # master pod that can't dispatch must not receive traffic
+            self.observability.add_readiness_check(
+                "servicer_started", lambda: self._serving
+            )
         if self.tensorboard_service is not None:
             self.tensorboard_service.start()
         self.task_monitor.start()
@@ -172,6 +258,11 @@ class Master:
             self.stop()
 
     def stop(self):
+        self._serving = False
+        if self.observability is not None:
+            self.observability.stop()
+            self.observability = None
+        trace.flush()
         self.task_monitor.stop()
         if self.evaluation_service is not None:
             self.evaluation_service.stop()

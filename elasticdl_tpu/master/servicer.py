@@ -12,6 +12,7 @@ import threading
 import time
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import trace
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = _logger_factory("elasticdl_tpu.master.servicer")
@@ -41,6 +42,28 @@ class MasterServicer:
         # worker_id -> host (from get_comm_info); lets the task monitor
         # evict a dead worker's host from the mesh rendezvous
         self._worker_hosts = {}
+        # worker_id -> reset_worker count: the logical relaunch epoch a
+        # worker stamps onto its gradient pushes as its incarnation.
+        # Master-assigned and monotonic per worker_id, so the sync PS
+        # can order a relaunch against its dead predecessor without
+        # trusting relaunch hosts' wall clocks (ADVICE round 5 #1).
+        self._worker_restarts = {}
+        # Epoch base re-anchors monotonicity across MASTER restarts:
+        # counts alone restart at 1 with a fresh master, and a PS that
+        # survived the restart window would order the relaunch BEHIND
+        # (or equal to) its dead predecessor's buffered epochs. The
+        # base is the single control plane's own clock at startup —
+        # base2 >= base1 + master uptime >> relaunch counts — so no
+        # WORKER-host clock trust is introduced. Residual window: a
+        # master rescheduled onto a node whose clock reads EARLIER
+        # than the dead master's start (NTP step-back / skewed node)
+        # can still issue lower epochs than already buffered; the sync
+        # PS surfaces that as a loud per-push warning plus the
+        # edl_ps_push_dropped_dead_incarnation_total counter, so it is
+        # an alertable condition rather than a silent hang. Closing it
+        # fully requires persisting the base, which the job-restart
+        # semantics here don't justify.
+        self._restart_epoch_base = int(time.time())
 
     # ------------------------------------------------------------------
     def _touch(self, worker_id):
@@ -91,8 +114,16 @@ class MasterServicer:
     def get_task(self, request, context=None):
         self._touch(request.worker_id)
         task_type = request.task_type if request.task_type else None
+        dispatch_start = time.time()
         task = self._task_dispatcher.get(request.worker_id, task_type)
         if task is not None:
+            # the master-side anchor of the cross-role task trace:
+            # merge_trace.py threads a flow from this span through the
+            # worker's train/push spans carrying the same task_id
+            trace.complete(
+                "dispatch", dispatch_start,
+                task_id=task.task_id, worker_id=request.worker_id,
+            )
             return task
         if (
             self._task_dispatcher.finished()
@@ -112,10 +143,27 @@ class MasterServicer:
         (the new process holds nothing by definition) — requeue it
         uncounted NOW instead of waiting out the task timeout. The
         liveness clock can't catch this: the successor reuses the
-        worker_id and heartbeats immediately."""
+        worker_id and heartbeats immediately.
+
+        Returns this worker_id's relaunch epoch (base + 1, base + 2,
+        ...): the worker's push incarnation for the sync PS's
+        round-buffer cleanup."""
         self._touch(request.worker_id)
+        with self._lock:
+            count = self._worker_restarts.get(request.worker_id, 0) + 1
+            self._worker_restarts[request.worker_id] = count
+            epoch = self._restart_epoch_base + count
         self._task_dispatcher.recover_tasks(request.worker_id)
-        return pb.Empty()
+        return pb.ResetWorkerResponse(restart_count=epoch)
+
+    def worker_relaunch_count(self):
+        """Relaunches observed across all workers (each reset_worker
+        beyond a worker_id's first is a relaunch) — the master's
+        ``edl_master_worker_relaunches_total`` gauge."""
+        with self._lock:
+            return sum(
+                max(0, n - 1) for n in self._worker_restarts.values()
+            )
 
     def report_task_result(self, request, context=None):
         self._touch(request.worker_id)
@@ -132,6 +180,10 @@ class MasterServicer:
         self._task_dispatcher.report(
             request.task_id, success, worker_id=request.worker_id,
             count_failure=count_failure,
+        )
+        trace.instant(
+            "task_reported", task_id=request.task_id,
+            worker_id=request.worker_id, success=success,
         )
         return pb.Empty()
 

@@ -82,6 +82,9 @@ class TaskDispatcher:
         self._job_failed = False
         # rolling task-duration samples for the timeout scanner
         self._task_durations = []
+        # task type -> successfully completed count (the "done" third
+        # of the master's pending/doing/done task gauges)
+        self._done_counts = {}
 
         if self._prediction_shards:
             self._todo.extend(
@@ -250,6 +253,9 @@ class TaskDispatcher:
                     self._task_durations.append(time.time() - start_time)
                     del self._task_durations[:-64]
                 del self._records[task_id]
+                self._done_counts[task.type] = (
+                    self._done_counts.get(task.type, 0) + 1
+                )
                 if not self._todo and not self._doing_training_locked():
                     if self._epochs_left > 0:
                         self._create_training_epoch_locked()
@@ -361,3 +367,37 @@ class TaskDispatcher:
         with self._lock:
             doing = self._doing.get(task_id)
             return doing[0] if doing else None
+
+    def stats(self):
+        """Queue-state snapshot for the master's task gauges:
+        {"pending": {type name: n}, "doing": {type name: n},
+        "done": {type name: n}, "queue_depth": {"training": n,
+        "evaluation": n}, "epochs_left": n}. Type names are lowercase
+        proto enum names ("training", "evaluation", ...)."""
+        with self._lock:
+            pending = {}
+            for task_id in self._todo + self._eval_todo:
+                name = pb.TaskType.Name(
+                    self._records[task_id].task.type
+                ).lower()
+                pending[name] = pending.get(name, 0) + 1
+            doing = {}
+            for task_id in self._doing:
+                name = pb.TaskType.Name(
+                    self._records[task_id].task.type
+                ).lower()
+                doing[name] = doing.get(name, 0) + 1
+            done = {
+                pb.TaskType.Name(t).lower(): n
+                for t, n in self._done_counts.items()
+            }
+            return {
+                "pending": pending,
+                "doing": doing,
+                "done": done,
+                "queue_depth": {
+                    "training": len(self._todo),
+                    "evaluation": len(self._eval_todo),
+                },
+                "epochs_left": self._epochs_left,
+            }

@@ -1,0 +1,24 @@
+"""Observability subsystem: metrics registry, health endpoints, RPC
+instrumentation, and the cross-role task trace.
+
+The reference framework's tracing story is "minimal" (SURVEY §5): a
+per-phase wall-clock accumulator dumped at DEBUG. A production elastic
+job needs to answer "why is the round not filling", "which worker is
+slow", and "is the PS saturated" while the job runs:
+
+- ``metrics``      — stdlib-only Counter/Gauge/Histogram + a
+                     process-global registry with Prometheus text
+                     exposition (no prometheus_client dependency).
+- ``http_server``  — /metrics, /healthz, /readyz daemon served from
+                     every role on ``--metrics_port``/``EDL_METRICS_PORT``
+                     (0 = disabled, the default).
+- ``grpc_metrics`` — server/client interceptors recording per-method
+                     request counters, error-code counters, and latency
+                     histograms for all Master and Pserver RPCs.
+- ``trace``        — lightweight span API buffering Chrome trace-event
+                     JSON per role under ``EDL_TRACE_DIR``; task_id is
+                     the correlation key and ``scripts/merge_trace.py``
+                     stitches the roles onto one Perfetto timeline.
+"""
+
+from elasticdl_tpu.observability import metrics  # noqa: F401

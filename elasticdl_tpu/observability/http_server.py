@@ -1,0 +1,151 @@
+"""Metrics exposition + liveness/readiness endpoints for every role.
+
+A stdlib ``http.server`` daemon thread serving:
+
+- ``GET /metrics`` — Prometheus text format 0.0.4 from the registry
+- ``GET /healthz`` — liveness: 200 while the process serves at all
+- ``GET /readyz``  — readiness: 200 only when every registered
+  role-specific check passes (master → servicer started; PS → model
+  initialized; worker → master channel ready), else 503 listing the
+  failing checks — the pod manager's signal to hold traffic, not
+  restart.
+
+Knobs: ``--metrics_port`` on each role's CLI, falling back to
+``EDL_METRICS_PORT``; 0 (the default) starts nothing, so tests/CI and
+benchmarks are unaffected unless they opt in.
+"""
+
+import http.server
+import os
+import threading
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import metrics as metrics_mod
+
+logger = _logger_factory("elasticdl_tpu.observability.http_server")
+
+PORT_ENV = metrics_mod.PORT_ENV
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def resolve_port(cli_port=None):
+    """Effective metrics port: CLI flag wins, then EDL_METRICS_PORT,
+    then 0 (disabled)."""
+    if cli_port:
+        return int(cli_port)
+    try:
+        return int(os.environ.get(PORT_ENV, "0") or "0")
+    except ValueError:
+        logger.warning("ignoring non-integer %s=%r", PORT_ENV,
+                       os.environ.get(PORT_ENV))
+        return 0
+
+
+class ObservabilityServer:
+    """Daemon-thread HTTP server for one role's /metrics + probes."""
+
+    def __init__(self, role, port, registry=None):
+        self.role = role
+        self.port = int(port)
+        self.registry = registry or metrics_mod.default_registry()
+        self._checks = []  # [(name, callable -> bool)]
+        self._httpd = None
+        self._thread = None
+        self.registry.gauge(
+            "edl_up", "1 while the role's process is serving", ("role",)
+        ).labels(role=role).set(1)
+
+    def add_readiness_check(self, name, check):
+        """``check()`` -> truthy when this aspect of the role is ready.
+        A check that raises counts as not ready."""
+        self._checks.append((name, check))
+
+    def readiness(self):
+        """(ready, [failing check names])."""
+        failing = []
+        for name, check in self._checks:
+            try:
+                ok = bool(check())
+            except Exception as e:
+                logger.warning("readiness check %s raised: %s", name, e)
+                ok = False
+            if not ok:
+                failing.append(name)
+        return not failing, failing
+
+    # ------------------------------------------------------------------
+    def start(self):
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = server.registry.render().encode("utf-8")
+                    self._reply(200, body, CONTENT_TYPE)
+                elif path == "/healthz":
+                    self._reply(200, b"ok\n")
+                elif path == "/readyz":
+                    ready, failing = server.readiness()
+                    if ready:
+                        self._reply(200, b"ready\n")
+                    else:
+                        self._reply(
+                            503,
+                            ("unready: %s\n" % ",".join(failing)).encode(),
+                        )
+                else:
+                    self._reply(404, b"not found\n")
+
+            def _reply(self, status, body, content_type="text/plain"):
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass  # probe traffic must not spam the job log
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("0.0.0.0", self.port), Handler
+        )
+        self._httpd.daemon_threads = True
+        # port may have been 0-adjacent (tests pass an ephemeral 0 via
+        # explicit Server construction); record what the OS gave us
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="edl-observability-%s" % self.role,
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info(
+            "%s observability on :%d (/metrics /healthz /readyz)",
+            self.role, self.port,
+        )
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def maybe_start(role, cli_port=None, registry=None):
+    """Start an ObservabilityServer when a port is configured; None
+    otherwise. The single call every role entry point makes."""
+    port = resolve_port(cli_port)
+    if port <= 0:
+        return None
+    try:
+        return ObservabilityServer(role, port, registry=registry).start()
+    except OSError as e:
+        # a busy port must not kill the job — telemetry is best-effort
+        logger.warning(
+            "could not start %s observability server on :%d: %s",
+            role, port, e,
+        )
+        return None

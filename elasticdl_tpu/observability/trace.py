@@ -1,0 +1,240 @@
+"""Cross-role task trace: Chrome trace-event JSON per role.
+
+``with span("train_batch", task_id=...)`` buffers a complete ("X")
+trace event; each role's buffer flushes to
+``$EDL_TRACE_DIR/<role>-<pid>.trace.json`` (atomic rename) on a size
+threshold, on ``flush()``, and at interpreter exit. Timestamps are
+wall-clock microseconds, so per-role files line up on one timeline when
+``scripts/merge_trace.py`` merges them; ``task_id`` is the correlation
+key that stitches dispatch (master) → pull/train/push (worker) → apply
+(PS) into one story, carried automatically by a thread-local context
+(``task_context``) so instrumentation deep in the PS client doesn't
+need task plumbing.
+
+Disabled (EDL_TRACE_DIR unset) the module is inert: ``span`` costs one
+module-global None check.
+"""
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.observability.trace")
+
+TRACE_DIR_ENV = "EDL_TRACE_DIR"
+
+_FLUSH_EVERY = 2048  # events buffered before an incremental flush
+
+_writer = None
+_writer_lock = threading.Lock()
+_tls = threading.local()
+
+
+class TraceWriter:
+    """Buffers events and APPENDS them to the role file on flush.
+
+    The file is the Chrome trace-event "JSON Array Format": a ``[``
+    followed by one event object per line, each with a trailing comma,
+    and — per the format spec — the closing ``]`` is optional, so the
+    file is Perfetto-loadable at any point, including after a crash
+    mid-run. Appending the delta (instead of rewriting the history)
+    keeps memory bounded and flush cost O(events since last flush) on
+    whatever hot-path thread crossed the buffer threshold; a
+    multi-million-step traced job would otherwise hold every event in
+    RAM and rewrite the whole file each flush."""
+
+    def __init__(self, role, trace_dir, pid=None):
+        self.role = role
+        self.dir = trace_dir
+        # pid override for tests that emulate several roles in one
+        # process (real roles are separate processes)
+        self.pid = os.getpid() if pid is None else pid
+        self.path = os.path.join(
+            trace_dir, "%s-%d.trace.json" % (role, self.pid)
+        )
+        self._lock = threading.Lock()
+        self._file_started = False
+        self._events = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self.pid,
+                "tid": 0,
+                "args": {"name": role},
+            }
+        ]
+
+    def add(self, event):
+        flush_now = False
+        with self._lock:
+            self._events.append(event)
+            flush_now = len(self._events) >= _FLUSH_EVERY
+        if flush_now:
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            events, self._events = self._events, []
+        if not events:
+            return
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            with self._lock:  # serialize appends across threads
+                with open(self.path, "a", encoding="utf-8") as f:
+                    if not self._file_started:
+                        f.write("[\n")
+                        self._file_started = True
+                    f.write(
+                        "".join(json.dumps(e) + ",\n" for e in events)
+                    )
+        except OSError as e:
+            logger.warning("trace flush to %s failed: %s", self.path, e)
+
+
+def configure(role):
+    """Install the per-process writer when EDL_TRACE_DIR is set; call
+    once from each role's entry point (extra calls re-bind the role).
+    Returns the writer or None when tracing is disabled."""
+    global _writer
+    trace_dir = os.environ.get(TRACE_DIR_ENV, "")
+    with _writer_lock:
+        if not trace_dir:
+            _writer = None
+            return None
+        _writer = TraceWriter(role, trace_dir)
+        return _writer
+
+
+def enabled():
+    return _writer is not None
+
+
+def flush():
+    writer = _writer
+    if writer is not None:
+        writer.flush()
+
+
+atexit.register(flush)
+
+
+# ---------------------------------------------------------------------------
+# span API
+
+def task_context(task_id):
+    """Thread-local task id merged into every span's args (the PS
+    client's pull/push spans inherit the worker loop's current task
+    without parameter plumbing). Use as a context manager."""
+    return _TaskContext(task_id)
+
+
+class _TaskContext:
+    __slots__ = ("task_id", "_previous")
+
+    def __init__(self, task_id):
+        self.task_id = task_id
+        self._previous = None
+
+    def __enter__(self):
+        self._previous = getattr(_tls, "task_id", None)
+        _tls.task_id = self.task_id
+        return self
+
+    def __exit__(self, *exc):
+        _tls.task_id = self._previous
+        return False
+
+
+def current_task_id():
+    return getattr(_tls, "task_id", None)
+
+
+@contextlib.contextmanager
+def span(name, **args):
+    """Time a block as a complete ("X") trace event."""
+    writer = _writer
+    if writer is None:
+        yield
+        return
+    start = time.time()
+    try:
+        yield
+    finally:
+        _emit(writer, name, start, time.time(), args)
+
+
+def complete(name, start, **args):
+    """Emit a complete event for a block timed by the caller (``start``
+    from ``time.time()``); for sites where the span name/args are only
+    known at the end — e.g. the dispatcher learns the task_id when the
+    pop returns."""
+    writer = _writer
+    if writer is None:
+        return
+    _emit(writer, name, start, time.time(), args)
+
+
+def instant(name, **args):
+    """A zero-duration marker event."""
+    writer = _writer
+    if writer is None:
+        return
+    task_id = args.pop("task_id", current_task_id())
+    if task_id is not None:
+        args["task_id"] = task_id
+    writer.add(
+        {
+            "name": name,
+            "ph": "i",
+            "s": "p",
+            "ts": time.time() * 1e6,
+            "pid": writer.pid,
+            "tid": threading.get_ident() & 0xFFFFFF,
+            "args": args,
+        }
+    )
+
+
+def _emit(writer, name, start, end, args):
+    task_id = args.pop("task_id", None)
+    if task_id is None:
+        task_id = current_task_id()
+    if task_id is not None:
+        args["task_id"] = task_id
+    writer.add(
+        {
+            "name": name,
+            "ph": "X",
+            "ts": start * 1e6,
+            "dur": max(0.0, (end - start) * 1e6),
+            "pid": writer.pid,
+            "tid": threading.get_ident() & 0xFFFFFF,
+            "args": args,
+        }
+    )
+
+
+def traced_handler(handler, service, method):
+    """Wrap a gRPC handler so each invocation is a span (used by the
+    server metrics interceptor; separate so tracing works with metrics
+    disabled and vice versa)."""
+
+    name = "%s/%s" % (service, method)
+
+    def wrapped(request, context):
+        writer = _writer
+        if writer is None:
+            return handler(request, context)
+        start = time.time()
+        try:
+            return handler(request, context)
+        finally:
+            _emit(writer, name, start, time.time(),
+                  {"kind": "grpc_server"})
+
+    return wrapped

@@ -25,8 +25,11 @@ _MASTER_METHODS = {
     # fresh-incarnation declaration: requeue everything still assigned
     # to this worker_id (a relaunched worker reuses its id, so stale
     # assignments from a fatally-aborted predecessor would otherwise
-    # look live until the slow task timeout)
-    "reset_worker": (pb.GetTaskRequest, pb.Empty),
+    # look live until the slow task timeout). Returns the
+    # master-assigned relaunch epoch the worker uses as its push
+    # incarnation (logical, monotonic per worker_id — wall clocks on
+    # relaunch hosts are not trusted to order incarnations).
+    "reset_worker": (pb.GetTaskRequest, pb.ResetWorkerResponse),
 }
 
 _PSERVER_METHODS = {

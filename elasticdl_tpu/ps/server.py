@@ -7,6 +7,7 @@ master goes away (the reference polls the master pod's K8s status every
 """
 
 import argparse
+import os
 import sys
 import time
 
@@ -15,6 +16,7 @@ import grpc
 from elasticdl_tpu.common.args import add_bool_argument
 from elasticdl_tpu.common.grpc_utils import build_server
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import http_server, trace
 from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
 from elasticdl_tpu.ps.embedding_store import create_store
 from elasticdl_tpu.ps.servicer import PserverServicer
@@ -53,6 +55,9 @@ def parse_ps_args(argv=None):
     # controlled-latency experiment behind docs/PERF_SPARSE.md — a
     # localhost PS otherwise measures at ~0 RTT)
     parser.add_argument("--inject_rpc_delay_ms", type=float, default=0.0)
+    # observability: /metrics + /healthz + /readyz on this port
+    # (0/unset = disabled; falls back to EDL_METRICS_PORT)
+    parser.add_argument("--metrics_port", type=int, default=0)
     return parser.parse_args(argv)
 
 
@@ -80,6 +85,13 @@ class _DelayedServicer:
 class ParameterServer:
     def __init__(self, args):
         self.args = args
+        if getattr(args, "metrics_port", 0):
+            # programmatic construction (no CLI entry ran): publish the
+            # knob before the servicer builds its instruments, or the
+            # process-global registry freezes disabled
+            os.environ.setdefault(
+                http_server.PORT_ENV, str(args.metrics_port)
+            )
         self.store = create_store(
             seed=args.seed + args.ps_id,
             prefer_native=bool(args.use_native_store),
@@ -141,6 +153,17 @@ class ParameterServer:
         add_pserver_servicer_to_server(servicer, self.server)
         self.server.add_insecure_port("[::]:%d" % self.args.port)
         self.server.start()
+        role = "ps-%d" % self.args.ps_id
+        trace.configure(role)
+        self.observability = http_server.maybe_start(
+            role, cli_port=getattr(self.args, "metrics_port", 0)
+        )
+        if self.observability is not None:
+            # readiness milestone: cold-start dense params arrived or an
+            # embedding table exists — before either, pulls serve nothing
+            self.observability.add_readiness_check(
+                "model_initialized", self.servicer.model_initialized
+            )
         logger.info(
             "PS %d/%d serving on :%d",
             self.args.ps_id,
@@ -170,10 +193,24 @@ class ParameterServer:
 
 
 def main(argv=None):
+    import signal
+
     from elasticdl_tpu.common.platform import apply_platform_overrides
 
     apply_platform_overrides()
     args = parse_ps_args(argv)
+    if args.metrics_port:
+        # publish the knob before any instrument is constructed: the
+        # registry decides enabled/no-op at first touch
+        os.environ[http_server.PORT_ENV] = str(args.metrics_port)
+
+    def _graceful_exit(signum, frame):
+        # the pod manager stops PS pods with SIGTERM, which skips
+        # atexit — flush the trace buffer before going down
+        trace.flush()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _graceful_exit)
     return ParameterServer(args).prepare().run()
 
 

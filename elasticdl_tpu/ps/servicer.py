@@ -17,6 +17,7 @@ Reference parity: elasticdl/python/ps/servicer.py and go/pkg/ps/server.go
 """
 
 import threading
+import time
 
 import numpy as np
 
@@ -27,6 +28,8 @@ from elasticdl_tpu.common.tensor_utils import (
     deserialize_indexed_slices,
     ndarray_to_blob,
 )
+from elasticdl_tpu.observability import metrics as obs_metrics
+from elasticdl_tpu.observability import trace
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = _logger_factory("elasticdl_tpu.ps.servicer")
@@ -84,6 +87,46 @@ class PserverServicer:
         # round applies only when its OWN tag's group fills — see
         # _push_gradients_sync
         self._round_groups = {}
+        # PS-side domain metrics (ISSUE 2): push/pull rates, the
+        # round-buffer fill the "why is the round not filling" question
+        # reads first, and version lag between store and pushers. All
+        # no-op instruments when metrics collection is off.
+        self._m_pull_requests = obs_metrics.counter(
+            "edl_ps_pull_requests_total",
+            "pull_embedding_vectors RPCs served", ("table",),
+        )
+        self._m_pull_rows = obs_metrics.counter(
+            "edl_ps_pulled_rows_total",
+            "Embedding rows served to workers", ("table",),
+        )
+        self._m_push_requests = obs_metrics.counter(
+            "edl_ps_push_requests_total", "push_gradients RPCs received"
+        )
+        self._m_push_rejected = obs_metrics.counter(
+            "edl_ps_push_rejected_total",
+            "Pushes rejected as stale (sync mode version check)",
+        )
+        self._m_push_dropped_dead = obs_metrics.counter(
+            "edl_ps_push_dropped_dead_incarnation_total",
+            "Pushes dropped as a dead incarnation's delayed delivery "
+            "(a sustained nonzero rate on a live worker means its "
+            "incarnation ordering is wrong — alert on it)",
+        )
+        self._m_version_lag = obs_metrics.gauge(
+            "edl_ps_version_lag",
+            "store version minus the last push's gradient version",
+        )
+        obs_metrics.gauge(
+            "edl_ps_round_buffer_fill",
+            "Buffered pushes awaiting a sync round (counting + scoped)",
+        ).set_function(self._buffered_count)
+        obs_metrics.gauge(
+            "edl_ps_store_version", "Embedding store version"
+        ).set_function(lambda: self._store.version)
+        self._m_table_rows = obs_metrics.gauge(
+            "edl_ps_embedding_rows",
+            "Materialized rows per embedding table", ("table",),
+        )
 
     # ------------------------------------------------------------------
     def push_model(self, request, context=None):
@@ -124,6 +167,24 @@ class PserverServicer:
             self._store.create_table(
                 info.name, info.dim, init_scale=param, initializer=kind
             )
+            self._m_table_rows.labels(table=info.name).set_function(
+                lambda name=info.name: self._store.table_size(name)
+            )
+
+    def model_initialized(self):
+        """This PS's /readyz milestone: cold-start dense parameters
+        arrived, or at least one embedding table exists to serve —
+        before either, a pull would hand out garbage."""
+        with self._lock:
+            if self._dense_initialized:
+                return True
+        return bool(self._store.table_names())
+
+    def _buffered_count(self):
+        # racy read for a gauge: list lengths are snapshots, no lock
+        return len(self._round_buffer) + sum(
+            len(group) for group in self._round_groups.values()
+        )
 
     # ------------------------------------------------------------------
     def pull_dense_parameters(self, request, context=None):
@@ -139,10 +200,16 @@ class PserverServicer:
     def pull_embedding_vectors(self, request, context=None):
         ids = np.asarray(request.ids, dtype=np.int64)
         values = self._store.lookup(request.name, ids)
+        self._m_pull_requests.labels(table=request.name).inc()
+        self._m_pull_rows.labels(table=request.name).inc(int(ids.size))
         return ndarray_to_blob(values)
 
     # ------------------------------------------------------------------
     def push_gradients(self, request, context=None):
+        self._m_push_requests.inc()
+        self._m_version_lag.set(
+            self._store.version - request.gradients.version
+        )
         if not self._use_async:
             return self._push_gradients_sync(request)
         grad_version = request.gradients.version
@@ -152,9 +219,12 @@ class PserverServicer:
             lr_scale = 1.0 / max(1, diff) if diff > 0 else 1.0
         if request.lr_scale > 0:
             lr_scale *= request.lr_scale
+        apply_start = time.time() if trace.enabled() else 0.0
         for name, slices in request.gradients.embedding_tables.items():
             values, ids = deserialize_indexed_slices(slices)
             self._store.push_gradients(name, ids, values, lr_scale=lr_scale)
+        trace.complete("ps_apply_push", apply_start,
+                       version=grad_version)
         self._store.bump_version()
         version = self._store.version
         self._maybe_checkpoint(version)
@@ -185,6 +255,7 @@ class PserverServicer:
         with self._push_lock:
             version = self._store.version
             if grad_version < version - self._sync_tolerance:
+                self._m_push_rejected.inc()
                 return pb.PushGradientsResponse(
                     accepted=False, version=version
                 )
@@ -226,10 +297,15 @@ class PserverServicer:
                     e[0][1] is not None and e[0][1] > incarnation
                     for e in same_worker
                 ):
+                    self._m_push_dropped_dead.inc()
                     logger.warning(
                         "sync PS: dropping a delayed push from worker "
-                        "%d's dead incarnation (a newer incarnation "
-                        "already holds this round)", request.worker_id,
+                        "%d's dead incarnation %d (a newer incarnation "
+                        "already holds this round). If this worker is "
+                        "LIVE, its epoch source is mis-ordered (e.g. a "
+                        "master restarted onto a stepped-back clock) — "
+                        "restart the job",
+                        request.worker_id, incarnation,
                     )
                     return pb.PushGradientsResponse(
                         accepted=True, version=version
@@ -283,19 +359,46 @@ class PserverServicer:
             yield from group
 
     def _remove_buffered_locked(self, entry):
-        if entry in self._round_buffer:
-            self._round_buffer.remove(entry)
+        # Removal is by IDENTITY, never list equality: entries are
+        # (key, {name: numpy arrays}, scale) tuples, and `in`/`remove`
+        # would == -compare a key-equal NEIGHBOR on the way to the
+        # target (e.g. a straggler's same-incarnation double push),
+        # tripping numpy's "truth value of an array is ambiguous"
+        # inside the push RPC handler (ADVICE round 5 #2).
+        kept = [e for e in self._round_buffer if e is not entry]
+        if len(kept) != len(self._round_buffer):
+            self._round_buffer[:] = kept
             return
         for tag, group in list(self._round_groups.items()):
-            if entry in group:
-                group.remove(entry)
-                if not group:
+            kept = [e for e in group if e is not entry]
+            if len(kept) != len(group):
+                if kept:
+                    group[:] = kept
+                else:
                     del self._round_groups[tag]
                 return
 
     def _apply_round_locked(self, entries):
         """Merge and apply one completed round's buffered pushes.
         Caller holds the push lock and bumps the store version."""
+        with trace.span(
+            "ps_apply_round", version=self._store.version,
+            pushes=len(entries),
+        ):
+            self._merge_apply_locked(entries)
+        # GC scoped groups that can never fill: their tag is already
+        # older than anything the stale check would admit (the check
+        # rejects tags < version - tolerance, and version only grows)
+        floor = self._store.version - self._sync_tolerance
+        for tag in [t for t in self._round_groups if t < floor]:
+            logger.warning(
+                "sync PS: dropping %d unfillable buffered push(es) at "
+                "stale round tag %d",
+                len(self._round_groups[tag]), tag,
+            )
+            del self._round_groups[tag]
+
+    def _merge_apply_locked(self, entries):
         scales = [s for _, _, s in entries]
         apply_scale = sum(scales) / len(scales)
         merged = {}  # name -> ([values...], [ids...])
@@ -322,17 +425,6 @@ class PserverServicer:
             self._store.push_gradients(
                 name, ids, values, lr_scale=apply_scale
             )
-        # GC scoped groups that can never fill: their tag is already
-        # older than anything the stale check would admit (the check
-        # rejects tags < version - tolerance, and version only grows)
-        floor = self._store.version - self._sync_tolerance
-        for tag in [t for t in self._round_groups if t < floor]:
-            logger.warning(
-                "sync PS: dropping %d unfillable buffered push(es) at "
-                "stale round tag %d",
-                len(self._round_groups[tag]), tag,
-            )
-            del self._round_groups[tag]
 
     def _maybe_checkpoint(self, version):
         if (

@@ -80,6 +80,20 @@ class LocalExecutor:
                 seed=seed,
             )
         self.state = None
+        # observability: opt-in via EDL_METRICS_PORT, same knob as the
+        # distributed roles — the "try it on my laptop" path is also
+        # the CI smoke that asserts /metrics serves the core series
+        from elasticdl_tpu.common.timing_utils import Timing
+        from elasticdl_tpu.observability import http_server, trace
+
+        self._timing = Timing()
+        trace.configure("local")
+        self.observability = http_server.maybe_start("local")
+        if self.observability is not None:
+            # a local run is ready as soon as the trainer exists
+            self.observability.add_readiness_check(
+                "trainer_constructed", lambda: self.trainer is not None
+            )
 
     # ------------------------------------------------------------------
     def _records(self, reader):
@@ -106,8 +120,10 @@ class LocalExecutor:
         losses = []
         for epoch in range(self._num_epochs):
             for batch in self._batches(self._train_reader, "training"):
+                t0 = self._timing.start()
                 self.state, loss = self.trainer.train_step(self.state, batch)
                 losses.append(float(loss))
+                self._timing.end_record("batch_process", t0)
             logger.info(
                 "Epoch %d done; last-batch loss %.4f", epoch, losses[-1]
             )

@@ -34,13 +34,32 @@ def main(argv=None):
 
     args = parse_worker_args(argv)
     configure_logging(args.log_level, args.log_file_path)
+    from elasticdl_tpu.observability import http_server, trace
+
+    if args.metrics_port:
+        # publish the knob before any instrument (or instrumented
+        # channel) is constructed: the registry decides enabled/no-op
+        # at first touch
+        os.environ[http_server.PORT_ENV] = str(args.metrics_port)
+    trace.configure("worker-%d" % args.worker_id)
     master_client = MasterClient(
         args.master_addr,
         worker_id=args.worker_id,
         worker_host=args.worker_host or None,
     )
+    observability = http_server.maybe_start(
+        "worker-%d" % args.worker_id, cli_port=args.metrics_port
+    )
+    if observability is not None:
+        # readiness milestone: the master channel has carried a
+        # successful RPC (reset_worker below, then the heartbeat)
+        observability.add_readiness_check(
+            "master_channel_ready", master_client.channel_ok
+        )
     # fresh incarnation: flush any task a fatally-aborted predecessor
-    # with this worker_id still holds (it can't have requeued them)
+    # with this worker_id still holds (it can't have requeued them).
+    # The response carries this worker_id's master-assigned relaunch
+    # epoch — the push incarnation the sync PS orders relaunches by.
     master_client.reset_worker()
     multihost_runtime = None
     if args.multihost:
@@ -155,6 +174,7 @@ def main(argv=None):
         logger.warning("Restarting for new mesh epoch: %s", e)
         import logging
 
+        trace.flush()  # os._exit skips atexit; don't lose the buffer
         logging.shutdown()
         os._exit(EPOCH_RESTART_EXIT_CODE)
     return 0

@@ -12,6 +12,7 @@ import grpc
 from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.common.grpc_utils import build_channel
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability.grpc_metrics import instrument_channel
 from elasticdl_tpu.common.tensor_utils import ndarray_to_blob
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.services import MasterStub
@@ -21,7 +22,7 @@ logger = _logger_factory("elasticdl_tpu.worker.master_client")
 
 class MasterClient:
     def __init__(self, master_addr, worker_id, worker_host=None):
-        self._channel = build_channel(master_addr)
+        self._channel = instrument_channel(build_channel(master_addr))
         self._stub = MasterStub(self._channel)
         self._worker_id = worker_id
         # worker_host="" is an explicit opt-out of mesh membership (used
@@ -30,10 +31,28 @@ class MasterClient:
         self._worker_host = (
             socket.gethostname() if worker_host is None else worker_host
         )
+        # master-assigned relaunch epoch (reset_worker response); the
+        # worker's push incarnation. None until reset_worker succeeds.
+        self._incarnation = None
+        # readiness signal for /readyz: True once any RPC round-tripped
+        self._channel_ok = False
 
     @property
     def worker_id(self):
         return self._worker_id
+
+    @property
+    def incarnation(self):
+        """Master-assigned relaunch epoch, or None if reset_worker
+        hasn't succeeded (standalone/test use)."""
+        return self._incarnation
+
+    def channel_ok(self):
+        """The worker's /readyz check: has the master channel carried a
+        successful RPC recently? Updated by reset_worker and the
+        heartbeat's get_comm_info, so a dead master flips the worker
+        unready within a heartbeat interval."""
+        return self._channel_ok
 
     # get_task deadline misses tolerated before concluding job-over: an
     # empty Task makes the worker EXIT, so a single slow call (master
@@ -110,25 +129,36 @@ class MasterClient:
     def reset_worker(self):
         """Declare this process a fresh incarnation of worker_id: the
         master requeues (uncounted) any task a dead predecessor still
-        holds. Call once at startup (servicer.reset_worker)."""
+        holds. Call once at startup (servicer.reset_worker).
+
+        Returns the master-assigned relaunch epoch (also remembered on
+        ``self.incarnation``), or None when the RPC failed — the PS
+        client then falls back to its legacy wall-clock incarnation."""
         try:
-            self._stub.reset_worker(
+            response = self._stub.reset_worker(
                 pb.GetTaskRequest(worker_id=self._worker_id),
                 timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS,
             )
         except grpc.RpcError:
             logger.warning("reset_worker failed")
+            return None
+        self._channel_ok = True
+        self._incarnation = response.restart_count
+        return self._incarnation
 
     def get_comm_info(self):
         try:
-            return self._stub.get_comm_info(
+            info = self._stub.get_comm_info(
                 pb.GetCommInfoRequest(
                     worker_id=self._worker_id, worker_host=self._worker_host
                 ),
                 timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS,
             )
         except grpc.RpcError:
+            self._channel_ok = False
             return pb.CommInfo(rank=-1, world_size=0, mesh_epoch=-1)
+        self._channel_ok = True
+        return info
 
     def close(self):
         self._channel.close()

@@ -18,6 +18,8 @@ import numpy as np
 from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.common.grpc_utils import build_channel
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import trace
+from elasticdl_tpu.observability.grpc_metrics import instrument_channel
 from elasticdl_tpu.common.tensor_utils import (
     blob_to_ndarray,
     deduplicate_indexed_slices,
@@ -69,22 +71,47 @@ class PushResult(NamedTuple):
 
 
 class PSClient:
-    def __init__(self, ps_addrs, worker_id=None):
+    def __init__(self, ps_addrs, worker_id=None, incarnation=None):
         if isinstance(ps_addrs, str):
             ps_addrs = [a for a in ps_addrs.split(",") if a]
-        self._stubs = [PserverStub(build_channel(a)) for a in ps_addrs]
+        self._stubs = [
+            PserverStub(instrument_channel(build_channel(a)))
+            for a in ps_addrs
+        ]
         # identity stamped onto pushes so the sync PS can clean its
         # round buffer per worker (orphaned-half-round recovery after a
         # mid-round kill, ps/servicer.py); None = anonymous. The
         # incarnation distinguishes a relaunched worker (whose dead
         # predecessor's buffered half-round must be dropped) from a
-        # live straggler-round double push (which must be counted).
-        # MONOTONIC (process construction time, ns) so the PS can order
-        # incarnations — a delayed in-flight push from a dead
-        # predecessor must never evict the relaunch's live entry — and
-        # seed-proof (time_ns is immune to user random.seed calls).
+        # live straggler-round double push (which must be counted), and
+        # the PS orders incarnations numerically, so it must be
+        # MONOTONIC per worker_id across relaunches. The correct source
+        # is the master-assigned relaunch epoch (MasterClient
+        # .reset_worker -> restart_count): logical, so a relaunch onto
+        # a clock-skewed host can never look OLDER than its dead
+        # predecessor (wall-clock incarnations made the sync PS drop
+        # every push from such a relaunch forever, ADVICE round 5 #1).
         self._worker_id = worker_id
-        self._incarnation = time.time_ns()
+        if incarnation is not None:
+            self._incarnation = int(incarnation)
+        else:
+            # No master-assigned epoch (standalone construction, or
+            # reset_worker failed): push WITHOUT an incarnation, which
+            # the PS treats as replace-by-worker_id — strictly weaker
+            # (a straggler's double push is replaced, not counted) but
+            # never ORDERS incarnations, so it cannot be mistaken for
+            # a dead predecessor. A fabricated wall-clock incarnation
+            # here would mix with small master epochs and the numeric
+            # comparison would silently drop a live relaunch's pushes
+            # forever (ADVICE round 5 #1's failure mode).
+            self._incarnation = None
+            if worker_id is not None:
+                logger.warning(
+                    "PSClient for worker %s has no master-assigned "
+                    "relaunch epoch; pushing without an incarnation "
+                    "(sync PS degrades to replace-by-worker_id round "
+                    "cleanup)", worker_id,
+                )
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(4, len(self._stubs))
         )
@@ -141,6 +168,10 @@ class PSClient:
     # ------------------------------------------------------------------
     def pull_embedding_vectors(self, name, ids):
         """ids: int64 array; returns rows aligned with input order."""
+        with trace.span("ps_pull", table=name):
+            return self._pull_embedding_vectors(name, ids)
+
+    def _pull_embedding_vectors(self, name, ids):
         ids = np.asarray(ids, dtype=np.int64)
         if ids.size == 0:
             return np.empty((0, 0), dtype=np.float32)
@@ -203,6 +234,14 @@ class PSClient:
         id-mod slice; otherwise that shard's apply cadence drifts
         behind its peers' (ps/servicer.py sync mode).
         """
+        with trace.span("ps_push", version=model_version):
+            return self._push_gradients(
+                grads_by_table, model_version, lr_scale, only_shards,
+                force_empty, round_scoped,
+            )
+
+    def _push_gradients(self, grads_by_table, model_version, lr_scale,
+                        only_shards, force_empty, round_scoped):
         shard_filter = (
             None if only_shards is None else set(int(s) for s in only_shards)
         )
@@ -212,7 +251,8 @@ class PSClient:
             request.lr_scale = lr_scale
             if self._worker_id is not None:
                 request.worker_id = self._worker_id
-                request.incarnation = self._incarnation
+                if self._incarnation is not None:
+                    request.incarnation = self._incarnation
             if round_scoped:
                 # lockstep tags are exact global round counters — the
                 # sync PS pairs these pushes by tag, not arrival order

@@ -157,6 +157,16 @@ class TaskDataService:
         with self._lock:
             return bool(self._pending_tasks)
 
+    def current_task_id(self):
+        """task_id of the oldest pending task — the one the records on
+        the training stream are currently drawn from; the correlation
+        key the trace spans carry (observability/trace.py). None
+        between tasks (e.g. lockstep zero-batch rounds)."""
+        with self._lock:
+            if self._pending_tasks:
+                return self._pending_tasks[0][0].task_id
+            return None
+
     # ------------------------------------------------------------------
     def task_record_stream(self, task):
         """Records of a single (eval/predict) task."""

@@ -11,6 +11,7 @@ callback task, and reporting model versions so the master can trigger
 evaluations.
 """
 
+import os
 import threading
 import time
 
@@ -18,6 +19,8 @@ import numpy as np
 
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import metrics as obs_metrics
+from elasticdl_tpu.observability import trace
 from elasticdl_tpu.data.pipeline import (
     Dataset,
     batch_real_count,
@@ -177,7 +180,11 @@ class Worker:
                 batch_size=minibatch_size
             )
             trainer_kwargs["ps_client"] = PSClient(
-                ps_addrs, worker_id=self._mc.worker_id
+                ps_addrs, worker_id=self._mc.worker_id,
+                # master-assigned relaunch epoch (reset_worker in
+                # worker/main.py) so a relaunch on a clock-skewed host
+                # still orders after its dead predecessor at the sync PS
+                incarnation=getattr(self._mc, "incarnation", None),
             )
             if sparse_cache_staleness > 0:
                 trainer_kwargs["cache_staleness"] = sparse_cache_staleness
@@ -303,6 +310,31 @@ class Worker:
         from elasticdl_tpu.common.timing_utils import Timing
 
         self._timing = Timing()
+        # domain gauges fed off the Timing clock (no second timer):
+        # examples/sec from the step phase + real batch count; MFU when
+        # the trainer knows its per-step FLOPs and the operator told us
+        # the hardware peak. No-op instruments when metrics are off.
+        self._m_examples_per_sec = obs_metrics.gauge(
+            "edl_worker_examples_per_second",
+            "Real (unpadded) examples trained per second, last step",
+        )
+        self._m_mfu = obs_metrics.gauge(
+            "edl_worker_mfu_ratio",
+            "Model FLOPs utilization: trainer step_flops / "
+            "(step_time * EDL_PEAK_FLOPS_PER_SEC)",
+        )
+        self._m_version = obs_metrics.gauge(
+            "edl_worker_model_version", "This worker's model version"
+        )
+        self._step_flops = float(
+            getattr(self.trainer, "step_flops", 0) or 0
+        )
+        try:
+            self._peak_flops = float(
+                os.environ.get("EDL_PEAK_FLOPS_PER_SEC", "0") or 0
+            )
+        except ValueError:
+            self._peak_flops = 0.0
         for cb in self._callbacks:
             cb.set_worker(self)
         # Heartbeat keeps master-side liveness fresh while the worker is
@@ -371,6 +403,26 @@ class Worker:
             state = self.trainer.checkpoint_state(state)
         self._checkpoint_mgr.save(self._version, state)
 
+    def _traced_train_step(self, batch):
+        """One train step, timed (Timing bridge feeds the step-time
+        gauge) and — when EDL_TRACE_DIR is set — wrapped in a
+        task_id-carrying span so the PS client's pull/push spans nested
+        inside it inherit the correlation key."""
+        t0 = self._timing.start()
+        if not trace.enabled():
+            self.state, loss = self.trainer.train_step(self.state, batch)
+            self._timing.end_record_sync("batch_process", t0, loss)
+            return loss
+        with trace.task_context(self.tds.current_task_id()):
+            with trace.span("train_batch", version=self._version):
+                self.state, loss = self.trainer.train_step(
+                    self.state, batch
+                )
+                # sync inside the span: async dispatch would otherwise
+                # record device-bound steps as near-zero slices
+                self._timing.end_record_sync("batch_process", t0, loss)
+        return loss
+
     def _after_train_batch(self, batch, loss):
         """Per-batch bookkeeping shared by every loop shape: version,
         checkpoint, record accounting, liveness, callbacks."""
@@ -380,8 +432,17 @@ class Worker:
             and self._version % self._checkpoint_steps == 0
         ):
             self._save_checkpoint()
+        real = batch_real_count(batch)
         with self._timing.timeit("report_record"):
-            self.tds.report_record_done(batch_real_count(batch))
+            self.tds.report_record_done(real)
+        step_secs = self._timing.last_seconds.get("batch_process")
+        if step_secs:
+            self._m_examples_per_sec.set(real / step_secs)
+            if self._step_flops and self._peak_flops:
+                self._m_mfu.set(
+                    self._step_flops / (step_secs * self._peak_flops)
+                )
+        self._m_version.set(self._version)
         if (
             self._report_version_steps
             and self._version % self._report_version_steps == 0
@@ -432,9 +493,7 @@ class Worker:
         for batch in batches:
             if not self._restore_attempted:
                 self._restore_from_checkpoint(batch)
-            t0 = self._timing.start()
-            self.state, loss = self.trainer.train_step(self.state, batch)
-            self._timing.end_record_sync("batch_process", t0, loss)
+            loss = self._traced_train_step(batch)
             self._after_train_batch(batch, loss)
             if self.stop_training:
                 break
@@ -562,9 +621,7 @@ class Worker:
             round_in_window = (round_in_window + 1) % window
             if not self._restore_attempted:
                 self._restore_from_checkpoint(batch)
-            t0 = self._timing.start()
-            self.state, loss = self.trainer.train_step(self.state, batch)
-            self._timing.end_record_sync("batch_process", t0, loss)
+            loss = self._traced_train_step(batch)
             if stopping:
                 # zero-batch participation rounds while peers finish:
                 # no version/checkpoint/record bookkeeping

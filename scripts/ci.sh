@@ -31,6 +31,41 @@ else
   python -m pytest tests/ -x -q -m "not slow"
 fi
 
+echo "== tier 1d: observability smoke (/metrics over a local run) =="
+# a local executor run with EDL_METRICS_PORT set must serve the core
+# series in Prometheus text format (docs/OBSERVABILITY.md catalog)
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import sys, tempfile, urllib.request
+sys.path.insert(0, "tests")
+from test_utils import create_mnist_recordio
+from elasticdl_tpu.common.grpc_utils import find_free_port
+import os
+port = find_free_port()
+os.environ["EDL_METRICS_PORT"] = str(port)
+from elasticdl_tpu.train.local_executor import LocalExecutor
+with tempfile.TemporaryDirectory() as tmp:
+    create_mnist_recordio(tmp + "/f0.rec", num_records=64, seed=0)
+    executor = LocalExecutor(
+        "elasticdl_tpu.models.mnist", training_data=tmp,
+        minibatch_size=32, num_epochs=1,
+    )
+    executor.train()
+    url = "http://localhost:%d/metrics" % executor.observability.port
+    body = urllib.request.urlopen(url, timeout=5).read().decode()
+    for series in (
+        'edl_up{role="local"} 1',
+        "edl_step_time_seconds",
+        'edl_phase_seconds_count{phase="batch_process"} 2',
+    ):
+        assert series in body, "missing series: %s" % series
+    ready = urllib.request.urlopen(
+        "http://localhost:%d/readyz" % executor.observability.port,
+        timeout=5,
+    )
+    assert ready.status == 200
+print("observability smoke OK")
+PYEOF
+
 echo "== tier 2a: multi-chip SPMD dryrun (dp/fsdp, tp/sp, ep, pp, pp x tp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Merge per-role EDL trace files into one Perfetto-loadable timeline.
+
+Each role (master / worker-N / ps-N) buffers Chrome trace events to
+``$EDL_TRACE_DIR/<role>-<pid>.trace.json``
+(elasticdl_tpu/observability/trace.py). Timestamps are already
+wall-clock microseconds, so merging is concatenation — plus flow
+events threaded through every span that carries the same ``task_id``,
+which is what makes a single task's dispatch (master) → pull/train/push
+(worker) → apply (PS) hop visibly connected when the merged file is
+opened in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+
+Usage:
+    python scripts/merge_trace.py TRACE_DIR [-o merged.trace.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _parse_events(text):
+    """Events from either trace shape: the object form
+    {"traceEvents": [...]} (e.g. a re-merged file) or the JSON Array
+    Format the role writers append — "[" + one event per line with
+    trailing commas, closing "]" optional per the trace-event spec (a
+    torn final line from a crashed process is skipped)."""
+    try:
+        data = json.loads(text)
+    except ValueError:
+        data = None
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    if isinstance(data, list):
+        return data
+    events = []
+    body = text.lstrip()
+    if body.startswith("["):
+        body = body[1:]
+    for line in body.splitlines():
+        line = line.strip().rstrip(",")
+        if not line or line == "]":
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue  # torn tail write from a crashed role
+    return events
+
+
+def load_role_files(trace_dir):
+    """[(filename, [events])] for every *.trace.json in the dir."""
+    names = sorted(
+        n for n in os.listdir(trace_dir)
+        if n.endswith(".trace.json") and not n.startswith("merged")
+    )
+    loaded = []
+    for name in names:
+        path = os.path.join(trace_dir, name)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                events = _parse_events(f.read())
+        except OSError as e:
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+            continue
+        loaded.append((name, events))
+    return loaded
+
+
+def task_flow_events(events):
+    """Flow (s/t/f) events connecting same-task_id spans across
+    processes, in timestamp order. Perfetto draws these as arrows from
+    the master's dispatch span through the worker's train/push spans."""
+    by_task = {}
+    for event in events:
+        if event.get("ph") != "X":
+            continue
+        task_id = (event.get("args") or {}).get("task_id")
+        if task_id in (None, ""):
+            continue
+        by_task.setdefault(task_id, []).append(event)
+    flows = []
+    for task_id, spans in sorted(by_task.items(), key=lambda kv: str(kv[0])):
+        if len(spans) < 2:
+            continue
+        spans.sort(key=lambda e: e["ts"])
+        for i, event in enumerate(spans):
+            phase = "s" if i == 0 else ("f" if i == len(spans) - 1 else "t")
+            flow = {
+                "name": "task",
+                "cat": "task",
+                "ph": phase,
+                "id": str(task_id),
+                "ts": event["ts"],
+                "pid": event["pid"],
+                "tid": event["tid"],
+            }
+            if phase == "f":
+                flow["bp"] = "e"  # bind to the enclosing slice
+            flows.append(flow)
+    return flows
+
+
+def merge(trace_dir):
+    role_files = load_role_files(trace_dir)
+    if not role_files:
+        raise SystemExit("no *.trace.json files in %s" % trace_dir)
+    events = []
+    for _name, role_events in role_files:
+        events.extend(role_events)
+    events.extend(task_flow_events(events))
+    # stable display: metadata first, then time order
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    return {"traceEvents": events}, [name for name, _ in role_files]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("trace_dir", help="EDL_TRACE_DIR of the run")
+    parser.add_argument(
+        "-o", "--output", default="",
+        help="output path (default: TRACE_DIR/merged.trace.json)",
+    )
+    args = parser.parse_args(argv)
+    merged, names = merge(args.trace_dir)
+    out = args.output or os.path.join(args.trace_dir, "merged.trace.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+    print(
+        "merged %d role file(s) (%s) -> %s [%d events]"
+        % (len(names), ", ".join(names), out, len(merged["traceEvents"]))
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -277,3 +277,26 @@ def test_flush_sentinel_forces_partial_batches_through():
     assert seen == [0, 2, 6, 8, 10, 12, 14, 16, 18, 20]
     # padded rows replicate the last real example
     assert batches[-1]["_mask"].tolist() == [1.0, 1.0, 0.0, 0.0]
+
+
+def test_flush_clears_pending_buffer_under_drop_remainder():
+    """ADVICE round 5 #3 regression: batch(drop_remainder=True) must
+    CLEAR its pending partial buffer on FLUSH, not retain it — retained
+    records are never reported consumed, recreating the worker/master
+    mutual-wait the sentinel exists to break. The records were going to
+    be dropped at end-of-stream anyway; the flush must not let them
+    leak into the next segment's first batch either."""
+    from elasticdl_tpu.data.pipeline import FLUSH, Dataset, batch_real_count
+
+    def source():
+        yield from range(5)  # one full batch of 4 + a partial of 1
+        yield FLUSH
+        yield from range(10, 14)  # exactly one full batch
+        yield FLUSH
+
+    batches = list(Dataset(source).batch(4, drop_remainder=True))
+    reals = [batch_real_count(b) for b in batches]
+    assert reals == [4, 4], reals
+    # record 4 was dropped at the flush boundary: the second segment's
+    # batch holds only its own records (no leak across the boundary)
+    assert batches[1]["features"].tolist() == [10, 11, 12, 13]

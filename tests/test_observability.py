@@ -1,0 +1,415 @@
+"""Observability subsystem (ISSUE 2): registry semantics, Prometheus
+exposition, health/readiness endpoints, RPC interceptors on a live
+in-process master<->worker channel, and the trace-merge round trip."""
+
+import json
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.observability import metrics as obs_metrics
+from elasticdl_tpu.observability import trace
+from elasticdl_tpu.observability.http_server import ObservabilityServer
+from elasticdl_tpu.observability.metrics import Registry
+
+
+def _get(url):
+    try:
+        response = urllib.request.urlopen(url, timeout=5)
+        return response.status, response.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+
+
+def test_counter_labels_accumulate_independently():
+    reg = Registry(enabled=True)
+    c = reg.counter("reqs_total", "requests", ("method", "code"))
+    c.labels(method="get_task", code="OK").inc()
+    c.labels(method="get_task", code="OK").inc(2)
+    c.labels(method="get_task", code="UNAVAILABLE").inc()
+    assert c.get("get_task", "OK") == 3
+    assert c.get("get_task", "UNAVAILABLE") == 1
+    with pytest.raises(ValueError):
+        c.labels(method="only-one-label")
+
+
+def test_counter_rejects_decrement():
+    reg = Registry(enabled=True)
+    c = reg.counter("ups_total", "u")
+    with pytest.raises((TypeError, ValueError)):
+        c.dec()
+
+
+def test_gauge_set_function_reads_live_state():
+    reg = Registry(enabled=True)
+    state = {"depth": 0}
+    g = reg.gauge("queue_depth", "d")
+    g.set_function(lambda: state["depth"])
+    state["depth"] = 7
+    assert "queue_depth 7" in reg.render()
+
+
+def test_histogram_buckets_are_cumulative():
+    reg = Registry(enabled=True)
+    h = reg.histogram("lat", "latency", ("m",), buckets=(0.1, 1.0, 10.0))
+    child = h.labels(m="push")
+    for value in (0.05, 0.5, 0.5, 5.0, 50.0):
+        child.observe(value)
+    text = reg.render()
+    assert 'lat_bucket{m="push",le="0.1"} 1' in text
+    assert 'lat_bucket{m="push",le="1"} 3' in text
+    assert 'lat_bucket{m="push",le="10"} 4' in text
+    assert 'lat_bucket{m="push",le="+Inf"} 5' in text
+    assert 'lat_count{m="push"} 5' in text
+    assert h.get_count("push") == 5
+
+
+def test_registry_get_or_create_is_idempotent():
+    reg = Registry(enabled=True)
+    a = reg.counter("same", "x", ("l",))
+    b = reg.counter("same", "x", ("l",))
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.counter("same", "x", ("other",))
+
+
+def test_disabled_registry_is_noop():
+    reg = Registry(enabled=False)
+    c = reg.counter("nope_total", "n", ("l",))
+    c.labels(l="x").inc()
+    c.inc(5)
+    g = reg.gauge("g", "g")
+    g.set(3)
+    h = reg.histogram("h", "h")
+    h.observe(1.0)
+    assert c is obs_metrics.NOOP and g is obs_metrics.NOOP
+    assert reg.render() == ""
+
+
+def test_metrics_disabled_without_knobs(monkeypatch):
+    monkeypatch.delenv("EDL_METRICS", raising=False)
+    monkeypatch.delenv("EDL_METRICS_PORT", raising=False)
+    assert not obs_metrics.metrics_enabled()
+    monkeypatch.setenv("EDL_METRICS_PORT", "9090")
+    assert obs_metrics.metrics_enabled()
+    monkeypatch.setenv("EDL_METRICS", "0")  # explicit off wins
+    assert not obs_metrics.metrics_enabled()
+
+
+def test_exposition_format_golden():
+    reg = Registry(enabled=True)
+    c = reg.counter("edl_reqs_total", "Requests served", ("code",))
+    c.labels(code="OK").inc(2)
+    g = reg.gauge("edl_depth", "Queue depth")
+    g.set(3)
+    h = reg.histogram("edl_lat_seconds", "Latency", buckets=(0.5,))
+    h.observe(0.25)
+    assert reg.render() == (
+        "# HELP edl_depth Queue depth\n"
+        "# TYPE edl_depth gauge\n"
+        "edl_depth 3\n"
+        "# HELP edl_lat_seconds Latency\n"
+        "# TYPE edl_lat_seconds histogram\n"
+        'edl_lat_seconds_bucket{le="0.5"} 1\n'
+        'edl_lat_seconds_bucket{le="+Inf"} 1\n'
+        "edl_lat_seconds_sum 0.25\n"
+        "edl_lat_seconds_count 1\n"
+        "# HELP edl_reqs_total Requests served\n"
+        "# TYPE edl_reqs_total counter\n"
+        'edl_reqs_total{code="OK"} 2\n'
+    )
+
+
+def test_render_survives_failing_and_nonfinite_callback_gauges():
+    """A broken callback gauge must not take /metrics down: its value
+    renders as NaN (and explicit non-finite sets render, not raise)."""
+    reg = Registry(enabled=True)
+    reg.gauge("broken", "b").set_function(lambda: 1 / 0)
+    reg.gauge("neg_inf", "n").set(float("-inf"))
+    text = reg.render()
+    assert "broken NaN" in text
+    assert "neg_inf -Inf" in text
+
+
+def test_label_values_are_escaped():
+    reg = Registry(enabled=True)
+    c = reg.counter("esc_total", "e", ("path",))
+    c.labels(path='a"b\\c\nd').inc()
+    assert 'esc_total{path="a\\"b\\\\c\\nd"} 1' in reg.render()
+
+
+# ---------------------------------------------------------------------------
+# health endpoints
+
+
+def test_healthz_readyz_role_transitions():
+    reg = Registry(enabled=True)
+    server = ObservabilityServer("ps-0", 0, registry=reg).start()
+    try:
+        ready = {"model": False}
+        server.add_readiness_check("model_initialized",
+                                   lambda: ready["model"])
+        base = "http://localhost:%d" % server.port
+        assert _get(base + "/healthz")[0] == 200
+        status, body = _get(base + "/readyz")
+        assert status == 503 and "model_initialized" in body
+        ready["model"] = True  # the role milestone flips
+        assert _get(base + "/readyz")[0] == 200
+        status, body = _get(base + "/metrics")
+        assert status == 200
+        assert 'edl_up{role="ps-0"} 1' in body
+        assert _get(base + "/nope")[0] == 404
+    finally:
+        server.stop()
+
+
+def test_raising_readiness_check_is_unready():
+    reg = Registry(enabled=True)
+    server = ObservabilityServer("w", 0, registry=reg)
+    server.add_readiness_check("boom", lambda: 1 / 0)
+    ok, failing = server.readiness()
+    assert not ok and failing == ["boom"]
+
+
+# ---------------------------------------------------------------------------
+# RPC interceptors on a live in-process master<->worker channel
+
+
+@pytest.fixture
+def live_metrics(monkeypatch):
+    """Flip the process-global registry to enabled for the duration of
+    the test, restoring the disabled default afterwards."""
+    from elasticdl_tpu.observability import grpc_metrics
+
+    monkeypatch.setenv("EDL_METRICS", "1")
+    obs_metrics.reset_default_registry()
+    monkeypatch.setattr(grpc_metrics, "_client_cache", (None, None))
+    yield obs_metrics.default_registry()
+    obs_metrics.reset_default_registry()
+
+
+def test_interceptors_count_live_master_rpcs(live_metrics):
+    from elasticdl_tpu.common.grpc_utils import build_server, find_free_port
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto.services import add_master_servicer_to_server
+    from elasticdl_tpu.worker.master_client import MasterClient
+
+    dispatcher = TaskDispatcher({"s": (0, 64)}, records_per_task=32)
+    server = build_server()
+    add_master_servicer_to_server(MasterServicer(dispatcher), server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    try:
+        mc = MasterClient("localhost:%d" % port, worker_id=0)
+        assert mc.reset_worker() == mc.incarnation > 0
+        task = mc.get_task()
+        assert task.task_id != 0
+        mc.report_task_result(task.task_id)
+
+        text = live_metrics.render()
+        for series in (
+            'edl_grpc_server_handled_total{service="Master",'
+            'method="get_task",code="OK"} 1',
+            'edl_grpc_client_handled_total{service="Master",'
+            'method="get_task",code="OK"} 1',
+            'edl_grpc_server_latency_seconds_count{service="Master",'
+            'method="get_task"} 1',
+            'edl_grpc_client_latency_seconds_count{service="Master",'
+            'method="get_task"} 1',
+        ):
+            assert series in text, series
+        # every Master AND Pserver method's latency histogram is
+        # pre-registered (zero-count series are part of the contract)
+        from elasticdl_tpu.proto import services
+
+        for method in list(services._MASTER_METHODS) + list(
+            services._PSERVER_METHODS
+        ):
+            assert (
+                'edl_grpc_client_latency_seconds_count' in text
+                and 'method="%s"' % method in text
+            ), method
+    finally:
+        server.stop(0)
+
+
+def test_client_interceptor_counts_deadline_exceeded(live_metrics):
+    """DEADLINE_EXCEEDED is a visible counter, not just a log line:
+    point a client at a port nobody answers quickly enough."""
+    import grpc
+
+    from elasticdl_tpu.observability.grpc_metrics import (
+        instrument_channel,
+    )
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.proto.services import MasterStub
+
+    channel = instrument_channel(
+        grpc.insecure_channel("localhost:1")  # nothing listens
+    )
+    stub = MasterStub(channel)
+    with pytest.raises(grpc.RpcError):
+        stub.get_task(pb.GetTaskRequest(worker_id=0), timeout=0.2)
+    counter = live_metrics.get("edl_grpc_client_handled_total")
+    assert (
+        counter.get("Master", "get_task", "UNAVAILABLE")
+        + counter.get("Master", "get_task", "DEADLINE_EXCEEDED")
+    ) >= 1
+
+
+def test_uninstrumented_channel_when_disabled(monkeypatch):
+    import grpc
+
+    from elasticdl_tpu.observability.grpc_metrics import (
+        instrument_channel, server_interceptors,
+    )
+
+    monkeypatch.delenv("EDL_METRICS", raising=False)
+    monkeypatch.delenv("EDL_METRICS_PORT", raising=False)
+    channel = grpc.insecure_channel("localhost:1")
+    assert instrument_channel(channel) is channel
+    assert server_interceptors() == ()
+
+
+# ---------------------------------------------------------------------------
+# cross-role trace + merge round trip
+
+
+def test_trace_merge_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv(trace.TRACE_DIR_ENV, str(tmp_path))
+    # emulate the three roles of a run in one process (real roles are
+    # separate processes; distinct pids keep their tracks apart)
+    master = trace.TraceWriter("master", str(tmp_path), pid=1001)
+    worker = trace.TraceWriter("worker-0", str(tmp_path), pid=1002)
+
+    monkeypatch.setattr(trace, "_writer", master)
+    trace.complete("dispatch", __import__("time").time() - 0.01,
+                   task_id=7, worker_id=0)
+    master.flush()
+
+    monkeypatch.setattr(trace, "_writer", worker)
+    with trace.task_context(7):
+        with trace.span("train_batch", version=1):
+            with trace.span("ps_push", version=1):
+                pass
+    worker.flush()
+    monkeypatch.setattr(trace, "_writer", None)
+
+    sys.path.insert(0, "scripts")
+    try:
+        import merge_trace
+    finally:
+        sys.path.pop(0)
+    merged, names = merge_trace.merge(str(tmp_path))
+    assert len(names) == 2
+    events = merged["traceEvents"]
+    # Perfetto-loadable: valid JSON with the traceEvents array shape
+    json.loads(json.dumps(merged))
+    spans = [e for e in events if e.get("ph") == "X"]
+    task7 = [e for e in spans if e["args"].get("task_id") == 7]
+    assert {e["name"] for e in task7} == {
+        "dispatch", "train_batch", "ps_push"
+    }
+    # dispatch (master pid) and train/push (worker pid) line up on one
+    # timeline, correlated by task_id through flow events
+    assert {e["pid"] for e in task7} == {1001, 1002}
+    flows = [e for e in events if e.get("ph") in ("s", "t", "f")]
+    assert [f["ph"] for f in flows] == ["s", "t", "f"]
+    assert all(f["id"] == "7" for f in flows)
+    # the span thread-local context propagated into the nested ps_push
+    push = next(e for e in spans if e["name"] == "ps_push")
+    assert push["args"]["task_id"] == 7
+
+
+def test_span_is_inert_without_trace_dir(monkeypatch):
+    monkeypatch.setattr(trace, "_writer", None)
+    with trace.span("nothing", task_id=1):
+        pass
+    trace.instant("nope")
+    trace.complete("nope", 0.0)
+    assert not trace.enabled()
+
+
+# ---------------------------------------------------------------------------
+# role wiring: PS readiness milestone + master dispatcher gauges
+
+
+def _ps_servicer():
+    from elasticdl_tpu.ps.embedding_store import create_store
+    from elasticdl_tpu.ps.servicer import PserverServicer
+
+    store = create_store(seed=0, prefer_native=False)
+    store.set_optimizer("sgd", lr=1.0)
+    return PserverServicer(store, use_async=True)
+
+
+def test_ps_model_initialized_transitions():
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    servicer = _ps_servicer()
+    assert not servicer.model_initialized()
+    infos = pb.Model()
+    infos.embedding_table_infos.add(name="emb", dim=4, initializer="0.05")
+    servicer.push_embedding_table_infos(infos)
+    assert servicer.model_initialized()
+
+
+def test_ps_dense_init_also_flips_ready():
+    from elasticdl_tpu.common.tensor_utils import ndarray_to_blob
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    servicer = _ps_servicer()
+    assert not servicer.model_initialized()
+    request = pb.Model(version=0)
+    ndarray_to_blob(np.ones((2, 2), np.float32),
+                    request.dense_parameters["w"])
+    servicer.push_model(request)
+    assert servicer.model_initialized()
+
+
+def test_dispatcher_stats_track_lifecycle():
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    dispatcher = TaskDispatcher({"s": (0, 64)}, records_per_task=32)
+    stats = dispatcher.stats()
+    assert stats["pending"] == {"training": 2}
+    assert stats["queue_depth"] == {"training": 2, "evaluation": 0}
+
+    task = dispatcher.get(worker_id=0)
+    stats = dispatcher.stats()
+    assert stats["pending"] == {"training": 1}
+    assert stats["doing"] == {"training": 1}
+
+    dispatcher.report(task.task_id, success=True, worker_id=0)
+    stats = dispatcher.stats()
+    assert stats["done"] == {"training": 1}
+    assert stats["doing"] == {}
+
+
+def test_timing_bridge_feeds_phase_metrics(monkeypatch):
+    monkeypatch.setenv("EDL_METRICS", "1")
+    monkeypatch.delenv("EDL_TIMING", raising=False)
+    obs_metrics.reset_default_registry()
+    try:
+        from elasticdl_tpu.common.timing_utils import Timing
+
+        timing = Timing()
+        assert not timing.enabled  # EDL_TIMING logging stays off
+        t0 = timing.start()
+        timing.end_record("batch_process", t0)
+        assert timing.last_seconds["batch_process"] >= 0
+        text = obs_metrics.default_registry().render()
+        assert (
+            'edl_phase_seconds_count{phase="batch_process"} 1' in text
+        )
+        assert "edl_step_time_seconds" in text
+    finally:
+        obs_metrics.reset_default_registry()

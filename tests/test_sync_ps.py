@@ -732,3 +732,148 @@ def test_round_scoped_transport_resend_is_idempotent():
         before - np.array([[1.0, 1.0]]),  # counted ONCE
         rtol=1e-6,
     )
+
+
+def test_remove_buffered_is_identity_based_past_key_equal_neighbor():
+    """ADVICE round 5 #2 regression: removing a buffered entry whose
+    key-equal NEIGHBOR (straggler double push: same worker, same
+    incarnation) precedes it in the scan would ==-compare the
+    neighbor's {name: numpy arrays} dict and raise "truth value of an
+    array is ambiguous" inside the push RPC handler. Removal must be
+    by identity — for the buffer AND for round-scoped groups."""
+    servicer, _ = _servicer(grads_to_wait=8)
+    entry_a = ((0, 5), {"t": (np.ones((1, 2), np.float32),
+                              np.array([2], np.int64))}, 1.0)
+    entry_b = ((0, 5), {"t": (np.full((1, 2), 2.0, np.float32),
+                              np.array([2], np.int64))}, 1.0)
+    servicer._round_buffer[:] = [entry_a, entry_b]
+    # old code: `entry_b in self._round_buffer` compares entry_a ==
+    # entry_b on the way and raises ValueError
+    servicer._remove_buffered_locked(entry_b)
+    assert servicer._round_buffer == [entry_a]
+
+    group_a = ((1, 3), {"t": (np.ones((1, 2), np.float32),
+                              np.array([4], np.int64))}, 1.0)
+    group_b = ((1, 3), {"t": (np.zeros((1, 2), np.float32),
+                              np.array([4], np.int64))}, 1.0)
+    servicer._round_groups[0] = [group_a, group_b]
+    servicer._remove_buffered_locked(group_b)
+    assert servicer._round_groups[0] == [group_a]
+    servicer._remove_buffered_locked(group_a)
+    assert 0 not in servicer._round_groups  # emptied group is GC'd
+    servicer._round_buffer[:] = []
+
+
+def test_relaunch_eviction_with_straggler_neighbor_applies_cleanly():
+    """End-to-end flavor of the same hazard: a worker with TWO
+    same-incarnation buffered pushes dies and relaunches; eviction
+    drops both orphans and the round completes from live pushes."""
+    servicer, store = _servicer(grads_to_wait=4)
+    before = store.lookup("t", np.array([2], np.int64)).copy()
+
+    # two buffered entries with the SAME (worker_id, incarnation) key
+    for values in ([[1.0, 0.0]], [[2.0, 0.0]]):
+        r = servicer.push_gradients(
+            _worker_push("t", values, [2], 0, worker_id=0, incarnation=5)
+        )
+        assert r.accepted and r.version == 0
+
+    # relaunch (incarnation 6): evicts BOTH predecessors — the removal
+    # scan crosses entry A while removing entry B (the old code raised
+    # ValueError here, inside the push handler)
+    r = servicer.push_gradients(
+        _worker_push("t", [[0.5, 0.0]], [2], 0, worker_id=0,
+                     incarnation=6)
+    )
+    assert r.accepted and r.version == 0
+
+    # the round completes from live pushes only: relaunch + 3 peers
+    for worker_id in (1, 2, 3):
+        r = servicer.push_gradients(
+            _worker_push("t", [[0.0, 0.5]], [2], 0, worker_id=worker_id)
+        )
+    assert r.version == 1
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([2], np.int64)),
+        before - np.array([[0.5, 1.5]]),  # orphans NOT applied
+        rtol=1e-6,
+    )
+
+
+def test_master_assigned_incarnation_survives_clock_skew(monkeypatch):
+    """ADVICE round 5 #1 regression: a relaunched worker must order
+    AFTER its dead predecessor even when its host's wall clock is
+    behind. The incarnation is the master's relaunch epoch for the
+    worker_id (reset_worker response), never the worker host's
+    time.time_ns()."""
+    import time as time_mod
+
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.worker.ps_client import PSClient
+
+    master = MasterServicer(TaskDispatcher({}, records_per_task=1))
+    first = master.reset_worker(pb.GetTaskRequest(worker_id=3))
+    relaunch = master.reset_worker(pb.GetTaskRequest(worker_id=3))
+    assert relaunch.restart_count == first.restart_count + 1
+    assert master.worker_relaunch_count() == 1
+    # independent per worker_id
+    assert master.reset_worker(
+        pb.GetTaskRequest(worker_id=4)
+    ).restart_count == first.restart_count
+
+    # a master restart re-anchors the epoch base ABOVE everything the
+    # previous master issued (counts alone would restart at 1 and
+    # order a relaunch behind its dead predecessor at a surviving PS)
+    restarted = MasterServicer(TaskDispatcher({}, records_per_task=1))
+    restarted._restart_epoch_base = master._restart_epoch_base + 60
+    fresh = restarted.reset_worker(pb.GetTaskRequest(worker_id=3))
+    assert fresh.restart_count > relaunch.restart_count
+
+    # the PS client adopts the master epoch verbatim — a relaunch on a
+    # host whose clock reads EARLIER than the predecessor's still gets
+    # the larger incarnation
+    monkeypatch.setattr(time_mod, "time_ns", lambda: 10_000)
+    predecessor = PSClient([], worker_id=3,
+                           incarnation=first.restart_count)
+    monkeypatch.setattr(time_mod, "time_ns", lambda: 5_000)  # skewed back
+    successor = PSClient([], worker_id=3,
+                         incarnation=relaunch.restart_count)
+    assert successor._incarnation > predecessor._incarnation
+
+    # without a master epoch the client pushes with NO incarnation
+    # (PS replace-by-worker_id semantics) — a fabricated wall-clock
+    # value would mix with small master epochs and order a live
+    # relaunch behind a dead predecessor
+    legacy = PSClient([], worker_id=3)
+    assert legacy._incarnation is None
+
+
+def test_sync_ps_drops_predecessor_after_backwards_clock_relaunch():
+    """End-to-end shape of the ADVICE #1 hang: predecessor buffered at
+    master epoch 1, relaunch pushes at master epoch 2 — the relaunch's
+    pushes are LIVE (the old wall-clock scheme dropped them forever
+    when the new host's clock was behind)."""
+    servicer, store = _servicer(grads_to_wait=2)
+    before = store.lookup("t", np.array([9], np.int64)).copy()
+
+    r = servicer.push_gradients(  # predecessor's half-round, then it dies
+        _worker_push("t", [[9.0, 9.0]], [9], 0, worker_id=0,
+                     incarnation=1)
+    )
+    assert r.accepted and r.version == 0
+    r = servicer.push_gradients(  # relaunch, master epoch 2
+        _worker_push("t", [[1.0, 0.0]], [9], 0, worker_id=0,
+                     incarnation=2)
+    )
+    assert r.accepted  # NOT classified as a delayed dead-incarnation push
+    r = servicer.push_gradients(
+        _worker_push("t", [[0.0, 1.0]], [9], 0, worker_id=1)
+    )
+    assert r.accepted and r.version == 1
+    np.testing.assert_allclose(
+        store.lookup("t", np.array([9], np.int64)),
+        before - np.array([[1.0, 1.0]]),  # relaunch's push applied
+        rtol=1e-6,
+    )
