@@ -1,6 +1,6 @@
 """edlint: framework-aware static analysis for elasticdl_tpu.
 
-Four rule packs, each encoding a failure class this codebase has paid
+Five rule packs, each encoding a failure class this codebase has paid
 for (or refuses to pay for):
 
 - ``lock-discipline``     — attributes mutated under a class's
@@ -9,6 +9,10 @@ for (or refuses to pay for):
 - ``jax-hot-path``        — no silent host-device syncs
   (``device_get``/``.item()``/``float``/``np.asarray``), host RNG, or
   wall-clock reads inside jit/pjit-compiled or ``@hot_path`` functions.
+- ``obs-hot-path``        — no logging calls or metrics-instrument
+  construction (Counter/Gauge/Histogram lookup) inside hot functions;
+  instruments are hoisted to module/init scope, only
+  inc/set/observe on the step path.
 - ``ft-swallowed-except`` / ``ft-grpc-timeout`` — fault-tolerance
   hygiene: no broad except that swallows without logging/re-raising,
   no gRPC stub call without a deadline.

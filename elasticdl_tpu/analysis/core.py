@@ -220,11 +220,13 @@ def _rules_by_name(names=None):
         fault_tolerance,
         hot_path,
         lock_discipline,
+        obs_hot_path,
     )
 
     registry = {
         "lock-discipline": lock_discipline.run,
         "jax-hot-path": hot_path.run,
+        "obs-hot-path": obs_hot_path.run,
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
         "xhost-determinism": determinism.run,
@@ -240,6 +242,7 @@ def _rules_by_name(names=None):
 RULE_NAMES = (
     "lock-discipline",
     "jax-hot-path",
+    "obs-hot-path",
     "ft-swallowed-except",
     "ft-grpc-timeout",
     "xhost-determinism",

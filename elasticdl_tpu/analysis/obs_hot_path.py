@@ -1,0 +1,110 @@
+"""obs-hot-path: logging and instrument construction on the step path.
+
+Inside a hot function (the same hot set the ``jax-hot-path`` rule
+resolves: ``@jax.jit``/``@pjit``/``@hot_path`` functions, jitted
+factory products, jitted lambdas), flag:
+
+- **logging calls** — ``logger.info(...)``, ``logging.warning(...)``,
+  ``print(...)``: a log record per compiled step is pure host-side
+  overhead in the hottest loop, and under jit tracing it fires at
+  trace time with tracer reprs, which is never what was meant;
+- **metrics-instrument construction/lookup** —
+  ``obs_metrics.counter/gauge/histogram(...)`` (and the
+  ``Counter``/``Gauge``/``Histogram`` constructors): each call takes
+  the registry lock and hashes the name. Instruments must be hoisted
+  to module or ``__init__`` scope and only ``inc``/``set``/``observe``
+  on the step path — the no-op-when-disabled discipline only holds
+  when construction is out of the loop.
+
+``.inc()``/``.set()``/``.observe()``/``.labels()`` on an existing
+instrument are NOT flagged: that is the supported hot-path surface.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, attr_chain
+from elasticdl_tpu.analysis.hot_path import _collect_hot
+
+RULE = "obs-hot-path"
+
+# leaf method names that log (bound logger or logging-module calls)
+_LOG_METHODS = {
+    "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "log",
+}
+# base names that make a <base>.<method>() call a logging call
+_LOG_BASES = ("logger", "logging", "log")
+
+# callables that construct or look up a metrics instrument
+_INSTRUMENT_FACTORIES = {
+    "counter", "gauge", "histogram", "Counter", "Gauge", "Histogram",
+}
+
+
+def _is_logging_call(func):
+    """True for logger.info / logging.warning / self._logger.error ...
+    and bare print."""
+    if isinstance(func, ast.Name):
+        return func.id == "print"
+    chain = attr_chain(func)
+    if chain is None:
+        return False
+    parts = chain.split(".")
+    if parts[-1] not in _LOG_METHODS:
+        return False
+    base = parts[-2].lstrip("_") if len(parts) >= 2 else ""
+    return any(base.startswith(b) or base.endswith(b) for b in _LOG_BASES)
+
+
+def _is_instrument_construction(func):
+    """True for obs_metrics.counter(...) / metrics.histogram(...) /
+    registry.gauge(...) / Counter(...)."""
+    if isinstance(func, ast.Name):
+        return func.id in _INSTRUMENT_FACTORIES
+    chain = attr_chain(func)
+    if chain is None:
+        return False
+    return chain.split(".")[-1] in _INSTRUMENT_FACTORIES
+
+
+def _scan(unit, node, symbol, findings):
+    body = node.body if isinstance(node.body, list) else [node.body]
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if _is_logging_call(func):
+                code = attr_chain(func) or "print"
+                message = (
+                    "hot path: %s logs every compiled step (and fires "
+                    "at trace time under jit) — log outside the step "
+                    "function" % code
+                )
+            elif _is_instrument_construction(func):
+                code = attr_chain(func) or "instrument"
+                message = (
+                    "hot path: %s constructs/looks up a metrics "
+                    "instrument per step (registry lock + name hash) — "
+                    "hoist the instrument to module/__init__ scope and "
+                    "only inc/set/observe here" % code
+                )
+            else:
+                continue
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.path,
+                    line=sub.lineno,
+                    symbol=symbol,
+                    code=code,
+                    message=message,
+                )
+            )
+
+
+def run(units):
+    findings = []
+    for unit, node, symbol in _collect_hot(units):
+        _scan(unit, node, symbol, findings)
+    return findings

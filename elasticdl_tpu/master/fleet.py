@@ -1,0 +1,360 @@
+"""Master-side fleet telemetry aggregation + online anomaly detectors.
+
+Workers and parameter servers piggyback a compact ``TelemetryBlob`` on
+the Master RPCs they already make (get_task / report_task_result /
+get_comm_info — proto field, no extra RPC); the servicer feeds every
+sighting into this monitor, which maintains the single cluster-level
+view PR 2's per-role /metrics endpoints could not give:
+
+- ``snapshot()``  — the full fleet JSON behind ``GET /statusz``
+- ``alerts()``    — currently-firing detectors behind ``GET /alerts``
+- ``evaluate()``  — one cheap O(fleet) detector pass; the task
+  monitor's scan thread calls it every second, and alert *transitions*
+  increment ``edl_master_alerts_total{alert=...}`` in the PR 2
+  registry and land in the event journal (``alert_raised`` /
+  ``alert_cleared``).
+
+Detectors (knobs are env vars so the same binary tunes per job;
+constructor args override for tests):
+
+- **straggler**     — a worker's step-time EWMA exceeds
+  ``EDL_STRAGGLER_FACTOR`` (default 3.0) x the fleet median, with at
+  least 3 workers reporting.
+- **dead-air**      — a role previously seen reporting has been silent
+  for ``EDL_DEAD_AIR_SECS`` (default 15 s).
+- **stuck-round**   — a PS reports a non-empty round buffer whose fill
+  has not grown and whose store version has not advanced for
+  ``EDL_STUCK_ROUND_SECS`` (default 20 s).
+- **version-lag**   — a PS reports version lag beyond
+  ``EDL_VERSION_LAG_MAX`` (default 100).
+
+Everything is plain dict/float work under one lock, sized for a scan
+thread ticking at 1 Hz over hundreds of roles — no numpy, no RPC.
+"""
+
+import os
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import events
+from elasticdl_tpu.observability import metrics as obs_metrics
+
+logger = _logger_factory("elasticdl_tpu.master.fleet")
+
+STRAGGLER_FACTOR_ENV = "EDL_STRAGGLER_FACTOR"
+DEAD_AIR_SECS_ENV = "EDL_DEAD_AIR_SECS"
+STUCK_ROUND_SECS_ENV = "EDL_STUCK_ROUND_SECS"
+VERSION_LAG_MAX_ENV = "EDL_VERSION_LAG_MAX"
+
+ALERT_KINDS = ("straggler", "dead_air", "stuck_round", "version_lag")
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name,
+                       os.environ.get(name))
+        return float(default)
+
+
+class _RoleState:
+    """Last-known telemetry for one reporting role."""
+
+    __slots__ = (
+        "role", "worker_id", "last_seen", "blob",
+        "stuck_since", "stuck_fill", "stuck_version",
+    )
+
+    def __init__(self, role, worker_id, now):
+        self.role = role
+        self.worker_id = worker_id
+        self.last_seen = now
+        self.blob = None  # dict of the last TelemetryBlob's fields
+        # stuck-round tracking: when fill/version last changed
+        self.stuck_since = None
+        self.stuck_fill = 0
+        self.stuck_version = 0
+
+
+class FleetMonitor:
+    def __init__(
+        self,
+        straggler_factor=None,
+        dead_air_secs=None,
+        stuck_round_secs=None,
+        version_lag_max=None,
+    ):
+        self._straggler_factor = (
+            straggler_factor
+            if straggler_factor is not None
+            else _env_float(STRAGGLER_FACTOR_ENV, 3.0)
+        )
+        self._dead_air_secs = (
+            dead_air_secs
+            if dead_air_secs is not None
+            else _env_float(DEAD_AIR_SECS_ENV, 15.0)
+        )
+        self._stuck_round_secs = (
+            stuck_round_secs
+            if stuck_round_secs is not None
+            else _env_float(STUCK_ROUND_SECS_ENV, 20.0)
+        )
+        self._version_lag_max = (
+            version_lag_max
+            if version_lag_max is not None
+            else _env_float(VERSION_LAG_MAX_ENV, 100.0)
+        )
+        self._lock = threading.Lock()
+        self._roles = {}  # key (worker_id or role string) -> _RoleState
+        # alert key (kind, target) -> {"since": ts, ...detail}
+        self._firing = {}
+        self._started_at = time.time()
+        # PR 2 registry: transitions-to-firing per alert kind, plus a
+        # live gauge of currently-firing alerts. No-ops when metrics
+        # collection is off.
+        self._m_alerts = obs_metrics.counter(
+            "edl_master_alerts_total",
+            "Fleet detector transitions to firing", ("alert",),
+        )
+        for kind in ALERT_KINDS:
+            self._m_alerts.labels(alert=kind)  # stable series set
+        obs_metrics.gauge(
+            "edl_master_alerts_firing", "Currently firing fleet alerts"
+        ).set_function(lambda: len(self._firing))
+
+    # ------------------------------------------------------------------
+    # ingestion (called from servicer RPC handlers — keep it O(1))
+
+    def observe(self, worker_id, blob=None):
+        """Record a sighting of ``worker_id`` (any Master RPC), with its
+        piggybacked telemetry when the request carried one. ``blob`` is
+        the TelemetryBlob message or None."""
+        now = time.time()
+        with self._lock:
+            state = self._roles.get(worker_id)
+            if state is None:
+                role = blob.role if blob is not None and blob.role else (
+                    "worker-%d" % worker_id
+                    if worker_id >= 0
+                    else "ps-%d" % (-worker_id - 1)
+                )
+                state = self._roles[worker_id] = _RoleState(
+                    role, worker_id, now
+                )
+            state.last_seen = now
+            if blob is None:
+                return
+            if blob.role:
+                state.role = blob.role
+            state.blob = {
+                "role": state.role,
+                "step_time_ewma": blob.step_time_ewma,
+                "examples_per_sec": blob.examples_per_sec,
+                "last_task_seconds": blob.last_task_seconds,
+                "push_rate": blob.push_rate,
+                "pull_rate": blob.pull_rate,
+                "version_lag": int(blob.version_lag),
+                "model_version": int(blob.model_version),
+                "round_buffer_fill": int(blob.round_buffer_fill),
+            }
+            # stuck-round bookkeeping: the clock restarts whenever the
+            # fill grows or the store version advances
+            fill = int(blob.round_buffer_fill)
+            version = int(blob.model_version)
+            if fill <= 0:
+                state.stuck_since = None
+            elif (
+                state.stuck_since is None
+                or fill > state.stuck_fill
+                or version > state.stuck_version
+            ):
+                state.stuck_since = now
+            state.stuck_fill = fill
+            state.stuck_version = version
+
+    def forget(self, worker_id):
+        """Drop a role and every alert about it (tests / explicit
+        cleanup; evictions go through mark_dead below)."""
+        with self._lock:
+            self._roles.pop(worker_id, None)
+            for key in [k for k in self._firing if k[1] == worker_id]:
+                del self._firing[key]
+
+    def mark_dead(self, worker_id):
+        """The task monitor confirmed this worker dead (liveness or
+        task-timeout eviction). Force the dead-air transition if the
+        silence window hadn't elapsed yet — in a fast-task job the
+        3x-average task timeout beats the dead-air window, and the
+        eviction must never be QUIETER than the suspicion — and leave
+        a tombstone on /alerts (detail ``evicted: true``) that clears
+        when the worker re-registers."""
+        now = time.time()
+        with self._lock:
+            state = self._roles.pop(worker_id, None)
+            for key in [
+                k for k in self._firing
+                if k[1] == worker_id and k[0] != "dead_air"
+            ]:
+                del self._firing[key]
+            key = ("dead_air", worker_id)
+            fresh = state is not None and key not in self._firing
+            if fresh:
+                self._firing[key] = {
+                    "since": now, "evicted": True,
+                    "role": state.role,
+                }
+            elif key in self._firing:
+                self._firing[key]["evicted"] = True
+        if fresh:
+            self._m_alerts.labels(alert="dead_air").inc()
+            logger.warning(
+                "fleet alert dead_air on %s: evicted", worker_id
+            )
+            events.emit("alert_raised", alert="dead_air",
+                        target=str(worker_id), evicted=True)
+
+    # ------------------------------------------------------------------
+    # detection
+
+    def evaluate(self):
+        """One detector pass; returns the currently-firing alert list.
+        Edge-triggered side effects (counter bump + journal event) fire
+        on transitions only, so a 1 Hz scan doesn't spam either."""
+        now = time.time()
+        with self._lock:
+            desired = self._detect_locked(now)
+            raised = [k for k in desired if k not in self._firing]
+            cleared = [k for k in self._firing if k not in desired]
+            for key in raised:
+                self._firing[key] = desired[key]
+            for key in cleared:
+                del self._firing[key]
+            firing = self._render_firing_locked()
+        for kind, target in raised:
+            self._m_alerts.labels(alert=kind).inc()
+            detail = desired[(kind, target)]
+            logger.warning("fleet alert %s on %s: %s", kind, target, detail)
+            events.emit("alert_raised", alert=kind, target=str(target),
+                        **{k: v for k, v in detail.items() if k != "since"})
+        for kind, target in cleared:
+            events.emit("alert_cleared", alert=kind, target=str(target))
+        return firing
+
+    def _detect_locked(self, now):
+        desired = {}
+        # straggler: needs a fleet to compare against
+        ewmas = [
+            (wid, s.blob["step_time_ewma"])
+            for wid, s in self._roles.items()
+            if s.blob is not None and s.blob["step_time_ewma"] > 0
+            and s.worker_id >= 0
+        ]
+        if len(ewmas) >= 3:
+            values = sorted(v for _, v in ewmas)
+            median = values[len(values) // 2]
+            threshold = self._straggler_factor * median
+            for wid, ewma in ewmas:
+                if median > 0 and ewma > threshold:
+                    desired[("straggler", wid)] = {
+                        "since": now,
+                        "step_time_ewma": round(ewma, 6),
+                        "fleet_median": round(median, 6),
+                        "factor": round(ewma / median, 2),
+                    }
+        for wid, state in self._roles.items():
+            silent = now - state.last_seen
+            if silent > self._dead_air_secs:
+                desired[("dead_air", wid)] = {
+                    "since": now,
+                    "silent_secs": round(silent, 2),
+                    "window_secs": self._dead_air_secs,
+                }
+            if (
+                state.stuck_since is not None
+                and now - state.stuck_since > self._stuck_round_secs
+            ):
+                desired[("stuck_round", wid)] = {
+                    "since": now,
+                    "fill": state.stuck_fill,
+                    "stalled_secs": round(now - state.stuck_since, 2),
+                }
+            if (
+                state.blob is not None
+                and state.blob["version_lag"] > self._version_lag_max
+            ):
+                desired[("version_lag", wid)] = {
+                    "since": now,
+                    "version_lag": state.blob["version_lag"],
+                    "max": self._version_lag_max,
+                }
+        # eviction tombstones persist while their worker stays gone;
+        # a re-registration re-adds the role and the normal logic
+        # above then clears (or re-raises) the alert
+        for key, detail in self._firing.items():
+            if key[0] == "dead_air" and key[1] not in self._roles:
+                desired[key] = detail
+        # a firing alert keeps its original "since"
+        for key, detail in desired.items():
+            if key in self._firing:
+                detail["since"] = self._firing[key]["since"]
+        return desired
+
+    def _render_firing_locked(self):
+        firing = []
+        for (kind, target), detail in sorted(
+            self._firing.items(), key=lambda kv: str(kv[0])
+        ):
+            state = self._roles.get(target)
+            entry = {
+                "alert": kind,
+                "worker_id": target,
+                "role": state.role if state is not None else str(target),
+                "firing_secs": round(time.time() - detail["since"], 2),
+            }
+            entry.update(
+                {k: v for k, v in detail.items() if k != "since"}
+            )
+            firing.append(entry)
+        return firing
+
+    # ------------------------------------------------------------------
+    # exposition
+
+    def alerts(self):
+        """Fresh detector pass + the firing list (the /alerts body)."""
+        return self.evaluate()
+
+    def snapshot(self, extra=None):
+        """Full fleet view (the /statusz body): every reporting role's
+        last telemetry + freshness, the firing alerts, and whatever the
+        master adds (task queue stats). JSON-ready."""
+        firing = self.evaluate()
+        now = time.time()
+        with self._lock:
+            roles = {}
+            for wid, state in self._roles.items():
+                entry = {
+                    "worker_id": wid,
+                    "last_seen_secs_ago": round(now - state.last_seen, 2),
+                }
+                if state.blob is not None:
+                    entry.update(state.blob)
+                roles[state.role] = entry
+        body = {
+            "ts": now,
+            "job": os.environ.get(events.JOB_NAME_ENV, ""),
+            "uptime_secs": round(now - self._started_at, 2),
+            "fleet": roles,
+            "alerts": firing,
+            "thresholds": {
+                "straggler_factor": self._straggler_factor,
+                "dead_air_secs": self._dead_air_secs,
+                "stuck_round_secs": self._stuck_round_secs,
+                "version_lag_max": self._version_lag_max,
+            },
+        }
+        if extra:
+            body.update(extra)
+        return body

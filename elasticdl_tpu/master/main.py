@@ -22,6 +22,13 @@ def main(argv=None):
     from elasticdl_tpu.common.log_utils import configure
 
     configure(args.log_level, args.log_file_path)
+    # black-box discipline (ISSUE 3): a K8s-evicted master must leave a
+    # complete flight record — SIGTERM dumps the event ring and flushes
+    # the journal + trace buffer, then exits so Master.run's finally
+    # runs stop(). Uncaught exceptions dump the ring too.
+    from elasticdl_tpu.observability import events
+
+    events.install_crash_hooks()
     if args.metrics_port:
         # publish the knob before any instrument is constructed: the
         # registry decides enabled/no-op at first touch

@@ -15,12 +15,13 @@ from elasticdl_tpu.common.grpc_utils import build_server
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.data.readers import create_data_reader
 from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.fleet import FleetMonitor
 from elasticdl_tpu.master.rendezvous import MeshRendezvous
 from elasticdl_tpu.master.servicer import MasterServicer
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.master.task_monitor import TaskMonitor
 from elasticdl_tpu.models.registry import get_model_spec
-from elasticdl_tpu.observability import http_server, trace
+from elasticdl_tpu.observability import events, http_server, trace
 from elasticdl_tpu.proto.services import add_master_servicer_to_server
 
 logger = _logger_factory("elasticdl_tpu.master.master")
@@ -50,6 +51,16 @@ class Master:
         symbol_overrides=None,
         metrics_port=0,
     ):
+        if metrics_port:
+            # programmatic construction (no CLI entry ran): publish the
+            # knob BEFORE the first instrument is constructed (the
+            # fleet monitor's alert counter below is the earliest), or
+            # the process-global registry freezes disabled and /metrics
+            # serves empty
+            import os
+
+            os.environ.setdefault(http_server.PORT_ENV,
+                                  str(metrics_port))
         self.spec = get_model_spec(
             model_zoo_module, model_def=model_def,
             model_params=model_params,
@@ -98,10 +109,15 @@ class Master:
                 summary_writer=self.tensorboard_service,
             )
         self.rendezvous = MeshRendezvous()
+        # cluster-level fleet view + anomaly detectors (/statusz,
+        # /alerts): fed by telemetry piggybacked on worker/PS RPCs,
+        # evaluated on the task monitor's scan tick
+        self.fleet_monitor = FleetMonitor()
         self.servicer = MasterServicer(
             self.task_dispatcher,
             self.evaluation_service,
             self.rendezvous,
+            fleet_monitor=self.fleet_monitor,
         )
         self.pod_manager = pod_manager
         self.task_monitor = TaskMonitor(
@@ -110,20 +126,13 @@ class Master:
             self.rendezvous,
             on_worker_dead=self._on_worker_dead,
             liveness_timeout_secs=task_timeout_secs,
+            fleet_monitor=self.fleet_monitor,
         )
         self._port = port
         self._server = None
         self._metrics_port = metrics_port
         self._serving = False
         self.observability = None
-        if metrics_port:
-            # programmatic construction (no CLI entry ran): publish the
-            # knob before the first instrument, or the process-global
-            # registry freezes disabled and /metrics serves empty
-            import os
-
-            os.environ.setdefault(http_server.PORT_ENV,
-                                  str(metrics_port))
         self._register_domain_gauges()
 
     def _register_domain_gauges(self):
@@ -215,6 +224,8 @@ class Master:
         self._server.start()
         self._serving = True
         trace.configure("master")
+        events.configure("master")
+        events.emit("role_start", port=self._port)
         self.observability = http_server.maybe_start(
             "master", cli_port=self._metrics_port
         )
@@ -223,6 +234,17 @@ class Master:
             # master pod that can't dispatch must not receive traffic
             self.observability.add_readiness_check(
                 "servicer_started", lambda: self._serving
+            )
+            # the cluster-level view: full fleet snapshot (+ task queue
+            # stats) and the firing anomaly detectors
+            self.observability.add_json_handler(
+                "/statusz",
+                lambda: self.fleet_monitor.snapshot(
+                    extra={"tasks": self.task_dispatcher.stats()}
+                ),
+            )
+            self.observability.add_json_handler(
+                "/alerts", self.fleet_monitor.alerts
             )
         if self.tensorboard_service is not None:
             self.tensorboard_service.start()
@@ -262,6 +284,8 @@ class Master:
         if self.observability is not None:
             self.observability.stop()
             self.observability = None
+        events.emit("role_stop")
+        events.flush()
         trace.flush()
         self.task_monitor.stop()
         if self.evaluation_service is not None:

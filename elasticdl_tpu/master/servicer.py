@@ -12,7 +12,7 @@ import threading
 import time
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
-from elasticdl_tpu.observability import trace
+from elasticdl_tpu.observability import events, trace
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = _logger_factory("elasticdl_tpu.master.servicer")
@@ -26,11 +26,16 @@ class MasterServicer:
         rendezvous=None,
         instance_manager=None,
         auto_join_mesh=True,
+        fleet_monitor=None,
     ):
         self._task_dispatcher = task_dispatcher
         self._evaluation_service = evaluation_service
         self._rendezvous = rendezvous
         self._instance_manager = instance_manager
+        # fleet telemetry sink (master/fleet.py): every RPC is a
+        # liveness sighting, and requests carrying the piggybacked
+        # TelemetryBlob update the role's fleet-view entry
+        self._fleet = fleet_monitor
         # Membership = live workers: a worker's first get_comm_info joins
         # its host to the mesh. A pod manager that owns membership
         # explicitly (K8s pod events) sets auto_join_mesh=False.
@@ -66,6 +71,18 @@ class MasterServicer:
         self._restart_epoch_base = int(time.time())
 
     # ------------------------------------------------------------------
+    def _observe(self, request):
+        """Fold one RPC into the fleet view: a liveness sighting always,
+        plus the telemetry blob when the sender piggybacked one."""
+        self._touch(request.worker_id)
+        if self._fleet is not None:
+            blob = (
+                request.telemetry
+                if request.HasField("telemetry")
+                else None
+            )
+            self._fleet.observe(request.worker_id, blob)
+
     def _touch(self, worker_id):
         with self._lock:
             # monotonic max: extend_liveness may have credited a future
@@ -112,7 +129,7 @@ class MasterServicer:
     # RPC handlers (also callable in-process without gRPC)
 
     def get_task(self, request, context=None):
-        self._touch(request.worker_id)
+        self._observe(request)
         task_type = request.task_type if request.task_type else None
         dispatch_start = time.time()
         task = self._task_dispatcher.get(request.worker_id, task_type)
@@ -123,6 +140,11 @@ class MasterServicer:
             trace.complete(
                 "dispatch", dispatch_start,
                 task_id=task.task_id, worker_id=request.worker_id,
+            )
+            events.emit(
+                "task_dispatch", task=task.task_id,
+                worker=request.worker_id,
+                type=pb.TaskType.Name(task.type).lower(),
             )
             return task
         if (
@@ -148,11 +170,15 @@ class MasterServicer:
         Returns this worker_id's relaunch epoch (base + 1, base + 2,
         ...): the worker's push incarnation for the sync PS's
         round-buffer cleanup."""
-        self._touch(request.worker_id)
+        self._observe(request)
         with self._lock:
             count = self._worker_restarts.get(request.worker_id, 0) + 1
             self._worker_restarts[request.worker_id] = count
             epoch = self._restart_epoch_base + count
+        events.emit(
+            "worker_register", worker=request.worker_id, epoch=epoch,
+            relaunch=count > 1,
+        )
         self._task_dispatcher.recover_tasks(request.worker_id)
         return pb.ResetWorkerResponse(restart_count=epoch)
 
@@ -166,7 +192,7 @@ class MasterServicer:
             )
 
     def report_task_result(self, request, context=None):
-        self._touch(request.worker_id)
+        self._observe(request)
         success = not request.err_message
         # "requeue:" prefix = mesh-lifecycle handback (worker restarting
         # for a new epoch / lockstep peer died): requeue WITHOUT charging
@@ -184,6 +210,11 @@ class MasterServicer:
         trace.instant(
             "task_reported", task_id=request.task_id,
             worker_id=request.worker_id, success=success,
+        )
+        events.emit(
+            "task_report", task=request.task_id,
+            worker=request.worker_id, ok=success,
+            err=request.err_message[:200],
         )
         return pb.Empty()
 
@@ -203,7 +234,7 @@ class MasterServicer:
         return pb.Empty()
 
     def get_comm_info(self, request, context=None):
-        self._touch(request.worker_id)
+        self._observe(request)
         if self._rendezvous is None:
             return pb.CommInfo(rank=0, world_size=1, mesh_epoch=0)
         if request.worker_host:

@@ -19,6 +19,7 @@ import time
 
 from elasticdl_tpu.common.constants import MAX_TASK_RETRIES
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import events
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = _logger_factory("elasticdl_tpu.master.task_dispatcher")
@@ -224,6 +225,10 @@ class TaskDispatcher:
         fire = []
         completed_callbacks = []
         result = (False, None)
+        # journal entries decided under the lock, written after it (the
+        # journal does file I/O; never under the dispatcher lock the
+        # RPC handlers contend on)
+        journal = []
         with self._lock:
             record = self._records.get(task_id)
             if record is None:
@@ -277,6 +282,10 @@ class TaskDispatcher:
                     )
                     self._job_failed = True
                     result = (False, task)
+                    journal.append(
+                        ("job_failed",
+                         dict(task=task_id, retries=record.retry_count))
+                    )
                 else:
                     queue = (
                         self._eval_todo
@@ -285,6 +294,14 @@ class TaskDispatcher:
                     )
                     queue.append(task_id)
                     result = (False, task)
+                    journal.append(
+                        ("task_requeue",
+                         dict(task=task_id, worker=assignee,
+                              retries=record.retry_count,
+                              counted=count_failure))
+                    )
+        for event, fields in journal:
+            events.emit(event, **fields)
         # Completion callbacks run outside the lock: they may call back
         # into the dispatcher (e.g. EvaluationService queueing more tasks).
         for cb in completed_callbacks:

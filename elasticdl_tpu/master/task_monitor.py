@@ -12,6 +12,7 @@ import threading
 import time
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import events
 
 logger = _logger_factory("elasticdl_tpu.master.task_monitor")
 
@@ -28,11 +29,16 @@ class TaskMonitor:
         scan_interval_secs=1.0,
         mesh_restart_grace_secs=30.0,
         mesh_rejoin_timeout_secs=90.0,
+        fleet_monitor=None,
     ):
         self._dispatcher = task_dispatcher
         self._servicer = servicer
         self._rendezvous = rendezvous
         self._on_worker_dead = on_worker_dead
+        # fleet anomaly detectors (master/fleet.py) ride this thread's
+        # existing 1 Hz scan — one cheap evaluate() per tick keeps the
+        # alert counters/journal current without a second timer thread
+        self._fleet = fleet_monitor
         self._liveness_timeout = liveness_timeout_secs
         # An epoch bump makes EVERY mesh member exit and relaunch to
         # re-initialize jax.distributed; their liveness necessarily
@@ -77,6 +83,8 @@ class TaskMonitor:
     def _scan(self):
         now = time.time()
         dead = set()
+        if self._fleet is not None:
+            self._fleet.evaluate()
 
         # Liveness: worker silent for too long while holding tasks OR
         # while a registered mesh member — an idle member that dies must
@@ -119,8 +127,18 @@ class TaskMonitor:
                 )
                 dead.add(worker_id)
 
-        # Task timeout: 3x slower than the rolling average.
-        threshold = self._timeout_factor * self._dispatcher.avg_task_duration()
+        # Task timeout: 3x slower than the rolling average, floored at
+        # the liveness timeout. Without the floor a fleet of fast tasks
+        # drags the threshold under a second and a FRESH worker's first
+        # task — which carries its 20-40 s jit compile — is falsely
+        # recovered while the worker is actively heartbeating (observed
+        # live: avg 0.11 s -> threshold 0.33 s -> spurious eviction +
+        # dead-air alert on a healthy relaunch). A worker that is
+        # pinging gets at least the liveness window of patience.
+        threshold = max(
+            self._timeout_factor * self._dispatcher.avg_task_duration(),
+            self._liveness_timeout,
+        )
         for task_id, (worker_id, start_time) in doing.items():
             if now - start_time > threshold:
                 logger.warning(
@@ -144,9 +162,17 @@ class TaskMonitor:
         entry point for pod-event-driven detection (the pod manager calls
         this on pod failure/deletion).
         """
-        self._dispatcher.recover_tasks(worker_id)
         host = self._servicer.worker_host(worker_id)
+        events.emit(
+            "worker_presumed_dead", worker=worker_id, host=host or "",
+        )
+        self._dispatcher.recover_tasks(worker_id)
         self._servicer.forget_worker(worker_id)
+        if self._fleet is not None:
+            # force the dead-air transition if it hadn't fired yet (a
+            # fast-task job's 3x-average timeout beats the dead-air
+            # window) and leave an eviction tombstone on /alerts
+            self._fleet.mark_dead(worker_id)
         if self._rendezvous is not None and host:
             # Membership change: surviving workers see a new mesh epoch on
             # their next get_comm_info and rebuild the SPMD mesh.

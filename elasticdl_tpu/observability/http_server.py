@@ -9,6 +9,9 @@ A stdlib ``http.server`` daemon thread serving:
   initialized; worker → master channel ready), else 503 listing the
   failing checks — the pod manager's signal to hold traffic, not
   restart.
+- role-registered JSON endpoints (``add_json_handler``): the master
+  mounts ``/statusz`` (full fleet telemetry snapshot) and ``/alerts``
+  (firing anomaly detectors) here — see master/fleet.py.
 
 Knobs: ``--metrics_port`` on each role's CLI, falling back to
 ``EDL_METRICS_PORT``; 0 (the default) starts nothing, so tests/CI and
@@ -16,6 +19,7 @@ benchmarks are unaffected unless they opt in.
 """
 
 import http.server
+import json
 import os
 import threading
 
@@ -50,6 +54,7 @@ class ObservabilityServer:
         self.port = int(port)
         self.registry = registry or metrics_mod.default_registry()
         self._checks = []  # [(name, callable -> bool)]
+        self._json_handlers = {}  # path -> callable -> JSON-able obj
         self._httpd = None
         self._thread = None
         self.registry.gauge(
@@ -60,6 +65,12 @@ class ObservabilityServer:
         """``check()`` -> truthy when this aspect of the role is ready.
         A check that raises counts as not ready."""
         self._checks.append((name, check))
+
+    def add_json_handler(self, path, fn):
+        """Serve ``fn()`` (any JSON-serializable object) on GET
+        ``path``. A raising handler answers 500 with the error text —
+        a broken snapshot source must not take the whole server down."""
+        self._json_handlers[path] = fn
 
     def readiness(self):
         """(ready, [failing check names])."""
@@ -95,6 +106,20 @@ class ObservabilityServer:
                             503,
                             ("unready: %s\n" % ",".join(failing)).encode(),
                         )
+                elif path in server._json_handlers:
+                    try:
+                        body = json.dumps(
+                            server._json_handlers[path]()
+                        ).encode("utf-8")
+                    except Exception as e:
+                        # a broken snapshot source degrades to a 500,
+                        # never takes the probe server down
+                        logger.warning("%s handler failed: %s", path, e)
+                        self._reply(
+                            500, ("error: %s\n" % e).encode("utf-8")
+                        )
+                        return
+                    self._reply(200, body, "application/json")
                 else:
                     self._reply(404, b"not found\n")
 

@@ -57,7 +57,10 @@ class TraceWriter:
         self.path = os.path.join(
             trace_dir, "%s-%d.trace.json" % (role, self.pid)
         )
-        self._lock = threading.Lock()
+        # RLock: the SIGTERM crash hook (observability/events.py) calls
+        # trace.flush() on the main thread, which may have been
+        # interrupted inside add()/flush() while holding this lock
+        self._lock = threading.RLock()
         self._file_started = False
         self._events = [
             {

@@ -16,7 +16,7 @@ import grpc
 from elasticdl_tpu.common.args import add_bool_argument
 from elasticdl_tpu.common.grpc_utils import build_server
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
-from elasticdl_tpu.observability import http_server, trace
+from elasticdl_tpu.observability import events, http_server, trace
 from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
 from elasticdl_tpu.ps.embedding_store import create_store
 from elasticdl_tpu.ps.servicer import PserverServicer
@@ -120,6 +120,9 @@ class ParameterServer:
                 worker_host="",
             )
         self._master_client = master_client
+        self._telemetry_on = (
+            os.environ.get("EDL_TELEMETRY", "") != "0"
+        )
         self.servicer = PserverServicer(
             self.store,
             ps_id=args.ps_id,
@@ -131,6 +134,12 @@ class ParameterServer:
             sync_version_tolerance=args.sync_version_tolerance,
             staleness_modulation=bool(args.lr_staleness_modulation),
         )
+        if master_client is not None and self._telemetry_on:
+            # piggyback this PS's telemetry (push/pull rates, version
+            # lag, round-buffer fill) on the 5 s liveness poll the run
+            # loop already makes — the master's stuck-round and
+            # version-lag detectors read it from the fleet view
+            master_client.telemetry_provider = self.servicer.telemetry_blob
         if args.checkpoint_dir_for_init:
             SparseCheckpointSaver(
                 args.checkpoint_dir_for_init,
@@ -155,6 +164,8 @@ class ParameterServer:
         self.server.start()
         role = "ps-%d" % self.args.ps_id
         trace.configure(role)
+        events.configure(role)
+        events.emit("role_start", port=self.args.port)
         self.observability = http_server.maybe_start(
             role, cli_port=getattr(self.args, "metrics_port", 0)
         )
@@ -187,14 +198,14 @@ class ParameterServer:
                 if misses >= 3:
                     logger.info("Master gone; PS exiting")
                     self.server.stop(grace=1.0)
+                    events.emit("role_stop", reason="master_gone")
+                    events.flush()
                     return 0
             else:
                 misses = 0
 
 
 def main(argv=None):
-    import signal
-
     from elasticdl_tpu.common.platform import apply_platform_overrides
 
     apply_platform_overrides()
@@ -203,14 +214,10 @@ def main(argv=None):
         # publish the knob before any instrument is constructed: the
         # registry decides enabled/no-op at first touch
         os.environ[http_server.PORT_ENV] = str(args.metrics_port)
-
-    def _graceful_exit(signum, frame):
-        # the pod manager stops PS pods with SIGTERM, which skips
-        # atexit — flush the trace buffer before going down
-        trace.flush()
-        sys.exit(0)
-
-    signal.signal(signal.SIGTERM, _graceful_exit)
+    # the pod manager stops PS pods with SIGTERM, which skips atexit —
+    # the crash hooks dump the event ring and flush the journal AND the
+    # trace buffer (PR 2 flushed only the trace here), then exit 0
+    events.install_crash_hooks()
     return ParameterServer(args).prepare().run()
 
 

@@ -28,6 +28,7 @@ from elasticdl_tpu.common.tensor_utils import (
     deserialize_indexed_slices,
     ndarray_to_blob,
 )
+from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.observability import trace
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
@@ -127,6 +128,41 @@ class PserverServicer:
             "edl_ps_embedding_rows",
             "Materialized rows per embedding table", ("table",),
         )
+        # Fleet-telemetry source (ISSUE 3): plain-int tallies kept
+        # INDEPENDENTLY of the metrics registry (telemetry must work
+        # with /metrics off), read by telemetry_blob() on the PS's 5 s
+        # master poll. Unlocked increments: a GIL-level race costs at
+        # most one count in a rate estimate — the detectors compare
+        # magnitudes, not exact totals.
+        self._t_push_count = 0
+        self._t_pull_count = 0
+        self._t_last_push_version = 0
+        self._t_prev = None  # (timestamp, push_count, pull_count)
+
+    def telemetry_blob(self):
+        """Piggyback payload for the PS's get_comm_info liveness poll:
+        push/pull rates over the window since the previous blob, the
+        store/pusher version lag, and the round-buffer fill the
+        stuck-round detector watches."""
+        now = time.time()
+        push_count, pull_count = self._t_push_count, self._t_pull_count
+        push_rate = pull_rate = 0.0
+        if self._t_prev is not None:
+            since, prev_push, prev_pull = self._t_prev
+            window = max(1e-6, now - since)
+            push_rate = (push_count - prev_push) / window
+            pull_rate = (pull_count - prev_pull) / window
+        self._t_prev = (now, push_count, pull_count)
+        return pb.TelemetryBlob(
+            role="ps-%d" % self._ps_id,
+            push_rate=push_rate,
+            pull_rate=pull_rate,
+            version_lag=max(
+                0, self._store.version - self._t_last_push_version
+            ),
+            model_version=self._store.version,
+            round_buffer_fill=self._buffered_count(),
+        )
 
     # ------------------------------------------------------------------
     def push_model(self, request, context=None):
@@ -200,12 +236,15 @@ class PserverServicer:
     def pull_embedding_vectors(self, request, context=None):
         ids = np.asarray(request.ids, dtype=np.int64)
         values = self._store.lookup(request.name, ids)
+        self._t_pull_count += 1
         self._m_pull_requests.labels(table=request.name).inc()
         self._m_pull_rows.labels(table=request.name).inc(int(ids.size))
         return ndarray_to_blob(values)
 
     # ------------------------------------------------------------------
     def push_gradients(self, request, context=None):
+        self._t_push_count += 1
+        self._t_last_push_version = request.gradients.version
         self._m_push_requests.inc()
         self._m_version_lag.set(
             self._store.version - request.gradients.version
@@ -232,6 +271,20 @@ class PserverServicer:
         return pb.PushGradientsResponse(accepted=True, version=version)
 
     def _push_gradients_sync(self, request):
+        """Sync push with the journal I/O outside the push lock:
+        events decided while holding ``_push_lock`` are written only
+        after it is released (same discipline as task_dispatcher) — a
+        slow journal flush must not serialize every worker's push."""
+        journal = []
+        try:
+            return self._push_gradients_sync_locked_path(
+                request, journal
+            )
+        finally:
+            for event, fields in journal:
+                events.emit(event, **fields)
+
+    def _push_gradients_sync_locked_path(self, request, journal):
         """Sync SGD: accumulate grads_to_wait pushes, reject stale ones
         (reference ps/servicer.py:166-236; sparse grads are summed, as
         there — each worker contributes disjoint-sign updates to the
@@ -256,6 +309,16 @@ class PserverServicer:
             version = self._store.version
             if grad_version < version - self._sync_tolerance:
                 self._m_push_rejected.inc()
+                journal.append((
+                    "stale_push_rejected",
+                    dict(
+                        worker=(
+                            request.worker_id
+                            if request.HasField("worker_id") else -1
+                        ),
+                        version=grad_version, store_version=version,
+                    ),
+                ))
                 return pb.PushGradientsResponse(
                     accepted=False, version=version
                 )
@@ -298,6 +361,11 @@ class PserverServicer:
                     for e in same_worker
                 ):
                     self._m_push_dropped_dead.inc()
+                    journal.append((
+                        "dead_incarnation_dropped",
+                        dict(worker=request.worker_id,
+                             incarnation=incarnation, version=version),
+                    ))
                     logger.warning(
                         "sync PS: dropping a delayed push from worker "
                         "%d's dead incarnation %d (a newer incarnation "
@@ -322,6 +390,32 @@ class PserverServicer:
             for name, slices in request.gradients.embedding_tables.items():
                 tables[name] = deserialize_indexed_slices(slices)
             entry = (key, tables, push_scale)
+            if events.enabled():
+                # round_open on the first push buffered toward THIS
+                # round (per-tag for scoped pushers: concurrent tags
+                # each get their open, so the postmortem's opened vs
+                # closed counts balance), round_fill on every buffered
+                # push — the journal answer to "why did the sync round
+                # stop filling"
+                if request.round_scoped:
+                    opened = not self._round_groups.get(grad_version)
+                else:
+                    opened = not self._round_buffer
+                if opened:
+                    journal.append(
+                        ("round_open", dict(version=grad_version))
+                    )
+                journal.append((
+                    "round_fill",
+                    dict(
+                        version=grad_version,
+                        fill=self._buffered_count() + 1,
+                        worker=(
+                            request.worker_id
+                            if request.HasField("worker_id") else -1
+                        ),
+                    ),
+                ))
             if request.round_scoped:
                 group = self._round_groups.setdefault(grad_version, [])
                 if key is not None:
@@ -337,14 +431,14 @@ class PserverServicer:
                         accepted=True, version=version
                     )
                 del self._round_groups[grad_version]
-                self._apply_round_locked(group)
+                self._apply_round_locked(group, journal)
             else:
                 self._round_buffer.append(entry)
                 if len(self._round_buffer) < self._grads_to_wait:
                     return pb.PushGradientsResponse(
                         accepted=True, version=version
                     )
-                self._apply_round_locked(self._round_buffer)
+                self._apply_round_locked(self._round_buffer, journal)
                 self._round_buffer = []
             self._store.bump_version()
             version = self._store.version
@@ -378,14 +472,19 @@ class PserverServicer:
                     del self._round_groups[tag]
                 return
 
-    def _apply_round_locked(self, entries):
+    def _apply_round_locked(self, entries, journal):
         """Merge and apply one completed round's buffered pushes.
-        Caller holds the push lock and bumps the store version."""
+        Caller holds the push lock and bumps the store version;
+        ``journal`` collects events the caller emits after release."""
         with trace.span(
             "ps_apply_round", version=self._store.version,
             pushes=len(entries),
         ):
             self._merge_apply_locked(entries)
+        journal.append((
+            "round_close",
+            dict(version=self._store.version, pushes=len(entries)),
+        ))
         # GC scoped groups that can never fill: their tag is already
         # older than anything the stale check would admit (the check
         # rejects tags < version - tolerance, and version only grows)
@@ -434,6 +533,8 @@ class PserverServicer:
         ):
             try:
                 self._checkpoint_saver.save(version, self._store)
+                events.emit("checkpoint_saved", version=version,
+                            kind="sparse")
             except Exception:
                 logger.exception("sparse checkpoint failed")
 

@@ -34,7 +34,7 @@ def main(argv=None):
 
     args = parse_worker_args(argv)
     configure_logging(args.log_level, args.log_file_path)
-    from elasticdl_tpu.observability import http_server, trace
+    from elasticdl_tpu.observability import events, http_server, trace
 
     if args.metrics_port:
         # publish the knob before any instrument (or instrumented
@@ -42,6 +42,11 @@ def main(argv=None):
         # at first touch
         os.environ[http_server.PORT_ENV] = str(args.metrics_port)
     trace.configure("worker-%d" % args.worker_id)
+    events.configure("worker-%d" % args.worker_id)
+    # black box discipline (ISSUE 3): a K8s eviction (SIGTERM) or an
+    # uncaught exception dumps the event ring and flushes the journal +
+    # trace buffer, so the killed pod's last moments survive it
+    events.install_crash_hooks()
     master_client = MasterClient(
         args.master_addr,
         worker_id=args.worker_id,
@@ -61,6 +66,10 @@ def main(argv=None):
     # The response carries this worker_id's master-assigned relaunch
     # epoch — the push incarnation the sync PS orders relaunches by.
     master_client.reset_worker()
+    events.emit(
+        "role_start", worker=args.worker_id,
+        epoch=master_client.incarnation or 0,
+    )
     multihost_runtime = None
     if args.multihost:
         # must run BEFORE any jax backend initialization
@@ -174,9 +183,17 @@ def main(argv=None):
         logger.warning("Restarting for new mesh epoch: %s", e)
         import logging
 
-        trace.flush()  # os._exit skips atexit; don't lose the buffer
+        events.emit(
+            "mesh_epoch_restart", worker=args.worker_id,
+            epoch=master_client.incarnation or 0, reason=str(e)[:200],
+        )
+        # os._exit skips atexit; don't lose either buffer
+        events.flush()
+        trace.flush()
         logging.shutdown()
         os._exit(EPOCH_RESTART_EXIT_CODE)
+    events.emit("role_stop", worker=args.worker_id)
+    events.flush()
     return 0
 
 

@@ -36,6 +36,25 @@ class MasterClient:
         self._incarnation = None
         # readiness signal for /readyz: True once any RPC round-tripped
         self._channel_ok = False
+        # fleet-telemetry piggyback (ISSUE 3): a callable returning a
+        # pb.TelemetryBlob (or None to skip) that get_task /
+        # report_task_result / get_comm_info attach to their requests —
+        # the worker/PS sets it; no extra RPC is ever made for
+        # telemetry. EDL_TELEMETRY=0 disables at the source.
+        self.telemetry_provider = None
+
+    def _attach_telemetry(self, request):
+        provider = self.telemetry_provider
+        if provider is None:
+            return request
+        try:
+            blob = provider()
+        except Exception:
+            logger.warning("telemetry provider failed", exc_info=True)
+            return request
+        if blob is not None:
+            request.telemetry.CopyFrom(blob)
+        return request
 
     @property
     def worker_id(self):
@@ -65,6 +84,7 @@ class MasterClient:
         request = pb.GetTaskRequest(worker_id=self._worker_id)
         if task_type is not None:
             request.task_type = task_type
+        self._attach_telemetry(request)
         deadline_misses = 0
         while True:
             try:
@@ -94,6 +114,7 @@ class MasterClient:
             err_message=err_message,
             worker_id=self._worker_id,
         )
+        self._attach_telemetry(request)
         for key, value in (exec_counters or {}).items():
             request.exec_counters[key] = str(value)
         try:
@@ -149,8 +170,11 @@ class MasterClient:
     def get_comm_info(self):
         try:
             info = self._stub.get_comm_info(
-                pb.GetCommInfoRequest(
-                    worker_id=self._worker_id, worker_host=self._worker_host
+                self._attach_telemetry(
+                    pb.GetCommInfoRequest(
+                        worker_id=self._worker_id,
+                        worker_host=self._worker_host,
+                    )
                 ),
                 timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS,
             )

@@ -24,8 +24,12 @@ class TaskDataService:
         self._reader = data_reader
         self._wait_sleep_secs = wait_sleep_secs
         self._lock = threading.Lock()
-        # deque of [task, records_total, records_reported]
+        # deque of [task, records_total, records_reported, fetched_at]
         self._pending_tasks = collections.deque()
+        # wall-clock duration of the most recently completed task
+        # (fetch -> fully reported): the last_task_seconds field of the
+        # fleet-telemetry blob. 0.0 until a task completes.
+        self.last_task_seconds = 0.0
         # bumped whenever a stream is (re)opened or failed: the stream
         # producer runs on a prefetch thread, and without a generation
         # check it could fetch one more task AFTER report_pending_failed
@@ -98,7 +102,9 @@ class TaskDataService:
                     stale = task  # fetched in the failure window
                 else:
                     stale = None
-                    self._pending_tasks.append([task, total, 0])
+                    self._pending_tasks.append(
+                        [task, total, 0, time.time()]
+                    )
             if stale is not None:
                 # hand it straight back so it requeues for a live worker
                 self._mc.report_task_result(
@@ -115,12 +121,13 @@ class TaskDataService:
         with self._lock:
             while count > 0 and self._pending_tasks:
                 entry = self._pending_tasks[0]
-                task, total, reported = entry
+                task, total, reported, fetched_at = entry
                 take = min(count, total - reported)
                 entry[2] += take
                 count -= take
                 if entry[2] >= total:
                     self._pending_tasks.popleft()
+                    self.last_task_seconds = time.time() - fetched_at
                     done.append(task)
         for task in done:
             self._mc.report_task_result(task.task_id, "")

@@ -19,6 +19,7 @@ import numpy as np
 
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.observability import trace
 from elasticdl_tpu.data.pipeline import (
@@ -351,6 +352,22 @@ class Worker:
         # last mesh epoch seen by the heartbeat; the training loop reads
         # this instead of issuing its own get_comm_info RPC per probe
         self._seen_mesh_epoch = None
+        # Fleet telemetry (ISSUE 3): a compact blob piggybacked on the
+        # master RPCs this worker already makes — the master's
+        # straggler/dead-air detectors compare these across the fleet.
+        # Cost: two time.time() calls + a few float ops per BATCH (not
+        # per compiled step) and one tiny proto per RPC; EDL_TELEMETRY=0
+        # opts out entirely.
+        self._telemetry_on = os.environ.get("EDL_TELEMETRY", "") != "0"
+        self._step_ewma = 0.0
+        self._last_examples_per_sec = 0.0
+        self._prev_batch_end = 0.0
+        self._telemetry_samples = 0
+        self._ewma_outlier_streak = 0
+        if self._telemetry_on and hasattr(
+            master_client, "telemetry_provider"
+        ):
+            master_client.telemetry_provider = self._telemetry_blob
 
     def _start_heartbeat(self, interval_secs=3.0):
         def beat():
@@ -366,6 +383,60 @@ class Worker:
 
     def _stop_heartbeat(self):
         self._heartbeat_stop.set()
+
+    def _telemetry_blob(self):
+        """The piggyback payload for MasterClient RPCs. Called on the
+        RPC path (get_task/report/heartbeat), never per step."""
+        return pb.TelemetryBlob(
+            role="worker-%d" % self._mc.worker_id,
+            step_time_ewma=self._step_ewma,
+            examples_per_sec=self._last_examples_per_sec,
+            last_task_seconds=self.tds.last_task_seconds,
+            model_version=self._version,
+        )
+
+    def _update_step_telemetry(self, real_count):
+        """Fold one finished batch into the telemetry EWMAs. Prefers
+        the Timing bridge's exact step duration (present when metrics
+        collection is on); falls back to the inter-batch wall delta —
+        every worker measures the same way, which is all the
+        straggler's fleet-relative comparison needs.
+
+        Outlier discipline: the first measured batch carries the jit
+        compile (20-40 s on TPU) and fallback deltas can swallow idle
+        task-boundary gaps; seeding/folding those would trip the fleet
+        straggler detector against a healthy worker. The first sample
+        is skipped outright; later samples >10x the EWMA are skipped
+        unless three arrive consecutively — a worker that is GENUINELY
+        10x degraded re-anchors after three steps, a one-off spike
+        never lands."""
+        now = time.time()
+        step_secs = self._timing.last_seconds.get("batch_process")
+        if step_secs is None and self._prev_batch_end > 0.0:
+            step_secs = now - self._prev_batch_end
+        self._prev_batch_end = now
+        if step_secs is None or step_secs <= 0:
+            return
+        self._telemetry_samples += 1
+        if self._telemetry_samples == 1:
+            return  # compile-carrying first batch
+        if (
+            self._step_ewma > 0.0
+            and step_secs > 10.0 * self._step_ewma
+            and step_secs > 1.0
+        ):
+            self._ewma_outlier_streak += 1
+            if self._ewma_outlier_streak < 3:
+                return
+            self._step_ewma = step_secs  # sustained: the new reality
+        else:
+            self._step_ewma = (
+                step_secs
+                if self._step_ewma == 0.0
+                else 0.9 * self._step_ewma + 0.1 * step_secs
+            )
+        self._ewma_outlier_streak = 0
+        self._last_examples_per_sec = real_count / step_secs
 
     def _check_mesh_epoch(self):
         """Elastic membership probe on the hot loops (the reference
@@ -402,6 +473,8 @@ class Worker:
             # aware path; fsdp/tp state is never gathered onto one host).
             state = self.trainer.checkpoint_state(state)
         self._checkpoint_mgr.save(self._version, state)
+        events.emit("checkpoint_saved", version=self._version,
+                    kind="dense")
 
     def _traced_train_step(self, batch):
         """One train step, timed (Timing bridge feeds the step-time
@@ -433,6 +506,8 @@ class Worker:
         ):
             self._save_checkpoint()
         real = batch_real_count(batch)
+        if self._telemetry_on:
+            self._update_step_telemetry(real)
         with self._timing.timeit("report_record"):
             self.tds.report_record_done(real)
         step_secs = self._timing.last_seconds.get("batch_process")

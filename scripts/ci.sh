@@ -66,6 +66,85 @@ with tempfile.TemporaryDirectory() as tmp:
 print("observability smoke OK")
 PYEOF
 
+echo "== tier 1d+: flight recorder smoke (/statusz /alerts + postmortem) =="
+# a real master + in-process worker with EDL_EVENTS_DIR set: the master
+# must serve the fleet snapshot and alert list, the roles must journal
+# lifecycle events, and scripts/postmortem.py must reconstruct a
+# non-empty ordered timeline from them (docs/OBSERVABILITY.md)
+EDL_EVENTS_DIR="$(mktemp -d)"
+export EDL_EVENTS_DIR
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, sys, tempfile, threading, urllib.request
+sys.path.insert(0, "tests")
+from test_utils import create_mnist_recordio
+from elasticdl_tpu.common.grpc_utils import find_free_port
+
+events_dir = os.environ["EDL_EVENTS_DIR"]
+from elasticdl_tpu.data.readers import RecordIODataReader
+from elasticdl_tpu.master.master import Master
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+with tempfile.TemporaryDirectory() as tmp:
+    create_mnist_recordio(tmp + "/f0.rec", num_records=96, seed=0)
+    master = Master(
+        "elasticdl_tpu.models.mnist", training_data=tmp,
+        records_per_task=32, num_epochs=1,
+        port=find_free_port(), metrics_port=find_free_port(),
+    )
+    master.prepare()
+    mc = MasterClient("localhost:%d" % master._port, worker_id=0)
+    mc.reset_worker()  # registration -> worker_register journaled
+    worker = Worker(
+        mc,
+        "elasticdl_tpu.models.mnist",
+        RecordIODataReader(data_dir=tmp),
+        minibatch_size=32, wait_sleep_secs=0.1,
+    )
+    thread = threading.Thread(target=worker.run, daemon=True)
+    thread.start()
+    rc = master.run(poll_secs=0.2, timeout_secs=180)
+    thread.join(timeout=30)
+    assert rc == 0, "job did not finish"
+    # master.run() stopped the server; restart exposition to curl the
+    # final fleet state the way an operator would mid-run
+    obs_port = find_free_port()
+    from elasticdl_tpu.observability.http_server import (
+        ObservabilityServer,
+    )
+    obs = ObservabilityServer("master", obs_port).start()
+    obs.add_json_handler(
+        "/statusz",
+        lambda: master.fleet_monitor.snapshot(
+            extra={"tasks": master.task_dispatcher.stats()}
+        ),
+    )
+    obs.add_json_handler("/alerts", master.fleet_monitor.alerts)
+    base = "http://localhost:%d" % obs.port
+    statusz = json.loads(
+        urllib.request.urlopen(base + "/statusz", timeout=5).read()
+    )
+    assert "worker-0" in statusz["fleet"], statusz
+    assert statusz["fleet"]["worker-0"]["model_version"] >= 3
+    assert statusz["tasks"]["done"]["training"] == 3
+    alerts = json.loads(
+        urllib.request.urlopen(base + "/alerts", timeout=5).read()
+    )
+    assert isinstance(alerts, list)
+    # save the final metrics snapshot for the postmortem to fold in
+    metrics = urllib.request.urlopen(
+        base + "/metrics", timeout=5
+    ).read().decode()
+    with open(os.path.join(events_dir, "master.metrics.txt"), "w") as f:
+        f.write(metrics)
+    obs.stop()
+print("flight recorder smoke OK")
+PYEOF
+python scripts/postmortem.py "$EDL_EVENTS_DIR" 2>/dev/null | tee /tmp/_postmortem.out | head -5 || true
+# non-empty ordered timeline with the task lifecycle threaded through
+grep -q "task_dispatch" /tmp/_postmortem.out
+grep -q "per-worker summary:" /tmp/_postmortem.out
+
 echo "== tier 2a: multi-chip SPMD dryrun (dp/fsdp, tp/sp, ep, pp, pp x tp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Postmortem: one dead job's artifacts -> one ordered incident timeline.
+
+Merges everything the flight recorder left under ``$EDL_EVENTS_DIR``:
+
+- per-role NDJSON event journals  (``<role>-<pid>.events.ndjson``)
+- crash-path ring dumps           (``<role>-<pid>.dump.json``)
+- optionally, final Prometheus /metrics snapshots saved as
+  ``*.metrics.txt`` (or passed via ``--metrics``), from which the alert
+  counters are summarized
+
+into a single timestamp-ordered timeline threaded by the correlation
+keys every event carries (``job`` / ``worker`` / ``task`` /
+``version``), plus a per-worker incident summary: relaunch epochs,
+requeued tasks, alerts raised against it, and its crash dump reason.
+One command turns "the job died overnight" into "worker-3 relaunched at
+epoch 7, its requeued task t41 stalled round 12, the master alerted
+stuck-round 8 s later".
+
+Usage:
+    python scripts/postmortem.py EVENTS_DIR [-o incident.json]
+
+The text report goes to stdout, the JSON report to ``-o`` (default
+``EVENTS_DIR/incident.json``). Exit code 1 when no events were found.
+"""
+
+import argparse
+import collections
+import glob
+import json
+import os
+import sys
+
+
+def _parse_ndjson(text):
+    """Tolerant NDJSON parse: a torn final line from a SIGKILLed role
+    is skipped, not fatal — partial journals are the expected input."""
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue  # torn tail write from a killed role
+        if isinstance(record, dict):
+            records.append(record)
+    return records
+
+
+def load_journals(events_dir):
+    """All journal events, each stamped with its source file."""
+    loaded = []
+    for path in sorted(glob.glob(
+        os.path.join(events_dir, "*.events.ndjson")
+    )):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                records = _parse_ndjson(f.read())
+        except OSError as e:
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+            continue
+        name = os.path.basename(path)
+        for record in records:
+            record.setdefault("source", name)
+        loaded.extend(records)
+    return loaded
+
+
+def load_dumps(events_dir):
+    """Crash-dump events + the dump headers (role, pid, reason)."""
+    dump_events = []
+    headers = []
+    for path in sorted(glob.glob(
+        os.path.join(events_dir, "*.dump.json")
+    )):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+            continue
+        name = os.path.basename(path)
+        headers.append(
+            {
+                "source": name,
+                "role": payload.get("role"),
+                "pid": payload.get("pid"),
+                "reason": payload.get("reason"),
+                "dumped_at": payload.get("dumped_at"),
+                "events": len(payload.get("events", ())),
+            }
+        )
+        for record in payload.get("events", ()):
+            if isinstance(record, dict):
+                record.setdefault("source", name)
+                dump_events.append(record)
+    return dump_events, headers
+
+
+def dedupe(events):
+    """Journal + dump overlap (dumps re-record the journaled tail):
+    keep one copy per (role, pid, seq); events without a seq pass
+    through untouched. Journal copies win (listed first by caller)."""
+    seen = set()
+    unique = []
+    for event in events:
+        key = (event.get("role"), event.get("pid"), event.get("seq"))
+        if key[2] is not None:
+            if key in seen:
+                continue
+            seen.add(key)
+        unique.append(event)
+    return unique
+
+
+def build_timeline(events):
+    """Timestamp-ordered (ties: role, seq) merged event list."""
+    return sorted(
+        events,
+        key=lambda e: (
+            e.get("ts", 0.0), str(e.get("role", "")), e.get("seq", 0)
+        ),
+    )
+
+
+def load_metrics_snapshots(paths):
+    """Alert counters out of saved Prometheus text snapshots:
+    {series_line: value} for every edl_master_alerts* sample."""
+    counters = {}
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+            continue
+        for line in text.splitlines():
+            if line.startswith("edl_master_alerts"):
+                parts = line.rsplit(None, 1)
+                if len(parts) == 2:
+                    try:
+                        counters[parts[0]] = float(parts[1])
+                    except ValueError:
+                        pass
+    return counters
+
+
+def summarize(timeline, dump_headers):
+    """Per-worker incident summary threaded by the correlation keys."""
+    workers = collections.defaultdict(lambda: {
+        "registrations": [], "requeued_tasks": [], "alerts": [],
+        "presumed_dead": 0, "dump": None,
+    })
+    rounds = {"opened": 0, "closed": 0, "stale_rejected": 0}
+    job_failed = None
+    for event in timeline:
+        kind = event.get("event")
+        worker = event.get("worker")
+        if kind == "worker_register":
+            workers[worker]["registrations"].append(event.get("epoch"))
+        elif kind == "task_requeue":
+            workers[worker]["requeued_tasks"].append(event.get("task"))
+        elif kind == "worker_presumed_dead":
+            workers[worker]["presumed_dead"] += 1
+        elif kind == "alert_raised":
+            target = event.get("target")
+            try:
+                target = int(target)
+            except (TypeError, ValueError):
+                pass
+            workers[target]["alerts"].append(event.get("alert"))
+        elif kind == "round_open":
+            rounds["opened"] += 1
+        elif kind == "round_close":
+            rounds["closed"] += 1
+        elif kind == "stale_push_rejected":
+            rounds["stale_rejected"] += 1
+        elif kind == "job_failed":
+            job_failed = event
+    for header in dump_headers:
+        role = header.get("role") or ""
+        # worker dumps are keyed by the role's worker id when present
+        for worker, entry in workers.items():
+            if role == "worker-%s" % worker:
+                entry["dump"] = header.get("reason")
+    return {
+        "workers": {str(k): v for k, v in sorted(
+            workers.items(), key=lambda kv: str(kv[0])
+        )},
+        "rounds": rounds,
+        "job_failed": job_failed,
+    }
+
+
+def render_text(timeline, summary, dump_headers, alert_counters):
+    """Human-readable incident report."""
+    lines = []
+    if timeline:
+        t0 = timeline[0].get("ts", 0.0)
+        lines.append(
+            "incident timeline (%d events, t0=%s):"
+            % (len(timeline), t0)
+        )
+        for event in timeline:
+            detail = {
+                k: v for k, v in event.items()
+                if k not in ("ts", "role", "pid", "seq", "event",
+                             "source", "job")
+            }
+            lines.append(
+                "  [%+10.3fs] %-12s %-22s %s"
+                % (
+                    event.get("ts", t0) - t0,
+                    str(event.get("role", "?")),
+                    str(event.get("event", "?")),
+                    " ".join(
+                        "%s=%s" % (k, v) for k, v in sorted(detail.items())
+                    ),
+                )
+            )
+    else:
+        lines.append("incident timeline: no events found")
+    if dump_headers:
+        lines.append("crash dumps:")
+        for header in dump_headers:
+            lines.append(
+                "  %s: reason=%s events=%d"
+                % (header["source"], header["reason"], header["events"])
+            )
+    if alert_counters:
+        lines.append("alert counters (final /metrics snapshot):")
+        for series, value in sorted(alert_counters.items()):
+            lines.append("  %s = %g" % (series, value))
+    lines.append("per-worker summary:")
+    for worker, entry in summary["workers"].items():
+        lines.append(
+            "  worker %s: epochs=%s requeued=%s alerts=%s "
+            "presumed_dead=%d dump=%s"
+            % (
+                worker, entry["registrations"], entry["requeued_tasks"],
+                entry["alerts"], entry["presumed_dead"], entry["dump"],
+            )
+        )
+    if summary["rounds"]["opened"] or summary["rounds"]["stale_rejected"]:
+        lines.append("  sync rounds: %r" % (summary["rounds"],))
+    if summary["job_failed"]:
+        lines.append("  JOB FAILED: %r" % (summary["job_failed"],))
+    return "\n".join(lines)
+
+
+def postmortem(events_dir, metrics_paths=()):
+    """The whole pipeline; returns the JSON-ready incident report."""
+    journal_events = load_journals(events_dir)
+    dump_events, dump_headers = load_dumps(events_dir)
+    timeline = build_timeline(dedupe(journal_events + dump_events))
+    metrics_paths = list(metrics_paths) or sorted(
+        glob.glob(os.path.join(events_dir, "*.metrics.txt"))
+    )
+    alert_counters = load_metrics_snapshots(metrics_paths)
+    summary = summarize(timeline, dump_headers)
+    return {
+        "events_dir": events_dir,
+        "timeline": timeline,
+        "dumps": dump_headers,
+        "alert_counters": alert_counters,
+        "summary": summary,
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("events_dir", help="EDL_EVENTS_DIR of the run")
+    parser.add_argument(
+        "-o", "--output", default="",
+        help="write the JSON report here "
+             "(default: EVENTS_DIR/incident.json)",
+    )
+    parser.add_argument(
+        "--metrics", action="append", default=[],
+        help="saved /metrics snapshot(s) to fold in (default: "
+             "EVENTS_DIR/*.metrics.txt)",
+    )
+    args = parser.parse_args(argv)
+    report = postmortem(args.events_dir, args.metrics)
+    out = args.output or os.path.join(args.events_dir, "incident.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=2)
+    # text to stdout, JSON to the file: both shapes, one command
+    print(render_text(
+        report["timeline"], report["summary"], report["dumps"],
+        report["alert_counters"],
+    ))
+    print(
+        "postmortem: %d events -> %s" % (len(report["timeline"]), out),
+        file=sys.stderr,
+    )
+    return 0 if report["timeline"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

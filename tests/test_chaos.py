@@ -3,11 +3,15 @@ master's liveness detection recovers them and a surviving worker drains
 the job. The reference had no fault-injection tests at all (SURVEY.md
 §5 "fault injection: none; CI relies on natural preemption")."""
 
+import json
 import os
+import signal
 import subprocess
 import sys
 import threading
 import time
+
+import pytest
 
 from elasticdl_tpu.common.grpc_utils import build_server, find_free_port
 from elasticdl_tpu.data.readers import RecordIODataReader
@@ -86,6 +90,174 @@ def test_worker_crash_recovers_and_job_completes(tmp_path):
     finally:
         monitor.stop()
         server.stop(0)
+
+
+VICTIM = r"""
+import sys, time
+sys.path.insert(0, %(repo)r)
+from elasticdl_tpu.observability import events
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.worker.master_client import MasterClient
+
+events.configure("worker-1")
+events.install_crash_hooks()
+mc = MasterClient(%(addr)r, worker_id=1)
+mc.telemetry_provider = lambda: pb.TelemetryBlob(
+    role="worker-1", step_time_ewma=0.1, model_version=1)
+mc.reset_worker()
+events.emit("role_start", worker=1, epoch=mc.incarnation or 0)
+task = mc.get_task()
+assert task.task_id != 0, "no task to hold"
+print("READY", flush=True)
+while True:  # heartbeat mid-round until killed
+    mc.get_comm_info()
+    time.sleep(0.2)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kill_signal",
+                         [signal.SIGTERM, signal.SIGKILL])
+def test_worker_kill_fires_dead_air_and_leaves_flight_record(
+    tmp_path, monkeypatch, kill_signal,
+):
+    """ISSUE 3 chaos acceptance: kill a real worker process mid-round;
+    the master's fleet monitor must raise a dead-air alert within the
+    detection window (counter incremented), the victim's flight record
+    must be on disk (journal always; ring dump for the SIGTERM/eviction
+    path — SIGKILL can't run hooks, write-through covers it), and
+    scripts/postmortem.py must thread one timeline spanning the
+    victim's record, the master's requeue, and the alert."""
+    from elasticdl_tpu.master.fleet import FleetMonitor
+    from elasticdl_tpu.observability import events
+    from elasticdl_tpu.observability import metrics as obs_metrics
+    from tests.test_utils import create_mnist_recordio
+
+    events_dir = tmp_path / "events"
+    events_dir.mkdir()
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=128,
+                          seed=0)
+    reader = RecordIODataReader(data_dir=str(train_dir))
+
+    monkeypatch.setenv(events.EVENTS_DIR_ENV, str(events_dir))
+    monkeypatch.setenv("EDL_METRICS", "1")
+    obs_metrics.reset_default_registry()
+    events.configure("master")
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(), records_per_task=64,
+        num_epochs=1, seed=0,
+    )
+    fleet = FleetMonitor(
+        straggler_factor=3.0, dead_air_secs=1.5,
+        stuck_round_secs=60.0, version_lag_max=1000,
+    )
+    servicer = MasterServicer(dispatcher, fleet_monitor=fleet)
+    monitor = TaskMonitor(
+        dispatcher, servicer, None, liveness_timeout_secs=4.0,
+        scan_interval_secs=0.2, fleet_monitor=fleet,
+    )
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    monitor.start()
+    victim = None
+    try:
+        victim = subprocess.Popen(
+            [sys.executable, "-c", VICTIM % {
+                "repo": os.path.dirname(os.path.dirname(__file__)),
+                "addr": "localhost:%d" % port,
+            }],
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 events.EVENTS_DIR_ENV: str(events_dir)},
+            stdout=subprocess.PIPE, text=True,
+        )
+        assert victim.stdout.readline().strip() == "READY"
+        assert dispatcher.doing_tasks(), "victim held no task"
+
+        # chaos: kill the worker process mid-round
+        victim.send_signal(kill_signal)
+        victim.wait(timeout=30)
+        killed_at = time.time()
+
+        # the dead-air detector must fire within its window (the scan
+        # thread evaluates every 0.2 s; window is 1.5 s of silence)
+        deadline = killed_at + 10
+        fired = None
+        while time.time() < deadline:
+            fired = [
+                a for a in fleet.alerts()
+                if a["alert"] == "dead_air" and a["worker_id"] == 1
+            ]
+            if fired:
+                break
+            time.sleep(0.1)
+        assert fired, "dead-air alert never fired for the victim"
+        assert time.time() - killed_at < 10, "detection too slow"
+        counter = obs_metrics.default_registry().get(
+            "edl_master_alerts_total"
+        )
+        assert counter.get("dead_air") >= 1
+
+        # the victim's flight record survived it
+        journals = [
+            name for name in os.listdir(str(events_dir))
+            if name.startswith("worker-1") and
+            name.endswith(".events.ndjson")
+        ]
+        assert journals, "victim journal missing"
+        with open(str(events_dir / journals[0])) as f:
+            victim_events = [json.loads(line) for line in f]
+        assert any(e["event"] == "role_start" for e in victim_events)
+        dumps = [
+            name for name in os.listdir(str(events_dir))
+            if name.startswith("worker-1") and
+            name.endswith(".dump.json")
+        ]
+        if kill_signal == signal.SIGTERM:
+            # the crash hook dumped the ring on the way down
+            assert dumps, "victim ring dump missing after SIGTERM"
+            with open(str(events_dir / dumps[0])) as f:
+                assert json.load(f)["reason"] == "sigterm"
+
+        # liveness recovery requeues the orphaned task -> journaled
+        deadline = time.time() + 15
+        while dispatcher.doing_tasks() and time.time() < deadline:
+            time.sleep(0.1)
+        assert not dispatcher.doing_tasks(), "task never recovered"
+    finally:
+        monitor.stop()
+        server.stop(0)
+        if victim is not None and victim.poll() is None:
+            victim.kill()
+        events.flush()
+
+    # postmortem threads one correlation-keyed timeline across the
+    # victim's record, the master's requeue, and the alert
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "scripts"
+    ))
+    try:
+        import postmortem
+    finally:
+        sys.path.pop(0)
+    report = postmortem.postmortem(str(events_dir))
+    events._reset_for_tests()
+    kinds = {e["event"] for e in report["timeline"]}
+    assert {"role_start", "worker_register", "task_dispatch",
+            "alert_raised", "task_requeue",
+            "worker_presumed_dead"} <= kinds, kinds
+    timeline_ts = [e.get("ts", 0) for e in report["timeline"]]
+    assert timeline_ts == sorted(timeline_ts)
+    worker1 = report["summary"]["workers"]["1"]
+    assert worker1["registrations"], "victim registration not threaded"
+    assert worker1["requeued_tasks"], "requeue not threaded"
+    assert "dead_air" in worker1["alerts"]
+    if kill_signal == signal.SIGTERM:
+        assert worker1["dump"] == "sigterm"
 
 
 def test_ps_crash_restart_job_completes(tmp_path):

@@ -1,6 +1,8 @@
-"""Observability subsystem (ISSUE 2): registry semantics, Prometheus
-exposition, health/readiness endpoints, RPC interceptors on a live
-in-process master<->worker channel, and the trace-merge round trip."""
+"""Observability subsystem (ISSUE 2 + the ISSUE 3 flight recorder):
+registry semantics, Prometheus exposition, health/readiness endpoints,
+RPC interceptors on a live in-process master<->worker channel, the
+trace-merge round trip, the structured event journal, and the master's
+fleet telemetry + anomaly detectors behind /statusz and /alerts."""
 
 import json
 import sys
@@ -9,6 +11,7 @@ import urllib.request
 import numpy as np
 import pytest
 
+from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.observability import trace
 from elasticdl_tpu.observability.http_server import ObservabilityServer
@@ -336,6 +339,415 @@ def test_span_is_inert_without_trace_dir(monkeypatch):
     trace.instant("nope")
     trace.complete("nope", 0.0)
     assert not trace.enabled()
+
+
+# ---------------------------------------------------------------------------
+# structured event journal (ISSUE 3 flight recorder)
+
+
+@pytest.fixture
+def journal_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(events.EVENTS_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(events.JOB_NAME_ENV, "test-job")
+    yield tmp_path
+    events._reset_for_tests()
+
+
+def _read_journal(path):
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_journal_is_write_through_ndjson(journal_dir):
+    """Every emit is on disk before the call returns — the SIGKILL
+    guarantee: no flush() needed to observe the lines."""
+    journal = events.configure("worker-0")
+    events.emit("role_start", worker=0, epoch=7)
+    events.emit("task_dispatch", task=41, worker=0)
+    records = _read_journal(journal.path)
+    assert [r["event"] for r in records] == ["role_start",
+                                             "task_dispatch"]
+    first = records[0]
+    assert first["role"] == "worker-0" and first["job"] == "test-job"
+    assert first["seq"] == 1 and first["ts"] > 0
+    assert records[1]["task"] == 41
+
+
+def test_emit_unknown_event_type_raises(journal_dir):
+    events.configure("worker-0")
+    with pytest.raises(ValueError):
+        events.emit("not_a_real_event")
+
+
+def test_journal_inert_without_events_dir(monkeypatch, tmp_path):
+    monkeypatch.delenv(events.EVENTS_DIR_ENV, raising=False)
+    assert events.configure("worker-0") is None
+    assert not events.enabled()
+    events.emit("role_start")  # no-op, no crash, nothing written
+    events.flush()
+    assert events.dump("whatever") is None
+    assert not list(tmp_path.iterdir())
+
+
+def test_ring_dump_is_bounded_and_first_reason_wins(journal_dir):
+    journal = events.configure("ps-0")
+    for i in range(events._RING_SIZE + 50):
+        events.emit("round_fill", version=i, fill=1, worker=0)
+    path = events.dump("sigterm")
+    assert path == journal.dump_path
+    # a later crash path must not overwrite the original cause
+    assert events.dump("uncaught:RuntimeError") is None
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    assert payload["reason"] == "sigterm"
+    assert payload["role"] == "ps-0"
+    assert len(payload["events"]) == events._RING_SIZE
+    # the ring holds the LAST K events
+    assert payload["events"][-1]["version"] == events._RING_SIZE + 49
+
+
+def test_excepthook_dumps_ring(journal_dir, monkeypatch):
+    journal = events.configure("worker-2")
+    events.emit("role_start", worker=2)
+    monkeypatch.setattr(events, "_hooks_installed", False)
+    calls = []
+    monkeypatch.setattr(sys, "excepthook",
+                        lambda *a: calls.append(a))
+    events.install_crash_hooks()
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError:
+        sys.excepthook(*sys.exc_info())
+    assert calls, "original excepthook must still run"
+    with open(journal.dump_path, encoding="utf-8") as f:
+        assert json.load(f)["reason"] == "uncaught:RuntimeError"
+
+
+# ---------------------------------------------------------------------------
+# fleet telemetry + anomaly detectors (master/fleet.py)
+
+
+def _blob(role="", **kw):
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    return pb.TelemetryBlob(role=role, **kw)
+
+
+def _fleet(**kw):
+    from elasticdl_tpu.master.fleet import FleetMonitor
+
+    defaults = dict(
+        straggler_factor=3.0, dead_air_secs=60.0,
+        stuck_round_secs=60.0, version_lag_max=100,
+    )
+    defaults.update(kw)
+    return FleetMonitor(**defaults)
+
+
+def test_straggler_fires_only_against_a_fleet():
+    fleet = _fleet()
+    fleet.observe(0, _blob(step_time_ewma=0.1))
+    fleet.observe(1, _blob(step_time_ewma=0.9))
+    assert fleet.evaluate() == []  # two workers: no median to trust
+    fleet.observe(2, _blob(step_time_ewma=0.1))
+    firing = fleet.evaluate()
+    assert [a["alert"] for a in firing] == ["straggler"]
+    assert firing[0]["worker_id"] == 1
+    # the straggler recovers -> the alert clears
+    fleet.observe(1, _blob(step_time_ewma=0.12))
+    assert fleet.evaluate() == []
+
+
+def test_dead_air_fires_after_window_and_clears_on_forget():
+    fleet = _fleet(dead_air_secs=0.05)
+    fleet.observe(0, _blob(role="worker-0"))
+    import time
+
+    time.sleep(0.1)
+    firing = fleet.evaluate()
+    assert [a["alert"] for a in firing] == ["dead_air"]
+    assert firing[0]["role"] == "worker-0"
+    fleet.forget(0)
+    assert fleet.evaluate() == []
+
+
+def test_eviction_forces_dead_air_tombstone(monkeypatch):
+    """A fast-task job's 3x-average task timeout can evict a dead
+    worker BEFORE the dead-air window elapses (observed live: avg task
+    0.25 s -> eviction at 0.75 s vs a 3 s window). The eviction must
+    force the transition — counter + journal + a tombstone on /alerts
+    — never silently erase the story."""
+    monkeypatch.setenv("EDL_METRICS", "1")
+    obs_metrics.reset_default_registry()
+    try:
+        fleet = _fleet(dead_air_secs=60.0)  # window far in the future
+        fleet.observe(1, _blob(role="worker-1"))
+        assert fleet.evaluate() == []
+        fleet.mark_dead(1)  # task monitor eviction beat the window
+        firing = fleet.evaluate()
+        assert [a["alert"] for a in firing] == ["dead_air"]
+        assert firing[0]["evicted"] is True
+        assert firing[0]["role"] == "worker-1"
+        counter = obs_metrics.default_registry().get(
+            "edl_master_alerts_total"
+        )
+        assert counter.get("dead_air") == 1
+        # the tombstone persists while the worker stays gone...
+        assert fleet.evaluate(), "tombstone must not self-clear"
+        # ...and clears when a relaunch re-registers the worker_id
+        fleet.observe(1, _blob(role="worker-1"))
+        assert fleet.evaluate() == []
+    finally:
+        obs_metrics.reset_default_registry()
+
+
+def test_stuck_round_fires_when_fill_stalls():
+    fleet = _fleet(stuck_round_secs=0.05)
+    fleet.observe(-1, _blob(role="ps-0", round_buffer_fill=2,
+                            model_version=5))
+    import time
+
+    time.sleep(0.1)
+    assert [a["alert"] for a in fleet.evaluate()] == ["stuck_round"]
+    # the round completes (fill empties, version advances): clears
+    fleet.observe(-1, _blob(role="ps-0", round_buffer_fill=0,
+                            model_version=6))
+    assert fleet.evaluate() == []
+
+
+def test_version_lag_runaway_fires():
+    fleet = _fleet(version_lag_max=10)
+    fleet.observe(-1, _blob(role="ps-0", version_lag=50))
+    assert [a["alert"] for a in fleet.evaluate()] == ["version_lag"]
+
+
+def test_alert_transitions_bump_counter_once(monkeypatch):
+    monkeypatch.setenv("EDL_METRICS", "1")
+    obs_metrics.reset_default_registry()
+    try:
+        fleet = _fleet(version_lag_max=10)
+        fleet.observe(-1, _blob(role="ps-0", version_lag=50))
+        fleet.evaluate()
+        fleet.evaluate()  # still firing: edge-triggered, no re-count
+        counter = obs_metrics.default_registry().get(
+            "edl_master_alerts_total"
+        )
+        assert counter.get("version_lag") == 1
+        text = obs_metrics.default_registry().render()
+        assert "edl_master_alerts_firing 1" in text
+    finally:
+        obs_metrics.reset_default_registry()
+
+
+def test_snapshot_carries_fleet_and_extras():
+    fleet = _fleet()
+    fleet.observe(0, _blob(role="worker-0", step_time_ewma=0.25,
+                           model_version=12))
+    body = fleet.snapshot(extra={"tasks": {"pending": 3}})
+    json.dumps(body)  # must be JSON-ready
+    entry = body["fleet"]["worker-0"]
+    assert entry["step_time_ewma"] == pytest.approx(0.25)
+    assert entry["model_version"] == 12
+    assert body["tasks"] == {"pending": 3}
+    assert body["thresholds"]["straggler_factor"] == 3.0
+
+
+def test_statusz_and_alerts_served_over_http():
+    reg = Registry(enabled=True)
+    server = ObservabilityServer("master", 0, registry=reg).start()
+    try:
+        fleet = _fleet(dead_air_secs=0.01)
+        fleet.observe(3, _blob(role="worker-3"))
+        server.add_json_handler("/statusz", fleet.snapshot)
+        server.add_json_handler("/alerts", fleet.alerts)
+        import time
+
+        time.sleep(0.05)
+        base = "http://localhost:%d" % server.port
+        status, body = _get(base + "/statusz")
+        assert status == 200
+        snap = json.loads(body)
+        assert "worker-3" in snap["fleet"]
+        status, body = _get(base + "/alerts")
+        assert status == 200
+        alerts = json.loads(body)
+        assert [a["alert"] for a in alerts] == ["dead_air"]
+        # a broken handler degrades to 500, never kills the server
+        server.add_json_handler("/boom", lambda: 1 / 0)
+        assert _get(base + "/boom")[0] == 500
+        assert _get(base + "/healthz")[0] == 200
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# telemetry piggyback: servicer ingestion + worker/PS production
+
+
+def test_servicer_feeds_fleet_from_piggybacked_blobs():
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    fleet = _fleet()
+    dispatcher = TaskDispatcher({"s": (0, 64)}, records_per_task=32)
+    servicer = MasterServicer(dispatcher, fleet_monitor=fleet)
+    request = pb.GetTaskRequest(
+        worker_id=0,
+        telemetry=pb.TelemetryBlob(role="worker-0",
+                                   step_time_ewma=0.5),
+    )
+    servicer.get_task(request)
+    # a blob-less RPC is still a liveness sighting
+    servicer.report_task_result(
+        pb.ReportTaskResultRequest(task_id=1, worker_id=5)
+    )
+    snap = fleet.snapshot()
+    assert snap["fleet"]["worker-0"]["step_time_ewma"] == pytest.approx(
+        0.5
+    )
+    assert "worker-5" in snap["fleet"]
+
+
+def test_worker_telemetry_blob_reflects_training(tmp_path):
+    from elasticdl_tpu.data.readers import RecordIODataReader
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.worker.worker import Worker
+    from tests.test_utils import create_mnist_recordio
+
+    class LoopbackClient:
+        """In-process MasterClient twin with the telemetry surface."""
+
+        def __init__(self, servicer):
+            from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+            self._pb = pb
+            self._servicer = servicer
+            self.worker_id = 0
+            self.incarnation = None
+            self.telemetry_provider = None
+
+        def _req(self, cls, **kw):
+            request = cls(**kw)
+            if self.telemetry_provider is not None:
+                blob = self.telemetry_provider()
+                if blob is not None:
+                    request.telemetry.CopyFrom(blob)
+            return request
+
+        def get_task(self, task_type=None):
+            request = self._req(
+                self._pb.GetTaskRequest, worker_id=self.worker_id
+            )
+            if task_type is not None:
+                request.task_type = task_type
+            return self._servicer.get_task(request)
+
+        def report_task_result(self, task_id, err_message="",
+                               exec_counters=None):
+            self._servicer.report_task_result(
+                self._req(
+                    self._pb.ReportTaskResultRequest,
+                    task_id=task_id, err_message=err_message,
+                    worker_id=self.worker_id,
+                )
+            )
+
+        def report_version(self, version):
+            pass
+
+        def report_evaluation_metrics(self, *a, **kw):
+            pass
+
+        def get_comm_info(self):
+            return self._pb.CommInfo(rank=0, world_size=1,
+                                     mesh_epoch=0)
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=96,
+                          seed=0)
+    reader = RecordIODataReader(data_dir=str(train_dir))
+    fleet = _fleet()
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(), records_per_task=32,
+    )
+    servicer = MasterServicer(dispatcher, fleet_monitor=fleet)
+    worker = Worker(
+        LoopbackClient(servicer),
+        "tests.models.mnist_with_export",
+        reader,
+        minibatch_size=32,
+        wait_sleep_secs=0.05,
+    )
+    worker.run()
+    assert dispatcher.finished()
+    snap = fleet.snapshot()
+    entry = snap["fleet"]["worker-0"]
+    # the piggybacked blobs carried real training telemetry
+    assert entry["step_time_ewma"] > 0
+    assert entry["examples_per_sec"] > 0
+    assert entry["last_task_seconds"] > 0
+    assert entry["model_version"] >= 3
+
+
+def test_ps_telemetry_blob_reports_rates_and_fill():
+    servicer = _sync_ps_servicer(grads_to_wait=2)
+    first = servicer.telemetry_blob()
+    assert first.role == "ps-0" and first.push_rate == 0.0
+    # one buffered push: fill=1, rates computed over the window
+    servicer.push_gradients(_push_request(version=0, worker_id=1))
+    blob = servicer.telemetry_blob()
+    assert blob.round_buffer_fill == 1
+    assert blob.push_rate > 0
+    assert blob.model_version == 0
+
+
+def _sync_ps_servicer(grads_to_wait=2):
+    from elasticdl_tpu.ps.embedding_store import create_store
+    from elasticdl_tpu.ps.servicer import PserverServicer
+
+    store = create_store(seed=0, prefer_native=False)
+    store.set_optimizer("sgd", lr=0.1)
+    store.create_table("emb", 4, init_scale=0.05)
+    return PserverServicer(
+        store, use_async=False, grads_to_wait=grads_to_wait,
+    )
+
+
+def _push_request(version, worker_id=None):
+    from elasticdl_tpu.common.tensor_utils import ndarray_to_blob
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    request = pb.PushGradientsRequest()
+    request.gradients.version = version
+    slices = request.gradients.embedding_tables["emb"]
+    ndarray_to_blob(
+        np.ones((2, 4), np.float32), slices.concat_tensors
+    )
+    slices.ids.extend([0, 1])
+    if worker_id is not None:
+        request.worker_id = worker_id
+    return request
+
+
+def test_sync_round_lifecycle_is_journaled(tmp_path, monkeypatch):
+    monkeypatch.setenv(events.EVENTS_DIR_ENV, str(tmp_path))
+    journal = events.configure("ps-0")
+    try:
+        servicer = _sync_ps_servicer(grads_to_wait=2)
+        servicer.push_gradients(_push_request(version=0, worker_id=0))
+        servicer.push_gradients(_push_request(version=0, worker_id=1))
+        # now store version is 1: a version-0 push is stale
+        servicer.push_gradients(_push_request(version=0, worker_id=0))
+        kinds = [r["event"] for r in _read_journal(journal.path)]
+        assert kinds == [
+            "round_open", "round_fill", "round_fill", "round_close",
+            "stale_push_rejected",
+        ]
+    finally:
+        events._reset_for_tests()
 
 
 # ---------------------------------------------------------------------------

@@ -236,6 +236,70 @@ def test_hot_path_quiet_on_host_code_and_clean_jit():
 
 
 # ---------------------------------------------------------------------------
+# obs-hot-path
+
+def test_obs_hot_path_flags_logging_and_instrument_lookup():
+    findings = findings_for("""
+        import jax
+        from elasticdl_tpu.observability import metrics as obs_metrics
+        from elasticdl_tpu.common.log_utils import default_logger
+        logger = default_logger(__name__)
+
+        @jax.jit
+        def step(x):
+            logger.info("step %s", x)                      # BUG
+            obs_metrics.counter("steps_total", "n").inc()  # BUG: lookup
+            print(x)                                       # BUG
+            return x
+    """, rules=["obs-hot-path"])
+    assert {f.code for f in findings} == {
+        "logger.info", "obs_metrics.counter", "print"
+    }, findings
+    assert all(f.rule == "obs-hot-path" for f in findings)
+
+
+def test_obs_hot_path_covers_hot_annotated_factory_products():
+    findings = findings_for("""
+        from elasticdl_tpu.common.annotations import hot_path
+        from elasticdl_tpu.observability import metrics
+
+        @hot_path
+        def make_step(logger):
+            def step(x):
+                logger.warning("x=%s", x)          # BUG
+                h = metrics.histogram("lat", "l")  # BUG
+                return x
+            return step
+    """, rules=["obs-hot-path"])
+    assert {f.code for f in findings} == {
+        "logger.warning", "metrics.histogram"
+    }, findings
+
+
+def test_obs_hot_path_quiet_on_host_code_and_instrument_methods():
+    assert not findings_for("""
+        import jax
+        from elasticdl_tpu.observability import metrics as obs_metrics
+        from elasticdl_tpu.common.log_utils import default_logger
+        logger = default_logger(__name__)
+
+        # module scope: the supported place to construct instruments
+        STEPS = obs_metrics.counter("steps_total", "n")
+
+        def host_loop(batches):
+            logger.info("starting")     # host code: fine
+            for batch in batches:
+                STEPS.inc()
+
+        @jax.jit
+        def step(x):
+            STEPS.inc()                 # method on a hoisted
+            STEPS.labels()              # instrument: the supported
+            return x                    # hot surface
+    """, rules=["obs-hot-path"])
+
+
+# ---------------------------------------------------------------------------
 # ft-swallowed-except
 
 def test_swallowed_except_flags_silent_broad_handler():
@@ -436,6 +500,15 @@ _CLI_POSITIVE_FIXTURES = {
         @jax.jit
         def step(x):
             return x + time.time()
+    """),
+    "obs-hot-path": ("bad_obs.py", """
+        import jax
+        import logging
+
+        @jax.jit
+        def step(x):
+            logging.info("step")
+            return x
     """),
     "ft-swallowed-except": ("bad_except.py", """
         def poll(client):

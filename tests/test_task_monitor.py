@@ -97,3 +97,46 @@ def test_idle_mesh_member_evicted_on_silence():
     time.sleep(0.15)
     monitor._scan()
     assert rendezvous.hosts() == []
+
+
+def test_first_compile_task_survives_fast_fleet_average():
+    """The task-timeout threshold is floored at the liveness timeout: a
+    fleet of 0.1 s tasks must not drag the threshold so low that a
+    heartbeating fresh worker's first task (carrying its jit compile)
+    is falsely recovered (observed live in the ISSUE 3 chaos drive)."""
+    import time
+
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.master.task_monitor import TaskMonitor
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    dispatcher = TaskDispatcher(
+        training_shards={"t": (0, 64)}, records_per_task=2, num_epochs=1
+    )
+    servicer = MasterServicer(dispatcher, None)
+    monitor = TaskMonitor(
+        dispatcher, servicer, None, liveness_timeout_secs=0.6,
+        scan_interval_secs=0.05,
+    )
+    # train the rolling average down to "fast" (>= 20 samples)
+    for _ in range(24):
+        task = servicer.get_task(pb.GetTaskRequest(worker_id=1))
+        dispatcher.report(task.task_id, success=True, worker_id=1)
+    assert dispatcher.avg_task_duration() < 0.05
+    # a fresh worker takes its first task and compiles: slower than
+    # 3x the fleet average, but heartbeating the whole time
+    task = servicer.get_task(pb.GetTaskRequest(worker_id=2))
+    deadline = time.time() + 0.3  # > 3x avg, < the liveness floor
+    while time.time() < deadline:
+        servicer.get_comm_info(pb.GetCommInfoRequest(worker_id=2))
+        monitor._scan()
+        time.sleep(0.05)
+    assert task.task_id in dispatcher.doing_tasks(), (
+        "compile-length first task was falsely recovered"
+    )
+    # a worker that actually goes silent past the liveness floor is
+    # still recovered
+    time.sleep(0.7)
+    monitor._scan()
+    assert task.task_id not in dispatcher.doing_tasks()
