@@ -229,6 +229,7 @@ def _rules_by_name(names=None):
         "obs-hot-path": obs_hot_path.run,
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
+        "ft-retry-no-jitter": fault_tolerance.run_retry_no_jitter,
         "xhost-determinism": determinism.run,
     }
     if names is None:
@@ -245,6 +246,7 @@ RULE_NAMES = (
     "obs-hot-path",
     "ft-swallowed-except",
     "ft-grpc-timeout",
+    "ft-retry-no-jitter",
     "xhost-determinism",
 )
 

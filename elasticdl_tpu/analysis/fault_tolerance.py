@@ -15,6 +15,16 @@ recovery path gets control. Framework-aware heuristic: a call
 name chain contains "stub" (``self._stub.get_task``,
 ``stub.push_gradients``, ``self._stubs[i].pull``) — the naming
 convention this repo uses for every generated-client handle.
+
+``ft-retry-no-jitter`` — a retry loop that sleeps a deterministically
+GROWING backoff (``delay``, then ``delay = min(delay * 2, cap)``)
+without any randomness retries in lockstep across a fleet: every
+worker that lost the same PS at the same moment re-arrives at the same
+instants, re-forming the thundering herd at each interval. Heuristic:
+a ``while``/``for`` loop that (a) sleeps a Name, (b) reassigns that
+Name multiplicatively inside the same loop, and (c) contains no
+randomness (``random``/``uniform``/``jitter``/``retry_call``) — use
+``common.grpc_utils.retry_call`` (full jitter) instead.
 """
 
 import ast
@@ -88,6 +98,94 @@ def run_swallowed_except(units):
                     ),
                 )
             )
+    return findings
+
+
+_JITTER_MARKERS = ("random", "uniform", "jitter", "retry_call", "randint")
+
+
+def _slept_names(loop):
+    """Names passed to time.sleep()/sleep() inside a loop body."""
+    names = set()
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        chain = attr_chain(node.func)
+        if chain is None or chain.split(".")[-1] != "sleep":
+            continue
+        if isinstance(node.args[0], ast.Name):
+            names.add(node.args[0].id)
+    return names
+
+
+def _grows_multiplicatively(loop, name):
+    """True when ``name`` is reassigned inside the loop via a value
+    containing a multiplication (the exponential-backoff shape,
+    including ``min(delay * 2, cap)``)."""
+    for node in ast.walk(loop):
+        value = None
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        ):
+            value = node.value
+        elif (
+            isinstance(node, ast.AugAssign)
+            and isinstance(node.target, ast.Name)
+            and node.target.id == name
+        ):
+            if isinstance(node.op, ast.Mult):
+                return True
+            value = node.value
+        if value is None:
+            continue
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Mult):
+                return True
+    return False
+
+
+def _has_jitter(loop):
+    for node in ast.walk(loop):
+        chain = None
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+        elif isinstance(node, ast.Name):
+            chain = node.id
+        if chain is None:
+            continue
+        lowered = chain.lower()
+        if any(marker in lowered for marker in _JITTER_MARKERS):
+            return True
+    return False
+
+
+def run_retry_no_jitter(units):
+    findings = []
+    for unit in units:
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            for name in sorted(_slept_names(node)):
+                if not _grows_multiplicatively(node, name):
+                    continue
+                if _has_jitter(node):
+                    continue
+                findings.append(
+                    Finding(
+                        rule="ft-retry-no-jitter",
+                        path=unit.path,
+                        line=node.lineno,
+                        symbol=scope,
+                        code="backoff: %s" % name,
+                        message=(
+                            "retry loop sleeps a deterministically "
+                            "growing backoff (%r) with no jitter; a "
+                            "fleet retries in lockstep (thundering "
+                            "herd) — use common.grpc_utils.retry_call "
+                            "or add a uniform draw" % name
+                        ),
+                    )
+                )
     return findings
 
 

@@ -1,23 +1,122 @@
-"""gRPC channel/server helpers.
+"""gRPC channel/server helpers + the shared jittered retry policy.
 
 Reference parity: elasticdl/python/common/grpc_utils.py:22-40.
 """
 
+import random
 import socket
+import time
 from concurrent import futures
 
 import grpc
 
 from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.common.grpc_utils")
 
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", GRPC.MAX_SEND_MESSAGE_LENGTH),
     ("grpc.max_receive_message_length", GRPC.MAX_RECEIVE_MESSAGE_LENGTH),
+    # grpc's default reconnect backoff grows to 120 s — longer than the
+    # whole master/PS relaunch retry budget, so a client whose channel
+    # went TRANSIENT_FAILURE during the outage could sit out a backoff
+    # gap and fail-fast UNAVAILABLE long after the relaunched peer is
+    # serving (observed: the master-SIGKILL chaos test). Cap the gap so
+    # recovery latency is bounded by OUR jittered retry policy, not the
+    # transport's.
+    ("grpc.max_reconnect_backoff_ms", 10000),
 ]
+
+# connection-shaped failures worth retrying: the peer pod is
+# relaunching (UNAVAILABLE) or wedged past its deadline; anything else
+# (bad request, server logic error) surfaces immediately
+RETRYABLE_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
+
+
+def _await_reconnect(channel, timeout_secs):
+    """Actively drive the channel's reconnection for up to
+    ``timeout_secs``; returns True when it went READY.
+
+    This is load-bearing, not an optimization: a fail-fast RPC against
+    a TRANSIENT_FAILURE channel fails immediately WITHOUT scheduling a
+    fresh connection attempt, so a retry loop that only sleeps can
+    burn its whole budget returning UNAVAILABLE while the relaunched
+    peer is long since serving (observed in the master-SIGKILL chaos
+    test). ``channel_ready_future`` subscribes a connectivity watcher
+    (which does schedule attempts, paced by the channel's
+    max_reconnect_backoff_ms) and unsubscribes on completion/cancel —
+    unlike a standing ``channel.subscribe``, it leaves nothing behind
+    to wedge interpreter shutdown on never-closed channels.
+    """
+    future = grpc.channel_ready_future(channel)
+    try:
+        future.result(timeout=timeout_secs)
+        return True
+    except grpc.FutureTimeoutError:
+        return False
+    finally:
+        future.cancel()
+
+
+def retry_call(fn, what, budget_secs, retryable=RETRYABLE_CODES,
+               base_delay=0.5, max_delay=10.0, rng=None, channel=None):
+    """Call ``fn`` with FULL-JITTER exponential backoff on retryable
+    gRPC errors, up to ``budget_secs`` of wall clock.
+
+    Each backoff is uniform in [0, ceiling) with the ceiling doubling
+    per attempt (capped at ``max_delay``) — AWS-style full jitter. The
+    jitter is the point, not a nicety: a sync-strategy fleet whose
+    every worker hits the same relaunching PS retries in LOCKSTEP under
+    deterministic backoff, re-forming the same thundering herd at every
+    interval; uniform draws decorrelate the fleet so the relaunched pod
+    sees a trickle instead of a wall. ``rng`` (tests) overrides the
+    module RNG for deterministic schedules.
+
+    Pass the call's ``channel`` whenever available: the backoff then
+    actively drives the channel's reconnection (see _await_reconnect)
+    instead of just sleeping, and when the peer comes back early the
+    retry fires after only a small residual jitter draw rather than
+    the full backoff.
+    """
+    draw = (rng or random).uniform
+    deadline = time.monotonic() + budget_secs
+    ceiling = base_delay
+    while True:
+        try:
+            return fn()
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            delay = draw(0.0, ceiling)
+            if code not in retryable or (
+                time.monotonic() + delay > deadline
+            ):
+                raise
+            logger.warning(
+                "%s unavailable (%s); retrying in %.2fs", what, code,
+                delay,
+            )
+            if channel is not None:
+                if _await_reconnect(channel, delay):
+                    # peer is back: keep a small residual jitter so a
+                    # fleet whose ready-futures all completed at the
+                    # same instant doesn't slam it in unison
+                    time.sleep(draw(0.0, min(0.25, delay)))
+            else:
+                time.sleep(delay)
+            ceiling = min(ceiling * 2, max_delay)
 
 
 def build_channel(addr: str) -> grpc.Channel:
-    return grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+    channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+    # deterministic fault injection (testing/faults.py): identity
+    # pass-through unless EDL_FAULT_SPEC names this role's client calls
+    from elasticdl_tpu.testing.faults import intercept_client_channel
+
+    return intercept_client_channel(channel)
 
 
 def build_server(max_workers: int = 64, instrument: bool = True) -> grpc.Server:
@@ -32,9 +131,22 @@ def build_server(max_workers: int = 64, instrument: bool = True) -> grpc.Server:
         )
 
         interceptors = server_interceptors()
+    # deterministic fault injection (testing/faults.py): empty tuple —
+    # an unchanged call path — unless EDL_FAULT_SPEC is set
+    from elasticdl_tpu.testing.faults import (
+        server_interceptors as fault_interceptors,
+    )
+
+    interceptors = tuple(interceptors) + fault_interceptors()
     return grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
-        options=_CHANNEL_OPTIONS,
+        # so_reuseport=0: every role here is one-process-per-port, and
+        # with SO_REUSEPORT a SIGKILLed predecessor's lingering socket
+        # can keep receiving (and black-holing) a share of incoming
+        # connections after the same-port relaunch binds — observed as
+        # minutes of UNAVAILABLE against a healthy relaunched master
+        # in the crash-recovery chaos tests
+        options=_CHANNEL_OPTIONS + [("grpc.so_reuseport", 0)],
         interceptors=interceptors,
     )
 

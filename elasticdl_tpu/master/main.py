@@ -29,6 +29,10 @@ def main(argv=None):
     from elasticdl_tpu.observability import events
 
     events.install_crash_hooks()
+    from elasticdl_tpu.testing import faults
+
+    # before the gRPC server is built: fault specs match on role
+    faults.set_role("master")
     if args.metrics_port:
         # publish the knob before any instrument is constructed: the
         # registry decides enabled/no-op at first touch

@@ -18,6 +18,7 @@ from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.fleet import FleetMonitor
 from elasticdl_tpu.master.rendezvous import MeshRendezvous
 from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.state_store import MasterStateJournal
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.master.task_monitor import TaskMonitor
 from elasticdl_tpu.models.registry import get_model_spec
@@ -77,6 +78,15 @@ class Master:
         self.job_type = self._infer_job_type(
             training_data, validation_data, prediction_data
         )
+        # control-plane crash recovery (EDL_STATE_DIR): replay the
+        # predecessor's journal so a relaunched master resumes the job
+        # mid-epoch instead of forgetting dispatched/done shards
+        self.state_journal = MasterStateJournal.maybe_create()
+        self._recovered = (
+            self.state_journal.load()
+            if self.state_journal is not None
+            else None
+        )
         self.task_dispatcher = TaskDispatcher(
             training_shards=shards_of(training_data),
             evaluation_shards=shards_of(validation_data),
@@ -84,6 +94,8 @@ class Master:
             records_per_task=records_per_task,
             num_epochs=num_epochs,
             seed=seed,
+            state_journal=self.state_journal,
+            recovered=self._recovered,
         )
         if saved_model_path and self.job_type != JobType.PREDICTION_ONLY:
             self.task_dispatcher.add_deferred_callback_create_train_end_task(
@@ -118,7 +130,17 @@ class Master:
             self.evaluation_service,
             self.rendezvous,
             fleet_monitor=self.fleet_monitor,
+            state_journal=self.state_journal,
+            recovered=self._recovered,
         )
+        if self.state_journal is not None:
+            # compaction snapshots read the LIVE state from both owners
+            self.state_journal.register_section(
+                "dispatcher", self.task_dispatcher.export_state
+            )
+            self.state_journal.register_section(
+                "workers", self.servicer.export_worker_state
+            )
         self.pod_manager = pod_manager
         self.task_monitor = TaskMonitor(
             self.task_dispatcher,
@@ -226,6 +248,16 @@ class Master:
         trace.configure("master")
         events.configure("master")
         events.emit("role_start", port=self._port)
+        if self._recovered is not None:
+            # flight-recorder marker: the postmortem threads the crash,
+            # the relaunch, and the resumed dispatch into one timeline
+            events.emit(
+                "master_restarted",
+                master_epoch=self.state_journal.master_epoch,
+                todo=len(self._recovered.get("todo", ())),
+                requeued=len(self._recovered.get("doing", ())),
+                epochs_left=self._recovered.get("epochs_left", 0),
+            )
         self.observability = http_server.maybe_start(
             "master", cli_port=self._metrics_port
         )
@@ -296,3 +328,5 @@ class Master:
             self.pod_manager.stop()
         if self._server is not None:
             self._server.stop(grace=1.0)
+        if self.state_journal is not None:
+            self.state_journal.close()

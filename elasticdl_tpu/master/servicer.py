@@ -27,6 +27,8 @@ class MasterServicer:
         instance_manager=None,
         auto_join_mesh=True,
         fleet_monitor=None,
+        state_journal=None,
+        recovered=None,
     ):
         self._task_dispatcher = task_dispatcher
         self._evaluation_service = evaluation_service
@@ -65,10 +67,37 @@ class MasterServicer:
         # can still issue lower epochs than already buffered; the sync
         # PS surfaces that as a loud per-push warning plus the
         # edl_ps_push_dropped_dead_incarnation_total counter, so it is
-        # an alertable condition rather than a silent hang. Closing it
-        # fully requires persisting the base, which the job-restart
-        # semantics here don't justify.
+        # an alertable condition rather than a silent hang. With a
+        # state journal (EDL_STATE_DIR) the base IS persisted: a
+        # relaunched master re-anchors strictly above its predecessor's
+        # base, closing the stepped-back-clock window entirely.
+        self._journal = state_journal
         self._restart_epoch_base = int(time.time())
+        if recovered is not None:
+            self._worker_restarts = {
+                int(w): int(c)
+                for w, c in recovered.get("worker_restarts", {}).items()
+            }
+            # strictly above the dead predecessor's base: every epoch
+            # granted from here orders AFTER every epoch it granted,
+            # whatever this node's clock says
+            self._restart_epoch_base = max(
+                self._restart_epoch_base,
+                int(recovered.get("epoch_base", 0)) + 1,
+            )
+        if self._journal is not None:
+            self._journal.append(
+                {"op": "epoch_base", "base": self._restart_epoch_base}
+            )
+        # Restart detector stamped on responses (Task / CommInfo /
+        # ResetWorkerResponse): with a journal, the persisted boot
+        # counter; without one, the startup base still moves across
+        # restarts, so reconnecting workers re-register either way.
+        self._master_epoch = (
+            state_journal.master_epoch
+            if state_journal is not None
+            else self._restart_epoch_base
+        )
 
     # ------------------------------------------------------------------
     def _observe(self, request):
@@ -134,6 +163,9 @@ class MasterServicer:
         dispatch_start = time.time()
         task = self._task_dispatcher.get(request.worker_id, task_type)
         if task is not None:
+            # restart detector: constant per process, so mutating the
+            # shared record's proto is idempotent
+            task.master_epoch = self._master_epoch
             # the master-side anchor of the cross-role task trace:
             # merge_trace.py threads a flow from this span through the
             # worker's train/push spans carrying the same task_id
@@ -154,10 +186,10 @@ class MasterServicer:
             # Default Task (task_id=0, type=TRAINING): the job is over
             # (success or terminal failure) and the worker should exit.
             # The master distinguishes the two via job_failed().
-            return pb.Task()
+            return pb.Task(master_epoch=self._master_epoch)
         # Queue temporarily empty (e.g. between epochs or during an eval
         # pass): tell the worker to wait and re-poll.
-        return pb.Task(type=pb.WAIT)
+        return pb.Task(type=pb.WAIT, master_epoch=self._master_epoch)
 
     def reset_worker(self, request, context=None):
         """A freshly (re)launched worker declares itself: anything still
@@ -175,12 +207,23 @@ class MasterServicer:
             count = self._worker_restarts.get(request.worker_id, 0) + 1
             self._worker_restarts[request.worker_id] = count
             epoch = self._restart_epoch_base + count
+        if self._journal is not None:
+            # the grant must be durable BEFORE the worker can stamp it
+            # on a push: a master relaunch that forgot the grant would
+            # re-issue lower epochs and the sync PS would order live
+            # pushes behind dead ones
+            self._journal.append({
+                "op": "grant", "worker": request.worker_id,
+                "count": count,
+            })
         events.emit(
             "worker_register", worker=request.worker_id, epoch=epoch,
             relaunch=count > 1,
         )
         self._task_dispatcher.recover_tasks(request.worker_id)
-        return pb.ResetWorkerResponse(restart_count=epoch)
+        return pb.ResetWorkerResponse(
+            restart_count=epoch, master_epoch=self._master_epoch
+        )
 
     def worker_relaunch_count(self):
         """Relaunches observed across all workers (each reset_worker
@@ -227,16 +270,32 @@ class MasterServicer:
         return pb.Empty()
 
     def report_version(self, request, context=None):
+        if self._journal is not None:
+            self._journal.append(
+                {"op": "version", "version": request.model_version}
+            )
         if self._evaluation_service is not None:
             self._evaluation_service.add_evaluation_task_if_needed(
                 request.model_version
             )
         return pb.Empty()
 
+    def export_worker_state(self):
+        """Snapshot section for journal compaction: the relaunch-epoch
+        grants and their base (state_store.empty_state keys)."""
+        with self._lock:
+            return {
+                "worker_restarts": dict(self._worker_restarts),
+                "epoch_base": self._restart_epoch_base,
+            }
+
     def get_comm_info(self, request, context=None):
         self._observe(request)
         if self._rendezvous is None:
-            return pb.CommInfo(rank=0, world_size=1, mesh_epoch=0)
+            return pb.CommInfo(
+                rank=0, world_size=1, mesh_epoch=0,
+                master_epoch=self._master_epoch,
+            )
         if request.worker_host:
             with self._lock:
                 self._worker_hosts[request.worker_id] = request.worker_host
@@ -250,4 +309,5 @@ class MasterServicer:
             world_size=size,
             mesh_epoch=epoch,
             coordinator_addr=coordinator,
+            master_epoch=self._master_epoch,
         )

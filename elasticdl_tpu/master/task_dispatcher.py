@@ -56,8 +56,22 @@ class TaskDispatcher:
         max_task_retries=MAX_TASK_RETRIES,
         shuffle=True,
         seed=None,
+        state_journal=None,
+        recovered=None,
     ):
         self._lock = threading.Lock()
+        # control-plane crash recovery (master/state_store.py): every
+        # queue transition is journaled write-through so a relaunched
+        # master resumes mid-epoch instead of forgetting the job. Ops
+        # are decided under the lock but written AFTER it (the journal
+        # does file I/O; same discipline as the event journal below).
+        self._journal = state_journal
+        self._journal_ops = []
+        # task_id -> pre-restart assignee for tasks the replay requeued
+        # out of ``doing``: if that worker is still alive and finishes,
+        # its success report is accepted (task leaves the queue) instead
+        # of being re-run by someone else — no shard trained twice.
+        self._recovered_assignee = {}
         self._training_shards = dict(training_shards or {})
         self._evaluation_shards = dict(evaluation_shards or {})
         self._prediction_shards = dict(prediction_shards or {})
@@ -87,12 +101,136 @@ class TaskDispatcher:
         # of the master's pending/doing/done task gauges)
         self._done_counts = {}
 
-        if self._prediction_shards:
-            self._todo.extend(
-                self._create_tasks_locked(pb.PREDICTION, self._prediction_shards)
+        if recovered is not None:
+            # authoritative even when empty: a journal that says "all
+            # tasks done" must not be answered with a fresh epoch
+            self._load_recovered_locked(recovered)
+        elif self._prediction_shards:
+            ids = self._create_tasks_locked(
+                pb.PREDICTION, self._prediction_shards
             )
+            self._todo.extend(ids)
+            self._journal_tasks_locked(ids, "train")
         elif self._training_shards:
             self._create_training_epoch_locked()
+        self._flush_journal()
+
+    # ------------------------------------------------------------------
+    # crash recovery (master/state_store.py)
+
+    def _journal_tasks_locked(self, ids, queue):
+        if self._journal is None or not ids:
+            return
+        self._journal_ops.append({
+            "op": "tasks_created",
+            "queue": queue,
+            "tasks": [
+                [t.task_id, t.type, t.shard_name, t.start, t.end,
+                 t.model_version]
+                for t in (self._records[i].task for i in ids)
+            ],
+            "epochs_left": self._epochs_left,
+        })
+
+    def _flush_journal(self):
+        """Write ops buffered under the lock; called after release."""
+        if self._journal is None:
+            return
+        with self._lock:
+            ops, self._journal_ops = self._journal_ops, []
+        for op in ops:
+            self._journal.append(op)
+
+    def _load_recovered_locked(self, recovered):
+        """Adopt a replayed state (state_store.load): queued tasks keep
+        their place, in-flight ``doing`` tasks are requeued (their
+        holder may be dead — and if it is not, its completion report is
+        still honored via ``_recovered_assignee``)."""
+        for task_id, fields in recovered["tasks"].items():
+            task_id = int(task_id)
+            task = pb.Task(
+                task_id=task_id,
+                type=int(fields[1]),
+                shard_name=fields[2],
+                start=int(fields[3]),
+                end=int(fields[4]),
+                model_version=int(fields[5]),
+            )
+            record = _TaskRecord(task)
+            record.retry_count = int(
+                recovered.get("retries", {}).get(task_id, 0)
+            )
+            self._records[task_id] = record
+        self._todo = [
+            t for t in recovered["todo"] if t in self._records
+        ]
+        self._eval_todo = [
+            t for t in recovered["eval_todo"] if t in self._records
+        ]
+        # doing -> todo requeue: appended at the BACK so a still-live
+        # holder usually reports done before the task is re-dispatched
+        for task_id, worker in recovered["doing"].items():
+            task_id = int(task_id)
+            if task_id not in self._records:
+                continue
+            record = self._records[task_id]
+            queue = (
+                self._eval_todo
+                if record.task.type == pb.EVALUATION
+                else self._todo
+            )
+            if task_id not in queue:
+                queue.append(task_id)
+            self._recovered_assignee[task_id] = worker
+            self._journal_ops.append({
+                "op": "requeue", "task": task_id,
+                "retries": record.retry_count,
+            })
+        self._epochs_left = int(recovered.get("epochs_left", 0))
+        self._next_task_id = max(
+            int(recovered.get("next_task_id", 1)),
+            max(self._records, default=0) + 1,
+        )
+        self._done_counts = {
+            int(t): int(n)
+            for t, n in recovered.get("done_counts", {}).items()
+        }
+        self._job_failed = bool(recovered.get("job_failed", False))
+        logger.info(
+            "Dispatcher resumed from journal: %d todo, %d eval, "
+            "%d requeued in-flight, epochs left %d",
+            len(self._todo), len(self._eval_todo),
+            len(self._recovered_assignee), self._epochs_left,
+        )
+
+    def export_state(self):
+        """Replay-schema snapshot for journal compaction
+        (state_store.empty_state keys this dispatcher owns)."""
+        with self._lock:
+            return {
+                "tasks": {
+                    task_id: [
+                        r.task.task_id, r.task.type, r.task.shard_name,
+                        r.task.start, r.task.end, r.task.model_version,
+                    ]
+                    for task_id, r in self._records.items()
+                },
+                "todo": list(self._todo),
+                "eval_todo": list(self._eval_todo),
+                "doing": {
+                    task_id: worker
+                    for task_id, (worker, _) in self._doing.items()
+                },
+                "retries": {
+                    task_id: r.retry_count
+                    for task_id, r in self._records.items()
+                    if r.retry_count
+                },
+                "done_counts": dict(self._done_counts),
+                "epochs_left": self._epochs_left,
+                "next_task_id": self._next_task_id,
+                "job_failed": self._job_failed,
+            }
 
     # ------------------------------------------------------------------
     # task creation
@@ -128,6 +266,7 @@ class TaskDispatcher:
         if self._shuffle:
             self._rng.shuffle(ids)
         self._todo.extend(ids)
+        self._journal_tasks_locked(ids, "train")
         logger.info(
             "Created %d training tasks (epochs left: %d)",
             len(ids),
@@ -141,7 +280,10 @@ class TaskDispatcher:
                 pb.EVALUATION, self._evaluation_shards, model_version
             )
             self._eval_todo.extend(ids)
-            return len(ids)
+            self._journal_tasks_locked(ids, "eval")
+            count = len(ids)
+        self._flush_journal()
+        return count
 
     def add_deferred_callback_create_train_end_task(self, extended_config=None):
         """Register the train-end task, created once all training finishes.
@@ -165,8 +307,17 @@ class TaskDispatcher:
             self._records[task.task_id] = _TaskRecord(task)
             self._next_task_id += 1
             self._todo.append(task.task_id)
+            self._journal_tasks_locked([task.task_id], "train")
 
         with self._lock:
+            # crash recovery: if the replayed state already holds (or
+            # already completed) the train-end task, re-registering
+            # would create a duplicate at the next drain — or leave a
+            # never-fired callback that wedges finished()
+            if self._records_have_train_end_locked() or self._done_counts.get(
+                pb.TRAIN_END_CALLBACK, 0
+            ):
+                return
             self._deferred_callbacks.append(_create)
 
     def _fire_deferred_locked(self):
@@ -177,6 +328,7 @@ class TaskDispatcher:
     def fire_deferred_callbacks(self):
         with self._lock:
             self._fire_deferred_locked()
+        self._flush_journal()
 
     # ------------------------------------------------------------------
     # queue operations
@@ -197,11 +349,22 @@ class TaskDispatcher:
                     self._create_training_epoch_locked()
                     queue = self._todo
             if not queue:
-                return None
-            task_id = queue.pop(0)
-            self._doing[task_id] = (worker_id, time.time())
-            self._worker_doing.setdefault(worker_id, set()).add(task_id)
-            return self._records[task_id].task
+                task = None
+            else:
+                task_id = queue.pop(0)
+                self._doing[task_id] = (worker_id, time.time())
+                self._worker_doing.setdefault(worker_id, set()).add(task_id)
+                # re-dispatched: the pre-restart assignee (if any) is no
+                # longer the source of truth for this task
+                self._recovered_assignee.pop(task_id, None)
+                if self._journal is not None:
+                    self._journal_ops.append({
+                        "op": "dispatch", "task": task_id,
+                        "worker": worker_id,
+                    })
+                task = self._records[task_id].task
+        self._flush_journal()
+        return task
 
     def report(self, task_id, success, worker_id=None, count_failure=True):
         """Mark a task done or failed; failed tasks re-queue up to the cap.
@@ -235,6 +398,28 @@ class TaskDispatcher:
                 logger.warning("Unknown task id reported: %s", task_id)
                 return False, None
             doing = self._doing.get(task_id)
+            if doing is None and success and worker_id is not None and (
+                self._recovered_assignee.get(task_id) == worker_id
+            ):
+                # Master-restart continuity: the replay requeued this
+                # in-flight task, but its pre-restart assignee survived
+                # the restart and finished it. Honor the completion —
+                # re-running the shard on another worker would train it
+                # twice.
+                queue = (
+                    self._eval_todo
+                    if record.task.type == pb.EVALUATION
+                    else self._todo
+                )
+                if task_id in queue:
+                    queue.remove(task_id)
+                    self._recovered_assignee.pop(task_id, None)
+                    doing = (worker_id, None)
+                    logger.info(
+                        "Accepted post-restart completion of task %s "
+                        "from its pre-restart assignee %s",
+                        task_id, worker_id,
+                    )
             if doing is None or (
                 worker_id is not None and doing[0] != worker_id
             ):
@@ -248,7 +433,7 @@ class TaskDispatcher:
                     worker_id,
                 )
                 return False, record.task
-            del self._doing[task_id]
+            self._doing.pop(task_id, None)
             assignee, start_time = doing
             self._worker_doing.get(assignee, set()).discard(task_id)
 
@@ -261,6 +446,11 @@ class TaskDispatcher:
                 self._done_counts[task.type] = (
                     self._done_counts.get(task.type, 0) + 1
                 )
+                if self._journal is not None:
+                    self._journal_ops.append({
+                        "op": "done", "task": task_id,
+                        "type": task.type,
+                    })
                 if not self._todo and not self._doing_training_locked():
                     if self._epochs_left > 0:
                         self._create_training_epoch_locked()
@@ -286,6 +476,10 @@ class TaskDispatcher:
                         ("job_failed",
                          dict(task=task_id, retries=record.retry_count))
                     )
+                    if self._journal is not None:
+                        self._journal_ops.append(
+                            {"op": "job_failed", "task": task_id}
+                        )
                 else:
                     queue = (
                         self._eval_todo
@@ -300,6 +494,12 @@ class TaskDispatcher:
                               retries=record.retry_count,
                               counted=count_failure))
                     )
+                    if self._journal is not None:
+                        self._journal_ops.append({
+                            "op": "requeue", "task": task_id,
+                            "retries": record.retry_count,
+                        })
+        self._flush_journal()
         for event, fields in journal:
             events.emit(event, **fields)
         # Completion callbacks run outside the lock: they may call back

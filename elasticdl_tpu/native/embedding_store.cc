@@ -309,6 +309,12 @@ void edl_store_bump_version(void* handle) {
   static_cast<Store*>(handle)->version.fetch_add(1);
 }
 
+// Re-anchor the version clock (PS checkpoint auto-restore): one store,
+// not O(version) bump calls at boot.
+void edl_store_set_version(void* handle, int64_t version) {
+  static_cast<Store*>(handle)->version.store(version);
+}
+
 // Export all (id, weight-row) pairs of a table into caller buffers.
 // Call with out_ids == nullptr to get the count. Weights-only variant,
 // used for serving export and weight inspection; checkpoints use

@@ -55,6 +55,15 @@ EVENT_TYPES = frozenset({
     "worker_register",       # reset_worker served (+ worker, epoch)
     "worker_presumed_dead",  # liveness/timeout eviction (+ worker)
     "mesh_epoch_restart",    # worker exiting to rejoin a new mesh epoch
+    # control-plane crash recovery (ISSUE 4)
+    "master_restarted",      # journal replayed (+ master_epoch, todo,
+                             #   requeued, epochs_left)
+    "ps_restored",           # PS auto-restored a checkpoint at boot
+                             #   (+ version, ps)
+    "worker_resynced",       # worker detected a PS state regression and
+                             #   re-pushed its model (+ shard, version)
+    "checkpoint_skipped",    # corrupt/incomplete checkpoint version
+                             #   skipped during restore (+ version, why)
     # task lifecycle (+ task, worker)
     "task_dispatch",
     "task_report",           # + ok, err

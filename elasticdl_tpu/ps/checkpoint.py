@@ -13,6 +13,7 @@ import shutil
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import events
 
 logger = _logger_factory("elasticdl_tpu.ps.checkpoint")
 
@@ -98,69 +99,129 @@ class SparseCheckpointSaver:
                 return v
         return None
 
+    def _candidate_versions(self, version):
+        """Versions to try, preferred first: the requested one (if any),
+        then every on-disk version newest-first."""
+        if not os.path.isdir(self._dir):
+            return []
+        versions = sorted(
+            (
+                int(d.split("-")[1])
+                for d in os.listdir(self._dir)
+                if d.startswith("version-") and d.split("-")[1].isdigit()
+            ),
+            reverse=True,
+        )
+        if version is not None:
+            versions = [version] + [v for v in versions if v != version]
+        return versions
+
+    def _shard_files(self, version):
+        vdir = self._version_dir(version)
+        return [
+            os.path.join(vdir, fname)
+            for fname in sorted(os.listdir(vdir))
+            if _FILE_RE.search(fname)
+        ]
+
+    def _verify_version_files(self, version):
+        """Raise on ANY missing/truncated/corrupt content of a version
+        BEFORE the import touches the live store — restore is
+        all-or-nothing, never half-imported. Reads one file at a time
+        and discards (forcing the zipfile CRC/length checks), so peak
+        memory is one shard file, not the whole checkpoint."""
+        if not self._complete(self._version_dir(version)):
+            raise ValueError("incomplete version dir (missing shards)")
+        for path in self._shard_files(version):
+            with np.load(path) as data:
+                for key in data.files:
+                    data[key]
+
     def restore(self, store, version=None):
         """Load all shard files of a version, keeping only rows belonging
-        to this shard — re-sharding is implicit (any old N -> new N)."""
-        version = (
-            version
-            if version is not None
-            else self.latest_version(self._dir)
-        )
-        if version is None:
-            return None
-        vdir = self._version_dir(version)
-        for fname in sorted(os.listdir(vdir)):
-            if not _FILE_RE.search(fname):
-                continue
-            data = np.load(os.path.join(vdir, fname))
-            tables = {
-                key.split("/", 1)[1]
-                for key in data.files
-                if key.startswith("ids/")
-            }
-            # sorted: table creation order must match across hosts —
-            # set order varies per process under hash randomization
-            for name in sorted(tables):
-                dim = int(data["dim/" + name])
-                store.create_table(name, dim)
-                saved_opt = (
-                    str(data["opt/" + name])
-                    if "opt/" + name in data.files
-                    else None
+        to this shard — re-sharding is implicit (any old N -> new N).
+
+        Hardened against the crash windows this module itself creates:
+        an incomplete ``version-<v>`` dir (PS died between shard saves)
+        or a truncated/corrupt ``.npz`` (died mid-write, disk trouble)
+        is SKIPPED — logged and journaled — and the newest older
+        complete version restores instead of the whole PS failing to
+        boot. Returns the restored version, or None when nothing on
+        disk was restorable."""
+        for candidate in self._candidate_versions(version):
+            try:
+                self._verify_version_files(candidate)
+            except Exception as e:
+                logger.warning(
+                    "skipping sparse checkpoint version %d: %s",
+                    candidate, e,
                 )
-                if (
-                    "fullrows/" + name in data.files
-                    and saved_opt == store.opt_type
-                ):
-                    store.import_table_full(
-                        name,
-                        data["ids/" + name],
-                        data["fullrows/" + name],
-                        data["steps/" + name],
-                        shard_id=self._shard_id,
-                        shard_num=self._shard_num,
+                events.emit(
+                    "checkpoint_skipped", version=candidate,
+                    why=str(e)[:200],
+                )
+                continue
+            # second pass imports one (verified) file at a time; only
+            # this shard's rows are kept, so peak memory stays at one
+            # shard file rather than the whole checkpoint
+            for path in self._shard_files(candidate):
+                with np.load(path) as data:
+                    self._import_shard_arrays(
+                        store, {key: data[key] for key in data.files}
                     )
-                elif "fullrows/" + name in data.files:
-                    # optimizer changed since the save: weights only
-                    store.import_table(
-                        name,
-                        data["ids/" + name],
-                        data["fullrows/" + name][:, :dim],
-                        shard_id=self._shard_id,
-                        shard_num=self._shard_num,
-                    )
-                else:  # weights-only checkpoint (older format)
-                    store.import_table(
-                        name,
-                        data["ids/" + name],
-                        data["values/" + name],
-                        shard_id=self._shard_id,
-                        shard_num=self._shard_num,
-                    )
-        logger.info(
-            "Restored sparse checkpoint version %d into shard %d/%d",
-            version,
-            self._shard_id,
-            self._shard_num,
-        )
-        return version
+            logger.info(
+                "Restored sparse checkpoint version %d into shard %d/%d",
+                candidate,
+                self._shard_id,
+                self._shard_num,
+            )
+            return candidate
+        return None
+
+    def _import_shard_arrays(self, store, data):
+        """Import one (fully pre-read) shard file's arrays, keeping only
+        the rows belonging to this shard."""
+        tables = {
+            key.split("/", 1)[1]
+            for key in data
+            if key.startswith("ids/")
+        }
+        # sorted: table creation order must match across hosts —
+        # set order varies per process under hash randomization
+        for name in sorted(tables):
+            dim = int(data["dim/" + name])
+            store.create_table(name, dim)
+            saved_opt = (
+                str(data["opt/" + name])
+                if "opt/" + name in data
+                else None
+            )
+            if (
+                "fullrows/" + name in data
+                and saved_opt == store.opt_type
+            ):
+                store.import_table_full(
+                    name,
+                    data["ids/" + name],
+                    data["fullrows/" + name],
+                    data["steps/" + name],
+                    shard_id=self._shard_id,
+                    shard_num=self._shard_num,
+                )
+            elif "fullrows/" + name in data:
+                # optimizer changed since the save: weights only
+                store.import_table(
+                    name,
+                    data["ids/" + name],
+                    data["fullrows/" + name][:, :dim],
+                    shard_id=self._shard_id,
+                    shard_num=self._shard_num,
+                )
+            else:  # weights-only checkpoint (older format)
+                store.import_table(
+                    name,
+                    data["ids/" + name],
+                    data["values/" + name],
+                    shard_id=self._shard_id,
+                    shard_num=self._shard_num,
+                )

@@ -140,6 +140,10 @@ def _load_native():
     lib.edl_store_version.restype = ctypes.c_int64
     lib.edl_store_version.argtypes = [ctypes.c_void_p]
     lib.edl_store_bump_version.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "edl_store_set_version"):  # absent in older builds
+        lib.edl_store_set_version.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
     lib.edl_store_export.restype = ctypes.c_int64
     lib.edl_store_export.argtypes = [
         ctypes.c_void_p,
@@ -287,6 +291,15 @@ class NativeEmbeddingStore:
 
     def bump_version(self):
         self._lib.edl_store_bump_version(self._handle)
+
+    def set_version(self, version):
+        """Re-anchor the version clock (checkpoint auto-restore)."""
+        if hasattr(self._lib, "edl_store_set_version"):
+            self._lib.edl_store_set_version(self._handle, int(version))
+            return
+        # older .so without the setter: bounded catch-up loop
+        while self.version < version:
+            self.bump_version()
 
     def table_names(self):
         return list(self._dims)
@@ -528,6 +541,11 @@ class NumpyEmbeddingStore:
     def bump_version(self):
         with self._lock:
             self.version += 1
+
+    def set_version(self, version):
+        """Re-anchor the version clock (checkpoint auto-restore)."""
+        with self._lock:
+            self.version = int(version)
 
     def table_names(self):
         return list(self._meta)

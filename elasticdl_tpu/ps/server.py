@@ -108,6 +108,30 @@ class ParameterServer:
                 shard_num=args.num_ps_pods,
                 keep_max=args.keep_checkpoint_max,
             )
+        # Auto-restore (ISSUE 4): a relaunched PS picks up the newest
+        # COMPLETE checkpoint from its own --checkpoint_dir with no
+        # operator flag — before this, a same-id relaunch
+        # (k8s/instance_manager.py) booted with an empty store unless
+        # someone remembered --checkpoint_dir_for_init. The explicit
+        # flag still wins (warm-starting from another job's dir).
+        self._restored_version = None
+        if args.checkpoint_dir_for_init:
+            self._restored_version = SparseCheckpointSaver(
+                args.checkpoint_dir_for_init,
+                shard_id=args.ps_id,
+                shard_num=args.num_ps_pods,
+            ).restore(self.store)
+        elif saver is not None:
+            self._restored_version = saver.restore(self.store)
+        if self._restored_version is not None:
+            # re-anchor the store's version clock at the checkpoint so
+            # sync staleness checks and worker version accounting line
+            # up with the restored state
+            self.store.set_version(self._restored_version)
+            logger.info(
+                "PS %d auto-restored checkpoint version %d",
+                args.ps_id, self._restored_version,
+            )
         master_client = None
         if args.master_addr:
             from elasticdl_tpu.worker.master_client import MasterClient
@@ -133,6 +157,7 @@ class ParameterServer:
             grads_to_wait=args.grads_to_wait,
             sync_version_tolerance=args.sync_version_tolerance,
             staleness_modulation=bool(args.lr_staleness_modulation),
+            restored_version=self._restored_version,
         )
         if master_client is not None and self._telemetry_on:
             # piggyback this PS's telemetry (push/pull rates, version
@@ -140,12 +165,6 @@ class ParameterServer:
             # loop already makes — the master's stuck-round and
             # version-lag detectors read it from the fleet view
             master_client.telemetry_provider = self.servicer.telemetry_blob
-        if args.checkpoint_dir_for_init:
-            SparseCheckpointSaver(
-                args.checkpoint_dir_for_init,
-                shard_id=args.ps_id,
-                shard_num=args.num_ps_pods,
-            ).restore(self.store)
         self.server = None
 
     def prepare(self):
@@ -166,6 +185,11 @@ class ParameterServer:
         trace.configure(role)
         events.configure(role)
         events.emit("role_start", port=self.args.port)
+        if self._restored_version is not None:
+            events.emit(
+                "ps_restored", version=self._restored_version,
+                ps=self.args.ps_id,
+            )
         self.observability = http_server.maybe_start(
             role, cli_port=getattr(self.args, "metrics_port", 0)
         )
@@ -189,13 +213,23 @@ class ParameterServer:
         if self._master_client is None:
             self.server.wait_for_termination()
             return 0
+        # polls missed before concluding the master is gone for good:
+        # must comfortably cover a master pod relaunch + state-journal
+        # replay (ISSUE 4) — the old 3-strike rule (15 s) made every
+        # recoverable master restart take the whole PS fleet with it
+        try:
+            gone_polls = int(
+                os.environ.get("EDL_PS_MASTER_GONE_POLLS", "") or 18
+            )
+        except ValueError:
+            gone_polls = 18
         misses = 0
         while True:
             time.sleep(poll_secs)
             info = self._master_client.get_comm_info()
             if info.mesh_epoch < 0:  # RPC failure marker
                 misses += 1
-                if misses >= 3:
+                if misses >= gone_polls:
                     logger.info("Master gone; PS exiting")
                     self.server.stop(grace=1.0)
                     events.emit("role_stop", reason="master_gone")
@@ -210,6 +244,10 @@ def main(argv=None):
 
     apply_platform_overrides()
     args = parse_ps_args(argv)
+    from elasticdl_tpu.testing import faults
+
+    # before any channel/server is built: fault specs match on role
+    faults.set_role("ps-%d" % args.ps_id)
     if args.metrics_port:
         # publish the knob before any instrument is constructed: the
         # registry decides enabled/no-op at first touch

@@ -53,9 +53,17 @@ class PserverServicer:
         use_async=True,
         grads_to_wait=1,
         sync_version_tolerance=0,
+        restored_version=None,
     ):
         self._store = store
         self._ps_id = ps_id
+        # checkpoint version this PS auto-restored at boot, stamped on
+        # push/pull responses (wire encoding: version + 1, 0 = none) so
+        # workers detecting a version regression know what state the
+        # relaunched PS came back with
+        self._restored_wire = (
+            int(restored_version) + 1 if restored_version is not None else 0
+        )
         self._staleness_modulation = staleness_modulation
         self._checkpoint_saver = checkpoint_saver
         self._checkpoint_steps = checkpoint_steps
@@ -164,6 +172,11 @@ class PserverServicer:
             round_buffer_fill=self._buffered_count(),
         )
 
+    def _stamp(self, response):
+        """Stamp the boot-restore marker on a push/pull response."""
+        response.restored_version = self._restored_wire
+        return response
+
     # ------------------------------------------------------------------
     def push_model(self, request, context=None):
         """First writer wins: later pushes are ignored (reference:
@@ -224,7 +237,7 @@ class PserverServicer:
 
     # ------------------------------------------------------------------
     def pull_dense_parameters(self, request, context=None):
-        response = pb.PullDenseParametersResponse()
+        response = self._stamp(pb.PullDenseParametersResponse())
         with self._lock:
             response.initialized = self._dense_initialized
             response.version = self._dense_version
@@ -268,7 +281,9 @@ class PserverServicer:
         version = self._store.version
         self._maybe_checkpoint(version)
         self._maybe_report_version(version)
-        return pb.PushGradientsResponse(accepted=True, version=version)
+        return self._stamp(
+            pb.PushGradientsResponse(accepted=True, version=version)
+        )
 
     def _push_gradients_sync(self, request):
         """Sync push with the journal I/O outside the push lock:
@@ -319,9 +334,9 @@ class PserverServicer:
                         version=grad_version, store_version=version,
                     ),
                 ))
-                return pb.PushGradientsResponse(
+                return self._stamp(pb.PushGradientsResponse(
                     accepted=False, version=version
-                )
+                ))
             # Per-push lr_scale cannot be folded into gradient values:
             # Adam's update is invariant to gradient scaling (the scale
             # would be a silent no-op) and for momentum/adagrad scaling
@@ -375,9 +390,9 @@ class PserverServicer:
                         "restart the job",
                         request.worker_id, incarnation,
                     )
-                    return pb.PushGradientsResponse(
+                    return self._stamp(pb.PushGradientsResponse(
                         accepted=True, version=version
-                    )
+                    ))
                 for entry in same_worker:
                     self._remove_buffered_locked(entry)
                     logger.warning(
@@ -427,24 +442,26 @@ class PserverServicer:
                     group[:] = [e for e in group if e[0] != key]
                 group.append(entry)
                 if len(group) < self._grads_to_wait:
-                    return pb.PushGradientsResponse(
+                    return self._stamp(pb.PushGradientsResponse(
                         accepted=True, version=version
-                    )
+                    ))
                 del self._round_groups[grad_version]
                 self._apply_round_locked(group, journal)
             else:
                 self._round_buffer.append(entry)
                 if len(self._round_buffer) < self._grads_to_wait:
-                    return pb.PushGradientsResponse(
+                    return self._stamp(pb.PushGradientsResponse(
                         accepted=True, version=version
-                    )
+                    ))
                 self._apply_round_locked(self._round_buffer, journal)
                 self._round_buffer = []
             self._store.bump_version()
             version = self._store.version
         self._maybe_checkpoint(version)
         self._maybe_report_version(version)
-        return pb.PushGradientsResponse(accepted=True, version=version)
+        return self._stamp(
+            pb.PushGradientsResponse(accepted=True, version=version)
+        )
 
     def _buffered_entries(self):
         for entry in self._round_buffer:

@@ -1,0 +1,284 @@
+"""Deterministic gRPC fault injection, driven by ``EDL_FAULT_SPEC``.
+
+Chaos tests must exercise the recovery paths (master relaunch, PS
+restore, retry budgets) the same way on every run, on CPU, with no
+cluster — so faults are injected at the gRPC boundary by interceptors
+whose firing schedule is a pure function of the spec:
+
+    EDL_FAULT_SPEC = spec[;spec...]
+    spec           = role:method:kind:rate[:seed]
+
+- ``role``   — fnmatch pattern against this process's role as set by
+  ``set_role`` ("master", "ps-0", "worker-3"; ``ps-*`` matches any PS).
+- ``method`` — fnmatch pattern against the bare RPC method name
+  (``get_task``, ``push_gradients``, ``*``).
+- ``kind``   — what happens when the spec fires:
+    - ``unavailable`` / ``deadline``: the call fails with that gRPC
+      status (server side aborts; client side raises before sending).
+    - ``delay``: the call sleeps ``rate`` seconds, then proceeds.
+    - ``kill-once``: the PROCESS dies by SIGKILL on the ``rate``-th
+      matching call (once per process lifetime; relaunch with the spec
+      cleared or it dies again).
+- ``rate``   — for unavailable/deadline: values >= 1 are a
+  deterministic BURST (the first ``int(rate)`` matching calls fail,
+  later ones pass — the "PS comes back after N retries" shape);
+  values < 1 are a per-call probability drawn from a ``Random(seed)``
+  sequence (seed defaults to 0), so a given (spec, call order) always
+  yields the same schedule. For delay: seconds. For kill-once: which
+  matching call dies (default 1).
+
+**Provably inert when unset**: ``server_interceptors()`` returns ``()``
+and ``intercept_client_channel`` returns the channel object it was
+given — no wrapper, no per-call branch. The only steady-state cost is
+one ``os.environ.get`` + string compare per channel/server BUILD (never
+per call).
+"""
+
+import fnmatch
+import os
+import signal
+import threading
+import time
+
+import grpc
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.testing.faults")
+
+FAULT_SPEC_ENV = "EDL_FAULT_SPEC"
+
+KINDS = ("unavailable", "deadline", "delay", "kill-once")
+
+_role = ""
+_role_lock = threading.Lock()
+
+# (env string, [FaultSpec]) parse cache: re-reads the env var on every
+# build call so tests can monkeypatch it, but parses only on change
+_cache = ("", [])
+_cache_lock = threading.Lock()
+
+
+def set_role(role):
+    """Declare this process's role for spec matching; call from role
+    entry points before any channel/server is built."""
+    global _role
+    with _role_lock:
+        _role = role or ""
+
+
+def current_role():
+    return _role
+
+
+class FaultSpec:
+    """One parsed spec with its deterministic firing schedule."""
+
+    def __init__(self, role_pat, method_pat, kind, rate, seed=0):
+        if kind not in KINDS:
+            raise ValueError("unknown fault kind %r" % kind)
+        self.role_pat = role_pat
+        self.method_pat = method_pat
+        self.kind = kind
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._calls = 0
+        self._fired_kill = False
+        import random
+
+        self._rng = random.Random(self.seed)
+
+    @classmethod
+    def parse(cls, text):
+        parts = text.strip().split(":")
+        if len(parts) not in (4, 5):
+            raise ValueError(
+                "bad fault spec %r (want role:method:kind:rate[:seed])"
+                % text
+            )
+        return cls(*parts)
+
+    def matches(self, role, method):
+        return fnmatch.fnmatch(role, self.role_pat) and fnmatch.fnmatch(
+            method, self.method_pat
+        )
+
+    def fire(self):
+        """Advance this spec's schedule by one matching call; returns
+        the action to apply now: None | "unavailable" | "deadline" |
+        ("delay", secs) | "kill"."""
+        with self._lock:
+            self._calls += 1
+            calls = self._calls
+            if self.kind == "delay":
+                return ("delay", self.rate)
+            if self.kind == "kill-once":
+                nth = max(1, int(self.rate))
+                if calls == nth and not self._fired_kill:
+                    self._fired_kill = True
+                    return "kill"
+                return None
+            # unavailable / deadline
+            if self.rate >= 1.0:
+                return self.kind if calls <= int(self.rate) else None
+            return self.kind if self._rng.random() < self.rate else None
+
+    def describe(self):
+        return "%s:%s:%s:%g:%d" % (
+            self.role_pat, self.method_pat, self.kind, self.rate,
+            self.seed,
+        )
+
+
+def _specs():
+    """Parsed specs for the current env value (cached per value)."""
+    global _cache
+    raw = os.environ.get(FAULT_SPEC_ENV, "")
+    with _cache_lock:
+        if raw == _cache[0]:
+            return _cache[1]
+        specs = []
+        for chunk in raw.split(";"):
+            chunk = chunk.strip()
+            if not chunk:
+                continue
+            try:
+                specs.append(FaultSpec.parse(chunk))
+            except ValueError as e:
+                logger.warning("ignoring bad fault spec: %s", e)
+        if specs:
+            logger.warning(
+                "FAULT INJECTION ARMED (%s): %s", FAULT_SPEC_ENV,
+                ", ".join(s.describe() for s in specs),
+            )
+        _cache = (raw, specs)
+        return specs
+
+
+def enabled():
+    return bool(_specs())
+
+
+def _reset_for_tests():
+    global _cache, _role
+    with _cache_lock:
+        _cache = ("", [])
+    _role = ""
+
+
+def _bare_method(full_method):
+    # "/elasticdl_tpu.Master/get_task" -> "get_task"
+    return full_method.rsplit("/", 1)[-1]
+
+
+def _kill_self(method):
+    logger.warning("fault injection: SIGKILL self on %s", method)
+    # stderr may be buffered; the log line above is best-effort only
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+class FaultInjectedError(grpc.RpcError):
+    """Client-side injected failure; quacks like a real RpcError for
+    every caller in this repo (code()/details())."""
+
+    def __init__(self, code, method):
+        super().__init__()
+        self._code = code
+        self._method = method
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return "injected fault on %s" % self._method
+
+    def __str__(self):
+        return "FaultInjectedError(%s, %s)" % (self._code, self._method)
+
+
+_STATUS = {
+    "unavailable": grpc.StatusCode.UNAVAILABLE,
+    "deadline": grpc.StatusCode.DEADLINE_EXCEEDED,
+}
+
+
+class _FaultServerInterceptor(grpc.ServerInterceptor):
+    """Wraps matching unary-unary handlers; the wrapped behavior runs
+    the spec schedule before delegating."""
+
+    def __init__(self, specs):
+        self._specs = specs
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or not handler.unary_unary:
+            return handler
+        method = _bare_method(handler_call_details.method)
+        specs = [
+            s for s in self._specs if s.matches(current_role(), method)
+        ]
+        if not specs:
+            return handler
+        inner = handler.unary_unary
+
+        def faulted(request, context):
+            for spec in specs:
+                action = spec.fire()
+                if action is None:
+                    continue
+                if action == "kill":
+                    _kill_self(method)
+                elif isinstance(action, tuple):  # ("delay", secs)
+                    time.sleep(action[1])
+                else:
+                    context.abort(
+                        _STATUS[action], "injected fault on %s" % method
+                    )
+            return inner(request, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            faulted,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
+class _FaultClientInterceptor(grpc.UnaryUnaryClientInterceptor):
+    def __init__(self, specs):
+        self._specs = specs
+
+    def intercept_unary_unary(self, continuation, client_call_details,
+                              request):
+        method = _bare_method(client_call_details.method)
+        for spec in self._specs:
+            if not spec.matches(current_role(), method):
+                continue
+            action = spec.fire()
+            if action is None:
+                continue
+            if action == "kill":
+                _kill_self(method)
+            elif isinstance(action, tuple):
+                time.sleep(action[1])
+            else:
+                raise FaultInjectedError(_STATUS[action], method)
+        return continuation(client_call_details, request)
+
+
+def server_interceptors():
+    """() when EDL_FAULT_SPEC is unset — build_server's call path is
+    then byte-identical to an uninstrumented server."""
+    specs = _specs()
+    if not specs:
+        return ()
+    return (_FaultServerInterceptor(specs),)
+
+
+def intercept_client_channel(channel):
+    """The channel itself when EDL_FAULT_SPEC is unset; a fault-
+    intercepted wrapper otherwise."""
+    specs = _specs()
+    if not specs:
+        return channel
+    return grpc.intercept_channel(channel, _FaultClientInterceptor(specs))

@@ -165,6 +165,11 @@ class HotRowCache:
             return np.zeros(unique.shape, dtype=bool), None
         return fresh, rows[pos_clipped[fresh]]
 
+    def clear(self):
+        """Invalidate every cached row (e.g. the PS they were pulled
+        from relaunched); hit/miss tallies are kept."""
+        self._tables.clear()
+
     def put(self, name, new_ids, new_rows):
         new_ids = np.asarray(new_ids, dtype=np.int64)
         new_rows = np.asarray(new_rows, dtype=np.float32)
@@ -211,6 +216,15 @@ class SparseBatchPreparer:
         self._ps = ps_client
         self._registered = False
         self._cache = cache
+        if hasattr(ps_client, "resync_hook"):
+            # PS crash recovery: when the client detects a relaunched
+            # shard (version regression on a push response), re-push the
+            # embedding-table infos on the next prepare — a PS that
+            # restored nothing must not lazily create tables with
+            # default dims/initializers — and drop cached rows that no
+            # longer reflect the restored store
+            ps_client.resync_hook = self._on_ps_restart
+
         self._pull_pool = None
         if len(self._specs) > 1:
             self._pull_pool = concurrent.futures.ThreadPoolExecutor(
@@ -225,6 +239,13 @@ class SparseBatchPreparer:
     @property
     def cache(self):
         return self._cache
+
+    def _on_ps_restart(self, shard):
+        self._registered = False
+        if self._cache is not None:
+            # cached rows were pulled from the dead process's store;
+            # staleness bounds don't cover a whole relaunch
+            self._cache.clear()
 
     def register_tables(self):
         if not self._registered:

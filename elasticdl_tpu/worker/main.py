@@ -43,6 +43,10 @@ def main(argv=None):
         os.environ[http_server.PORT_ENV] = str(args.metrics_port)
     trace.configure("worker-%d" % args.worker_id)
     events.configure("worker-%d" % args.worker_id)
+    from elasticdl_tpu.testing import faults
+
+    # before any master/PS channel is built: fault specs match on role
+    faults.set_role("worker-%d" % args.worker_id)
     # black box discipline (ISSUE 3): a K8s eviction (SIGTERM) or an
     # uncaught exception dumps the event ring and flushes the journal +
     # trace buffer, so the killed pod's last moments survive it

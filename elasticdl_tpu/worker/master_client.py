@@ -3,14 +3,23 @@
 Reference parity: elasticdl/python/worker/master_client.py — get_task
 returns an empty Task on RPC error, which the worker reads as "job over"
 (:63-69), so a master that exits cleanly never strands its workers.
+
+Master-restart tolerance (ISSUE 4): connection errors on get_task are
+retried with full-jitter backoff through ``MASTER_RETRY_BUDGET_SECS``
+(the relaunch window of a journaled master,
+``EDL_MASTER_RETRY_BUDGET_SECS`` overrides) before concluding job-over,
+and every response's ``master_epoch`` feeds a restart detector: when
+the epoch moves, this client re-registers (reset_worker) so the new
+master process knows the worker before it carries on.
 """
 
+import os
 import socket
 
 import grpc
 
 from elasticdl_tpu.common.constants import GRPC
-from elasticdl_tpu.common.grpc_utils import build_channel
+from elasticdl_tpu.common.grpc_utils import build_channel, retry_call
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability.grpc_metrics import instrument_channel
 from elasticdl_tpu.common.tensor_utils import ndarray_to_blob
@@ -18,6 +27,15 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.services import MasterStub
 
 logger = _logger_factory("elasticdl_tpu.worker.master_client")
+
+# how long get_task keeps retrying a CONNECTION failure before reading
+# it as job-over: must cover a master pod relaunch + journal replay
+try:
+    MASTER_RETRY_BUDGET_SECS = float(
+        os.environ.get("EDL_MASTER_RETRY_BUDGET_SECS", "") or 120.0
+    )
+except ValueError:
+    MASTER_RETRY_BUDGET_SECS = 120.0
 
 
 class MasterClient:
@@ -34,6 +52,11 @@ class MasterClient:
         # master-assigned relaunch epoch (reset_worker response); the
         # worker's push incarnation. None until reset_worker succeeds.
         self._incarnation = None
+        # restart detector: the master's boot epoch as last seen on a
+        # response. Only a client that REGISTERED re-registers on a
+        # move (a PS's liveness poll must not start registering).
+        self._seen_master_epoch = None
+        self._registered = False
         # readiness signal for /readyz: True once any RPC round-tripped
         self._channel_ok = False
         # fleet-telemetry piggyback (ISSUE 3): a callable returning a
@@ -76,9 +99,35 @@ class MasterClient:
     # get_task deadline misses tolerated before concluding job-over: an
     # empty Task makes the worker EXIT, so a single slow call (master
     # under API-server pressure, long dispatcher-lock hold during a
-    # recovery sweep) must not end training. Connection errors don't
-    # get this grace — a dead master fails fast, as before.
+    # recovery sweep) must not end training. Connection errors get the
+    # jittered MASTER_RETRY_BUDGET_SECS instead — a master pod relaunch
+    # (journal replay included) must not end the job either.
     GET_TASK_DEADLINE_RETRIES = 3
+
+    def _maybe_reregister(self, master_epoch):
+        """Fold a response's master_epoch into the restart detector;
+        returns True when the master restarted and this client
+        re-registered (callers discard the triggering response — the
+        re-registration requeued anything the new master had just
+        assigned us)."""
+        if not master_epoch or not self._registered:
+            return False
+        if self._seen_master_epoch is None:
+            self._seen_master_epoch = master_epoch
+            return False
+        if master_epoch == self._seen_master_epoch:
+            return False
+        logger.warning(
+            "master restarted (epoch %d -> %d); re-registering "
+            "worker %d", self._seen_master_epoch, master_epoch,
+            self._worker_id,
+        )
+        # commit the new epoch only if re-registration SUCCEEDED
+        # (reset_worker updates _seen_master_epoch from its response):
+        # on a transient failure the epoch stays "unseen", so the next
+        # response retries the re-registration instead of silently
+        # never introducing this worker to the new master
+        return self.reset_worker() is not None
 
     def get_task(self, task_type=None):
         request = pb.GetTaskRequest(worker_id=self._worker_id)
@@ -88,8 +137,14 @@ class MasterClient:
         deadline_misses = 0
         while True:
             try:
-                return self._stub.get_task(
-                    request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                task = retry_call(
+                    lambda: self._stub.get_task(
+                        request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                    ),
+                    "get_task",
+                    MASTER_RETRY_BUDGET_SECS,
+                    retryable=(grpc.StatusCode.UNAVAILABLE,),
+                    channel=self._channel,
                 )
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
@@ -104,9 +159,16 @@ class MasterClient:
                         deadline_misses, self.GET_TASK_DEADLINE_RETRIES,
                     )
                     continue
-                # Master gone (or slow past every grace deadline):
-                # treat as job over (reference behavior).
+                # Master gone past the whole relaunch budget (or slow
+                # past every grace deadline): job over (reference
+                # behavior).
                 return pb.Task()
+            self._channel_ok = True
+            if self._maybe_reregister(task.master_epoch):
+                # discard: reset_worker requeued whatever the restarted
+                # master just handed this id; fetch fresh
+                continue
+            return task
 
     def report_task_result(self, task_id, err_message="", exec_counters=None):
         request = pb.ReportTaskResultRequest(
@@ -165,23 +227,41 @@ class MasterClient:
             return None
         self._channel_ok = True
         self._incarnation = response.restart_count
+        self._registered = True
+        if response.master_epoch:
+            self._seen_master_epoch = response.master_epoch
         return self._incarnation
 
     def get_comm_info(self):
+        request = self._attach_telemetry(
+            pb.GetCommInfoRequest(
+                worker_id=self._worker_id,
+                worker_host=self._worker_host,
+            )
+        )
         try:
-            info = self._stub.get_comm_info(
-                self._attach_telemetry(
-                    pb.GetCommInfoRequest(
-                        worker_id=self._worker_id,
-                        worker_host=self._worker_host,
-                    )
+            # a short channel-driving retry: the heartbeat / PS
+            # liveness poll is often the only RPC a quiet process
+            # makes, and fail-fast attempts alone never re-dial a
+            # TRANSIENT_FAILURE channel — without the kick, the caller
+            # would report the master dead forever after a relaunch
+            info = retry_call(
+                lambda: self._stub.get_comm_info(
+                    request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
                 ),
-                timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS,
+                "get_comm_info",
+                8.0,
+                retryable=(grpc.StatusCode.UNAVAILABLE,),
+                channel=self._channel,
             )
         except grpc.RpcError:
             self._channel_ok = False
             return pb.CommInfo(rank=-1, world_size=0, mesh_epoch=-1)
         self._channel_ok = True
+        # the heartbeat is usually the first RPC to see a restarted
+        # master: re-register so the new process has this worker's
+        # liveness + relaunch epoch before the next dispatch
+        self._maybe_reregister(info.master_epoch)
         return info
 
     def close(self):

@@ -9,16 +9,16 @@ pull); there is no per-step dense traffic.
 """
 
 import concurrent.futures
-import time
+import threading
 from typing import NamedTuple, Tuple
 
 import grpc
 import numpy as np
 
 from elasticdl_tpu.common.constants import GRPC
-from elasticdl_tpu.common.grpc_utils import build_channel
+from elasticdl_tpu.common.grpc_utils import build_channel, retry_call
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
-from elasticdl_tpu.observability import trace
+from elasticdl_tpu.observability import events, trace
 from elasticdl_tpu.observability.grpc_metrics import instrument_channel
 from elasticdl_tpu.common.tensor_utils import (
     blob_to_ndarray,
@@ -37,28 +37,22 @@ logger = _logger_factory("elasticdl_tpu.worker.ps_client")
 # worker main connected to every PS channel with retry/timeout
 # (worker/main.py:87). UNAVAILABLE/UNKNOWN-connection errors retry with
 # backoff up to this budget; anything else (bad request, server logic
-# error) surfaces immediately.
+# error) surfaces immediately. The backoff itself is the shared
+# FULL-JITTER policy (common/grpc_utils.retry_call): a sync fleet whose
+# every worker hits the relaunching PS must not retry in lockstep.
 PS_RETRY_BUDGET_SECS = 120.0
-_RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
 
 
-def _call_with_retry(fn, what, budget_secs=None):
-    budget = PS_RETRY_BUDGET_SECS if budget_secs is None else budget_secs
-    deadline = time.time() + budget
-    delay = 0.5
-    while True:
-        try:
-            return fn()
-        except grpc.RpcError as e:
-            code = e.code() if hasattr(e, "code") else None
-            if code not in _RETRYABLE or time.time() + delay > deadline:
-                raise
-            logger.warning(
-                "PS %s unavailable (%s); retrying in %.1fs", what, code,
-                delay,
-            )
-            time.sleep(delay)
-            delay = min(delay * 2, 10.0)
+def _call_with_retry(fn, what, budget_secs=None, channel=None):
+    return retry_call(
+        fn,
+        "PS %s" % what,
+        PS_RETRY_BUDGET_SECS if budget_secs is None else budget_secs,
+        # the backoff actively drives this shard's channel reconnection
+        # (grpc_utils._await_reconnect) — fail-fast retries alone never
+        # re-dial a TRANSIENT_FAILURE channel
+        channel=channel,
+    )
 
 
 class PushResult(NamedTuple):
@@ -74,10 +68,10 @@ class PSClient:
     def __init__(self, ps_addrs, worker_id=None, incarnation=None):
         if isinstance(ps_addrs, str):
             ps_addrs = [a for a in ps_addrs.split(",") if a]
-        self._stubs = [
-            PserverStub(instrument_channel(build_channel(a)))
-            for a in ps_addrs
+        self._channels = [
+            instrument_channel(build_channel(a)) for a in ps_addrs
         ]
+        self._stubs = [PserverStub(ch) for ch in self._channels]
         # identity stamped onto pushes so the sync PS can clean its
         # round buffer per worker (orphaned-half-round recovery after a
         # mid-round kill, ps/servicer.py); None = anonymous. The
@@ -115,6 +109,24 @@ class PSClient:
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(4, len(self._stubs))
         )
+        # PS-restart detection (ISSUE 4): a PS's store version only
+        # grows within one process lifetime, so a push response whose
+        # version is BELOW the highest this client has seen from that
+        # shard means the PS relaunched (auto-restored a checkpoint, or
+        # booted fresh). On detection the client resyncs: re-pushes the
+        # cached dense init, fires ``resync_hook`` (the sparse preparer
+        # re-registers embedding-table infos), and reports the PS's
+        # version so the trainer rolls back instead of pushing
+        # gradients into a void.
+        self._version_lock = threading.Lock()
+        self._shard_versions = {}  # shard -> highest seen store version
+        # shard -> last seen restored_version stamp: a CHANGE means a
+        # relaunch even when the version clock didn't regress (the PS
+        # died right after checkpointing, so the restored clock matches
+        # — but its round buffer and dense state are still gone)
+        self._shard_restored = {}
+        self._dense_init = None    # (params, version) last pushed
+        self.resync_hook = None    # callable(shard); preparer installs
 
     @property
     def ps_num(self):
@@ -130,17 +142,72 @@ class PSClient:
             )
         list(
             self._pool.map(
-                lambda stub: _call_with_retry(
-                    lambda: stub.push_embedding_table_infos(
+                lambda pair: _call_with_retry(
+                    lambda stub=pair[0]: stub.push_embedding_table_infos(
                         request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
                     ),
                     "push_embedding_table_infos",
+                    channel=pair[1],
                 ),
-                self._stubs,
+                zip(self._stubs, self._channels),
             )
         )
 
+    def _note_version(self, shard, version, restored_wire):
+        """Fold one push response's store version into the per-shard
+        monotonic expectation; on regression, resync that shard.
+        Returns True when a regression was handled."""
+        with self._version_lock:
+            last = self._shard_versions.get(shard)
+            regressed = last is not None and version < last
+            last_restored = self._shard_restored.get(shard)
+            restarted = (
+                last_restored is not None
+                and restored_wire != last_restored
+            )
+            self._shard_versions[shard] = version
+            self._shard_restored[shard] = restored_wire
+        if not regressed and not restarted:
+            return False
+        restored = restored_wire - 1 if restored_wire > 0 else None
+        logger.warning(
+            "PS shard %d relaunched (version %d, %d seen; restored "
+            "checkpoint: %s) — resyncing model and adopting its version",
+            shard, version, last,
+            restored if restored is not None else "none",
+        )
+        if self._dense_init is not None:
+            params, dense_version = self._dense_init
+            request = pb.Model(version=dense_version)
+            for name, array in params.items():
+                ndarray_to_blob(
+                    np.asarray(array), request.dense_parameters[name]
+                )
+            try:
+                # push_model is first-writer-wins on the PS: the
+                # relaunched process has no dense state, so this lands;
+                # a healthy shard would ignore it
+                _call_with_retry(
+                    lambda: self._stubs[shard].push_model(
+                        request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                    ),
+                    "push_model (resync)",
+                    channel=self._channels[shard],
+                )
+            except grpc.RpcError:
+                logger.warning("dense re-init to PS %d failed", shard)
+        hook = self.resync_hook
+        if hook is not None:
+            hook(shard)
+        events.emit(
+            "worker_resynced", shard=shard, version=version,
+            restored=restored if restored is not None else -1,
+            worker=self._worker_id if self._worker_id is not None else -1,
+        )
+        return True
+
     def push_dense_init(self, params, version=0):
+        self._dense_init = (dict(params), version)
         request = pb.Model(version=version)
         for name, array in params.items():
             ndarray_to_blob(np.asarray(array), request.dense_parameters[name])
@@ -184,6 +251,7 @@ class PSClient:
                     request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
                 ),
                 "pull_embedding_vectors",
+                channel=self._channels[0],
             )
             return blob_to_ndarray(blob)
         shard_of = ids % self.ps_num
@@ -203,6 +271,7 @@ class PSClient:
                         request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
                     ),
                 "pull_embedding_vectors",
+                channel=self._channels[int(shard)],
             )
         dim = None
         rows = None
@@ -302,15 +371,27 @@ class PSClient:
                             request, timeout=PS_RETRY_BUDGET_SECS
                         ),
                     "push_gradients",
+                    channel=self._channels[shard],
                 ))
             )
         # empty push (e.g. fully masked batch): version must pass
         # through unchanged, or a sync worker would look maximally stale
         version = model_version
         rejected = []
+        regressed_versions = []
         for shard, future in futures:
             response = future.result()
+            if self._note_version(
+                shard, response.version, response.restored_version
+            ):
+                regressed_versions.append(response.version)
             version = max(version, response.version)
             if not response.accepted:
                 rejected.append(shard)
+        if regressed_versions:
+            # a PS relaunched mid-job: report ITS version (the lowest
+            # reality on the wire) so the trainer rolls back to it —
+            # continuing at the old high version would make every
+            # staleness/round computation lie about the restored state
+            version = min(regressed_versions)
         return PushResult(not rejected, version, tuple(rejected))

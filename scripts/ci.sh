@@ -145,6 +145,118 @@ python scripts/postmortem.py "$EDL_EVENTS_DIR" 2>/dev/null | tee /tmp/_postmorte
 grep -q "task_dispatch" /tmp/_postmortem.out
 grep -q "per-worker summary:" /tmp/_postmortem.out
 
+echo "== tier 1e: chaos smoke (EDL_FAULT_SPEC + control-plane crash recovery) =="
+# a live local master+PS+worker job under deterministic fault injection
+# (docs/FAULT_TOLERANCE.md): the PS answers UNAVAILABLE for its first
+# pushes (the worker's jittered retry rides through), the master
+# SIGKILLs itself mid-epoch (kill-once) and is relaunched to replay its
+# EDL_STATE_DIR journal. The job must complete with every task done
+# exactly once, and the postmortem must thread the recovery events.
+# (The gRPC-free local executor can't host interceptor faults; this is
+# the smallest real-wire topology.)
+CHAOS_DIR="$(mktemp -d)"
+export CHAOS_DIR
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, signal, socket, subprocess, sys, tempfile, threading, time
+sys.path.insert(0, "tests")
+# trim the post-job retry tail BEFORE master_client is imported (the
+# budget is read at import time)
+os.environ["EDL_MASTER_RETRY_BUDGET_SECS"] = "60"
+from test_utils import create_ctr_recordio
+from elasticdl_tpu.common.grpc_utils import find_free_port
+
+chaos = os.environ["CHAOS_DIR"]
+events_dir = os.path.join(chaos, "events")
+state_dir = os.path.join(chaos, "state")
+os.makedirs(events_dir); os.makedirs(state_dir)
+train = tempfile.mkdtemp()
+create_ctr_recordio(train + "/f0.rec", num_records=512, seed=0)
+mport, pport = find_free_port(), find_free_port()
+base_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+            "EDL_EVENTS_DIR": events_dir}
+master_cmd = [
+    sys.executable, "-m", "elasticdl_tpu.master.main",
+    "--model_zoo", "elasticdl_tpu.models.deepfm",
+    "--training_data", train, "--records_per_task", "64",
+    "--num_epochs", "1", "--port", str(mport),
+    "--task_timeout_secs", "60",
+]
+master = subprocess.Popen(master_cmd, env={
+    **base_env, "EDL_STATE_DIR": state_dir,
+    # deterministic: the 4th task report SIGKILLs the master mid-epoch
+    "EDL_FAULT_SPEC": "master:report_task_result:kill-once:4",
+})
+ps = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.ps.server", "--ps_id", "0",
+    "--num_ps_pods", "1", "--port", str(pport),
+    "--opt_type", "adam", "--opt_args", "lr=0.01",
+], env={
+    **base_env,
+    # deterministic burst: first 3 pushes fail UNAVAILABLE; the
+    # worker's full-jitter retry must ride through without burning
+    # task retries
+    "EDL_FAULT_SPEC": "ps-0:push_gradients:unavailable:3",
+})
+
+def wait_port(port, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port)); return
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    raise TimeoutError(port)
+
+wait_port(mport); wait_port(pport)
+from elasticdl_tpu.data.readers import RecordIODataReader
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+mc = MasterClient("localhost:%d" % mport, worker_id=0)
+mc.reset_worker()
+worker = Worker(
+    mc, "elasticdl_tpu.models.deepfm",
+    RecordIODataReader(data_dir=train), minibatch_size=64,
+    wait_sleep_secs=0.1, ps_addrs=["localhost:%d" % pport],
+)
+runner = threading.Thread(target=worker.run, daemon=True)
+runner.start()
+# the injected kill-once takes the master down mid-epoch...
+master.wait(timeout=180)
+assert master.returncode != 0, "master survived its kill-once fault"
+# ...and the relaunch (fault spec cleared) replays the state journal
+master = subprocess.Popen(master_cmd, env={
+    **base_env, "EDL_STATE_DIR": state_dir,
+})
+rc = master.wait(timeout=300)
+assert rc == 0, "relaunched master did not finish the job (rc=%s)" % rc
+runner.join(timeout=150)
+assert not runner.is_alive(), "worker never finished"
+ps.terminate(); ps.wait(timeout=30)
+# done-exactly-once accounting straight from the state journal
+ops = []
+with open(os.path.join(state_dir, "master.journal.ndjson")) as f:
+    for line in f:
+        try:
+            ops.append(json.loads(line))
+        except ValueError:
+            pass  # torn tail from the SIGKILL
+created = {t[0] for op in ops if op["op"] == "tasks_created"
+           for t in op["tasks"]}
+done = [op["task"] for op in ops if op["op"] == "done"]
+assert sorted(done) == sorted(created), (len(done), len(created))
+assert len([op for op in ops if op["op"] == "master_restarted"]) == 2
+print("chaos smoke OK: %d tasks done exactly once across a master kill"
+      % len(done))
+PYEOF
+python scripts/postmortem.py "$CHAOS_DIR/events" 2>/dev/null | tee /tmp/_chaos_pm.out | head -5 || true
+# the recovery events thread through the postmortem timeline
+grep -q "master_restarted" /tmp/_chaos_pm.out
+grep -q "task_dispatch" /tmp/_chaos_pm.out
+grep -q "worker_register" /tmp/_chaos_pm.out
+
 echo "== tier 2a: multi-chip SPMD dryrun (dp/fsdp, tp/sp, ep, pp, pp x tp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

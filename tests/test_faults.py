@@ -1,0 +1,142 @@
+"""Deterministic fault injection (testing/faults.py): spec grammar,
+deterministic schedules, and the provably-inert disabled path."""
+
+import grpc
+import pytest
+
+from elasticdl_tpu.common.grpc_utils import (
+    build_channel,
+    build_server,
+    find_free_port,
+)
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.services import (
+    MasterStub,
+    add_master_servicer_to_server,
+)
+from elasticdl_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV, raising=False)
+    faults._reset_for_tests()
+    yield
+    faults._reset_for_tests()
+
+
+def test_spec_parse_and_match():
+    spec = faults.FaultSpec.parse("ps-*:push_gradients:unavailable:3")
+    assert spec.matches("ps-0", "push_gradients")
+    assert spec.matches("ps-12", "push_gradients")
+    assert not spec.matches("worker-0", "push_gradients")
+    assert not spec.matches("ps-0", "pull_embedding_vectors")
+    wildcard = faults.FaultSpec.parse("*:*:deadline:0.5:7")
+    assert wildcard.matches("", "anything")
+    with pytest.raises(ValueError):
+        faults.FaultSpec.parse("too:few")
+    with pytest.raises(ValueError):
+        faults.FaultSpec.parse("a:b:explode:1")
+
+
+def test_burst_schedule_is_deterministic():
+    spec = faults.FaultSpec.parse("m:get_task:unavailable:3")
+    fired = [spec.fire() for _ in range(6)]
+    assert fired == ["unavailable"] * 3 + [None] * 3
+
+
+def test_probability_schedule_reproducible_per_seed():
+    a = faults.FaultSpec.parse("m:x:unavailable:0.5:42")
+    b = faults.FaultSpec.parse("m:x:unavailable:0.5:42")
+    schedule_a = [a.fire() for _ in range(64)]
+    schedule_b = [b.fire() for _ in range(64)]
+    assert schedule_a == schedule_b
+    assert "unavailable" in schedule_a and None in schedule_a
+
+
+def test_kill_once_fires_on_nth_call_only():
+    spec = faults.FaultSpec.parse("m:x:kill-once:3")
+    assert [spec.fire() for _ in range(5)] == [
+        None, None, "kill", None, None
+    ]
+
+
+def test_inert_when_env_unset():
+    assert not faults.enabled()
+    assert faults.server_interceptors() == ()
+    channel = grpc.insecure_channel("localhost:1")
+    try:
+        # identity: the exact object, no wrapper in the call path
+        assert faults.intercept_client_channel(channel) is channel
+    finally:
+        channel.close()
+
+
+def test_delay_spec_returns_sleep_action():
+    spec = faults.FaultSpec.parse("m:x:delay:0.25")
+    assert spec.fire() == ("delay", 0.25)
+    assert spec.fire() == ("delay", 0.25)
+
+
+def _serve_master(dispatcher):
+    server = build_server()
+    add_master_servicer_to_server(MasterServicer(dispatcher), server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    return server, port
+
+
+def test_server_interceptor_injects_unavailable_burst(monkeypatch):
+    monkeypatch.setenv(
+        faults.FAULT_SPEC_ENV, "master:get_task:unavailable:2"
+    )
+    faults.set_role("master")
+    dispatcher = TaskDispatcher(
+        training_shards={"f0": (0, 64)}, records_per_task=64
+    )
+    server, port = _serve_master(dispatcher)
+    try:
+        stub = MasterStub(grpc.insecure_channel("localhost:%d" % port))
+        request = pb.GetTaskRequest(worker_id=1)
+        for _ in range(2):
+            with pytest.raises(grpc.RpcError) as excinfo:
+                stub.get_task(request, timeout=5)
+            assert excinfo.value.code() == grpc.StatusCode.UNAVAILABLE
+        # burst exhausted: the call path is the real handler again
+        task = stub.get_task(request, timeout=5)
+        assert task.task_id != 0
+        # other methods never matched the spec
+        stub.report_task_result(
+            pb.ReportTaskResultRequest(task_id=task.task_id, worker_id=1),
+            timeout=5,
+        )
+    finally:
+        server.stop(0)
+
+
+def test_client_interceptor_raises_code_the_retry_path_reads(monkeypatch):
+    dispatcher = TaskDispatcher(
+        training_shards={"f0": (0, 64)}, records_per_task=64
+    )
+    server, port = _serve_master(dispatcher)
+    monkeypatch.setenv(
+        faults.FAULT_SPEC_ENV, "worker-1:get_comm_info:unavailable:1"
+    )
+    faults.set_role("worker-1")
+    try:
+        stub = MasterStub(build_channel("localhost:%d" % port))
+        with pytest.raises(grpc.RpcError) as excinfo:
+            stub.get_comm_info(
+                pb.GetCommInfoRequest(worker_id=1), timeout=5
+            )
+        assert excinfo.value.code() == grpc.StatusCode.UNAVAILABLE
+        # one-shot burst: next call goes through to the real server
+        info = stub.get_comm_info(
+            pb.GetCommInfoRequest(worker_id=1), timeout=5
+        )
+        assert info.world_size == 1
+    finally:
+        server.stop(0)

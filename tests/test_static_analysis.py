@@ -371,6 +371,44 @@ def test_grpc_timeout_quiet_with_deadline_or_non_stub():
     """)
 
 
+def test_retry_no_jitter_flags_deterministic_backoff_loop():
+    findings = findings_for("""
+        import time
+
+        def call_with_retry(fn):
+            delay = 0.5
+            while True:
+                try:
+                    return fn()
+                except ConnectionError:
+                    time.sleep(delay)            # BUG: lockstep herd
+                    delay = min(delay * 2, 10.0)
+    """)
+    assert rules_of(findings) == {"ft-retry-no-jitter"}
+
+
+def test_retry_no_jitter_quiet_with_jitter_or_constant_sleep():
+    assert not findings_for("""
+        import random
+        import time
+
+        def jittered(fn):
+            ceiling = 0.5
+            while True:
+                try:
+                    return fn()
+                except ConnectionError:
+                    delay = random.uniform(0, ceiling)
+                    time.sleep(delay)
+                    ceiling = min(ceiling * 2, 10.0)
+
+        def poller(fn, poll_secs):
+            while True:
+                fn()
+                time.sleep(poll_secs)   # constant cadence, not backoff
+    """)
+
+
 # ---------------------------------------------------------------------------
 # xhost-determinism
 
@@ -520,6 +558,18 @@ _CLI_POSITIVE_FIXTURES = {
     "ft-grpc-timeout": ("bad_rpc.py", """
         def call(stub, request):
             return stub.get_task(request)
+    """),
+    "ft-retry-no-jitter": ("bad_backoff.py", """
+        import time
+
+        def retry(fn):
+            delay = 1.0
+            while True:
+                try:
+                    return fn()
+                except OSError:
+                    time.sleep(delay)
+                    delay = delay * 2
     """),
     "xhost-determinism": ("bad_checkpoint.py", """
         def restore(names):
