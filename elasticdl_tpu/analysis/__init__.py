@@ -16,6 +16,9 @@ for (or refuses to pay for):
 - ``ft-swallowed-except`` / ``ft-grpc-timeout`` — fault-tolerance
   hygiene: no broad except that swallows without logging/re-raising,
   no gRPC stub call without a deadline.
+- ``perf-varint-ids``     — no per-element Python-loop serialization
+  into repeated proto fields (``.extend(int(i) for i in ids)``); use
+  the packed ``ids_blob`` wire field or ``astype().tolist()``.
 - ``xhost-determinism``   — no set-ordered or filesystem-ordered
   iteration in checkpoint/export/gradient-aggregation paths, where
   ordering must match across hosts.

@@ -221,12 +221,14 @@ def _rules_by_name(names=None):
         hot_path,
         lock_discipline,
         obs_hot_path,
+        perf_wire,
     )
 
     registry = {
         "lock-discipline": lock_discipline.run,
         "jax-hot-path": hot_path.run,
         "obs-hot-path": obs_hot_path.run,
+        "perf-varint-ids": perf_wire.run,
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
         "ft-retry-no-jitter": fault_tolerance.run_retry_no_jitter,
@@ -244,6 +246,7 @@ RULE_NAMES = (
     "lock-discipline",
     "jax-hot-path",
     "obs-hot-path",
+    "perf-varint-ids",
     "ft-swallowed-except",
     "ft-grpc-timeout",
     "ft-retry-no-jitter",

@@ -1,0 +1,92 @@
+"""perf-varint-ids: per-element Python-loop serialization into
+repeated proto fields.
+
+The idiom this rule exists for (the pre-ISSUE-5 wire path's per-step
+hot cost):
+
+    slices.ids.extend(int(i) for i in ids)
+
+Filling a ``repeated int64`` field from a Python generator/compreension
+walks every id through the interpreter AND re-encodes 8-byte ids as
+1-10 varint bytes each. The fixes are mechanical: the packed
+``ids_blob`` wire field (``tensor_utils.pack_ids`` — one vectorized
+``astype().tobytes()``) or, where the repeated field must stay,
+``ids.astype(np.int64).tolist()`` so the element conversion happens in
+numpy, not a Python loop.
+
+Flagged anywhere (not only in resolved-hot functions): serialization
+helpers are rarely decorated ``@hot_path`` themselves but always run
+on the step path of whoever calls them, and the construct has no
+correct-but-slow use worth keeping.
+
+What fires: ``<expr>.extend(<generator or comprehension>)`` whose
+element expression wraps each item in a scalar conversion
+(``int(...)``/``float(...)``) — the signature of feeding a proto
+repeated scalar field element-by-element. A comprehension that does
+real per-element WORK (conditions, arithmetic) is left alone.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, walk_with_scope
+
+RULE = "perf-varint-ids"
+
+_SCALAR_CASTS = {"int", "float"}
+
+
+def _is_scalar_cast_comprehension(node):
+    """True for ``int(i) for i in xs`` / ``[float(v) for v in xs]``:
+    a single-generator, condition-free comprehension whose element is
+    just a scalar cast of the loop variable."""
+    if not isinstance(node, (ast.GeneratorExp, ast.ListComp)):
+        return False
+    if len(node.generators) != 1 or node.generators[0].ifs:
+        return False
+    elt = node.elt
+    if not (
+        isinstance(elt, ast.Call)
+        and isinstance(elt.func, ast.Name)
+        and elt.func.id in _SCALAR_CASTS
+        and len(elt.args) == 1
+        and not elt.keywords
+    ):
+        return False
+    return isinstance(elt.args[0], ast.Name)
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute) and func.attr == "extend"
+            ):
+                continue
+            if len(node.args) != 1:
+                continue
+            if not _is_scalar_cast_comprehension(node.args[0]):
+                continue
+            cast = node.args[0].elt.func.id
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.path,
+                    line=node.lineno,
+                    symbol=scope,
+                    code=".extend(%s(...))" % cast,
+                    message=(
+                        "per-element Python-loop serialization: "
+                        ".extend(%s(x) for x in ...) walks every "
+                        "element through the interpreter (and varint-"
+                        "encodes repeated proto ints one by one); use "
+                        "the packed ids_blob wire field "
+                        "(tensor_utils.pack_ids) or "
+                        "arr.astype(...).tolist()" % cast
+                    ),
+                )
+            )
+    return findings

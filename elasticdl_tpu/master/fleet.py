@@ -158,6 +158,10 @@ class FleetMonitor:
                 "version_lag": int(blob.version_lag),
                 "model_version": int(blob.model_version),
                 "round_buffer_fill": int(blob.round_buffer_fill),
+                # cumulative wire payload bytes at the PS (ISSUE 5) —
+                # what packed ids / EDL_WIRE_DTYPE actually moved
+                "push_bytes": int(blob.push_bytes),
+                "pull_bytes": int(blob.pull_bytes),
             }
             # stuck-round bookkeeping: the clock restarts whenever the
             # fill grows or the store version advances

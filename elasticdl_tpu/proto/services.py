@@ -40,6 +40,10 @@ _PSERVER_METHODS = {
         pb.PullDenseParametersResponse,
     ),
     "pull_embedding_vectors": (pb.PullEmbeddingVectorsRequest, pb.TensorBlob),
+    # fused multi-table pull: every table's ids for this shard ride one
+    # RPC (ids-only IndexedSlicesProto in, per-table row blobs out) —
+    # a step costs ps_num pull RPCs instead of tables x ps_num
+    "pull_embedding_batch": (pb.BatchedSlices, pb.PullEmbeddingBatchResponse),
     "push_gradients": (pb.PushGradientsRequest, pb.PushGradientsResponse),
 }
 

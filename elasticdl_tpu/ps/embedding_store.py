@@ -503,8 +503,19 @@ class NumpyEmbeddingStore:
             raise KeyError(name)
         opt_type, args = self._opt
         lr = args["lr"] * lr_scale
+        ids = np.asarray(ids, dtype=np.int64)
+        grads = np.asarray(grads, dtype=np.float32)
         with self._lock:
-            for i, grad in zip(ids, np.asarray(grads, dtype=np.float32)):
+            if ids.size > 1 and np.unique(ids).size == ids.size:
+                # the common shape: clients dedup before pushing, so a
+                # push's ids are unique — one vectorized [n, dim]
+                # optimizer apply instead of n per-row Python applies
+                # (the elementwise math is identical, so results match
+                # the sequential path bit for bit)
+                self._apply_unique_locked(name, ids, grads, opt_type,
+                                          args, lr)
+                return
+            for i, grad in zip(ids, grads):
                 i = int(i)
                 w = self._row_locked(name, i)
                 slots = self._slots[name][i]
@@ -534,6 +545,67 @@ class NumpyEmbeddingStore:
                         v = slots[2]
                     vhat = v / (1 - args["beta2"] ** step)
                     w -= lr * mhat / (np.sqrt(vhat) + args["epsilon"])
+
+    def _apply_unique_locked(self, name, ids, grads, opt_type, args, lr):
+        """Vectorized optimizer apply for a unique-id push: gather the
+        touched rows/slots into dense [n, ...] arrays, run the update
+        math once, scatter back. Caller holds the lock and guarantees
+        ids are unique (duplicate streams take the sequential path —
+        slot-state optimizers are order-sensitive across repeats)."""
+        id_list = [int(i) for i in ids]
+        # gather in input order: lazy row init draws from the per-table
+        # RNG stream, so creation order must match the sequential path
+        rows = [self._row_locked(name, i) for i in id_list]
+        w = np.stack(rows)
+        slot_map = self._slots[name]
+        step_map = self._steps[name]
+        steps = np.empty((ids.size, 1), dtype=np.float64)
+        for k, i in enumerate(id_list):
+            step_map[i] += 1
+            steps[k, 0] = step_map[i]
+        if opt_type == "sgd":
+            w -= lr * grads
+        elif opt_type in ("momentum", "nesterov"):
+            m = np.stack([slot_map[i][0] for i in id_list])
+            m = args["momentum"] * m + grads
+            if opt_type == "nesterov":
+                w -= lr * (grads + args["momentum"] * m)
+            else:
+                w -= lr * m
+            for k, i in enumerate(id_list):
+                slot_map[i][0] = m[k]
+        elif opt_type == "adagrad":
+            s = np.stack([slot_map[i][0] for i in id_list])
+            s += grads * grads
+            w -= lr * grads / (np.sqrt(s) + args["epsilon"])
+            for k, i in enumerate(id_list):
+                slot_map[i][0] = s[k]
+        elif opt_type in ("adam", "amsgrad"):
+            slots = np.stack([slot_map[i] for i in id_list])
+            slots[:, 0] = (
+                args["beta1"] * slots[:, 0] + (1 - args["beta1"]) * grads
+            )
+            slots[:, 1] = (
+                args["beta2"] * slots[:, 1]
+                + (1 - args["beta2"]) * grads * grads
+            )
+            # bias corrections in float64 then rounded to float32, the
+            # same value the sequential path's weak python-float scalar
+            # takes inside its float32 division — keeps this path
+            # bit-identical to the per-id loop
+            bc1 = (1.0 - args["beta1"] ** steps).astype(np.float32)
+            bc2 = (1.0 - args["beta2"] ** steps).astype(np.float32)
+            mhat = slots[:, 0] / bc1
+            v = slots[:, 1]
+            if opt_type == "amsgrad":
+                slots[:, 2] = np.maximum(slots[:, 2], v)
+                v = slots[:, 2]
+            vhat = v / bc2
+            w -= lr * mhat / (np.sqrt(vhat) + args["epsilon"])
+            for k, i in enumerate(id_list):
+                slot_map[i][:] = slots[k]
+        for k, row in enumerate(rows):
+            row[:] = w[k]
 
     def table_size(self, name):
         return len(self._tables.get(name, {}))

@@ -4,12 +4,30 @@ Lets LocalExecutor (and tests) run sparse models with no gRPC or PS
 processes — the reference's LocalExecutor had no sparse story at all
 (local_executor.py trains only non-EDL-embedding models); this closes
 that gap.
+
+``EDL_WIRE_DTYPE`` is honored here as *precision emulation*: payloads
+round-trip through the configured wire dtype (one astype down and back,
+no actual serialization), so a local-executor run trains with exactly
+the rounding a real worker<->PS deployment under the knob would see —
+the CI opt-in proof lane (scripts/ci.sh tier 1f) relies on this.
 """
 
 import numpy as np
 
-from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
+from elasticdl_tpu.common.tensor_utils import (
+    deduplicate_indexed_slices,
+    wire_dtype,
+)
 from elasticdl_tpu.ps.embedding_store import create_store, parse_initializer
+
+
+def _wire_round_trip(values):
+    """values -> wire dtype -> float32, mirroring what serialization
+    at EDL_WIRE_DTYPE followed by the receiver's fp32 upcast does."""
+    dtype = wire_dtype()
+    if dtype is None or values.dtype != np.float32:
+        return values
+    return values.astype(dtype).astype(np.float32)
 
 
 class LocalPSClient:
@@ -36,7 +54,17 @@ class LocalPSClient:
         return False, 0, {}
 
     def pull_embedding_vectors(self, name, ids):
-        return self.store.lookup(name, np.asarray(ids, dtype=np.int64))
+        rows = self.store.lookup(name, np.asarray(ids, dtype=np.int64))
+        return _wire_round_trip(rows)
+
+    def pull_embedding_batch(self, ids_by_table):
+        """{table: ids} -> {table: rows}; the in-process analogue of
+        the fused multi-table pull RPC."""
+        return {
+            name: self.pull_embedding_vectors(name, ids)
+            for name, ids in ids_by_table.items()
+            if np.asarray(ids).size
+        }
 
     def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0,
                        only_shards=None, force_empty=False,
@@ -50,6 +78,7 @@ class LocalPSClient:
             values, ids = deduplicate_indexed_slices(
                 np.asarray(values), np.asarray(ids, dtype=np.int64)
             )
+            values = _wire_round_trip(np.asarray(values, dtype=np.float32))
             self.store.push_gradients(name, ids, values, lr_scale=lr_scale)
         self.store.bump_version()
         return True, self.store.version

@@ -27,6 +27,8 @@ from elasticdl_tpu.common.tensor_utils import (
     deduplicate_indexed_slices,
     deserialize_indexed_slices,
     ndarray_to_blob,
+    unpack_ids,
+    wire_dtype,
 )
 from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
@@ -34,6 +36,18 @@ from elasticdl_tpu.observability import trace
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = _logger_factory("elasticdl_tpu.ps.servicer")
+
+
+def _deserialize_gradients(slices):
+    """One table's pushed gradients off the wire, upcast to the fp32
+    master precision: a reduced wire dtype (EDL_WIRE_DTYPE) covers the
+    PAYLOAD only — buffering/merging/applying in bf16 would compound
+    rounding across the round's summation, which the knob's contract
+    (fp32 master copies on the PS) rules out."""
+    values, ids = deserialize_indexed_slices(slices)
+    if values.dtype != np.float32:
+        values = values.astype(np.float32)
+    return values, ids
 
 
 class PserverServicer:
@@ -57,6 +71,10 @@ class PserverServicer:
     ):
         self._store = store
         self._ps_id = ps_id
+        # fail a misconfigured EDL_WIRE_DTYPE at boot, not per pull
+        # RPC: a PS that passes health probes while every pull raises
+        # would crash-loop its workers instead of itself
+        wire_dtype()
         # checkpoint version this PS auto-restored at boot, stamped on
         # push/pull responses (wire encoding: version + 1, 0 = none) so
         # workers detecting a version regression know what state the
@@ -136,6 +154,20 @@ class PserverServicer:
             "edl_ps_embedding_rows",
             "Materialized rows per embedding table", ("table",),
         )
+        # Bytes-on-wire counters (ISSUE 5): gradient/row PAYLOAD bytes
+        # (tensor content + packed ids), labeled by the payload dtype so
+        # an EDL_WIRE_DTYPE rollout is directly visible as the fp32
+        # series flatlining and the bf16 series taking over.
+        self._m_push_bytes = obs_metrics.counter(
+            "edl_ps_push_bytes_total",
+            "Gradient payload bytes received (tensor content + ids), "
+            "by wire dtype", ("dtype",),
+        )
+        self._m_pull_bytes = obs_metrics.counter(
+            "edl_ps_pull_bytes_total",
+            "Embedding-row payload bytes served, by wire dtype",
+            ("dtype",),
+        )
         # Fleet-telemetry source (ISSUE 3): plain-int tallies kept
         # INDEPENDENTLY of the metrics registry (telemetry must work
         # with /metrics off), read by telemetry_blob() on the PS's 5 s
@@ -144,6 +176,8 @@ class PserverServicer:
         # magnitudes, not exact totals.
         self._t_push_count = 0
         self._t_pull_count = 0
+        self._t_push_bytes = 0
+        self._t_pull_bytes = 0
         self._t_last_push_version = 0
         self._t_prev = None  # (timestamp, push_count, pull_count)
 
@@ -170,6 +204,8 @@ class PserverServicer:
             ),
             model_version=self._store.version,
             round_buffer_fill=self._buffered_count(),
+            push_bytes=self._t_push_bytes,
+            pull_bytes=self._t_pull_bytes,
         )
 
     def _stamp(self, response):
@@ -246,19 +282,72 @@ class PserverServicer:
                     ndarray_to_blob(array, response.dense_parameters[name])
         return response
 
+    def _pull_table(self, name, ids, blob=None, reduced_ok=True):
+        """Look up one table's rows and serialize them at the wire
+        dtype, folding payload bytes into the counters.
+        ``reduced_ok=False`` pins the payload to fp32 — for legacy
+        clients that predate the wire-dtype contract and cannot decode
+        extension dtype names."""
+        values = self._store.lookup(name, ids)
+        blob = ndarray_to_blob(
+            values, blob,
+            wire_dtype=wire_dtype() if reduced_ok else None,
+        )
+        payload = len(blob.content)
+        self._t_pull_bytes += payload
+        self._m_pull_bytes.labels(dtype=blob.dtype).inc(payload)
+        self._m_pull_requests.labels(table=name).inc()
+        self._m_pull_rows.labels(table=name).inc(int(ids.size))
+        return blob
+
     def pull_embedding_vectors(self, request, context=None):
-        ids = np.asarray(request.ids, dtype=np.int64)
-        values = self._store.lookup(request.name, ids)
+        ids = unpack_ids(request)
         self._t_pull_count += 1
-        self._m_pull_requests.labels(table=request.name).inc()
-        self._m_pull_rows.labels(table=request.name).inc(int(ids.size))
-        return ndarray_to_blob(values)
+        # a request carrying repeated ids (no packed blob) is from a
+        # pre-ids_blob client, which also predates EDL_WIRE_DTYPE:
+        # serve it plain fp32 or its blob_to_ndarray cannot resolve
+        # the extension dtype name ("new servers always serve old
+        # clients", docs/PERFORMANCE.md)
+        legacy_peer = bool(request.ids) and not request.ids_blob
+        return self._pull_table(
+            request.name, ids, reduced_ok=not legacy_peer
+        )
+
+    def pull_embedding_batch(self, request, context=None):
+        """Fused multi-table pull: one RPC serves every table's rows
+        for this shard (request: ids-only IndexedSlicesProto per table;
+        response: per-table row blobs aligned with the request's id
+        order). The legacy per-table pull_embedding_vectors stays
+        served for old peers."""
+        response = pb.PullEmbeddingBatchResponse(
+            restored_version=self._restored_wire
+        )
+        self._t_pull_count += 1
+        for name, slices in request.tables.items():
+            self._pull_table(
+                name, unpack_ids(slices), response.tables[name]
+            )
+        return response
 
     # ------------------------------------------------------------------
+    def _count_push_bytes(self, request):
+        """Fold one push's gradient payload bytes (tensor content +
+        ids, either encoding) into the counters."""
+        payload = 0
+        dtype = "none"
+        for slices in request.gradients.embedding_tables.values():
+            payload += len(slices.concat_tensors.content)
+            payload += len(slices.ids_blob) or 8 * len(slices.ids)
+            dtype = slices.concat_tensors.dtype or dtype
+        self._t_push_bytes += payload
+        if payload:
+            self._m_push_bytes.labels(dtype=dtype).inc(payload)
+
     def push_gradients(self, request, context=None):
         self._t_push_count += 1
         self._t_last_push_version = request.gradients.version
         self._m_push_requests.inc()
+        self._count_push_bytes(request)
         self._m_version_lag.set(
             self._store.version - request.gradients.version
         )
@@ -273,7 +362,7 @@ class PserverServicer:
             lr_scale *= request.lr_scale
         apply_start = time.time() if trace.enabled() else 0.0
         for name, slices in request.gradients.embedding_tables.items():
-            values, ids = deserialize_indexed_slices(slices)
+            values, ids = _deserialize_gradients(slices)
             self._store.push_gradients(name, ids, values, lr_scale=lr_scale)
         trace.complete("ps_apply_push", apply_start,
                        version=grad_version)
@@ -289,17 +378,25 @@ class PserverServicer:
         """Sync push with the journal I/O outside the push lock:
         events decided while holding ``_push_lock`` are written only
         after it is released (same discipline as task_dispatcher) — a
-        slow journal flush must not serialize every worker's push."""
+        slow journal flush must not serialize every worker's push.
+        Gradient deserialization is hoisted out of the lock too: it is
+        pure per-request CPU work, and under it every peer's push of
+        the round serializes behind one worker's decode."""
+        tables = {
+            name: _deserialize_gradients(slices)
+            for name, slices
+            in request.gradients.embedding_tables.items()
+        }
         journal = []
         try:
             return self._push_gradients_sync_locked_path(
-                request, journal
+                request, tables, journal
             )
         finally:
             for event, fields in journal:
                 events.emit(event, **fields)
 
-    def _push_gradients_sync_locked_path(self, request, journal):
+    def _push_gradients_sync_locked_path(self, request, tables, journal):
         """Sync SGD: accumulate grads_to_wait pushes, reject stale ones
         (reference ps/servicer.py:166-236; sparse grads are summed, as
         there — each worker contributes disjoint-sign updates to the
@@ -401,9 +498,6 @@ class PserverServicer:
                         "predecessor's buffered half-round",
                         request.worker_id, version,
                     )
-            tables = {}
-            for name, slices in request.gradients.embedding_tables.items():
-                tables[name] = deserialize_indexed_slices(slices)
             entry = (key, tables, push_scale)
             if events.enabled():
                 # round_open on the first push buffered toward THIS

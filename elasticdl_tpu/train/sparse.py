@@ -16,6 +16,7 @@ capacity so XLA compiles the step once.
 """
 
 import concurrent.futures
+import os
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,14 @@ from elasticdl_tpu.train.train_state import (
 )
 
 logger = _logger_factory("elasticdl_tpu.train.sparse")
+
+# Double-buffered async push (ISSUE 5): step N's gradient push runs on
+# a background executor while step N+1's pull/forward/backward
+# computes; a depth-1 bounded-staleness barrier (SparseTrainer
+# .join_pushes) joins it before the next push is submitted and before
+# any eval/checkpoint boundary. Opt-in, async-PS only — the sync PS's
+# rejection/retry protocol needs the synchronous step.
+ASYNC_PUSH_ENV = "EDL_ASYNC_PUSH"
 
 ROWS_SUFFIX = "__rows"
 INDICES_SUFFIX = "__indices"
@@ -216,6 +225,9 @@ class SparseBatchPreparer:
         self._ps = ps_client
         self._registered = False
         self._cache = cache
+        # set by _on_ps_restart (possibly from the async-push thread),
+        # consumed at the top of prepare() on the pulling thread
+        self._cache_dirty = False
         if hasattr(ps_client, "resync_hook"):
             # PS crash recovery: when the client detects a relaunched
             # shard (version regression on a push response), re-push the
@@ -242,10 +254,16 @@ class SparseBatchPreparer:
 
     def _on_ps_restart(self, shard):
         self._registered = False
-        if self._cache is not None:
-            # cached rows were pulled from the dead process's store;
-            # staleness bounds don't cover a whole relaunch
-            self._cache.clear()
+        # cached rows were pulled from the dead process's store;
+        # staleness bounds don't cover a whole relaunch. The clear is
+        # DEFERRED to the next prepare(): under async push this hook
+        # fires on the push-executor thread, and HotRowCache has no
+        # locking — an immediate clear() here races the main thread's
+        # in-flight cache.put, which could re-insert pre-crash rows
+        # AFTER the invalidation and keep them for `staleness` more
+        # prepares. The flag write is atomic; the clear then runs on
+        # the one thread that ever mutates the cache.
+        self._cache_dirty = True
 
     def register_tables(self):
         if not self._registered:
@@ -253,6 +271,23 @@ class SparseBatchPreparer:
                 [(s.name, s.dim, _wire_initializer(s)) for s in self._specs]
             )
             self._registered = True
+
+    def _assemble_rows(self, spec, unique, cached_mask, cached_rows,
+                       fetched):
+        """Merge cache hits and one fresh fetch into [n_unique, dim]
+        fp32, recording the fetched rows in the cache. The single home
+        of the cache-fill protocol — the per-table and batched pull
+        paths both end here, so a staleness/fill rule change cannot
+        fork between them."""
+        rows = np.empty((unique.size, spec.dim), dtype=np.float32)
+        if cached_rows is not None:
+            rows[cached_mask] = cached_rows
+        missing = unique[~cached_mask]
+        if missing.size:
+            fetched = np.asarray(fetched, dtype=np.float32)
+            rows[~cached_mask] = fetched
+            self._cache.put(spec.name, missing, fetched)
+        return rows
 
     def _pull_rows(self, spec, unique):
         """Pull rows for the unique ids of one table, consulting the
@@ -263,24 +298,80 @@ class SparseBatchPreparer:
                 dtype=np.float32,
             )
         cached_mask, cached_rows = self._cache.split(spec.name, unique)
-        rows = np.empty((unique.size, spec.dim), dtype=np.float32)
-        if cached_rows is not None:
-            rows[cached_mask] = cached_rows
         missing = unique[~cached_mask]
+        fetched = None
         if missing.size:
-            pulled = np.asarray(
-                self._ps.pull_embedding_vectors(spec.name, missing),
-                dtype=np.float32,
-            )
-            rows[~cached_mask] = pulled
-            self._cache.put(spec.name, missing, pulled)
-        return rows
+            fetched = self._ps.pull_embedding_vectors(spec.name, missing)
+        return self._assemble_rows(
+            spec, unique, cached_mask, cached_rows, fetched
+        )
+
+    def _pull_tables(self, plans):
+        """Pull every table's unique rows for this batch; returns
+        {name: (capacity, rows [n_unique, dim] float32)}.
+
+        Against a batch-capable client (PSClient, LocalPSClient) the
+        cache-missing ids of ALL tables ride one fused
+        pull_embedding_batch call — ps_num RPCs per step instead of
+        tables x ps_num (DeepFM: 3 tables over 2 shards went 6 -> 2).
+        A client without the batch surface falls back to the per-table
+        thread fan-out."""
+        batch_pull = getattr(self._ps, "pull_embedding_batch", None)
+        if batch_pull is None:
+            if self._pull_pool is not None and len(plans) > 1:
+                futures = [
+                    (spec, capacity,
+                     self._pull_pool.submit(self._pull_rows, spec, unique))
+                    for spec, unique, capacity in plans
+                    if unique.size
+                ]
+                return {
+                    spec.name: (capacity, future.result())
+                    for spec, capacity, future in futures
+                }
+            return {
+                spec.name: (capacity, self._pull_rows(spec, unique))
+                for spec, unique, capacity in plans
+                if unique.size
+            }
+        to_pull = {}
+        cache_parts = {}  # name -> (cached_mask, cached_rows)
+        for spec, unique, capacity in plans:
+            if not unique.size:
+                continue
+            if self._cache is None:
+                to_pull[spec.name] = unique
+                continue
+            cached_mask, cached_rows = self._cache.split(spec.name, unique)
+            cache_parts[spec.name] = (cached_mask, cached_rows)
+            missing = unique[~cached_mask]
+            if missing.size:
+                to_pull[spec.name] = missing
+        fetched = batch_pull(to_pull) if to_pull else {}
+        pulled = {}
+        for spec, unique, capacity in plans:
+            if not unique.size:
+                continue
+            if self._cache is None:
+                rows = np.asarray(fetched[spec.name], dtype=np.float32)
+            else:
+                cached_mask, cached_rows = cache_parts[spec.name]
+                rows = self._assemble_rows(
+                    spec, unique, cached_mask, cached_rows,
+                    fetched.get(spec.name),
+                )
+            pulled[spec.name] = (capacity, rows)
+        return pulled
 
     def prepare(self, batch):
         """Returns (batch with rows/indices features, pull_info) where
         pull_info = {name: (unique_ids, n_unique)} for the grad push."""
         self.register_tables()
         if self._cache is not None:
+            if self._cache_dirty:
+                # deferred PS-relaunch invalidation (_on_ps_restart)
+                self._cache_dirty = False
+                self._cache.clear()
             self._cache.advance()
         features = dict(batch["features"])
         # Zero-padded batch rows (lockstep padding, SPMD batch-multiple
@@ -345,25 +436,7 @@ class SparseBatchPreparer:
             ).astype(np.int32)
             pull_info[spec.name] = (unique, unique.size)
             plans.append((spec, unique, capacity))
-        # fan out this batch's pulls across tables (each may itself fan
-        # out across PS shards inside the client)
-        if self._pull_pool is not None and len(plans) > 1:
-            futures = [
-                (spec, capacity,
-                 self._pull_pool.submit(self._pull_rows, spec, unique))
-                for spec, unique, capacity in plans
-                if unique.size
-            ]
-            pulled = {
-                spec.name: (capacity, future.result())
-                for spec, capacity, future in futures
-            }
-        else:
-            pulled = {
-                spec.name: (capacity, self._pull_rows(spec, unique))
-                for spec, unique, capacity in plans
-                if unique.size
-            }
+        pulled = self._pull_tables(plans)
         for spec, unique, capacity in plans:
             padded = np.zeros((capacity, spec.dim), dtype=np.float32)
             if unique.size:
@@ -414,7 +487,13 @@ def _normalize_push_result(result, model_version):
         return True, model_version, ()
     parts = tuple(result)
     if len(parts) >= 3:
-        return parts[0], parts[1], tuple(parts[2])
+        # idempotent: a re-normalized (accepted, version, None) must
+        # keep its unknown-shards None, not crash in tuple(None)
+        rejected = parts[2]
+        return (
+            parts[0], parts[1],
+            None if rejected is None else tuple(rejected),
+        )
     accepted, version = parts
     return accepted, version, None if not accepted else ()
 
@@ -567,6 +646,7 @@ class SparseTrainer:
         seed=0,
         cache_staleness=0,
         cache_capacity=1_000_000,
+        async_push=None,
     ):
         self._model = model
         self._tx = optimizer
@@ -596,6 +676,26 @@ class SparseTrainer:
         # observability: total sync-PS version rejections this trainer
         # has retried through (tests assert the race really raced)
         self.push_rejections = 0
+        # Async double-buffered push (ASYNC_PUSH_ENV): at most ONE push
+        # in flight; train_step joins step N-1's push before submitting
+        # step N's, so gradients land at most one step late — inside
+        # the async PS's staleness envelope, the same bound
+        # train_stream already rides.
+        if async_push is None:
+            from elasticdl_tpu.common.args import bool_flag
+
+            raw = os.environ.get(ASYNC_PUSH_ENV, "").strip()
+            # same bool spellings as every other knob (common/args
+            # .bool_flag): "false"/"no" must disable, not silently
+            # enable; garbage fails loudly at construction
+            async_push = bool(bool_flag(raw)) if raw else False
+        self._async_push = bool(async_push)
+        self._push_future = None
+        self._async_pool = None
+        if self._async_push:
+            self._async_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="sparse-async-push"
+            )
         # memo of the last prepared batch, so ensure_state followed by
         # eval_step/train_step on the same batch pulls rows once
         self._prep_memo = None
@@ -656,6 +756,50 @@ class SparseTrainer:
     def prepare_batch(self, batch):
         return self._prepare_once(batch)
 
+    def join_pushes(self):
+        """Depth-1 bounded-staleness barrier for the async push path:
+        blocks until the in-flight step push (if any) resolves and
+        adopts its version. Failures surface HERE, one step after
+        dispatch — an RpcError that exhausted the client's retry
+        budget propagates, and a sync-PS rejection raises (the
+        async path cannot replay the rejected minibatch; see
+        PushResult.rejected_shards). Called automatically before the
+        next push and before eval; checkpoint/round boundaries
+        (worker, executor) call it explicitly. No-op when async push
+        is off or nothing is in flight."""
+        future, self._push_future = self._push_future, None
+        if future is None:
+            return
+        accepted, version, rejected = _normalize_push_result(
+            future.result(), self._version
+        )
+        if not accepted:
+            self.push_rejections += 1
+            raise RuntimeError(
+                "async-push gradients rejected as stale by a sync-mode "
+                "PS (shards %s); %s requires the async PS — use the "
+                "synchronous step against --use_async=false"
+                % (sorted(rejected) if rejected else "all",
+                   ASYNC_PUSH_ENV)
+            )
+        self._version = version
+
+    def close(self):
+        """Release the async-push executor at end of life. Joins the
+        in-flight push first (best-effort: teardown must not mask the
+        caller's own exception — stream/checkpoint boundaries already
+        surfaced push failures loudly via join_pushes). After close the
+        trainer degrades to synchronous pushes, so a late train_step
+        still works."""
+        try:
+            self.join_pushes()
+        except Exception:
+            logger.exception("in-flight async push failed at close")
+        pool, self._async_pool = self._async_pool, None
+        self._async_push = False
+        if pool is not None:
+            pool.shutdown(wait=True)
+
     def train_step(self, state, batch):
         """batch: raw (un-prepared) batch with id features."""
         prepared, pull_info = self._prepare_once(batch)
@@ -666,6 +810,23 @@ class SparseTrainer:
         state, loss, row_grads = self._train_step(state, prepared)
         row_grads = self._fetch_row_grads(row_grads)
         self.timing.end_record_sync("batch_process", t0, loss)
+        if self._async_push:
+            # join step N-1's push (depth-1 barrier), then hand step
+            # N's off to the executor: it overlaps the caller's
+            # bookkeeping and step N+1's pull + forward/backward. The
+            # rows step N+1 pulls may miss THIS push's contribution —
+            # exactly one push of staleness, the async-PS envelope.
+            with self.timing.timeit("sparse_push"):
+                self.join_pushes()
+            self._push_future = self._async_pool.submit(
+                self.preparer.push_gradients,
+                row_grads,
+                pull_info,
+                model_version=self._version,
+                force_empty=self.FORCE_EMPTY_PUSH,
+                round_scoped=self.ROUND_SCOPED_PUSH,
+            )
+            return state, loss
         with self.timing.timeit("sparse_push"):
             accepted, version, rejected = self.preparer.push_gradients(
                 row_grads,
@@ -721,6 +882,10 @@ class SparseTrainer:
         return state, loss
 
     def eval_step(self, state, batch):
+        # eval pulls fresh rows: the in-flight async push must land
+        # first or the scored rows would be one update behind the
+        # training reality the caller just observed
+        self.join_pushes()
         prepared, _ = self._prepare_once(batch)
         self._prep_memo = None
         outputs = self._eval_step(state, prepared["features"])
@@ -777,6 +942,10 @@ class SparseTrainer:
         """
         if push_interval < 1:
             raise ValueError("push_interval must be >= 1")
+        # a round boundary for the train_step async-push path: anything
+        # still in flight from before this stream joins first (the
+        # stream runs its own single-push-in-flight overlap below)
+        self.join_pushes()
         it = iter(batches)
         sentinel = object()
         batch = next(it, sentinel)

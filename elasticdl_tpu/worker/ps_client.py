@@ -24,7 +24,9 @@ from elasticdl_tpu.common.tensor_utils import (
     blob_to_ndarray,
     deduplicate_indexed_slices,
     ndarray_to_blob,
+    pack_ids,
     serialize_indexed_slices,
+    wire_dtype,
 )
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.services import PserverStub
@@ -53,6 +55,15 @@ def _call_with_retry(fn, what, budget_secs=None, channel=None):
         # re-dial a TRANSIENT_FAILURE channel
         channel=channel,
     )
+
+
+def _rows_f32(values):
+    """Pulled rows at compute precision: a server running with a
+    reduced EDL_WIRE_DTYPE sends self-describing bf16/fp16 payloads;
+    everything downstream (cache, padded row buffers) is fp32."""
+    if values.dtype != np.float32:
+        return values.astype(np.float32)
+    return values
 
 
 class PushResult(NamedTuple):
@@ -127,6 +138,30 @@ class PSClient:
         self._shard_restored = {}
         self._dense_init = None    # (params, version) last pushed
         self.resync_hook = None    # callable(shard); preparer installs
+        # Per-shard push requests reused across steps (ISSUE 5): a
+        # PushGradientsRequest allocates a Model + one IndexedSlices
+        # submessage per table; Clear() keeps the arena instead of
+        # rebuilding it every step. Sound because a client instance
+        # pushes at most one step at a time (trainer contract: the
+        # depth-1 async-push barrier joins step N before step N+1's
+        # push) and _push_gradients collects every shard future before
+        # returning.
+        self._push_requests = [pb.PushGradientsRequest() for _ in self._stubs]
+        # An old server answers the fused pull with UNIMPLEMENTED once;
+        # after that every pull goes per-table AND every id travels in
+        # the legacy repeated field — a pre-ids_blob server reads only
+        # `ids`, and a packed-only push against it would silently apply
+        # nothing. The capability is learned before any payload-bearing
+        # push: every training flow's first PS exchange is the
+        # preparer's pull.
+        self._batch_pull_supported = True
+        self._legacy_ids = False
+        # table-level fan-out pool for the legacy per-table fallback,
+        # created only if that path ever runs. It must NOT be
+        # self._pool: a per-table task there blocks on per-shard
+        # sub-tasks submitted to the same pool, and with >= max_workers
+        # tables every worker thread is a blocked parent — deadlock.
+        self._table_pool = None
 
     @property
     def ps_num(self):
@@ -169,12 +204,43 @@ class PSClient:
             self._shard_restored[shard] = restored_wire
         if not regressed and not restarted:
             return False
+        self._resync_shard(shard, version, restored_wire, last)
+        return True
+
+    def _note_restored(self, shard, restored_wire):
+        """Pull responses carry only the boot-restore stamp (no store
+        version): a CHANGED stamp still means the shard relaunched, and
+        catching it here resyncs one pull earlier than waiting for the
+        next push to observe the version regression — the pulled rows
+        feeding the HotRowCache come from the restored store, so the
+        stale cache must drop now, not a step later."""
+        with self._version_lock:
+            last_restored = self._shard_restored.get(shard)
+            restarted = (
+                last_restored is not None
+                and restored_wire != last_restored
+            )
+            self._shard_restored[shard] = restored_wire
+            if restarted:
+                # drop the pre-crash version expectation too, or the
+                # next push response's (lower, restored) version would
+                # read as a fresh regression and resync a second time
+                self._shard_versions.pop(shard, None)
+        if not restarted:
+            return False
+        self._resync_shard(shard, None, restored_wire, None)
+        return True
+
+    def _resync_shard(self, shard, version, restored_wire, last):
         restored = restored_wire - 1 if restored_wire > 0 else None
         logger.warning(
-            "PS shard %d relaunched (version %d, %d seen; restored "
-            "checkpoint: %s) — resyncing model and adopting its version",
-            shard, version, last,
+            "PS shard %d relaunched (version %s, %s seen; restored "
+            "checkpoint: %s) — resyncing model%s",
+            shard,
+            version if version is not None else "n/a",
+            last if last is not None else "n/a",
             restored if restored is not None else "none",
+            " and adopting its version" if version is not None else "",
         )
         if self._dense_init is not None:
             params, dense_version = self._dense_init
@@ -200,11 +266,11 @@ class PSClient:
         if hook is not None:
             hook(shard)
         events.emit(
-            "worker_resynced", shard=shard, version=version,
+            "worker_resynced", shard=shard,
+            version=version if version is not None else -1,
             restored=restored if restored is not None else -1,
             worker=self._worker_id if self._worker_id is not None else -1,
         )
-        return True
 
     def push_dense_init(self, params, version=0):
         self._dense_init = (dict(params), version)
@@ -232,6 +298,15 @@ class PSClient:
         }
         return response.initialized, response.version, params
 
+    def _pull_request(self, name, ids):
+        if self._legacy_ids:
+            return pb.PullEmbeddingVectorsRequest(
+                name=name, ids=ids.tolist()
+            )
+        return pb.PullEmbeddingVectorsRequest(
+            name=name, ids_blob=pack_ids(ids)
+        )
+
     # ------------------------------------------------------------------
     def pull_embedding_vectors(self, name, ids):
         """ids: int64 array; returns rows aligned with input order."""
@@ -243,9 +318,7 @@ class PSClient:
         if ids.size == 0:
             return np.empty((0, 0), dtype=np.float32)
         if self.ps_num == 1:
-            request = pb.PullEmbeddingVectorsRequest(
-                name=name, ids=ids.tolist()
-            )
+            request = self._pull_request(name, ids)
             blob = _call_with_retry(
                 lambda: self._stubs[0].pull_embedding_vectors(
                     request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
@@ -253,16 +326,14 @@ class PSClient:
                 "pull_embedding_vectors",
                 channel=self._channels[0],
             )
-            return blob_to_ndarray(blob)
+            return _rows_f32(blob_to_ndarray(blob))
         shard_of = ids % self.ps_num
         futures = {}
         positions = {}
         for shard in np.unique(shard_of):
             pos = np.nonzero(shard_of == shard)[0]
             positions[int(shard)] = pos
-            request = pb.PullEmbeddingVectorsRequest(
-                name=name, ids=ids[pos].tolist()
-            )
+            request = self._pull_request(name, ids[pos])
             stub = self._stubs[int(shard)]
             futures[int(shard)] = self._pool.submit(
                 _call_with_retry,
@@ -276,12 +347,116 @@ class PSClient:
         dim = None
         rows = None
         for shard, future in futures.items():
-            values = blob_to_ndarray(future.result())
+            values = _rows_f32(blob_to_ndarray(future.result()))
             if rows is None:
                 dim = values.shape[1]
                 rows = np.empty((ids.size, dim), dtype=values.dtype)
             rows[positions[shard]] = values
         return rows
+
+    # ------------------------------------------------------------------
+    def pull_embedding_batch(self, ids_by_table):
+        """Fused multi-table pull: ``{table: int64 ids}`` in, ``{table:
+        rows aligned with that table's input order}`` out, costing ONE
+        RPC per PS shard for the whole step instead of one per (table,
+        shard). Falls back to per-table pulls against an old server
+        (UNIMPLEMENTED answer, remembered)."""
+        with trace.span("ps_pull_batch", tables=len(ids_by_table)):
+            return self._pull_embedding_batch(ids_by_table)
+
+    def _pull_per_table(self, ids_by_table):
+        """Legacy fallback: fan the per-table pulls out on a DEDICATED
+        table-level pool (see __init__._table_pool — nesting them on
+        self._pool deadlocks once tables >= its worker count, because
+        each per-table task blocks on per-shard sub-tasks queued behind
+        it) so an old server still gets table-level concurrency."""
+        if self._table_pool is None:
+            self._table_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(4, len(ids_by_table)),
+                thread_name_prefix="ps-table-pull",
+            )
+        futures = {
+            name: self._table_pool.submit(
+                self._pull_embedding_vectors, name, ids
+            )
+            for name, ids in ids_by_table.items()
+        }
+        return {name: future.result() for name, future in futures.items()}
+
+    def _pull_embedding_batch(self, ids_by_table):
+        ids_by_table = {
+            name: np.asarray(ids, dtype=np.int64)
+            for name, ids in ids_by_table.items()
+            if np.asarray(ids).size
+        }
+        if not ids_by_table:
+            return {}
+        if not self._batch_pull_supported:
+            return self._pull_per_table(ids_by_table)
+        # per-shard request holding every table's id slice for it
+        requests = [pb.BatchedSlices() for _ in self._stubs]
+        positions = {}  # (name, shard) -> input positions
+        for name, ids in ids_by_table.items():
+            if self.ps_num == 1:
+                requests[0].tables[name].ids_blob = pack_ids(ids)
+                continue
+            shard_of = ids % self.ps_num
+            for shard in np.unique(shard_of):
+                pos = np.nonzero(shard_of == shard)[0]
+                positions[(name, int(shard))] = pos
+                requests[int(shard)].tables[name].ids_blob = pack_ids(
+                    ids[pos]
+                )
+        futures = {}
+        for shard, request in enumerate(requests):
+            if not request.tables:
+                continue
+            stub = self._stubs[shard]
+            futures[shard] = self._pool.submit(
+                _call_with_retry,
+                lambda stub=stub, request=request:
+                    stub.pull_embedding_batch(
+                        request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                    ),
+                "pull_embedding_batch",
+                channel=self._channels[shard],
+            )
+        out = {}
+        try:
+            for shard, future in futures.items():
+                response = future.result()
+                # pulls are this client's most frequent RPC: catching a
+                # changed boot-restore stamp here drops the stale
+                # HotRowCache one pull earlier than push-side detection
+                self._note_restored(shard, response.restored_version)
+                for name, blob in response.tables.items():
+                    values = _rows_f32(blob_to_ndarray(blob))
+                    if self.ps_num == 1:
+                        out[name] = values
+                        continue
+                    rows = out.get(name)
+                    if rows is None:
+                        rows = np.empty(
+                            (ids_by_table[name].size, values.shape[1]),
+                            dtype=values.dtype,
+                        )
+                        out[name] = rows
+                    rows[positions[(name, shard)]] = values
+        except grpc.RpcError as e:
+            if e.code() != grpc.StatusCode.UNIMPLEMENTED:
+                raise
+            # old server: remember and serve this pull per-table (the
+            # shards already answered are discarded — pulls are
+            # read-only, so re-pulling is free of side effects)
+            logger.warning(
+                "PS does not serve pull_embedding_batch (pre-ids_blob "
+                "release); falling back to per-table pulls and legacy "
+                "repeated-id encoding for this client"
+            )
+            self._batch_pull_supported = False
+            self._legacy_ids = True
+            return self._pull_per_table(ids_by_table)
+        return out
 
     def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0,
                        only_shards=None, force_empty=False,
@@ -314,8 +489,12 @@ class PSClient:
         shard_filter = (
             None if only_shards is None else set(int(s) for s in only_shards)
         )
-        per_ps = [pb.PushGradientsRequest() for _ in self._stubs]
+        per_ps = self._push_requests
+        # a pre-ids_blob peer predates the wire-dtype contract too: it
+        # may not resolve extension dtype names — send it plain fp32
+        payload_dtype = None if self._legacy_ids else wire_dtype()
         for request in per_ps:
+            request.Clear()  # reused across steps; see __init__
             request.gradients.version = model_version
             request.lr_scale = lr_scale
             if self._worker_id is not None:
@@ -332,7 +511,9 @@ class PSClient:
             )
             if self.ps_num == 1:
                 serialize_indexed_slices(
-                    values, ids, per_ps[0].gradients.embedding_tables[name]
+                    values, ids, per_ps[0].gradients.embedding_tables[name],
+                    wire_dtype=payload_dtype,
+                    packed=not self._legacy_ids,
                 )
                 continue
             shard_of = ids % self.ps_num
@@ -344,6 +525,8 @@ class PSClient:
                     values[pos],
                     ids[pos],
                     per_ps[int(shard)].gradients.embedding_tables[name],
+                    wire_dtype=payload_dtype,
+                    packed=not self._legacy_ids,
                 )
         futures = []
         for shard, (stub, request) in enumerate(zip(self._stubs, per_ps)):
@@ -379,8 +562,24 @@ class PSClient:
         version = model_version
         rejected = []
         regressed_versions = []
+        responses = []
+        error = None
         for shard, future in futures:
-            response = future.result()
+            # drain EVERY future even after one raises: the reused
+            # per-shard request objects (__init__) must not be
+            # Clear()ed by a later push while a still-running retry
+            # holds them — a half-failed push therefore waits out its
+            # surviving shards' retries before surfacing the error
+            try:
+                responses.append((shard, future.result()))
+            # re-raised after the drain completes (the `raise error`
+            # below) — deferred, not swallowed
+            except BaseException as e:  # edlint: disable=ft-swallowed-except
+                if error is None:
+                    error = e
+        if error is not None:
+            raise error
+        for shard, response in responses:
             if self._note_version(
                 shard, response.version, response.restored_version
             ):

@@ -462,7 +462,21 @@ class Worker:
         return dataset.batch(self._minibatch_size).prefetch(2)
 
     # ------------------------------------------------------------------
+    def _join_trainer_pushes(self):
+        """Depth-1 async-push barrier (train/sparse.py join_pushes) at
+        worker-level boundaries — checkpoints, stream/round ends,
+        train-end export — so an in-flight push either lands or raises
+        here instead of silently outliving the boundary. No-op for
+        dense trainers and with async push off."""
+        join = getattr(self.trainer, "join_pushes", None)
+        if join is not None:
+            join()
+
     def _save_checkpoint(self):
+        # in-flight sparse pushes land before the version is stamped
+        # durable: a checkpoint claiming version V must not precede
+        # V's gradients reaching the PS
+        self._join_trainer_pushes()
         state = self.state
         if self._lockstep:
             # orbax's save is itself a cross-process collective
@@ -745,6 +759,10 @@ class Worker:
                 self._train_batches_pipelined(batches)
             else:
                 self._train_batches_sequential(batches)
+            # stream/round boundary: a failed in-flight async push
+            # surfaces here and routes through the same handlers as an
+            # in-stream failure (tasks get retried, not lost)
+            self._join_trainer_pushes()
         except CheckpointRestoreError:
             # fatal for this process; requeue held tasks first (the
             # relaunched same-id worker keeps liveness fresh, so the
@@ -938,6 +956,9 @@ class Worker:
     def _process_train_end_task(self, task):
         from elasticdl_tpu.train.callbacks import SavedModelExporter
 
+        # the exported artifact must reflect every pushed gradient
+        self._join_trainer_pushes()
+
         wants_export = bool(task.extended_config.get("saved_model_path"))
         if wants_export and self.state is None:
             # this worker never trained (e.g. relaunched after an
@@ -1028,6 +1049,12 @@ class Worker:
             self._run()
         finally:
             self._stop_heartbeat()
+            # release the sparse trainer's async-push executor (joins
+            # its in-flight push; failures were already surfaced at the
+            # stream boundary, so close only logs)
+            close = getattr(self.trainer, "close", None)
+            if close is not None:
+                close()
             if self._checkpoint_mgr is not None:
                 # Flush any in-flight orbax commit before process exit.
                 self._checkpoint_mgr.close()

@@ -1,0 +1,161 @@
+"""Wire-path microbenchmark: serialization + dedup + store apply.
+
+Prints ONE JSON line with per-path milliseconds plus the in-run
+speedup of each ISSUE-5 fast path over the legacy path it replaced:
+
+- packed ids_blob serialization   vs  repeated-varint Python-loop ids
+- sort+reduceat dedup             vs  np.add.at scatter-add
+- vectorized numpy-store apply    vs  the per-id sequential loop
+
+Exit code 1 ONLY when a fast path measures as an actual regression
+(>= ``--fail-under``x SLOWER than its legacy twin, default 1/3x i.e.
+"the new path is more than 3x worse than what it replaced"). Absolute
+numbers are report-only — CI journals them but never gates on them, so
+box-to-box noise cannot flake the lane; the relative comparison runs
+both paths back-to-back in one process.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from elasticdl_tpu.common import tensor_utils  # noqa: E402
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb  # noqa: E402
+
+
+def timeit(fn, reps):
+    best = float("inf")
+    for _ in range(reps):
+        start = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - start)
+    return best * 1000.0  # ms
+
+
+def bench_serialize(ids, values, reps):
+    def legacy():
+        slices = pb.IndexedSlicesProto()
+        tensor_utils.ndarray_to_blob(values, slices.concat_tensors)
+        del slices.ids[:]
+        slices.ids.extend(int(i) for i in ids)  # the pre-ISSUE-5 path
+        return slices.SerializeToString()
+
+    def packed():
+        slices = tensor_utils.serialize_indexed_slices(values, ids)
+        return slices.SerializeToString()
+
+    legacy_wire = legacy()
+    packed_wire = packed()
+    return {
+        "serialize_legacy_ms": round(timeit(legacy, reps), 3),
+        "serialize_packed_ms": round(timeit(packed, reps), 3),
+        "serialize_legacy_bytes": len(legacy_wire),
+        "serialize_packed_bytes": len(packed_wire),
+    }
+
+
+def bench_dedup(ids, values, reps):
+    def add_at():
+        # the pre-ISSUE-5 deduplicate_indexed_slices body
+        unique, index = np.unique(ids, return_inverse=True)
+        summed = np.zeros((unique.size, values.shape[1]), values.dtype)
+        np.add.at(summed, index, values)
+        return summed
+
+    def segmented():
+        return tensor_utils.deduplicate_indexed_slices(values, ids)
+
+    ref = add_at()
+    got, _ = segmented()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-2)
+    return {
+        "dedup_add_at_ms": round(timeit(add_at, reps), 3),
+        "dedup_segment_ms": round(timeit(segmented, reps), 3),
+    }
+
+
+def bench_apply(dim, n_rows, reps):
+    from elasticdl_tpu.ps.embedding_store import NumpyEmbeddingStore
+
+    rng = np.random.RandomState(0)
+    unique_ids = rng.permutation(10 * n_rows)[:n_rows].astype(np.int64)
+    grads = rng.randn(n_rows, dim).astype(np.float32)
+
+    def run(ids):
+        store = NumpyEmbeddingStore(seed=0)
+        store.set_optimizer("adam", lr=0.01)
+        store.create_table("t", dim)
+        store.push_gradients("t", ids, grads)  # init rows (untimed cost
+        # is shared: both paths lazily create the same rows first)
+
+        def push():
+            store.push_gradients("t", ids, grads)
+
+        return timeit(push, reps)
+
+    # one duplicated id forces the sequential per-id path on the same
+    # data volume: n identical optimizer applies either way
+    dup_ids = unique_ids.copy()
+    dup_ids[-1] = dup_ids[0]
+    return {
+        "apply_vectorized_ms": round(run(unique_ids), 3),
+        "apply_per_id_ms": round(run(dup_ids), 3),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n-ids", type=int, default=100_000)
+    parser.add_argument("--dim", type=int, default=16)
+    parser.add_argument("--apply-rows", type=int, default=4096)
+    parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument(
+        "--fail-under", type=float, default=1.0 / 3.0,
+        help="hard-fail when fast/legacy speedup drops below this "
+             "(default 1/3 = a >3x regression)",
+    )
+    args = parser.parse_args()
+
+    rng = np.random.RandomState(0)
+    # Zipfian ids: the duplicate-heavy CTR stream shape both the dedup
+    # and the scatter-add worst case come from
+    ids = (rng.zipf(1.2, size=args.n_ids) % 1_000_000).astype(np.int64)
+    values = rng.randn(args.n_ids, args.dim).astype(np.float32)
+
+    out = {}
+    out.update(bench_serialize(ids, values, args.reps))
+    out.update(bench_dedup(ids, values, args.reps))
+    out.update(bench_apply(args.dim, args.apply_rows, args.reps))
+    out["serialize_speedup"] = round(
+        out["serialize_legacy_ms"] / max(out["serialize_packed_ms"], 1e-6), 2
+    )
+    out["dedup_speedup"] = round(
+        out["dedup_add_at_ms"] / max(out["dedup_segment_ms"], 1e-6), 2
+    )
+    out["apply_speedup"] = round(
+        out["apply_per_id_ms"] / max(out["apply_vectorized_ms"], 1e-6), 2
+    )
+    print(json.dumps(out))
+
+    failures = [
+        name for name in
+        ("serialize_speedup", "dedup_speedup", "apply_speedup")
+        if out[name] < args.fail_under
+    ]
+    if failures:
+        print(
+            "wire-micro REGRESSION: %s below the %.2fx floor"
+            % (", ".join(failures), args.fail_under),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

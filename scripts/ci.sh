@@ -257,6 +257,44 @@ grep -q "master_restarted" /tmp/_chaos_pm.out
 grep -q "task_dispatch" /tmp/_chaos_pm.out
 grep -q "worker_register" /tmp/_chaos_pm.out
 
+echo "== tier 1f: wire-path perf smoke (micro + EDL_WIRE_DTYPE opt-in) =="
+# Microbenchmark of the ISSUE-5 wire fast paths vs the legacy paths
+# they replaced: packed ids_blob vs repeated-varint serialization,
+# sort+reduceat dedup vs np.add.at, vectorized numpy-store apply vs
+# the per-id loop. Numbers are REPORT-ONLY (journaled below, never
+# gated on — absolute timings flake across boxes); the script
+# hard-fails only when a fast path measures >3x SLOWER than its legacy
+# twin in the same run, which is a real regression, not noise.
+python scripts/bench_wire_micro.py | tee /tmp/_wire_micro.json
+printf '{"ts": "%s", "wire_micro": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_wire_micro.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "wire-micro numbers journaled to /tmp/ci_wire_micro.jsonl"
+
+# The reduced-precision wire opt-in must actually train: a sparse
+# local-executor run with EDL_WIRE_DTYPE=bfloat16 (LocalPSClient
+# round-trips payloads through the wire dtype, emulating exactly the
+# rounding a real worker<->PS deployment under the knob sees).
+JAX_PLATFORMS=cpu EDL_WIRE_DTYPE=bfloat16 python - <<'PYEOF'
+import sys, tempfile
+sys.path.insert(0, "tests")
+from test_utils import create_ctr_recordio
+from elasticdl_tpu.train.local_executor import LocalExecutor
+
+with tempfile.TemporaryDirectory() as tmp:
+    create_ctr_recordio(tmp + "/f0.rec", num_records=256, seed=0)
+    executor = LocalExecutor(
+        "elasticdl_tpu.models.deepfm", training_data=tmp,
+        minibatch_size=64, num_epochs=2,
+    )
+    losses = executor.train()
+    assert all(l == l for l in losses), "NaN loss under bfloat16 wire"
+    assert losses[-1] < losses[0], (
+        "bfloat16 wire run did not learn: %s" % losses
+    )
+print("EDL_WIRE_DTYPE=bfloat16 opt-in trains OK")
+PYEOF
+
 echo "== tier 2a: multi-chip SPMD dryrun (dp/fsdp, tp/sp, ep, pp, pp x tp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -547,7 +547,22 @@ def test_master_sigkill_mid_epoch_replay_no_shard_lost_or_doubled(
     assert len(registers) >= 2, registers
 
 
-def test_ps_sigkill_auto_restore_and_worker_resync(tmp_path, monkeypatch):
+@pytest.mark.parametrize(
+    "async_push",
+    [
+        False,
+        # ISSUE 5 acceptance: the same SIGKILL/auto-restore/resync
+        # protocol must hold with the double-buffered async push on —
+        # an in-flight push resolves (retry budget) or surfaces at the
+        # depth-1 join, never silently drops. Slow-marked: the fault
+        # window alone is ~a minute; the fast lane keeps the sync
+        # variant.
+        pytest.param(True, marks=pytest.mark.slow),
+    ],
+)
+def test_ps_sigkill_auto_restore_and_worker_resync(
+    tmp_path, monkeypatch, async_push
+):
     """ISSUE 4 tentpole acceptance: SIGKILL the PS mid-round and
     relaunch it with NO restore flag — the PS auto-restores its newest
     complete checkpoint from its own --checkpoint_dir, stamps
@@ -567,11 +582,19 @@ def test_ps_sigkill_auto_restore_and_worker_resync(tmp_path, monkeypatch):
     events_dir = tmp_path / "events"
     events_dir.mkdir()
     monkeypatch.setenv(events.EVENTS_DIR_ENV, str(events_dir))
+    if async_push:
+        # read by SparseTrainer at construction (inside Worker below)
+        monkeypatch.setenv("EDL_ASYNC_PUSH", "1")
     events.configure("worker-0")
 
     train_dir = tmp_path / "train"
     train_dir.mkdir()
-    create_ctr_recordio(str(train_dir / "f0.rec"), num_records=768, seed=0)
+    # enough records that the job still holds real work when the kill
+    # fires: the kill-decision poll below can lag many steps under
+    # full-suite CPU contention, and a job that drains before the
+    # SIGKILL leaves nothing to resync (the flight-recorder asserts at
+    # the end would then fail on a technicality, not a recovery bug)
+    create_ctr_recordio(str(train_dir / "f0.rec"), num_records=1152, seed=0)
     reader = RecordIODataReader(data_dir=str(train_dir))
     dispatcher = TaskDispatcher(
         training_shards=reader.create_shards(),
@@ -595,8 +618,13 @@ def test_ps_sigkill_auto_restore_and_worker_resync(tmp_path, monkeypatch):
     ps_port = free_port()
     ckpt_dir = str(tmp_path / "ps_ckpt")
 
-    def spawn_ps():
+    def spawn_ps(fault_spec=None):
         # note: NO --checkpoint_dir_for_init — restore must be automatic
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               events.EVENTS_DIR_ENV: str(events_dir)}
+        env.pop("EDL_FAULT_SPEC", None)
+        if fault_spec:
+            env["EDL_FAULT_SPEC"] = fault_spec
         return subprocess.Popen(
             [
                 sys.executable, "-m", "elasticdl_tpu.ps.server",
@@ -604,15 +632,23 @@ def test_ps_sigkill_auto_restore_and_worker_resync(tmp_path, monkeypatch):
                 "--port", str(ps_port),
                 "--opt_type", "adam", "--opt_args", "lr=0.01",
                 "--checkpoint_dir", ckpt_dir,
-                "--checkpoint_steps", "5",
+                "--checkpoint_steps", "3",
             ],
-            env={**os.environ, "JAX_PLATFORMS": "cpu",
-                 events.EVENTS_DIR_ENV: str(events_dir)},
+            env=env,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         )
 
-    ps_proc = spawn_ps()
+    # Deterministic mid-job death (testing/faults.py): the PS SIGKILLs
+    # ITSELF on its 12th push_gradients — checkpoints at versions 3/6/9
+    # are complete by then and 24 of the job's 36 steps remain, so
+    # there is always post-kill work left to resync. (An external
+    # kill decided by polling the worker's version raced the worker
+    # under full-suite CPU contention: by the time the polling thread
+    # got scheduled the job had drained, and the flight-recorder
+    # asserts below failed with nothing left to push — a 1-in-N flake
+    # once the ISSUE-5 wire path sped the steps up.)
+    ps_proc = spawn_ps("ps-0:push_gradients:kill-once:12")
     _wait_port(ps_port)
     try:
         worker = Worker(
@@ -626,30 +662,25 @@ def test_ps_sigkill_auto_restore_and_worker_resync(tmp_path, monkeypatch):
         runner = threading.Thread(target=worker.run, daemon=True)
         runner.start()
 
-        # progress until at least one complete checkpoint is on disk
         from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
 
-        # wait until the store has moved PAST the newest checkpoint so
-        # the relaunch restores an observably older version (a kill
-        # landing exactly on a checkpoint is the restored_version-stamp
-        # detection path instead; this test pins the regression path)
-        deadline = time.time() + 120
-        restored_floor = None
-        while time.time() < deadline:
-            restored_floor = SparseCheckpointSaver.latest_version(ckpt_dir)
-            if (
-                restored_floor is not None
-                and worker.trainer._version >= restored_floor + 2
-            ):
-                break
-            time.sleep(0.2)
+        # the injected kill-once takes the PS down mid-job (SIGKILL:
+        # rc is nonzero), after versions past complete checkpoints —
+        # the relaunch restores an observably older version (the
+        # version-REGRESSION detection path this test pins; a kill
+        # landing exactly on a checkpoint would be the restored-stamp
+        # path instead, which kill-once on push 12 ≠ 0 mod 3 avoids)
+        rc = ps_proc.wait(timeout=120)
+        assert rc != 0, "PS survived its kill-once fault"
+        restored_floor = SparseCheckpointSaver.latest_version(ckpt_dir)
         assert restored_floor is not None, "PS never checkpointed"
 
-        # chaos: SIGKILL the PS mid-round; relaunch with NO restore flag
-        ps_proc.send_signal(signal.SIGKILL)
-        ps_proc.wait(timeout=30)
         time.sleep(2)  # let the worker hit the outage window
         ps_proc = spawn_ps()
+        # the relaunch must reach serving (restore done, ps_restored
+        # journaled) before this test can tear it down — a fast job
+        # ending right after the relaunch must not kill a booting PS
+        _wait_port(ps_port)
 
         runner.join(timeout=180)
         assert not runner.is_alive(), "worker never finished after PS restart"

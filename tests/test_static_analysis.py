@@ -410,6 +410,45 @@ def test_retry_no_jitter_quiet_with_jitter_or_constant_sleep():
 
 
 # ---------------------------------------------------------------------------
+# perf-varint-ids
+
+def test_perf_varint_ids_flags_scalar_cast_extend():
+    findings = findings_for("""
+        def serialize_indexed_slices(values, ids, slices):
+            del slices.ids[:]
+            slices.ids.extend(int(i) for i in ids)   # BUG: per-id loop
+            return slices
+
+        def also_bad(request, ids):
+            request.ids.extend([float(v) for v in ids])
+    """, rules=["perf-varint-ids"])
+    assert len(findings) == 2
+    assert all(f.rule == "perf-varint-ids" for f in findings)
+    assert findings[0].code == ".extend(int(...))"
+
+
+def test_perf_varint_ids_quiet_on_vectorized_and_working_comprehensions():
+    assert not findings_for("""
+        import numpy as np
+
+        def packed(slices, ids):
+            slices.ids_blob = np.ascontiguousarray(
+                ids, dtype="<i8"
+            ).tobytes()
+
+        def legacy_but_vectorized(slices, ids):
+            slices.ids.extend(ids.astype(np.int64).tolist())
+
+        def real_per_element_work(out, pairs):
+            # arithmetic / filtering per element: not the serialization
+            # anti-pattern
+            out.extend(int(a) * 2 for a in pairs)
+            out.extend(int(a) for a in pairs if a > 0)
+            out.extend(str(x) for x in pairs)
+    """, rules=["perf-varint-ids"])
+
+
+# ---------------------------------------------------------------------------
 # xhost-determinism
 
 def test_determinism_flags_set_iteration_in_checkpoint_path():
@@ -574,6 +613,10 @@ _CLI_POSITIVE_FIXTURES = {
     "xhost-determinism": ("bad_checkpoint.py", """
         def restore(names):
             return [n for n in set(names)]
+    """),
+    "perf-varint-ids": ("bad_wire.py", """
+        def serialize(slices, ids):
+            slices.ids.extend(int(i) for i in ids)
     """),
 }
 

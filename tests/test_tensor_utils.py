@@ -1,0 +1,221 @@
+"""Round-trip property tests for the wire serialization layer
+(common/tensor_utils.py): every dtype the protocol carries, the packed
+vs legacy id encodings, and the EDL_WIRE_DTYPE payload knob's
+bit-exactness contract (ISSUE 5)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+def _roundtrip(array):
+    return tensor_utils.blob_to_ndarray(
+        tensor_utils.ndarray_to_blob(array)
+    )
+
+
+# ---------------------------------------------------------------------------
+# TensorBlob round trips
+
+@pytest.mark.parametrize("dtype", [
+    "float32", "float64", "float16", "int8", "uint8", "int32", "int64",
+    "bool",
+])
+def test_blob_roundtrip_numeric_dtypes(dtype):
+    rng = np.random.RandomState(0)
+    array = (rng.rand(3, 5) * 100).astype(dtype)
+    out = _roundtrip(array)
+    assert out.dtype == array.dtype
+    np.testing.assert_array_equal(out, array)
+
+
+def test_blob_roundtrip_bfloat16():
+    import ml_dtypes
+
+    array = np.arange(12, dtype=np.float32).reshape(4, 3)
+    array = array.astype(ml_dtypes.bfloat16)
+    out = _roundtrip(array)
+    assert out.dtype == array.dtype
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32), np.asarray(array, np.float32)
+    )
+
+
+def test_blob_roundtrip_unicode_and_bytes():
+    unicode_arr = np.array([["alpha", "β"], ["γγγ", ""]])
+    out = _roundtrip(unicode_arr)
+    assert out.dtype.kind == "U"
+    np.testing.assert_array_equal(out, unicode_arr)
+
+    bytes_arr = np.array([b"ab", b"c", b""], dtype="|S2")
+    out = _roundtrip(bytes_arr)
+    assert out.dtype == bytes_arr.dtype
+    np.testing.assert_array_equal(out, bytes_arr)
+
+
+def test_blob_roundtrip_object_strings_materialize_as_unicode():
+    arr = np.array(["x", "longer"], dtype=object)
+    out = _roundtrip(arr)
+    assert out.dtype.kind == "U"
+    np.testing.assert_array_equal(out, arr.astype(str))
+
+
+def test_blob_roundtrip_zero_d_and_empty():
+    scalar = np.float32(3.5)
+    out = _roundtrip(np.asarray(scalar))
+    assert out.shape == ()
+    assert out == scalar
+
+    empty = np.empty((0, 7), dtype=np.float32)
+    out = _roundtrip(empty)
+    assert out.shape == (0, 7)
+    assert out.dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# IndexedSlices: packed ids_blob vs legacy repeated ids
+
+def test_serialize_prefers_packed_ids():
+    values = np.arange(6, dtype=np.float32).reshape(3, 2)
+    ids = np.array([5, 1, 9], dtype=np.int64)
+    slices = tensor_utils.serialize_indexed_slices(values, ids)
+    assert slices.ids_blob == ids.astype("<i8").tobytes()
+    assert len(slices.ids) == 0
+    out_values, out_ids = tensor_utils.deserialize_indexed_slices(slices)
+    np.testing.assert_array_equal(out_values, values)
+    np.testing.assert_array_equal(out_ids, ids)
+    assert out_ids.dtype == np.int64
+
+
+def test_legacy_repeated_ids_still_deserialize():
+    """An old peer writes only the repeated field; a new reader must
+    decode it identically (wire-compat acceptance, ISSUE 5)."""
+    values = np.ones((2, 3), dtype=np.float32)
+    legacy = pb.IndexedSlicesProto()
+    tensor_utils.ndarray_to_blob(values, legacy.concat_tensors)
+    legacy.ids.extend([7, 2])
+    # the wire bytes an old writer would produce
+    legacy = pb.IndexedSlicesProto.FromString(legacy.SerializeToString())
+    out_values, out_ids = tensor_utils.deserialize_indexed_slices(legacy)
+    np.testing.assert_array_equal(out_ids, [7, 2])
+    np.testing.assert_array_equal(out_values, values)
+
+
+def test_packed_wins_when_both_encodings_present():
+    slices = pb.IndexedSlicesProto()
+    tensor_utils.ndarray_to_blob(
+        np.zeros((2, 1), np.float32), slices.concat_tensors
+    )
+    slices.ids.extend([1, 2])
+    slices.ids_blob = tensor_utils.pack_ids(np.array([3, 4], np.int64))
+    _, ids = tensor_utils.deserialize_indexed_slices(slices)
+    np.testing.assert_array_equal(ids, [3, 4])
+
+
+def test_pack_unpack_ids_roundtrip_and_empty():
+    ids = np.array([0, -1, 2**62], dtype=np.int64)
+    request = pb.PullEmbeddingVectorsRequest(
+        ids_blob=tensor_utils.pack_ids(ids)
+    )
+    np.testing.assert_array_equal(tensor_utils.unpack_ids(request), ids)
+
+    empty = pb.PullEmbeddingVectorsRequest()
+    out = tensor_utils.unpack_ids(empty)
+    assert out.size == 0 and out.dtype == np.int64
+
+
+# ---------------------------------------------------------------------------
+# EDL_WIRE_DTYPE
+
+def test_wire_dtype_unset_and_float32_are_bit_exact(monkeypatch):
+    values = np.random.RandomState(3).randn(4, 8).astype(np.float32)
+    ids = np.arange(4, dtype=np.int64)
+
+    monkeypatch.delenv(tensor_utils.WIRE_DTYPE_ENV, raising=False)
+    assert tensor_utils.wire_dtype() is None
+    unset = tensor_utils.serialize_indexed_slices(
+        values, ids, wire_dtype=tensor_utils.wire_dtype()
+    ).SerializeToString()
+
+    monkeypatch.setenv(tensor_utils.WIRE_DTYPE_ENV, "float32")
+    assert tensor_utils.wire_dtype() is None
+    explicit = tensor_utils.serialize_indexed_slices(
+        values, ids, wire_dtype=tensor_utils.wire_dtype()
+    ).SerializeToString()
+
+    assert unset == explicit
+    out_values, _ = tensor_utils.deserialize_indexed_slices(
+        pb.IndexedSlicesProto.FromString(unset)
+    )
+    assert out_values.dtype == np.float32
+    assert out_values.tobytes() == values.tobytes()  # bit-exact
+
+
+@pytest.mark.parametrize("knob,expected", [
+    ("bfloat16", "bfloat16"), ("bf16", "bfloat16"),
+    ("float16", "float16"), ("fp16", "float16"),
+])
+def test_wire_dtype_downcasts_float32_payloads(monkeypatch, knob, expected):
+    monkeypatch.setenv(tensor_utils.WIRE_DTYPE_ENV, knob)
+    dtype = tensor_utils.wire_dtype()
+    assert dtype is not None and dtype.name == expected
+    values = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    slices = tensor_utils.serialize_indexed_slices(
+        values, np.arange(5, dtype=np.int64), wire_dtype=dtype
+    )
+    assert slices.concat_tensors.dtype == expected
+    # half the payload bytes of fp32
+    assert len(slices.concat_tensors.content) == values.size * 2
+    out, _ = tensor_utils.deserialize_indexed_slices(slices)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), values, rtol=2e-2, atol=2e-2
+    )
+
+
+def test_wire_dtype_never_touches_non_float32(monkeypatch):
+    monkeypatch.setenv(tensor_utils.WIRE_DTYPE_ENV, "bfloat16")
+    dtype = tensor_utils.wire_dtype()
+    ints = np.arange(6, dtype=np.int64).reshape(2, 3)
+    blob = tensor_utils.ndarray_to_blob(ints, wire_dtype=dtype)
+    assert blob.dtype == "int64"
+    doubles = np.arange(4, dtype=np.float64)
+    blob = tensor_utils.ndarray_to_blob(doubles, wire_dtype=dtype)
+    assert blob.dtype == "float64"
+
+
+def test_wire_dtype_rejects_unknown_value(monkeypatch):
+    monkeypatch.setenv(tensor_utils.WIRE_DTYPE_ENV, "int4")
+    with pytest.raises(ValueError, match="EDL_WIRE_DTYPE"):
+        tensor_utils.wire_dtype()
+
+
+# ---------------------------------------------------------------------------
+# dedup
+
+def test_dedup_matches_scatter_add_on_zipfian_stream():
+    rng = np.random.RandomState(0)
+    ids = (rng.zipf(1.2, size=4000) % 500).astype(np.int64)
+    values = rng.randn(ids.size, 6).astype(np.float32)
+    unique, index = np.unique(ids, return_inverse=True)
+    ref = np.zeros((unique.size, 6), np.float32)
+    np.add.at(ref, index, values)
+    summed, out_ids = tensor_utils.deduplicate_indexed_slices(values, ids)
+    np.testing.assert_array_equal(out_ids, unique)
+    np.testing.assert_allclose(summed, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_dedup_no_duplicates_returns_sorted_rows():
+    ids = np.array([30, 10, 20], dtype=np.int64)
+    values = np.array([[3.0], [1.0], [2.0]], dtype=np.float32)
+    summed, out_ids = tensor_utils.deduplicate_indexed_slices(values, ids)
+    np.testing.assert_array_equal(out_ids, [10, 20, 30])
+    np.testing.assert_array_equal(summed, [[1.0], [2.0], [3.0]])
+
+
+def test_dedup_empty():
+    summed, ids = tensor_utils.deduplicate_indexed_slices(
+        np.empty((0, 4), np.float32), np.empty((0,), np.int64)
+    )
+    assert summed.shape[0] == 0 and ids.size == 0

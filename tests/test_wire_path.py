@@ -1,0 +1,440 @@
+"""ISSUE 5 wire-path overhaul, end to end: fused multi-table pulls
+over live gRPC, legacy-peer interop, EDL_WIRE_DTYPE payloads, push
+request reuse, bytes accounting, and the async double-buffered push."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.grpc_utils import (
+    build_server,
+    find_free_port,
+)
+from elasticdl_tpu.common import tensor_utils
+from elasticdl_tpu.proto import services
+from elasticdl_tpu.proto.services import add_pserver_servicer_to_server
+from elasticdl_tpu.ps.embedding_store import NumpyEmbeddingStore
+from elasticdl_tpu.ps.servicer import PserverServicer
+from elasticdl_tpu.worker.ps_client import PSClient
+
+
+class _RecordingServicer(PserverServicer):
+    """Counts RPCs and remembers each push's table set."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.pull_vector_calls = 0
+        self.pull_batch_calls = 0
+        self.pushed_table_sets = []
+
+    def pull_embedding_vectors(self, request, context=None):
+        self.pull_vector_calls += 1
+        return super().pull_embedding_vectors(request, context)
+
+    def pull_embedding_batch(self, request, context=None):
+        self.pull_batch_calls += 1
+        return super().pull_embedding_batch(request, context)
+
+    def push_gradients(self, request, context=None):
+        self.pushed_table_sets.append(
+            sorted(request.gradients.embedding_tables)
+        )
+        self.push_id_encodings = getattr(self, "push_id_encodings", [])
+        for slices in request.gradients.embedding_tables.values():
+            self.push_id_encodings.append(
+                "packed" if slices.ids_blob else "legacy"
+            )
+        return super().push_gradients(request, context)
+
+
+def _start_ps(n_shards=2, legacy=False):
+    """n live PS servers; ``legacy=True`` serves only the pre-ISSUE-5
+    method set (no pull_embedding_batch), like an old binary."""
+    servers, servicers, addrs = [], [], []
+    for ps_id in range(n_shards):
+        store = NumpyEmbeddingStore(seed=ps_id)
+        store.set_optimizer("adam", lr=0.01)
+        servicer = _RecordingServicer(store, ps_id=ps_id)
+        server = build_server()
+        if legacy:
+            methods = {
+                name: pair
+                for name, pair in services._PSERVER_METHODS.items()
+                if name != "pull_embedding_batch"
+            }
+            services._add_service(
+                server, servicer, services._PSERVER_SERVICE, methods
+            )
+        else:
+            add_pserver_servicer_to_server(servicer, server)
+        port = find_free_port()
+        server.add_insecure_port("localhost:%d" % port)
+        server.start()
+        servers.append(server)
+        servicers.append(servicer)
+        addrs.append("localhost:%d" % port)
+    return servers, servicers, addrs
+
+
+@pytest.fixture
+def live_ps():
+    servers, servicers, addrs = _start_ps()
+    yield servicers, addrs
+    for server in servers:
+        server.stop(None)
+
+
+@pytest.fixture
+def legacy_ps():
+    servers, servicers, addrs = _start_ps(legacy=True)
+    yield servicers, addrs
+    for server in servers:
+        server.stop(None)
+
+
+def _register(client, tables=("t1", "t2", "t3"), dim=4):
+    client.push_embedding_table_infos(
+        [(name, dim, "0.05") for name in tables]
+    )
+
+
+# ---------------------------------------------------------------------------
+# fused multi-table pull
+
+def test_batched_pull_matches_per_table_and_costs_one_rpc_per_shard(
+    live_ps,
+):
+    servicers, addrs = live_ps
+    client = PSClient(addrs)
+    _register(client)
+    rng = np.random.RandomState(0)
+    ids_by_table = {
+        name: rng.randint(0, 1000, size=n).astype(np.int64)
+        for name, n in (("t1", 64), ("t2", 17), ("t3", 1))
+    }
+    per_table = {
+        name: client.pull_embedding_vectors(name, ids)
+        for name, ids in ids_by_table.items()
+    }
+    vector_rpcs = sum(s.pull_vector_calls for s in servicers)
+    assert vector_rpcs >= 3  # per-table path: >= one RPC per table
+    batched = client.pull_embedding_batch(ids_by_table)
+    assert sorted(batched) == ["t1", "t2", "t3"]
+    for name, ids in ids_by_table.items():
+        assert batched[name].shape == (ids.size, 4)
+        np.testing.assert_array_equal(batched[name], per_table[name])
+    # the whole 3-table pull cost at most one batch RPC per shard
+    assert sum(s.pull_batch_calls for s in servicers) <= len(servicers)
+    assert sum(s.pull_vector_calls for s in servicers) == vector_rpcs
+
+
+def test_batched_pull_empty_and_missing_ids():
+    servers, _, addrs = _start_ps(n_shards=1)
+    try:
+        client = PSClient(addrs)
+        _register(client)
+        assert client.pull_embedding_batch({}) == {}
+        out = client.pull_embedding_batch(
+            {"t1": np.empty((0,), np.int64)}
+        )
+        assert out == {}
+    finally:
+        for server in servers:
+            server.stop(None)
+
+
+def test_batched_pull_falls_back_against_legacy_server(legacy_ps):
+    """An old PS answers pull_embedding_batch with UNIMPLEMENTED; the
+    client must remember and serve every pull per-table."""
+    servicers, addrs = legacy_ps
+    client = PSClient(addrs)
+    _register(client)
+    ids = np.arange(40, dtype=np.int64)
+    out = client.pull_embedding_batch({"t1": ids, "t2": ids[:7]})
+    assert client._batch_pull_supported is False
+    assert out["t1"].shape == (40, 4)
+    assert out["t2"].shape == (7, 4)
+    np.testing.assert_array_equal(
+        out["t1"], client.pull_embedding_vectors("t1", ids)
+    )
+    # second pull goes straight per-table (no repeated UNIMPLEMENTED)
+    out2 = client.pull_embedding_batch({"t3": ids[:3]})
+    assert out2["t3"].shape == (3, 4)
+    # and pushes switch to the legacy repeated-id encoding: a
+    # pre-ids_blob server reads only `ids`, so a packed push against
+    # it would silently apply nothing
+    client.push_gradients(
+        {"t1": (np.ones((4, 4), np.float32),
+                np.arange(4, dtype=np.int64))}
+    )
+    encodings = [e for s in servicers
+                 for e in getattr(s, "push_id_encodings", [])]
+    assert encodings and set(encodings) == {"legacy"}, encodings
+
+
+def test_legacy_fallback_many_tables_does_not_deadlock(legacy_ps):
+    """Regression: the per-table fallback must fan out on its own pool.
+    Nested on the client's shard pool, >= max_workers simultaneously
+    blocked per-table tasks starve their own per-shard sub-tasks and
+    the pull hangs forever."""
+    servicers, addrs = legacy_ps
+    client = PSClient(addrs)
+    tables = tuple("t%d" % i for i in range(6))  # > pool max_workers
+    _register(client, tables=tables)
+    ids = np.arange(20, dtype=np.int64)
+    done = {}
+
+    def pull():
+        done["out"] = client.pull_embedding_batch(
+            {name: ids for name in tables}
+        )
+
+    thread = threading.Thread(target=pull, daemon=True)
+    thread.start()
+    thread.join(timeout=30)
+    assert not thread.is_alive(), "legacy per-table fallback deadlocked"
+    assert sorted(done["out"]) == sorted(tables)
+    for name in tables:
+        assert done["out"][name].shape == (20, 4)
+
+
+def test_legacy_repeated_ids_request_still_served(monkeypatch, live_ps):
+    """A legacy CLIENT sending repeated varint ids must keep working
+    against the new server (reader-accepts-either contract) — and must
+    be served plain fp32 even when the server runs a reduced wire
+    dtype, since a pre-knob client cannot resolve extension dtype
+    names."""
+    import grpc
+
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.proto.services import PserverStub
+
+    servicers, addrs = live_ps
+    client = PSClient(addrs)
+    _register(client)
+    monkeypatch.setenv(tensor_utils.WIRE_DTYPE_ENV, "bfloat16")
+    stub = PserverStub(grpc.insecure_channel(addrs[0]))
+    request = pb.PullEmbeddingVectorsRequest(name="t1", ids=[1, 2, 3])
+    blob = stub.pull_embedding_vectors(request, timeout=10)
+    assert blob.dtype == "float32"  # legacy peers never get bf16
+    rows = tensor_utils.blob_to_ndarray(blob)
+    assert rows.shape == (3, 4)
+
+
+# ---------------------------------------------------------------------------
+# push path: request reuse + packed ids on the wire
+
+def test_push_requests_reused_without_cross_step_leftovers(live_ps):
+    servicers, addrs = live_ps
+    client = PSClient(addrs)
+    _register(client)
+    rng = np.random.RandomState(1)
+    grads = lambda n: (  # noqa: E731
+        rng.randn(n, 4).astype(np.float32),
+        rng.permutation(1000)[:n].astype(np.int64),
+    )
+    client.push_gradients({"t1": grads(8), "t2": grads(5)})
+    client.push_gradients({"t3": grads(6)})
+    pushed = [s for servicer in servicers
+              for s in servicer.pushed_table_sets]
+    # no request carried t1/t2 leftovers into the second step
+    for table_set in pushed:
+        assert not ({"t1", "t2"} & set(table_set)) or "t3" not in table_set
+    assert any("t3" in s for s in pushed)
+    # ids traveled packed: the store applied them (value check) and
+    # bytes were tallied
+    assert sum(s._t_push_bytes for s in servicers) > 0
+
+
+def test_push_and_pull_bytes_flow_into_telemetry(live_ps):
+    servicers, addrs = live_ps
+    client = PSClient(addrs)
+    _register(client)
+    ids = np.arange(32, dtype=np.int64)
+    client.pull_embedding_batch({"t1": ids})
+    client.push_gradients(
+        {"t1": (np.ones((32, 4), np.float32), ids)}
+    )
+    blobs = [s.telemetry_blob() for s in servicers]
+    assert sum(b.pull_bytes for b in blobs) == 32 * 4 * 4
+    # push payload: 32 fp32 rows of dim 4 + 32 packed int64 ids
+    assert sum(b.push_bytes for b in blobs) == 32 * 4 * 4 + 32 * 8
+
+
+# ---------------------------------------------------------------------------
+# EDL_WIRE_DTYPE over a real wire
+
+def test_bfloat16_wire_end_to_end(monkeypatch, live_ps):
+    servicers, addrs = live_ps
+    monkeypatch.setenv(tensor_utils.WIRE_DTYPE_ENV, "bfloat16")
+    client = PSClient(addrs)
+    _register(client, tables=("t1",))
+    ids = np.arange(16, dtype=np.int64)
+    rows = client.pull_embedding_batch({"t1": ids})["t1"]
+    assert rows.dtype == np.float32  # upcast client-side
+    grads = np.full((16, 4), 0.125, np.float32)  # bf16-exact value
+    accepted, version, _ = client.push_gradients({"t1": (grads, ids)})
+    assert accepted
+    # the PS kept fp32 master copies and applied the (exactly
+    # representable) payload: rows moved by adam's first step
+    total_rows = sum(s._store.table_size("t1") for s in servicers)
+    assert total_rows == 16
+    # payload bytes were half of fp32 and labeled bfloat16
+    pushed = sum(s._t_push_bytes for s in servicers)
+    assert pushed == 16 * 4 * 2 + 16 * 8  # bf16 rows + packed ids
+    # float32 pull on a fresh client (knob off) still decodes tables
+    monkeypatch.delenv(tensor_utils.WIRE_DTYPE_ENV)
+    again = client.pull_embedding_batch({"t1": ids})["t1"]
+    assert again.dtype == np.float32
+    assert not np.array_equal(again, rows)  # the push landed
+
+
+# ---------------------------------------------------------------------------
+# async double-buffered push
+
+class _SlowLocalClient:
+    """LocalPSClient wrapper whose pushes block until released —
+    deterministic overlap/join probes."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.release = threading.Event()
+        self.push_started = threading.Event()
+        self.pushes = 0
+        self.fail_next = None  # None | "reject" | "raise"
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def push_gradients(self, *args, **kwargs):
+        self.push_started.set()
+        assert self.release.wait(timeout=30), "push never released"
+        self.pushes += 1
+        failure, self.fail_next = self.fail_next, None
+        if failure == "reject":
+            from elasticdl_tpu.worker.ps_client import PushResult
+
+            return PushResult(False, 7, (0,))
+        if failure == "raise":
+            raise ConnectionError("injected push transport failure")
+        return self._inner.push_gradients(*args, **kwargs)
+
+
+def _async_trainer(client):
+    from elasticdl_tpu.models import deepfm
+    from elasticdl_tpu.train.sparse import SparseTrainer
+
+    return SparseTrainer(
+        model=deepfm.custom_model(),
+        loss_fn=deepfm.loss,
+        optimizer=deepfm.optimizer(),
+        specs=deepfm.sparse_embedding_specs(
+            num_features=5, batch_size=8
+        ),
+        ps_client=client,
+        seed=0,
+        async_push=True,
+    )
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{
+        "features": {
+            "ids": rng.randint(0, 100, size=(8, 5)).astype(np.int64)
+        },
+        "labels": rng.randint(0, 2, 8).astype(np.float32),
+        "_mask": np.ones(8, np.float32),
+    } for _ in range(n)]
+
+
+def test_async_push_overlaps_step_and_joins_depth_one():
+    from elasticdl_tpu.ps.local_client import LocalPSClient
+
+    client = _SlowLocalClient(LocalPSClient(seed=0, opt_type="adam"))
+    trainer = _async_trainer(client)
+    b1, b2 = _batches(2)
+    state, _ = trainer.train_step(None, b1)
+    # step 1 returned while its push is still blocked: overlap is real
+    assert client.push_started.wait(timeout=10)
+    assert client.pushes == 0
+    client.release.set()
+    # depth-1 barrier: step 2 joins step 1's push before submitting
+    state, _ = trainer.train_step(state, b2)
+    trainer.join_pushes()
+    assert client.pushes == 2
+    assert trainer._version == 2
+
+
+def test_async_push_failure_surfaces_on_join():
+    from elasticdl_tpu.ps.local_client import LocalPSClient
+
+    client = _SlowLocalClient(LocalPSClient(seed=0, opt_type="adam"))
+    client.release.set()
+    trainer = _async_trainer(client)
+    (batch,) = _batches(1)
+    client.fail_next = "raise"
+    state, _ = trainer.train_step(None, batch)
+    with pytest.raises(ConnectionError, match="injected push"):
+        trainer.join_pushes()
+    # the failed future is consumed: the barrier is reusable
+    trainer.join_pushes()
+
+
+def test_async_push_rejection_raises_with_shards_on_join():
+    from elasticdl_tpu.ps.local_client import LocalPSClient
+
+    client = _SlowLocalClient(LocalPSClient(seed=0, opt_type="adam"))
+    client.release.set()
+    trainer = _async_trainer(client)
+    (batch,) = _batches(1)
+    client.fail_next = "reject"
+    trainer.train_step(None, batch)
+    with pytest.raises(RuntimeError, match=r"rejected.*\[0\]"):
+        trainer.join_pushes()
+    assert trainer.push_rejections == 1
+
+
+def test_async_push_trains_through_live_ps(live_ps):
+    """Async-push training over a real gRPC PS: every step's push
+    lands (version accounting adds up) and losses stay finite."""
+    _, addrs = live_ps
+    from elasticdl_tpu.models import deepfm
+    from elasticdl_tpu.train.sparse import SparseTrainer
+
+    def run(async_push):
+        trainer = SparseTrainer(
+            model=deepfm.custom_model(),
+            loss_fn=deepfm.loss,
+            optimizer=deepfm.optimizer(),
+            specs=deepfm.sparse_embedding_specs(
+                num_features=5, batch_size=8
+            ),
+            ps_client=PSClient(addrs),
+            seed=0,
+            async_push=async_push,
+        )
+        rng = np.random.RandomState(7)
+        state = None
+        losses = []
+        for k in range(4):
+            ids = (k * 100 + rng.randint(0, 100, size=(8, 5))).astype(
+                np.int64
+            )
+            batch = {
+                "features": {"ids": ids},
+                "labels": rng.randint(0, 2, 8).astype(np.float32),
+                "_mask": np.ones(8, np.float32),
+            }
+            state, loss = trainer.train_step(state, batch)
+            losses.append(float(loss))
+        trainer.join_pushes()
+        return losses
+
+    sync_losses = run(False)
+    async_losses = run(True)
+    assert np.isfinite(sync_losses).all() and np.isfinite(
+        async_losses
+    ).all()
