@@ -19,6 +19,10 @@ for (or refuses to pay for):
 - ``perf-varint-ids``     — no per-element Python-loop serialization
   into repeated proto fields (``.extend(int(i) for i in ids)``); use
   the packed ``ids_blob`` wire field or ``astype().tolist()``.
+- ``perf-host-gather``    — no per-id Python loops gathering embedding
+  rows (``for i in ids: table[i]``) inside hot functions; use a
+  vectorized gather (``table[ids]``/``np.take``) or the fused
+  device-tier kernels (``ops/embedding_tier.py``).
 - ``xhost-determinism``   — no set-ordered or filesystem-ordered
   iteration in checkpoint/export/gradient-aggregation paths, where
   ordering must match across hosts.

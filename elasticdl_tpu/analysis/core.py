@@ -221,6 +221,7 @@ def _rules_by_name(names=None):
         hot_path,
         lock_discipline,
         obs_hot_path,
+        perf_gather,
         perf_wire,
     )
 
@@ -229,6 +230,7 @@ def _rules_by_name(names=None):
         "jax-hot-path": hot_path.run,
         "obs-hot-path": obs_hot_path.run,
         "perf-varint-ids": perf_wire.run,
+        "perf-host-gather": perf_gather.run,
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
         "ft-retry-no-jitter": fault_tolerance.run_retry_no_jitter,
@@ -247,6 +249,7 @@ RULE_NAMES = (
     "jax-hot-path",
     "obs-hot-path",
     "perf-varint-ids",
+    "perf-host-gather",
     "ft-swallowed-except",
     "ft-grpc-timeout",
     "ft-retry-no-jitter",

@@ -1,0 +1,132 @@
+"""perf-host-gather: per-id Python loops over embedding rows on the
+step path.
+
+The idiom this rule exists for (the anti-pattern ISSUE 6's device tier
+removes — and the one a HOST-side id->row map invites back):
+
+    for i in ids:
+        out.append(table[i])          # or rows[i] = store[i]
+
+    rows = [table[int(i)] for i in ids]
+
+A Python-level loop that subscripts a table/array with the loop
+variable walks every id through the interpreter — O(ids) dict/array
+ops per step where a single vectorized gather (``table[ids]``,
+``np.take``, ``jnp.take``, or the fused tier kernels in
+ops/embedding_tier.py) does one. Inside jit tracing it is worse: the
+loop UNROLLS into per-id gather ops and compile time scales with the
+id count.
+
+Scope: only functions the shared hot-set resolver marks hot
+(``@hot_path`` / ``@jax.jit`` / jitted factory products — the same set
+jax-hot-path and obs-hot-path police). Host-side setup loops
+(checkpoint import/export, store bookkeeping) are deliberately out of
+scope: correctness code may loop.
+
+What fires: a ``for`` statement or comprehension whose body/element
+contains ``<name-or-attr>[<loop-var>]`` (possibly wrapped in
+``int(...)``/``np.int64(...)`` style casts) where the subscripted
+expression is not the loop's own iterable re-indexed for enumerate
+bookkeeping. Subscripts with computed slices, multiple indices doing
+real per-element work, or dict literals are left alone.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, attr_chain
+from elasticdl_tpu.analysis.hot_path import _collect_hot
+
+RULE = "perf-host-gather"
+
+
+def _loop_var_names(target):
+    """Names bound by a for-loop target (handles tuple unpacking)."""
+    names = set()
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+    return names
+
+
+def _subscript_index_name(node):
+    """The bare (possibly scalar-cast) Name used as a subscript index,
+    or None. Matches ``x[i]``, ``x[int(i)]``; not ``x[i + 1]``,
+    ``x[i, j]``, ``x[i:j]``."""
+    index = node.slice
+    if isinstance(index, ast.Call):
+        if len(index.args) != 1 or index.keywords:
+            return None
+        func = index.func
+        is_cast = (
+            isinstance(func, ast.Name) and func.id in ("int", "float")
+        ) or (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("int32", "int64", "asarray")
+        )
+        if not is_cast:
+            return None
+        index = index.args[0]
+    if isinstance(index, ast.Name):
+        return index.id
+    return None
+
+
+def _gather_subscripts(body_nodes, loop_vars):
+    """Subscript nodes in ``body_nodes`` that index by a loop var."""
+    hits = []
+    for stmt in body_nodes:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Subscript):
+                continue
+            if not isinstance(sub.value, (ast.Name, ast.Attribute)):
+                continue
+            if _subscript_index_name(sub) in loop_vars:
+                hits.append(sub)
+    return hits
+
+
+def _scan_loops(unit, node, symbol, findings):
+    body = node.body if isinstance(node.body, list) else [node.body]
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.For):
+                loop_vars = _loop_var_names(sub.target)
+                gathers = _gather_subscripts(sub.body, loop_vars)
+            elif isinstance(
+                sub, (ast.ListComp, ast.SetComp, ast.GeneratorExp)
+            ):
+                if len(sub.generators) != 1:
+                    continue
+                loop_vars = _loop_var_names(sub.generators[0].target)
+                gathers = _gather_subscripts([sub.elt], loop_vars)
+            else:
+                continue
+            for gather in gathers:
+                code = "%s[%s]" % (
+                    attr_chain(gather.value) or "<expr>",
+                    _subscript_index_name(gather),
+                )
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.path,
+                        line=gather.lineno,
+                        symbol=symbol,
+                        code=code,
+                        message=(
+                            "hot path: per-id Python loop gathers "
+                            "%s one row at a time (unrolls under jit, "
+                            "O(ids) interpreter ops on host) — use a "
+                            "vectorized gather (table[ids] / np.take /"
+                            " jnp.take) or the fused device-tier "
+                            "kernels (ops/embedding_tier.py)" % code
+                        ),
+                    )
+                )
+
+
+def run(units):
+    findings = []
+    for unit, node, symbol in _collect_hot(units):
+        _scan_loops(unit, node, symbol, findings)
+    return findings

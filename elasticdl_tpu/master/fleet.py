@@ -162,6 +162,14 @@ class FleetMonitor:
                 # what packed ids / EDL_WIRE_DTYPE actually moved
                 "push_bytes": int(blob.push_bytes),
                 "pull_bytes": int(blob.pull_bytes),
+                # device embedding tier (ISSUE 6): the worker's HBM
+                # hot-set hit rate / fill — the fraction of embedding
+                # traffic that never touches the PS wire
+                "tier_hit_rate": round(float(blob.tier_hit_rate), 4),
+                "tier_occupancy": round(float(blob.tier_occupancy), 4),
+                "tier_hits": int(blob.tier_hits),
+                "tier_misses": int(blob.tier_misses),
+                "tier_evictions": int(blob.tier_evictions),
             }
             # stuck-round bookkeeping: the clock restarts whenever the
             # fill grows or the store version advances

@@ -72,6 +72,13 @@ NUM_FIELDS = 39
 # ValueError out of the box.
 MAX_ID_CAPACITY = 8192
 
+# capacity-warning dedup (ISSUE 6 satellite): specs are constructed
+# once per trainer, and a bench/worker process builds several trainers
+# over its life — BENCH_r05's tail carried the identical line 3x. One
+# line per distinct (capacity, batch, fields) shape per process says
+# everything the repeat said.
+_warned_capacities = set()
+
 
 def sparse_embedding_specs(num_features=NUM_FIELDS, batch_size=64,
                            capacity=None):
@@ -90,7 +97,12 @@ def sparse_embedding_specs(num_features=NUM_FIELDS, batch_size=64,
         capacity = int(os.environ.get(
             "EDL_SPARSE_ID_CAPACITY", batch_size * num_features
         ))
-    if capacity < batch_size * num_features:
+    shape_key = (capacity, batch_size, num_features)
+    if (
+        capacity < batch_size * num_features
+        and shape_key not in _warned_capacities
+    ):
+        _warned_capacities.add(shape_key)
         _logger.info(
             "deepfm id-buffer capacity %d < worst case %d (batch %d x "
             "%d fields): fine for Zipfian id streams; a near-uniform "

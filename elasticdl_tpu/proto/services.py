@@ -45,6 +45,11 @@ _PSERVER_METHODS = {
     # a step costs ps_num pull RPCs instead of tables x ps_num
     "pull_embedding_batch": (pb.BatchedSlices, pb.PullEmbeddingBatchResponse),
     "push_gradients": (pb.PushGradientsRequest, pb.PushGradientsResponse),
+    # device-tier writeback (ISSUE 6): raw row VALUES overwriting the
+    # store (eviction/flush of the HBM hot set), not gradients — no
+    # optimizer math, no version bump. Reuses the Model message
+    # (embedding_tables: IndexedSlicesProto carries values + ids).
+    "push_embedding_rows": (pb.Model, pb.PushGradientsResponse),
 }
 
 

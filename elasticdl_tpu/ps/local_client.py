@@ -66,6 +66,19 @@ class LocalPSClient:
             if np.asarray(ids).size
         }
 
+    def push_embedding_rows(self, rows_by_table):
+        """Device-tier writeback: raw row values overwrite the store
+        (no optimizer math, no version bump, and no wire round trip —
+        writebacks are authoritative fp32 master copies even under
+        EDL_WIRE_DTYPE, matching PSClient.push_embedding_rows)."""
+        for name, (ids, values) in rows_by_table.items():
+            ids = np.asarray(ids, dtype=np.int64)
+            if not ids.size:
+                continue
+            self.store.import_table(
+                name, ids, np.asarray(values, dtype=np.float32)
+            )
+
     def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0,
                        only_shards=None, force_empty=False,
                        round_scoped=False):

@@ -168,6 +168,13 @@ class PserverServicer:
             "Embedding-row payload bytes served, by wire dtype",
             ("dtype",),
         )
+        # device-tier writebacks (ISSUE 6): rows overwritten by
+        # push_embedding_rows — eviction/flush traffic from workers'
+        # HBM hot sets
+        self._m_rows_written = obs_metrics.counter(
+            "edl_ps_rows_written_total",
+            "Embedding rows overwritten by device-tier writebacks",
+        )
         # Fleet-telemetry source (ISSUE 3): plain-int tallies kept
         # INDEPENDENTLY of the metrics registry (telemetry must work
         # with /metrics off), read by telemetry_blob() on the PS's 5 s
@@ -373,6 +380,29 @@ class PserverServicer:
         return self._stamp(
             pb.PushGradientsResponse(accepted=True, version=version)
         )
+
+    def push_embedding_rows(self, request, context=None):
+        """Device-tier writeback (ISSUE 6): raw row values overwrite
+        the store — an eviction or flush of the worker's HBM hot set
+        handing authority over those rows back to this spillover tier.
+        No optimizer math and no version bump: the values already
+        carry every update the tier applied in device memory (a bump
+        here would also perturb sync-round pairing, and the tier is an
+        async-PS feature). Existing rows keep their optimizer slot
+        state; rows unseen by this shard materialize fresh."""
+        self._m_rows_written.inc(
+            sum(
+                len(slices.ids) or len(slices.ids_blob) // 8
+                for slices
+                in request.embedding_tables.values()
+            )
+        )
+        for name, slices in request.embedding_tables.items():
+            values, ids = _deserialize_gradients(slices)
+            self._store.import_table(name, ids, values)
+        return self._stamp(pb.PushGradientsResponse(
+            accepted=True, version=self._store.version
+        ))
 
     def _push_gradients_sync(self, request):
         """Sync push with the journal I/O outside the push lock:

@@ -212,19 +212,51 @@ class HotRowCache:
         self._tables[name] = (new_ids, new_rows, new_stamps)
 
 
+class PullInfo(dict):
+    """``{table: (push_ids, n)}`` for the gradient push, plus the
+    device-tier step context riding as attributes (slots / push
+    positions per table, and the tier epoch the lookups ran under) —
+    consumers that treat it as a plain mapping are unaffected."""
+
+    tier_ctx = None
+    tier_epoch = None
+
+
 class SparseBatchPreparer:
     """Host-side: swap raw id features for (rows, indices) pairs.
 
-    Pulls for all tables fan out concurrently (DeepFM's second-order
-    and linear tables ride one round trip instead of two), and an
-    optional HotRowCache bounds how often hot rows are re-pulled.
+    With a device tier attached, each table's unique ids are looked up
+    in the HBM hot set first; only the misses reach the HotRowCache /
+    PS pull path, and ids promoted this step leave the PS push set
+    entirely (their gradients apply in-device). Pulls for all tables
+    fan out concurrently (DeepFM's second-order and linear tables ride
+    one round trip instead of two), and an optional HotRowCache bounds
+    how often hot rows are re-pulled.
     """
 
-    def __init__(self, specs, ps_client, cache=None):
+    def __init__(self, specs, ps_client, cache=None, device_tier=None):
         self._specs = list(specs)
         self._ps = ps_client
         self._registered = False
+        if cache is not None and device_tier is not None:
+            # The tier SUPERSEDES the hot-row cache: resident rows are
+            # served from device, and the residual misses are
+            # tail/cold ids the cache barely helps. More importantly,
+            # a cache-stale row must never become a promotion's staged
+            # value — the tier makes resident values AUTHORITATIVE
+            # (writebacks raw-overwrite the PS), so promoting a row
+            # that is missing the staleness window's PS-applied
+            # gradients would erase them permanently. Cache-only and
+            # tier-only configurations are both sound; the combination
+            # is not, so the tier wins.
+            logger.warning(
+                "HotRowCache disabled: the device embedding tier owns "
+                "the hot set, and stale cached rows must not be "
+                "promoted as authoritative tier values"
+            )
+            cache = None
         self._cache = cache
+        self._tier = device_tier
         # set by _on_ps_restart (possibly from the async-push thread),
         # consumed at the top of prepare() on the pulling thread
         self._cache_dirty = False
@@ -264,6 +296,13 @@ class SparseBatchPreparer:
         # prepares. The flag write is atomic; the clear then runs on
         # the one thread that ever mutates the cache.
         self._cache_dirty = True
+        if self._tier is not None:
+            # device tier: host maps invalidate NOW (thread-safe), the
+            # dirty rows' device values flush back to the restored PS
+            # from the dispatch thread before the state resets — the
+            # flush-then-invalidate order that makes a PS SIGKILL lose
+            # no tier-held updates (device_tier.mark_restart)
+            self._tier.mark_restart()
 
     def register_tables(self):
         if not self._registered:
@@ -365,7 +404,9 @@ class SparseBatchPreparer:
 
     def prepare(self, batch):
         """Returns (batch with rows/indices features, pull_info) where
-        pull_info = {name: (unique_ids, n_unique)} for the grad push."""
+        pull_info = {name: (push_ids, n)} for the grad push (all unique
+        ids without a device tier; only the un-promoted misses with
+        one)."""
         self.register_tables()
         if self._cache is not None:
             if self._cache_dirty:
@@ -373,6 +414,8 @@ class SparseBatchPreparer:
                 self._cache_dirty = False
                 self._cache.clear()
             self._cache.advance()
+        if self._tier is not None:
+            self._tier.advance()
         features = dict(batch["features"])
         # Zero-padded batch rows (lockstep padding, SPMD batch-multiple
         # padding — data/pipeline.pad_batch) must be invisible to the
@@ -390,9 +433,13 @@ class SparseBatchPreparer:
         batch_mask = None
         if MASK_KEY in batch:
             batch_mask = np.asarray(batch[MASK_KEY]) > 0
-        pull_info = {}
+        pull_info = PullInfo()
+        if self._tier is not None:
+            pull_info.tier_ctx = {}
+            pull_info.tier_epoch = self._tier.epoch
         consumed = set()
         plans = []
+        tier_meta = {}  # name -> (unique, slots, miss_pos)
         for spec in self._specs:
             # multiple tables may read the same id feature (e.g. DeepFM's
             # second-order and linear tables), so consume keys at the end
@@ -434,14 +481,64 @@ class SparseBatchPreparer:
             features[spec.name + INDICES_SUFFIX] = inverse.reshape(
                 ids.shape
             ).astype(np.int32)
-            pull_info[spec.name] = (unique, unique.size)
-            plans.append((spec, unique, capacity))
+            if self._tier is not None and unique.size:
+                # hot-set lookup first: only misses reach the PS path
+                slots = self._tier.lookup(spec.name, unique)
+                miss_pos = np.nonzero(slots < 0)[0]
+                if miss_pos.size:
+                    # ordering barrier: a miss id with an eviction
+                    # writeback still in flight must not be pulled
+                    # until the writeback lands (the pull would read
+                    # the pre-writeback value, and the late overwrite
+                    # would revert gradients pushed in between)
+                    self._tier.wait_for_writebacks(
+                        spec.name, unique[miss_pos]
+                    )
+                tier_meta[spec.name] = (unique, slots, miss_pos)
+                plans.append((spec, unique[miss_pos], capacity))
+            else:
+                plans.append((spec, unique, capacity))
         pulled = self._pull_tables(plans)
-        for spec, unique, capacity in plans:
+        for spec, pull_ids, capacity in plans:
             padded = np.zeros((capacity, spec.dim), dtype=np.float32)
-            if unique.size:
-                padded[: unique.size] = pulled[spec.name][1]
+            meta = tier_meta.get(spec.name)
+            if meta is None:
+                if pull_ids.size:
+                    padded[: pull_ids.size] = pulled[spec.name][1]
+                features[spec.name + ROWS_SUFFIX] = padded
+                pull_info[spec.name] = (pull_ids, pull_ids.size)
+                continue
+            unique, slots, miss_pos = meta
+            fetched = (
+                np.asarray(pulled[spec.name][1], np.float32)
+                if pull_ids.size
+                else np.empty((0, spec.dim), np.float32)
+            )
+            if miss_pos.size:
+                # PS rows land at their miss positions; hit positions
+                # stay zero — the tier's fused gather fills them on
+                # device at combine time
+                padded[miss_pos] = fetched
+            promoted, new_slots = self._tier.admit(
+                spec.name, pull_ids, fetched
+            )
+            if promoted.size and promoted.any():
+                # promoted ids are hits from THIS step on: their
+                # gradient applies in-device to the freshly staged
+                # slot, and they leave the PS push set (pushing too
+                # would double-apply the step)
+                slots = slots.copy()
+                slots[miss_pos[promoted]] = new_slots
+            push_pos = miss_pos[~promoted] if promoted.size else miss_pos
+            push_ids = pull_ids[~promoted] if promoted.size else pull_ids
+            slots_padded = np.full((capacity,), -1, np.int32)
+            slots_padded[: unique.size] = slots
             features[spec.name + ROWS_SUFFIX] = padded
+            pull_info[spec.name] = (push_ids, int(push_ids.size))
+            pull_info.tier_ctx[spec.name] = {
+                "slots": slots_padded,
+                "push_pos": push_pos,
+            }
         for key in consumed:
             features.pop(key, None)
         out = dict(batch)
@@ -634,6 +731,12 @@ class SparseTrainer:
     # recompute would also be a cross-process collective that a
     # single process must not run alone.
     RETRY_RECOMPUTES = True
+    # Device-resident embedding tier (ISSUE 6, train/device_tier.py):
+    # hit gradients apply in HBM outside the PS's round/version
+    # accounting, so the tier composes with the async PS only; the
+    # lockstep multi-host trainer turns it off (its rows buffer is
+    # dp-sharded, a different layout contract).
+    SUPPORTS_DEVICE_TIER = True
 
     def __init__(
         self,
@@ -647,6 +750,7 @@ class SparseTrainer:
         cache_staleness=0,
         cache_capacity=1_000_000,
         async_push=None,
+        device_tier=None,
     ):
         self._model = model
         self._tx = optimizer
@@ -657,8 +761,33 @@ class SparseTrainer:
             if cache_staleness > 0
             else None
         )
+        # Device-resident embedding tier (ISSUE 6): None reads
+        # EDL_DEVICE_TIER*, False disables, True/DeviceTierConfig
+        # opt in programmatically. With the tier off this trainer is
+        # bit-exact with the PS-only path (test-enforced).
+        from elasticdl_tpu.train.device_tier import resolve_tier_config
+
+        tier_config = resolve_tier_config(device_tier)
+        self.device_tier = None
+        if tier_config is not None and not self.SUPPORTS_DEVICE_TIER:
+            logger.warning(
+                "%s does not support the device embedding tier "
+                "(dp-sharded rows layout); EDL_DEVICE_TIER ignored",
+                type(self).__name__,
+            )
+            tier_config = None
+        if tier_config is not None:
+            from elasticdl_tpu.train.device_tier import (
+                DeviceEmbeddingTier,
+            )
+
+            self.device_tier = DeviceEmbeddingTier(
+                self._specs, ps_client, tier_config,
+                mesh=self._tier_mesh(),
+            )
         self.preparer = SparseBatchPreparer(
-            self._specs, ps_client, cache=cache
+            self._specs, ps_client, cache=cache,
+            device_tier=self.device_tier,
         )
         compute_dtype = resolve_dtype(compute_dtype)
         from elasticdl_tpu.train.step_fns import make_eval_step
@@ -719,6 +848,68 @@ class SparseTrainer:
             # its presence as an importable package is the tell
             and importlib.util.find_spec("axon") is None
         )
+
+    def _tier_mesh(self):
+        """Mesh the device tier shards its tables over (``ep`` axis);
+        resolves to the SPMD subclasses' mesh, None on single device.
+        Called before super().__init__ finishes, so it must only read
+        attributes the subclass set first."""
+        return getattr(self, "mesh", None)
+
+    def _tier_combine(self, batch, prepared, pull_info):
+        """Materialize the step's combined row buffers on device
+        (staged promotions land, eviction victims read out, hits
+        gathered from HBM). If a PS relaunch invalidated the tier
+        between this batch's prepare and now (epoch moved), the batch
+        is re-prepared — its slot context points into a map that no
+        longer exists, and the rows must re-pull from the restored
+        PS."""
+        tier = self.device_tier
+        ctx = getattr(pull_info, "tier_ctx", None)
+        if tier is None or not ctx:
+            return prepared, pull_info
+        if pull_info.tier_epoch != tier.epoch:
+            prepared, pull_info = self.preparer.prepare(batch)
+            ctx = getattr(pull_info, "tier_ctx", None) or {}
+        features = dict(prepared["features"])
+        for name, step_ctx in ctx.items():
+            features[name + ROWS_SUFFIX] = tier.combine(
+                name, step_ctx["slots"], features[name + ROWS_SUFFIX]
+            )
+        out = dict(prepared)
+        out["features"] = features
+        return out, pull_info
+
+    def _tier_apply_extract(self, row_grads, pull_info):
+        """Dispatch the fused in-device scatter-apply for every
+        table's hit gradients, then extract the (host) miss gradients
+        aligned with pull_info's push ids. The applies go first so the
+        device works while the host fetch blocks."""
+        tier = self.device_tier
+        ctx = getattr(pull_info, "tier_ctx", None)
+        if tier is None or not ctx:
+            return row_grads
+        for name, grads in row_grads.items():
+            step_ctx = ctx.get(name)
+            if step_ctx is not None:
+                tier.apply(name, step_ctx["slots"], grads)
+        # after every table's apply has been dispatched: the periodic
+        # writeback's device fetch then reads post-apply values
+        tier.maybe_periodic_writeback()
+        out = {}
+        for name, grads in row_grads.items():
+            step_ctx = ctx.get(name)
+            if step_ctx is None:
+                out[name] = grads
+            else:
+                out[name] = np.asarray(grads)[step_ctx["push_pos"]]
+        return out
+
+    def flush_device_tier(self):
+        """Write every tier-held row update back to the PS (worker
+        checkpoint/export boundaries); no-op without a tier."""
+        if self.device_tier is not None:
+            self.device_tier.flush()
 
     def _jit_steps(self, train_step_fn, row_grads_fn, eval_step_fn):
         """Compile the three step callables; single-device default."""
@@ -799,6 +990,11 @@ class SparseTrainer:
         self._async_push = False
         if pool is not None:
             pool.shutdown(wait=True)
+        if self.device_tier is not None:
+            # final writeback: tier-held updates reach the PS before
+            # the process exits (export/a successor would otherwise
+            # read stale spillover rows)
+            self.device_tier.close()
 
     def train_step(self, state, batch):
         """batch: raw (un-prepared) batch with id features."""
@@ -806,9 +1002,13 @@ class SparseTrainer:
         if state is None:
             state = self.create_state(prepared["features"])
         self._prep_memo = None
+        prepared, pull_info = self._tier_combine(
+            batch, prepared, pull_info
+        )
         t0 = self.timing.start()
         state, loss, row_grads = self._train_step(state, prepared)
         row_grads = self._fetch_row_grads(row_grads)
+        row_grads = self._tier_apply_extract(row_grads, pull_info)
         self.timing.end_record_sync("batch_process", t0, loss)
         if self._async_push:
             # join step N-1's push (depth-1 barrier), then hand step
@@ -834,6 +1034,16 @@ class SparseTrainer:
                 model_version=self._version,
                 force_empty=self.FORCE_EMPTY_PUSH,
                 round_scoped=self.ROUND_SCOPED_PUSH,
+            )
+        if not accepted and self.device_tier is not None:
+            # the retry protocol recomputes FULL row grads against
+            # fresh pulls — with hit grads already applied in-device
+            # that would double-apply; the tier is async-PS only by
+            # contract (class attr docstring)
+            raise RuntimeError(
+                "sync-mode PS rejected a push with the device "
+                "embedding tier enabled; EDL_DEVICE_TIER requires the "
+                "async PS (--use_async=true)"
             )
         retries = 0
         while not accepted and retries < self.MAX_PUSH_RETRIES:
@@ -884,10 +1094,12 @@ class SparseTrainer:
     def eval_step(self, state, batch):
         # eval pulls fresh rows: the in-flight async push must land
         # first or the scored rows would be one update behind the
-        # training reality the caller just observed
+        # training reality the caller just observed (tier hits are
+        # fresher still — gathered straight from HBM)
         self.join_pushes()
-        prepared, _ = self._prepare_once(batch)
+        prepared, pull_info = self._prepare_once(batch)
         self._prep_memo = None
+        prepared, _ = self._tier_combine(batch, prepared, pull_info)
         outputs = self._eval_step(state, prepared["features"])
         return jax.tree_util.tree_map(np.asarray, outputs)
 
@@ -979,13 +1191,18 @@ class SparseTrainer:
 
         def fold_in_flight():
             """Fetch the in-flight step's row grads (fences the device)
-            and fold them into the accumulator."""
+            and fold them into the accumulator. With a device tier the
+            hit grads apply in HBM first and only the miss grads come
+            to host (flight_info's push ids are miss-only)."""
             nonlocal in_flight, acc_steps
             row_grads, flight_info = in_flight
             in_flight = None
+            grads = self._tier_apply_extract(
+                self._fetch_row_grads(row_grads), flight_info
+            )
             fetched = {
                 name: np.asarray(value)
-                for name, value in self._fetch_row_grads(row_grads).items()
+                for name, value in grads.items()
             }
             for name, (unique, n) in flight_info.items():
                 if n == 0:
@@ -1002,6 +1219,12 @@ class SparseTrainer:
         try:
             while True:
                 t0 = self.timing.start()
+                # tier combine on the dispatch thread, after the
+                # previous step's in-device apply (fold) — staged
+                # promotions/evictions land here, hits gather from HBM
+                prepared, pull_info = self._tier_combine(
+                    batch, prepared, pull_info
+                )
                 state, loss, row_grads = self._train_step(state, prepared)
                 # Start the device->host copy of the row grads NOW:
                 # np.asarray in fold_in_flight would otherwise only

@@ -96,6 +96,7 @@ class SparseSpmdTrainer(SparseTrainer):
         sharding_rules=None,
         cache_staleness=0,
         cache_capacity=1_000_000,
+        device_tier=None,
     ):
         self.mesh = mesh if mesh is not None else build_mesh(mesh_config)
         self._rules = sharding_rules
@@ -112,6 +113,7 @@ class SparseSpmdTrainer(SparseTrainer):
             seed=seed,
             cache_staleness=cache_staleness,
             cache_capacity=cache_capacity,
+            device_tier=device_tier,
         )
         logger.info(
             "sparse-SPMD mesh %s (%d-way data parallel), %d tables",
@@ -331,6 +333,11 @@ class MultiHostSparseSpmdTrainer(LockstepMixin, SparseSpmdTrainer):
     MAX_PUSH_RETRIES = 8
     FORCE_EMPTY_PUSH = True
     RETRY_RECOMPUTES = False
+    # the lockstep rows buffer is dp-sharded (one worker's pulled rows
+    # per shard) — the device tier's replicated-combine layout does not
+    # apply, and its in-device applies would sit outside the sync PS's
+    # round accounting; EDL_DEVICE_TIER is ignored here with a warning
+    SUPPORTS_DEVICE_TIER = False
     # lockstep version tags are exact global round counters: have the
     # sync PS pair pushes by tag instead of arrival order, so a worker
     # whose pushes lag its rounds (host contention) can never have its
@@ -351,6 +358,7 @@ class MultiHostSparseSpmdTrainer(LockstepMixin, SparseSpmdTrainer):
         sharding_rules=None,
         cache_staleness=0,
         cache_capacity=1_000_000,
+        device_tier=None,
     ):
         super().__init__(
             model,
@@ -365,6 +373,7 @@ class MultiHostSparseSpmdTrainer(LockstepMixin, SparseSpmdTrainer):
             sharding_rules=sharding_rules,
             cache_staleness=cache_staleness,
             cache_capacity=cache_capacity,
+            device_tier=device_tier,
         )
         self._init_lockstep()
         nproc = jax.process_count()

@@ -458,6 +458,62 @@ class PSClient:
             return self._pull_per_table(ids_by_table)
         return out
 
+    def push_embedding_rows(self, rows_by_table):
+        """Device-tier writeback (ISSUE 6): ``{table: (ids, values)}``
+        raw row VALUES overwriting the PS store — eviction/flush of
+        the HBM hot set. Always fp32 on the wire regardless of
+        EDL_WIRE_DTYPE: these are authoritative master copies, and a
+        reduced payload would permanently round them (gradients
+        tolerate that; replacements do not)."""
+        with trace.span("ps_push_rows", tables=len(rows_by_table)):
+            return self._push_embedding_rows(rows_by_table)
+
+    def _push_embedding_rows(self, rows_by_table):
+        requests = [pb.Model() for _ in self._stubs]
+        for name, (ids, values) in rows_by_table.items():
+            ids = np.asarray(ids, dtype=np.int64)
+            values = np.asarray(values, dtype=np.float32)
+            if not ids.size:
+                continue
+            if self.ps_num == 1:
+                serialize_indexed_slices(
+                    values, ids, requests[0].embedding_tables[name],
+                    packed=not self._legacy_ids,
+                )
+                continue
+            shard_of = ids % self.ps_num
+            for shard in np.unique(shard_of):
+                pos = np.nonzero(shard_of == shard)[0]
+                serialize_indexed_slices(
+                    values[pos], ids[pos],
+                    requests[int(shard)].embedding_tables[name],
+                    packed=not self._legacy_ids,
+                )
+        futures = []
+        for shard, (stub, request) in enumerate(
+            zip(self._stubs, requests)
+        ):
+            if not request.embedding_tables:
+                continue
+            futures.append((shard, self._pool.submit(
+                _call_with_retry,
+                lambda stub=stub, request=request:
+                    stub.push_embedding_rows(
+                        request, timeout=PS_RETRY_BUDGET_SECS
+                    ),
+                "push_embedding_rows",
+                channel=self._channels[shard],
+            )))
+        for shard, future in futures:
+            response = future.result()
+            # stamp-only fold (_note_restored, not _note_version): the
+            # writeback thread races the push thread, so its response
+            # version can legitimately arrive older than a push's —
+            # feeding it to the version-regression detector would fake
+            # a relaunch. The boot-restore stamp has no ordering, and
+            # still catches a real relaunch a beat earlier.
+            self._note_restored(shard, response.restored_version)
+
     def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0,
                        only_shards=None, force_empty=False,
                        round_scoped=False):

@@ -387,13 +387,24 @@ class Worker:
     def _telemetry_blob(self):
         """The piggyback payload for MasterClient RPCs. Called on the
         RPC path (get_task/report/heartbeat), never per step."""
-        return pb.TelemetryBlob(
+        blob = pb.TelemetryBlob(
             role="worker-%d" % self._mc.worker_id,
             step_time_ewma=self._step_ewma,
             examples_per_sec=self._last_examples_per_sec,
             last_task_seconds=self.tds.last_task_seconds,
             model_version=self._version,
         )
+        # device embedding tier (ISSUE 6): hot-set health rides the
+        # same piggyback into the master's /statusz fleet view
+        tier = getattr(self.trainer, "device_tier", None)
+        if tier is not None:
+            stats = tier.stats()
+            blob.tier_hit_rate = stats["hit_rate"]
+            blob.tier_occupancy = stats["occupancy"]
+            blob.tier_hits = stats["hits"]
+            blob.tier_misses = stats["misses"]
+            blob.tier_evictions = stats["evictions"]
+        return blob
 
     def _update_step_telemetry(self, real_count):
         """Fold one finished batch into the telemetry EWMAs. Prefers
@@ -472,11 +483,23 @@ class Worker:
         if join is not None:
             join()
 
+    def _flush_device_tier(self):
+        """Device-tier writeback barrier (train/device_tier.py):
+        checkpoint / export / train-end boundaries write the HBM hot
+        set's dirty rows back to the PS first, so the PS-side state
+        those artifacts derive from carries the tier's updates. No-op
+        for dense trainers and with the tier off."""
+        flush = getattr(self.trainer, "flush_device_tier", None)
+        if flush is not None:
+            flush()
+
     def _save_checkpoint(self):
         # in-flight sparse pushes land before the version is stamped
         # durable: a checkpoint claiming version V must not precede
-        # V's gradients reaching the PS
+        # V's gradients reaching the PS; device-tier rows flush for
+        # the same reason (the PS sparse checkpoint must carry them)
         self._join_trainer_pushes()
+        self._flush_device_tier()
         state = self.state
         if self._lockstep:
             # orbax's save is itself a cross-process collective
@@ -956,8 +979,10 @@ class Worker:
     def _process_train_end_task(self, task):
         from elasticdl_tpu.train.callbacks import SavedModelExporter
 
-        # the exported artifact must reflect every pushed gradient
+        # the exported artifact must reflect every pushed gradient —
+        # and every device-tier row update (export reads the PS tables)
         self._join_trainer_pushes()
+        self._flush_device_tier()
 
         wants_export = bool(task.extended_config.get("saved_model_path"))
         if wants_export and self.state is None:

@@ -52,12 +52,12 @@ def main():
 
     rows = []
     for delay in [float(d) for d in args.delays_ms.split(",")]:
-        sequential = deepfm_run(
+        sequential, _ = deepfm_run(
             pipelined=False, inject_rpc_delay_ms=delay,
             batch_size=args.batch_size, warmup=args.warmup,
             steps=args.steps,
         )
-        pipelined = deepfm_run(
+        pipelined, _ = deepfm_run(
             pipelined=True, inject_rpc_delay_ms=delay,
             batch_size=args.batch_size, warmup=args.warmup,
             steps=args.steps,

@@ -271,6 +271,17 @@ printf '{"ts": "%s", "wire_micro": %s}\n' \
   >> /tmp/ci_wire_micro.jsonl
 echo "wire-micro numbers journaled to /tmp/ci_wire_micro.jsonl"
 
+# Device-tier A-B (ISSUE 6): deepfm steps/s with the HBM hot set on vs
+# off under an emulated per-row wire cost, plus the warm-phase hit
+# rate. Report-only journaled like the wire micro; the script
+# hard-fails only on a >3x tier-on regression, a sub-0.9 Zipfian hit
+# rate (promotion/demotion policy broke), or flush-parity corruption.
+JAX_PLATFORMS=cpu python scripts/bench_device_tier.py | tee /tmp/_device_tier.json
+printf '{"ts": "%s", "device_tier": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_device_tier.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "device-tier A-B journaled to /tmp/ci_wire_micro.jsonl"
+
 # The reduced-precision wire opt-in must actually train: a sparse
 # local-executor run with EDL_WIRE_DTYPE=bfloat16 (LocalPSClient
 # round-trips payloads through the wire dtype, emulating exactly the

@@ -548,20 +548,27 @@ def test_master_sigkill_mid_epoch_replay_no_shard_lost_or_doubled(
 
 
 @pytest.mark.parametrize(
-    "async_push",
+    "async_push,device_tier",
     [
-        False,
+        (False, False),
         # ISSUE 5 acceptance: the same SIGKILL/auto-restore/resync
         # protocol must hold with the double-buffered async push on —
         # an in-flight push resolves (retry budget) or surfaces at the
         # depth-1 join, never silently drops. Slow-marked: the fault
         # window alone is ~a minute; the fast lane keeps the sync
         # variant.
-        pytest.param(True, marks=pytest.mark.slow),
+        pytest.param(True, False, marks=pytest.mark.slow),
+        # ISSUE 6 acceptance: PS SIGKILL mid-job with the DEVICE TIER
+        # enabled loses no tier-held updates — the restored-stamp
+        # change triggers flush-then-invalidate (the tier's rows,
+        # newer than the restored checkpoint, write back before the
+        # map drops), and at job end every resident row's value
+        # matches the PS store (writebacks all landed).
+        pytest.param(True, True, marks=pytest.mark.slow),
     ],
 )
 def test_ps_sigkill_auto_restore_and_worker_resync(
-    tmp_path, monkeypatch, async_push
+    tmp_path, monkeypatch, async_push, device_tier
 ):
     """ISSUE 4 tentpole acceptance: SIGKILL the PS mid-round and
     relaunch it with NO restore flag — the PS auto-restores its newest
@@ -585,6 +592,19 @@ def test_ps_sigkill_auto_restore_and_worker_resync(
     if async_push:
         # read by SparseTrainer at construction (inside Worker below)
         monkeypatch.setenv("EDL_ASYNC_PUSH", "1")
+    if device_tier:
+        monkeypatch.setenv("EDL_DEVICE_TIER", "1")
+        # a PARTIAL hot set (256 rows over the ctr fixture's 1000-id
+        # uniform vocab): misses keep flowing so the PS still sees
+        # pushes (the kill-once trigger counts push_gradients — a
+        # full-residency tier absorbs ALL traffic and the fault never
+        # fires), and LFU churn keeps eviction writebacks live across
+        # the kill window
+        monkeypatch.setenv("EDL_DEVICE_TIER_ROWS", "256")
+        monkeypatch.setenv("EDL_DEVICE_TIER_PROMOTE", "2")
+        # match the PS server's optimizer config (adam lr=0.01)
+        monkeypatch.setenv("EDL_DEVICE_TIER_OPT", "adam")
+        monkeypatch.setenv("EDL_DEVICE_TIER_OPT_ARGS", "lr=0.01")
     events.configure("worker-0")
 
     train_dir = tmp_path / "train"
@@ -691,6 +711,30 @@ def test_ps_sigkill_auto_restore_and_worker_resync(
         # rolled back then advanced: the final version is consistent
         # with the restored base, not the pre-kill high-water mark
         assert worker.trainer._version >= restored_floor
+        if device_tier:
+            # no lost updates across the SIGKILL: the trainer's
+            # end-of-life close() flushed the tier, and every resident
+            # row's device value must match what the (restarted) PS
+            # now stores — the resync flush + eviction/periodic
+            # writebacks all landed
+            import numpy as np
+
+            from elasticdl_tpu.worker.ps_client import PSClient
+
+            tier = worker.trainer.device_tier
+            assert tier is not None, "EDL_DEVICE_TIER did not engage"
+            assert tier.epoch >= 1, (
+                "PS relaunch never invalidated the tier"
+            )
+            probe = PSClient(["localhost:%d" % ps_port])
+            for table in ("deepfm_emb", "deepfm_linear"):
+                ids, rows = tier.table_rows(table)
+                if not ids.size:
+                    continue
+                np.testing.assert_allclose(
+                    probe.pull_embedding_vectors(table, ids), rows,
+                    rtol=1e-5, atol=1e-6,
+                )
     finally:
         server.stop(0)
         if ps_proc.poll() is None:
