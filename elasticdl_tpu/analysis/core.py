@@ -234,6 +234,7 @@ def _rules_by_name(names=None):
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
         "ft-retry-no-jitter": fault_tolerance.run_retry_no_jitter,
+        "ft-sigterm-no-chain": fault_tolerance.run_sigterm_no_chain,
         "xhost-determinism": determinism.run,
     }
     if names is None:
@@ -253,6 +254,7 @@ RULE_NAMES = (
     "ft-swallowed-except",
     "ft-grpc-timeout",
     "ft-retry-no-jitter",
+    "ft-sigterm-no-chain",
     "xhost-determinism",
 )
 

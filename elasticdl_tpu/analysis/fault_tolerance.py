@@ -16,6 +16,14 @@ name chain contains "stub" (``self._stub.get_task``,
 ``stub.push_gradients``, ``self._stubs[i].pull``) — the naming
 convention this repo uses for every generated-client handle.
 
+``ft-sigterm-no-chain`` — a ``signal.signal(SIGTERM, handler)``
+registration in a scope that never calls ``signal.getsignal`` silently
+REPLACES whatever handler was installed before it. SIGTERM hooks in
+this codebase compose in a chain (flight-recorder ring dump ->
+graceful drain -> exit, observability/events.py + worker/drain.py), so
+an overwriting registration severs the links behind it — the drain
+hook must capture the previous handler (``getsignal``) and call it.
+
 ``ft-retry-no-jitter`` — a retry loop that sleeps a deterministically
 GROWING backoff (``delay``, then ``delay = min(delay * 2, cap)``)
 without any randomness retries in lockstep across a fleet: every
@@ -186,6 +194,55 @@ def run_retry_no_jitter(units):
                         ),
                     )
                 )
+    return findings
+
+
+def _mentions_sigterm(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "SIGTERM":
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "SIGTERM":
+            return True
+    return False
+
+
+def run_sigterm_no_chain(units):
+    findings = []
+    for unit in units:
+        # scopes that capture the previous handler
+        chaining_scopes = set()
+        for node, scope in walk_with_scope(unit.tree):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain is not None and (
+                    chain.split(".")[-1] == "getsignal"
+                ):
+                    chaining_scopes.add(scope)
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.Call) or len(node.args) < 2:
+                continue
+            chain = attr_chain(node.func)
+            if chain is None or chain.split(".")[-1] != "signal":
+                continue
+            if not _mentions_sigterm(node.args[0]):
+                continue
+            if scope in chaining_scopes:
+                continue
+            findings.append(
+                Finding(
+                    rule="ft-sigterm-no-chain",
+                    path=unit.path,
+                    line=node.lineno,
+                    symbol=scope,
+                    code="signal.signal(SIGTERM)",
+                    message=(
+                        "SIGTERM handler registered without capturing "
+                        "the previous one (signal.getsignal); this "
+                        "severs the crash-hook/drain chain — capture "
+                        "and call the prior handler"
+                    ),
+                )
+            )
     return findings
 
 

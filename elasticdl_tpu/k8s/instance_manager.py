@@ -75,12 +75,71 @@ class InstanceManager:
         self._worker_pod_info = {}
         self._relaunch_deleted_live_worker = True
         self._relaunch_deleted_live_ps = True
+        # pods removed ON PURPOSE (autoscaler scale-down / drained
+        # victims): their DELETED event must neither relaunch a
+        # replacement nor count toward all_workers_failed
+        self._removed_on_purpose = set()
         self.all_workers_failed = False
 
     # ------------------------------------------------------------------
     def start_workers(self):
         for _ in range(self._num_workers):
             self._start_worker(self._next_worker_id())
+
+    # -- elasticity control loop (ISSUE 7): the scaler protocol --------
+    def scale_up(self, count=1):
+        """Add ``count`` fresh workers (new ids, as relaunches get);
+        returns the started ids. Membership recomputes so the
+        rendezvous alive-host list is current before the pods run."""
+        started = []
+        for _ in range(max(0, count)):
+            worker_id = self._next_worker_id()
+            self._start_worker(worker_id)
+            started.append(worker_id)
+        if started:
+            self._update_membership()
+        return started
+
+    def remove_worker(self, worker_id):
+        """Intentional scale-down removal: the pod delete delivers
+        SIGTERM (the worker's graceful-drain hook runs inside the K8s
+        grace period; kubelet's SIGKILL after it is the hard
+        deadline). The DELETED event that follows must NOT relaunch —
+        this worker is leaving on purpose. Returns False when no live
+        pod holds ``worker_id``."""
+        with self._lock:
+            name = next(
+                (
+                    pod_name
+                    for pod_name, (wid, _) in self._worker_pod_info.items()
+                    if wid == worker_id
+                ),
+                None,
+            )
+            if name is None:
+                return False
+            self._removed_on_purpose.add(name)
+        try:
+            self._client.delete_worker(worker_id)
+        except Exception as e:
+            # log-and-degrade, but KEEP the intentional mark: the
+            # victim is condemned either way (its get_task gate answers
+            # WAIT, so it does no further work), and the master's drain
+            # deadline / liveness fallback will delete the pod again —
+            # that later delete (or any genuine death meanwhile) is
+            # this scale-down completing late. Dropping the mark here
+            # would make the fallback's DELETED event relaunch a
+            # replacement, undoing the shrink in a loop.
+            logger.warning(
+                "scale-down delete of worker %d failed: %s", worker_id, e
+            )
+        return True
+
+    def worker_ids(self):
+        """Live worker ids (pods not yet observed dead/removed) — the
+        autoscaler's fleet-size input."""
+        with self._lock:
+            return [wid for wid, _ in self._worker_pod_info.values()]
 
     def _start_worker(self, worker_id):
         logger.info("Starting worker %d", worker_id)
@@ -164,23 +223,53 @@ class InstanceManager:
                         _start_time_of(pod),
                     )
             if phase == "Failed":
-                logger.warning("Worker pod %s failed", name)
-                self._recover(worker_id)
-                relaunch = not _was_oom_killed(pod)
-                if not relaunch:
-                    logger.warning(
-                        "Worker pod %s was OOM-killed; NOT relaunching "
-                        "(a bigger pod is an operator decision)",
-                        name,
+                with self._lock:
+                    intentional = name in self._removed_on_purpose
+                    self._removed_on_purpose.discard(name)
+                if intentional:
+                    # scale-down victim that died non-zero inside the
+                    # grace period (wedged drain → watchdog exit, or
+                    # kubelet's SIGKILL): still an intentional removal.
+                    # No replacement, no all-failed — the master's
+                    # drain deadline, not this sweep, requeues whatever
+                    # the failed drain stranded.
+                    logger.info(
+                        "Worker pod %s failed during scale-down "
+                        "removal", name,
                     )
-                self._forget_worker(name)
+                    self._forget_worker(name, failed=False)
+                else:
+                    logger.warning("Worker pod %s failed", name)
+                    self._recover(worker_id)
+                    relaunch = not _was_oom_killed(pod)
+                    if not relaunch:
+                        logger.warning(
+                            "Worker pod %s was OOM-killed; NOT "
+                            "relaunching (a bigger pod is an operator "
+                            "decision)",
+                            name,
+                        )
+                    self._forget_worker(name)
         elif event_type == "DELETED":
-            logger.warning("Worker pod %s deleted", name)
-            self._recover(worker_id)
-            relaunch = self._relaunch_deleted_live_worker and (
-                phase not in ("Succeeded",)
-            )
-            self._forget_worker(name)
+            with self._lock:
+                intentional = name in self._removed_on_purpose
+                self._removed_on_purpose.discard(name)
+            if intentional:
+                # scale-down victim: its tasks drained (or the drain
+                # deadline requeued them) — no recovery sweep, no
+                # replacement, and an empty fleet here is a scaling
+                # decision, not a failure
+                logger.info(
+                    "Worker pod %s removed by scale-down", name
+                )
+                self._forget_worker(name, failed=False)
+            else:
+                logger.warning("Worker pod %s deleted", name)
+                self._recover(worker_id)
+                relaunch = self._relaunch_deleted_live_worker and (
+                    phase not in ("Succeeded",)
+                )
+                self._forget_worker(name)
         self._update_membership()
         if relaunch:
             # a replacement worker gets a NEW id: the dead worker's tasks
@@ -188,11 +277,11 @@ class InstanceManager:
             self._start_worker(self._next_worker_id())
             self._update_membership()
 
-    def _forget_worker(self, name):
+    def _forget_worker(self, name, failed=True):
         with self._lock:
             self._worker_pods_phase.pop(name, None)
             self._worker_pod_info.pop(name, None)
-            if not self._worker_pods_phase:
+            if failed and not self._worker_pods_phase:
                 self.all_workers_failed = True
 
     def _recover(self, worker_id):

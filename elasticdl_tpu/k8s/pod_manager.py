@@ -200,6 +200,19 @@ class K8sPodManager:
     def all_workers_failed(self):
         return self._manager.all_workers_failed
 
+    # -- scaler protocol (master/autoscaler.py ElasticController) -----
+    def worker_ids(self):
+        return self._manager.worker_ids()
+
+    def scale_up(self, count):
+        return self._manager.scale_up(count)
+
+    def remove_worker(self, worker_id):
+        """Scale-down eviction: pod delete -> SIGTERM -> the worker's
+        graceful-drain hook; the DELETED event is marked intentional so
+        no replacement launches."""
+        return self._manager.remove_worker(worker_id)
+
     def on_worker_presumed_dead(self, worker_id):
         """Liveness-timeout kill: reclaim the pod so K8s emits the
         DELETED event that relaunches a replacement (the reference's

@@ -110,6 +110,14 @@ class FleetMonitor:
         self._roles = {}  # key (worker_id or role string) -> _RoleState
         # alert key (kind, target) -> {"since": ts, ...detail}
         self._firing = {}
+        # drain hygiene (ISSUE 7): workers the control plane is removing
+        # ON PURPOSE. A draining worker is exempt from straggler/dead-air
+        # detection (it was often picked BECAUSE it is slow, and it goes
+        # quiet while it flushes); a cleanly drained worker leaves a
+        # silent tombstone in the snapshot's "drained" section instead
+        # of a dead_air alert.
+        self._draining = {}  # worker_id -> since
+        self._drained = {}   # worker_id -> {since, role, reason}
         self._started_at = time.time()
         # PR 2 registry: transitions-to-firing per alert kind, plus a
         # live gauge of currently-firing alerts. No-ops when metrics
@@ -135,6 +143,9 @@ class FleetMonitor:
         with self._lock:
             state = self._roles.get(worker_id)
             if state is None:
+                # a reused worker_id is a fresh process: its drain
+                # history belongs to the predecessor
+                self._drained.pop(worker_id, None)
                 role = blob.role if blob is not None and blob.role else (
                     "worker-%d" % worker_id
                     if worker_id >= 0
@@ -191,6 +202,8 @@ class FleetMonitor:
         cleanup; evictions go through mark_dead below)."""
         with self._lock:
             self._roles.pop(worker_id, None)
+            self._draining.pop(worker_id, None)
+            self._drained.pop(worker_id, None)
             for key in [k for k in self._firing if k[1] == worker_id]:
                 del self._firing[key]
 
@@ -201,9 +214,14 @@ class FleetMonitor:
         3x-average task timeout beats the dead-air window, and the
         eviction must never be QUIETER than the suspicion — and leave
         a tombstone on /alerts (detail ``evicted: true``) that clears
-        when the worker re-registers."""
+        when the worker re-registers. A worker that was DRAINING when
+        it died (drain deadline expired mid-flush) keeps the alert —
+        the drain failed, which is exactly what an operator must hear —
+        but the tombstone carries ``drained: true`` so the incident
+        reads as a late intentional removal, not a surprise death."""
         now = time.time()
         with self._lock:
+            was_draining = self._draining.pop(worker_id, None) is not None
             state = self._roles.pop(worker_id, None)
             for key in [
                 k for k in self._firing
@@ -217,15 +235,69 @@ class FleetMonitor:
                     "since": now, "evicted": True,
                     "role": state.role,
                 }
+                if was_draining:
+                    self._firing[key]["drained"] = True
             elif key in self._firing:
                 self._firing[key]["evicted"] = True
+                if was_draining:
+                    self._firing[key]["drained"] = True
         if fresh:
             self._m_alerts.labels(alert="dead_air").inc()
             logger.warning(
-                "fleet alert dead_air on %s: evicted", worker_id
+                "fleet alert dead_air on %s: evicted%s", worker_id,
+                " (drain deadline expired)" if was_draining else "",
             )
             events.emit("alert_raised", alert="dead_air",
-                        target=str(worker_id), evicted=True)
+                        target=str(worker_id), evicted=True,
+                        drained=was_draining)
+
+    # ------------------------------------------------------------------
+    # graceful drain (ISSUE 7): on-purpose removals must stay silent
+
+    def mark_draining(self, worker_id):
+        """The control plane is removing this worker on purpose
+        (scale-down victim / preemption notice): exempt it from the
+        straggler and dead-air detectors — it is expected to slow down
+        and then go quiet — and clear any straggler alert already
+        firing about it (it was likely picked BECAUSE it is slow)."""
+        cleared = []
+        with self._lock:
+            self._draining[worker_id] = time.time()
+            for key in [
+                k for k in self._firing
+                if k[1] == worker_id and k[0] == "straggler"
+            ]:
+                del self._firing[key]
+                cleared.append(key)
+        for kind, target in cleared:
+            events.emit("alert_cleared", alert=kind, target=str(target))
+
+    def mark_drained(self, worker_id, reason=""):
+        """Clean drain ack: the worker deregistered after flushing.
+        Removes the role and every alert about it WITHOUT raising
+        dead_air (the satellite contract: a worker removed on purpose
+        must never alert) and records a ``drained: true`` tombstone in
+        the snapshot's ``drained`` section, cleared if the id
+        re-registers."""
+        with self._lock:
+            self._draining.pop(worker_id, None)
+            state = self._roles.pop(worker_id, None)
+            for key in [k for k in self._firing if k[1] == worker_id]:
+                del self._firing[key]
+            # pop-before-insert keeps dict insertion order == since
+            # order even when an id re-registers and drains again
+            self._drained.pop(worker_id, None)
+            self._drained[worker_id] = {
+                "since": time.time(),
+                "role": state.role if state is not None
+                else str(worker_id),
+                "reason": reason,
+                "drained": True,
+            }
+            # bounded: a long-lived autoscaled job drains thousands of
+            # workers; keep the most recent tombstones only
+            while len(self._drained) > 64:
+                del self._drained[next(iter(self._drained))]
 
     # ------------------------------------------------------------------
     # detection
@@ -261,7 +333,7 @@ class FleetMonitor:
             (wid, s.blob["step_time_ewma"])
             for wid, s in self._roles.items()
             if s.blob is not None and s.blob["step_time_ewma"] > 0
-            and s.worker_id >= 0
+            and s.worker_id >= 0 and wid not in self._draining
         ]
         if len(ewmas) >= 3:
             values = sorted(v for _, v in ewmas)
@@ -277,7 +349,7 @@ class FleetMonitor:
                     }
         for wid, state in self._roles.items():
             silent = now - state.last_seen
-            if silent > self._dead_air_secs:
+            if silent > self._dead_air_secs and wid not in self._draining:
                 desired[("dead_air", wid)] = {
                     "since": now,
                     "silent_secs": round(silent, 2),
@@ -332,6 +404,30 @@ class FleetMonitor:
         return firing
 
     # ------------------------------------------------------------------
+    # autoscaler inputs (master/autoscaler.py): cheap O(fleet) reads
+
+    def worker_step_ewmas(self):
+        """{worker_id: step_time_ewma} for every reporting worker —
+        the autoscaler's victim-selection signal (slowest first)."""
+        with self._lock:
+            return {
+                wid: s.blob["step_time_ewma"]
+                for wid, s in self._roles.items()
+                if wid >= 0 and s.blob is not None
+                and s.blob["step_time_ewma"] > 0
+            }
+
+    def fleet_examples_per_sec(self):
+        """Sum of worker examples/s — the throughput the autoscaler's
+        marginal-gain guard tracks across resizes."""
+        with self._lock:
+            return sum(
+                s.blob["examples_per_sec"]
+                for wid, s in self._roles.items()
+                if wid >= 0 and s.blob is not None
+            )
+
+    # ------------------------------------------------------------------
     # exposition
 
     def alerts(self):
@@ -353,12 +449,24 @@ class FleetMonitor:
                 }
                 if state.blob is not None:
                     entry.update(state.blob)
+                if wid in self._draining:
+                    entry["draining"] = True
                 roles[state.role] = entry
+            drained = {
+                detail["role"]: {
+                    "worker_id": wid,
+                    "drained_secs_ago": round(now - detail["since"], 2),
+                    "reason": detail["reason"],
+                    "drained": True,
+                }
+                for wid, detail in self._drained.items()
+            }
         body = {
             "ts": now,
             "job": os.environ.get(events.JOB_NAME_ENV, ""),
             "uptime_secs": round(now - self._started_at, 2),
             "fleet": roles,
+            "drained": drained,
             "alerts": firing,
             "thresholds": {
                 "straggler_factor": self._straggler_factor,

@@ -14,6 +14,7 @@ from elasticdl_tpu.common.constants import JobType
 from elasticdl_tpu.common.grpc_utils import build_server
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.data.readers import create_data_reader
+from elasticdl_tpu.master.autoscaler import DrainManager, ElasticController
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.fleet import FleetMonitor
 from elasticdl_tpu.master.rendezvous import MeshRendezvous
@@ -142,6 +143,19 @@ class Master:
                 "workers", self.servicer.export_worker_state
             )
         self.pod_manager = pod_manager
+        # elasticity control loop (ISSUE 7): the drain manager always
+        # exists (the deregister RPC and preemption drains need it even
+        # on static fleets); the autoscaler only under EDL_AUTOSCALE
+        # with a scaling-capable pod manager — created in prepare(),
+        # after main() has had the chance to attach one.
+        self.drain_manager = DrainManager(
+            self.task_dispatcher,
+            servicer=self.servicer,
+            fleet=self.fleet_monitor,
+            rendezvous=self.rendezvous,
+        )
+        self.servicer.drain_manager = self.drain_manager
+        self.autoscaler = None
         self.task_monitor = TaskMonitor(
             self.task_dispatcher,
             self.servicer,
@@ -149,6 +163,7 @@ class Master:
             on_worker_dead=self._on_worker_dead,
             liveness_timeout_secs=task_timeout_secs,
             fleet_monitor=self.fleet_monitor,
+            drain_manager=self.drain_manager,
         )
         self._port = port
         self._server = None
@@ -234,6 +249,19 @@ class Master:
 
     # ------------------------------------------------------------------
     def prepare(self):
+        if self.autoscaler is None and self.pod_manager is not None:
+            # EDL_AUTOSCALE gate: None on static fleets or when the pod
+            # manager can't scale (maybe_create checks both)
+            self.autoscaler = ElasticController.maybe_create(
+                self.task_dispatcher,
+                self.pod_manager,
+                self.drain_manager,
+                fleet=self.fleet_monitor,
+            )
+            if self.autoscaler is not None:
+                self.task_monitor.set_autoscaler(self.autoscaler)
+                logger.info("Autoscaler enabled: %s",
+                            self.autoscaler.state())
         if self.evaluation_service is not None:
             self.evaluation_service.start()
         if self.job_type == JobType.EVALUATION_ONLY:
@@ -272,7 +300,15 @@ class Master:
             self.observability.add_json_handler(
                 "/statusz",
                 lambda: self.fleet_monitor.snapshot(
-                    extra={"tasks": self.task_dispatcher.stats()}
+                    extra={
+                        "tasks": self.task_dispatcher.stats(),
+                        "draining": self.drain_manager.state(),
+                        "autoscaler": (
+                            self.autoscaler.state()
+                            if self.autoscaler is not None
+                            else None
+                        ),
+                    }
                 ),
             )
             self.observability.add_json_handler(

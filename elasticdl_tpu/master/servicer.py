@@ -34,6 +34,11 @@ class MasterServicer:
         self._evaluation_service = evaluation_service
         self._rendezvous = rendezvous
         self._instance_manager = instance_manager
+        # graceful-drain coordination (master/autoscaler.py): set by the
+        # Master after construction. None = the pre-ISSUE-7 behavior
+        # (deregister still honored inline below, just without drain
+        # bookkeeping).
+        self.drain_manager = None
         # fleet telemetry sink (master/fleet.py): every RPC is a
         # liveness sighting, and requests carrying the piggybacked
         # TelemetryBlob update the role's fleet-view entry
@@ -159,6 +164,17 @@ class MasterServicer:
 
     def get_task(self, request, context=None):
         self._observe(request)
+        if self.drain_manager is not None and (
+            self.drain_manager.is_draining(request.worker_id)
+        ):
+            # drain gate (ISSUE 7): a draining worker gets NO new work.
+            # WAIT(draining=true) tells it to finish the current task,
+            # flush, and deregister — its record stream reads the flag
+            # as end-of-stream.
+            return pb.Task(
+                type=pb.WAIT, master_epoch=self._master_epoch,
+                draining=True,
+            )
         task_type = request.task_type if request.task_type else None
         dispatch_start = time.time()
         task = self._task_dispatcher.get(request.worker_id, task_type)
@@ -224,6 +240,32 @@ class MasterServicer:
         return pb.ResetWorkerResponse(
             restart_count=epoch, master_epoch=self._master_epoch
         )
+
+    def deregister_worker(self, request, context=None):
+        """Graceful-drain ack (ISSUE 7): the worker finished draining —
+        current task reported, async push joined, device-tier rows
+        flushed — and is about to exit ON PURPOSE. Remove it with no
+        dead-air alert and no counted requeue. Works for both
+        master-initiated drains (scale-down victims) and self-initiated
+        ones (kubelet SIGTERMed the pod; the master hears about the
+        preemption through this RPC)."""
+        if request.HasField("telemetry"):
+            # final telemetry fold (don't _observe: that would re-add
+            # the liveness entry the drain is about to remove)
+            if self._fleet is not None:
+                self._fleet.observe(request.worker_id, request.telemetry)
+        if self.drain_manager is None:
+            # bare servicer (tests/benches): the ack bookkeeping is the
+            # same either way, so construct the manager on first use
+            # instead of duplicating its cleanup sequence inline
+            from elasticdl_tpu.master.autoscaler import DrainManager
+
+            self.drain_manager = DrainManager(
+                self._task_dispatcher, servicer=self,
+                fleet=self._fleet, rendezvous=self._rendezvous,
+            )
+        self.drain_manager.deregister(request)
+        return pb.Empty()
 
     def worker_relaunch_count(self):
         """Relaunches observed across all workers (each reset_worker

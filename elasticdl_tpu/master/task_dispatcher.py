@@ -618,3 +618,19 @@ class TaskDispatcher:
                 },
                 "epochs_left": self._epochs_left,
             }
+
+    def queue_counts(self):
+        """O(1) scalar snapshot for the 1 Hz elasticity tick: stats()
+        resolves a proto enum name per queued/in-flight task under
+        this same lock, which every get_task/report RPC contends on —
+        a per-second cost that grows with job size for four numbers
+        the controller needs."""
+        with self._lock:
+            return {
+                "queue_depth": {
+                    "training": len(self._todo),
+                    "evaluation": len(self._eval_todo),
+                },
+                "doing": len(self._doing),
+                "epochs_left": self._epochs_left,
+            }

@@ -30,6 +30,8 @@ class TaskMonitor:
         mesh_restart_grace_secs=30.0,
         mesh_rejoin_timeout_secs=90.0,
         fleet_monitor=None,
+        drain_manager=None,
+        autoscaler=None,
     ):
         self._dispatcher = task_dispatcher
         self._servicer = servicer
@@ -39,6 +41,12 @@ class TaskMonitor:
         # existing 1 Hz scan — one cheap evaluate() per tick keeps the
         # alert counters/journal current without a second timer thread
         self._fleet = fleet_monitor
+        # elasticity control loop (master/autoscaler.py) rides the same
+        # scan: drain deadlines are enforced here (expiry falls back to
+        # mark_worker_dead = requeue-on-death) and the autoscaler gets
+        # its 1 Hz decision tick
+        self._drain_manager = drain_manager
+        self._autoscaler = autoscaler
         self._liveness_timeout = liveness_timeout_secs
         # An epoch bump makes EVERY mesh member exit and relaunch to
         # re-initialize jax.distributed; their liveness necessarily
@@ -63,6 +71,12 @@ class TaskMonitor:
         self._stopping = threading.Event()
         self._thread = None
 
+    def set_autoscaler(self, autoscaler):
+        """Late binding: the pod manager (the autoscaler's scaler) is
+        attached to the Master after construction, so the controller is
+        created in Master.prepare() and hooked here."""
+        self._autoscaler = autoscaler
+
     def start(self):
         self._thread = threading.Thread(
             target=self._loop, name="task-monitor", daemon=True
@@ -85,6 +99,12 @@ class TaskMonitor:
         dead = set()
         if self._fleet is not None:
             self._fleet.evaluate()
+        if self._drain_manager is not None:
+            # graceful drains whose deadline passed fall back to the
+            # requeue-on-death path below
+            dead.update(self._drain_manager.take_expired(now))
+        if self._autoscaler is not None:
+            self._autoscaler.tick(now)
 
         # Liveness: worker silent for too long while holding tasks OR
         # while a registered mesh member — an idle member that dies must
@@ -166,6 +186,10 @@ class TaskMonitor:
         events.emit(
             "worker_presumed_dead", worker=worker_id, host=host or "",
         )
+        if self._drain_manager is not None:
+            # a draining worker evicted for its own reasons must not be
+            # evicted AGAIN when its drain deadline later expires
+            self._drain_manager.on_worker_dead(worker_id)
         self._dispatcher.recover_tasks(worker_id)
         self._servicer.forget_worker(worker_id)
         if self._fleet is not None:

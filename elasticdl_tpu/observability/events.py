@@ -64,6 +64,21 @@ EVENT_TYPES = frozenset({
                              #   re-pushed its model (+ shard, version)
     "checkpoint_skipped",    # corrupt/incomplete checkpoint version
                              #   skipped during restore (+ version, why)
+    # elasticity control loop (ISSUE 7)
+    "scale_decision",        # autoscaler resize (+direction, delta,
+                             #   workers, queue_depth, reasons)
+    "worker_draining",       # graceful drain begun (+worker, reason,
+                             #   initiator master|worker)
+    "drain_ack",             # drain completed: task reported, push
+                             #   joined, tier flushed (+worker, reason)
+                             #   — journaled by the MASTER on the
+                             #   deregister RPC; exactly one per drain
+    "drain_unacked",         # worker finished flushing but the master
+                             #   never acknowledged the deregister
+                             #   (old master / RPC failure); the
+                             #   worker-side record of the drain
+    "drain_expired",         # drain deadline passed; requeue-on-death
+                             #   fallback fired (+worker)
     # task lifecycle (+ task, worker)
     "task_dispatch",
     "task_report",           # + ok, err
@@ -134,7 +149,12 @@ class EventJournal:
                 # lifecycle events are rare enough that a flush per
                 # line costs nothing next to the RPC that produced it
                 self._file.flush()
-            except OSError as e:
+            except (OSError, RuntimeError) as e:
+                # RuntimeError: reentrant TextIOWrapper call when a
+                # signal handler (SIGTERM drain hook) emits while the
+                # interrupted thread is inside this same write(); the
+                # record is still in the ring, and losing one journal
+                # line beats crashing the drain
                 logger.warning("event journal write failed: %s", e)
 
     def dump(self, reason):

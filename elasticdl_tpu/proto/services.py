@@ -30,6 +30,13 @@ _MASTER_METHODS = {
     # incarnation (logical, monotonic per worker_id — wall clocks on
     # relaunch hosts are not trusted to order incarnations).
     "reset_worker": (pb.GetTaskRequest, pb.ResetWorkerResponse),
+    # graceful-drain ack (ISSUE 7): a scale-down victim / preempted
+    # worker that finished draining (task reported, async push joined,
+    # device-tier rows flushed) deregisters so the master removes it
+    # cleanly — no dead_air alert, no requeue-on-death fallback. Old
+    # masters answer UNIMPLEMENTED; the worker exits anyway and the
+    # liveness path covers the cleanup.
+    "deregister_worker": (pb.DeregisterWorkerRequest, pb.Empty),
 }
 
 _PSERVER_METHODS = {

@@ -8,6 +8,7 @@ master goes away (the reference polls the master pod's K8s status every
 
 import argparse
 import os
+import signal
 import sys
 import time
 
@@ -199,6 +200,14 @@ class ParameterServer:
             self.observability.add_readiness_check(
                 "model_initialized", self.servicer.model_initialized
             )
+        # SIGTERM graceful stop (ISSUE 7): the pod manager stops PS
+        # pods with SIGTERM, which skips atexit. Chain order: this
+        # handler registers LAST, so it runs FIRST — flush the round
+        # buffer + save a final complete checkpoint (servicer
+        # .graceful_stop) — then chains the flight-recorder hook
+        # (installed in main() before us), which dumps the event ring,
+        # flushes the journal, and exits 0.
+        self._install_sigterm_stop()
         logger.info(
             "PS %d/%d serving on :%d",
             self.args.ps_id,
@@ -206,6 +215,34 @@ class ParameterServer:
             self.args.port,
         )
         return self
+
+    def _install_sigterm_stop(self):
+        previous = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            try:
+                # stop taking new pushes; in-flight handlers finish
+                # under the push lock graceful_stop is about to take
+                self.server.stop(grace=1.0)
+            except Exception:
+                logger.exception("server stop at SIGTERM failed")
+            self.servicer.graceful_stop()
+            events.emit("role_stop", reason="sigterm_drain")
+            events.flush()
+            if callable(previous):
+                previous(signum, frame)
+            else:
+                sys.exit(0)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            # not the main thread (embedded/test use): the write-through
+            # journal still covers SIGKILL; only the final-checkpoint
+            # convenience is lost
+            logger.warning(
+                "not on main thread; PS SIGTERM flush not installed"
+            )
 
     def run(self, poll_secs=5.0):
         """Serve until the master stops answering (reference: PS pods poll
@@ -254,7 +291,8 @@ def main(argv=None):
         os.environ[http_server.PORT_ENV] = str(args.metrics_port)
     # the pod manager stops PS pods with SIGTERM, which skips atexit —
     # the crash hooks dump the event ring and flush the journal AND the
-    # trace buffer (PR 2 flushed only the trace here), then exit 0
+    # trace buffer, then exit 0. prepare() layers the graceful stop on
+    # top (round-buffer flush + final checkpoint, then chains here).
     events.install_crash_hooks()
     return ParameterServer(args).prepare().run()
 

@@ -358,6 +358,17 @@ class PserverServicer:
         self._m_version_lag.set(
             self._store.version - request.gradients.version
         )
+        if getattr(self, "_stopped", False):
+            # SIGTERM drain already flushed the round buffer and is
+            # saving the final checkpoint: an update admitted now
+            # would be ACKed yet missing from the state the successor
+            # restores. Reject so the worker retries/resyncs against
+            # the relaunch instead. (The sync path re-checks under
+            # _push_lock, where _stopped is set — this early check is
+            # what the lock-free async path gets.)
+            return self._stamp(pb.PushGradientsResponse(
+                accepted=False, version=self._store.version
+            ))
         if not self._use_async:
             return self._push_gradients_sync(request)
         grad_version = request.gradients.version
@@ -390,6 +401,16 @@ class PserverServicer:
         here would also perturb sync-round pairing, and the tier is an
         async-PS feature). Existing rows keep their optimizer slot
         state; rows unseen by this shard materialize fresh."""
+        if getattr(self, "_stopped", False):
+            # SIGTERM drain: the final checkpoint is (being) written —
+            # importing rows now would ACK a flush the successor never
+            # restores (and mutate the store mid-save). The client
+            # raises on the rejection, so a draining worker's ack
+            # honestly reports tier_flushed=False instead of claiming
+            # parity that does not hold.
+            return self._stamp(pb.PushGradientsResponse(
+                accepted=False, version=self._store.version
+            ))
         self._m_rows_written.inc(
             sum(
                 len(slices.ids) or len(slices.ids_blob) // 8
@@ -449,6 +470,15 @@ class PserverServicer:
         grad_version = request.gradients.version
         with self._push_lock:
             version = self._store.version
+            if getattr(self, "_stopped", False):
+                # lost the lock race against graceful_stop: the round
+                # buffer this push would join was already flushed into
+                # the final checkpoint — buffering now silently drops
+                # an ACKed update
+                self._m_push_rejected.inc()
+                return self._stamp(pb.PushGradientsResponse(
+                    accepted=False, version=version
+                ))
             if grad_version < version - self._sync_tolerance:
                 self._m_push_rejected.inc()
                 journal.append((
@@ -665,6 +695,53 @@ class PserverServicer:
             self._store.push_gradients(
                 name, ids, values, lr_scale=apply_scale
             )
+
+    def graceful_stop(self):
+        """SIGTERM drain (ISSUE 7, ps/server.py): the pod manager stops
+        PS pods with SIGTERM, which skips atexit — before this, a
+        buffered partial sync round and everything since the last
+        periodic checkpoint died with the pod. Apply whatever the round
+        buffer holds (an under-filled round applied beats losing its
+        pushes outright — the relaunch re-anchors at the checkpoint
+        version and workers resync, exactly the ISSUE-4 machinery),
+        then save a final COMPLETE checkpoint so the successor restores
+        the freshest possible state. Idempotent; every step guarded —
+        a failed flush must not stop the exit."""
+        journal = []
+        with self._push_lock:
+            if getattr(self, "_stopped", False):
+                return
+            self._stopped = True
+            entries = list(self._buffered_entries())
+            if entries:
+                logger.warning(
+                    "SIGTERM with %d buffered push(es); applying the "
+                    "partial round before exit", len(entries),
+                )
+                try:
+                    self._apply_round_locked(entries, journal)
+                    self._round_buffer = []
+                    self._round_groups = {}
+                    self._store.bump_version()
+                except Exception:
+                    logger.exception(
+                        "partial-round flush failed at SIGTERM"
+                    )
+            version = self._store.version
+        for event, fields in journal:
+            events.emit(event, **fields)
+        if self._checkpoint_saver is not None:
+            try:
+                self._checkpoint_saver.save(version, self._store)
+                events.emit("checkpoint_saved", version=version,
+                            kind="sparse_final")
+                logger.info(
+                    "final sparse checkpoint saved at version %d",
+                    version,
+                )
+            except Exception:
+                logger.exception("final sparse checkpoint failed")
+        events.flush()
 
     def _maybe_checkpoint(self, version):
         if (

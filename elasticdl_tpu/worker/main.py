@@ -47,9 +47,17 @@ def main(argv=None):
 
     # before any master/PS channel is built: fault specs match on role
     faults.set_role("worker-%d" % args.worker_id)
-    # black box discipline (ISSUE 3): a K8s eviction (SIGTERM) or an
-    # uncaught exception dumps the event ring and flushes the journal +
-    # trace buffer, so the killed pod's last moments survive it
+    # Eviction discipline (ISSUE 3 + 7), in chain order: the drain hook
+    # installs FIRST so install_crash_hooks captures it as the previous
+    # handler — a SIGTERM then dumps the event ring / flushes the
+    # journal (black box) and CHAINS into the graceful drain, which
+    # finishes the current task, joins the in-flight async push,
+    # flushes device-tier rows, and deregisters before exit (bounded by
+    # EDL_DRAIN_DEADLINE_SECS). Before the worker exists, the chain
+    # falls through to the old exit-0 eviction contract.
+    from elasticdl_tpu.worker.drain import install_sigterm_drain
+
+    drain_hook = install_sigterm_drain()
     events.install_crash_hooks()
     master_client = MasterClient(
         args.master_addr,
@@ -155,6 +163,8 @@ def main(argv=None):
         # explicit operator resume request is strict
         resume_optional=not args.checkpoint_dir_for_init,
     )
+    # SIGTERM now triggers the graceful drain instead of a bare exit
+    drain_hook.bind(worker)
     from elasticdl_tpu.common.log_utils import default_logger
     from elasticdl_tpu.worker.worker import (
         EPOCH_RESTART_EXIT_CODE,

@@ -232,6 +232,41 @@ class MasterClient:
             self._seen_master_epoch = response.master_epoch
         return self._incarnation
 
+    def deregister_worker(self, reason="", pushes_joined=False,
+                          tier_flushed=False, tasks_reported=0):
+        """Graceful-drain ack (ISSUE 7): tell the master this worker is
+        leaving ON PURPOSE after flushing — no dead-air alert, no
+        requeue-on-death. Returns True when the master acknowledged;
+        False when the RPC failed (old master without the method
+        answers UNIMPLEMENTED, or the master is gone) — the caller
+        exits anyway and the master's liveness/drain-deadline fallback
+        covers the cleanup."""
+        request = self._attach_telemetry(
+            pb.DeregisterWorkerRequest(
+                worker_id=self._worker_id,
+                reason=reason,
+                pushes_joined=pushes_joined,
+                tier_flushed=tier_flushed,
+                tasks_reported=tasks_reported,
+            )
+        )
+        try:
+            self._stub.deregister_worker(
+                request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+            )
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code == grpc.StatusCode.UNIMPLEMENTED:
+                logger.warning(
+                    "master predates deregister_worker; exiting without "
+                    "a drain ack (liveness fallback will requeue)"
+                )
+            else:
+                logger.warning("deregister_worker failed: %s", code)
+            return False
+        self._registered = False
+        return True
+
     def get_comm_info(self):
         request = self._attach_telemetry(
             pb.GetCommInfoRequest(

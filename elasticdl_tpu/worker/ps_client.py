@@ -513,6 +513,16 @@ class PSClient:
             # a relaunch. The boot-restore stamp has no ordering, and
             # still catches a real relaunch a beat earlier.
             self._note_restored(shard, response.restored_version)
+            if not response.accepted:
+                # the PS is in its SIGTERM drain: the rows were NOT
+                # imported and the final checkpoint will not contain
+                # them. Raise so drain_writebacks surfaces the loss —
+                # a flush that proceeds past this would report
+                # tier↔PS parity that does not hold.
+                raise RuntimeError(
+                    "ps-%d rejected an embedding-row writeback "
+                    "(draining); rows not applied" % shard
+                )
 
     def push_gradients(self, grads_by_table, model_version=0, lr_scale=0.0,
                        only_shards=None, force_empty=False,

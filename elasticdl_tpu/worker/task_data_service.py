@@ -38,6 +38,13 @@ class TaskDataService:
         self._stream_gen = 0
         self.train_end_task = None
         self.job_over = False
+        # graceful drain (ISSUE 7): once set — by the worker's SIGTERM
+        # hook or by a master WAIT(draining=true) response — the
+        # training stream stops fetching NEW tasks and ends after the
+        # current task's records are consumed, so the last task
+        # completes (reported done, never requeued) before the worker
+        # flushes and deregisters.
+        self.draining = False
         # non-training tasks encountered while streaming training records;
         # the worker drains these between minibatch loops
         self.out_of_band_tasks = collections.deque()
@@ -67,12 +74,25 @@ class TaskDataService:
             with self._lock:
                 if self._stream_gen != my_gen:
                     return  # stream was failed/superseded
+            if self.draining:
+                # drain boundary: the current task's records are fully
+                # yielded (the check sits between tasks); flush the
+                # batcher's tail so report_record_done covers the range
+                # and the task is reported DONE, not handed back
+                if dirty:
+                    yield FLUSH
+                return
             task = self._mc.get_task()
+            if getattr(task, "draining", False):
+                # master-side drain gate: no more work for this worker
+                self.draining = True
             if task.task_id == 0:
                 if task.type == pb.WAIT:
                     if dirty:
                         dirty = False
                         yield FLUSH
+                    if self.draining:
+                        return
                     time.sleep(self._wait_sleep_secs)
                     continue
                 self.job_over = True
@@ -143,6 +163,7 @@ class TaskDataService:
             self._pending_tasks.clear()
         for task in pending:
             self._mc.report_task_result(task.task_id, err_message)
+        return len(pending)
 
     def report_parked_failed(self, err_message):
         """Hand back tasks parked for later processing (out-of-band
@@ -159,6 +180,7 @@ class TaskDataService:
                 self.train_end_task = None
         for task in parked:
             self._mc.report_task_result(task.task_id, err_message)
+        return len(parked)
 
     def has_pending(self):
         with self._lock:

@@ -243,6 +243,12 @@ class Worker:
         self._sparse_push_interval = max(1, sparse_push_interval)
         self.state = None
         self.stop_training = False
+        # graceful drain (ISSUE 7): set by begin_drain (SIGTERM hook /
+        # scale-down victim); the run loop finishes the current task,
+        # joins pushes, flushes the device tier, and deregisters
+        self._draining = False
+        self._drain_reason = ""
+        self._drain_done = False
         self._version = 0
         # Dense full-state checkpoints (params + model_state + optimizer
         # slots + step; the reference drops slot state,
@@ -471,6 +477,125 @@ class Worker:
             Dataset(lambda: record_stream), mode, self._reader.metadata
         )
         return dataset.batch(self._minibatch_size).prefetch(2)
+
+    # ------------------------------------------------------------------
+    # graceful drain (ISSUE 7)
+
+    def begin_drain(self, reason="sigterm"):
+        """Request a graceful drain: finish the current task, then
+        flush and deregister instead of fetching more work. Called from
+        the SIGTERM hook (worker/drain.py) on the main thread — it only
+        flips flags and arms the deadline watchdog, so it is safe at
+        any interrupt point; the run loop does the actual flushing at
+        its next task boundary. Idempotent."""
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_reason = reason
+        # The sequential/pipelined loops drain via the record stream:
+        # tds.draining ends it AFTER the current task's records, so the
+        # last task completes (reported done, never requeued). They must
+        # NOT see stop_training — that breaks mid-task. Lockstep is the
+        # exception: a member can't leave a collective mid-round, so the
+        # stop converts to the stream-end vote (tasks handed back
+        # uncounted) and the drain deadline bounds the wait for peers.
+        if self._lockstep:
+            self.stop_training = True
+        self.tds.draining = True
+        logger.warning(
+            "Worker %s draining (%s): finishing current task, then "
+            "flush + deregister", self._mc.worker_id, reason,
+        )
+        events.emit(
+            "worker_draining", worker=self._mc.worker_id, reason=reason,
+            initiator="worker",
+        )
+        try:
+            deadline = float(
+                os.environ.get("EDL_DRAIN_DEADLINE_SECS", "") or 45.0
+            )
+        except ValueError:
+            deadline = 45.0
+        # the watchdog bounds a wedged drain (a stuck collective, a PS
+        # that stopped answering): past the deadline the process dies
+        # NOW and the master's requeue-on-death fallback takes over —
+        # better a requeued task than a pod K8s hard-kills mid-flush
+        # with the journal unflushed
+        watchdog = threading.Timer(
+            deadline, self._drain_deadline_abort, args=(deadline,)
+        )
+        watchdog.daemon = True
+        watchdog.start()
+
+    def _drain_deadline_abort(self, deadline):
+        if self._drain_done:
+            return
+        logger.error(
+            "drain did not finish within %.0fs; aborting", deadline
+        )
+        events.dump("drain_deadline")
+        events.flush()
+        trace.flush()
+        os._exit(1)
+
+    def _finish_drain(self):
+        """The drain tail, at a task boundary: join the in-flight async
+        push, flush dirty device-tier rows to the PS, hand back any
+        tasks that could NOT be finished (uncounted requeue — none on
+        the clean path), then send the drain ack. Every step is
+        individually guarded: a dead PS must not stop the deregister,
+        and a dead master must not stop the exit (old masters without
+        the RPC just miss the ack; their liveness fallback requeues)."""
+        self._draining = True
+        self.tds.draining = True
+        reason = self._drain_reason or "master_drain"
+        joined = flushed = True
+        try:
+            self._join_trainer_pushes()
+        except Exception:
+            joined = False
+            logger.exception("drain: joining in-flight pushes failed")
+        try:
+            self._flush_device_tier()
+        except Exception:
+            flushed = False
+            logger.exception("drain: device-tier flush failed")
+        handed_back = 0
+        try:
+            # count BOTH streams of hand-backs — pending record-stream
+            # tasks and parked out-of-band/train-end tasks — so the ack
+            # can't call a drain clean while parked work requeued
+            handed_back += self.tds.report_pending_failed(
+                "requeue: draining"
+            )
+            handed_back += self.tds.report_parked_failed(
+                "requeue: draining"
+            )
+        except Exception:
+            logger.exception("drain: task hand-back failed")
+        acked = self._mc.deregister_worker(
+            reason,
+            pushes_joined=joined,
+            tier_flushed=flushed,
+            tasks_reported=handed_back,
+        )
+        if not acked:
+            # the canonical drain_ack is journaled by the master on
+            # the deregister RPC — never from here, so a response that
+            # timed out AFTER the master processed it can't double the
+            # ack. This side's record of an unheard flush gets its own
+            # event name.
+            events.emit(
+                "drain_unacked", worker=self._mc.worker_id,
+                reason=reason, pushes_joined=joined,
+                tier_flushed=flushed, handed_back=handed_back,
+            )
+        events.flush()
+        self._drain_done = True
+        logger.info(
+            "Worker %s drained at version %d (%s; acked=%s)",
+            self._mc.worker_id, self._version, reason, acked,
+        )
 
     # ------------------------------------------------------------------
     def _join_trainer_pushes(self):
@@ -1052,11 +1177,22 @@ class Worker:
 
     def _drain_fast(self):
         """After MaxStepsStopping: consume remaining tasks without
-        training so the job can finish."""
+        training so the job can finish. Honors a drain request the
+        same way the task-mode loop does: once this worker is picked
+        as a victim, the master's get_task gate answers WAIT(draining)
+        forever, so looping on it would wedge until the watchdog —
+        route to _finish_drain instead (no task is held between
+        iterations, so any point here is a task boundary)."""
         import time
 
         while True:
+            if self._draining:
+                self._finish_drain()
+                return
             task = self._mc.get_task()
+            if getattr(task, "draining", False):
+                self._finish_drain()
+                return
             if task.task_id == 0:
                 if task.type == pb.WAIT:
                     time.sleep(0.2)
@@ -1094,6 +1230,12 @@ class Worker:
             return
         while True:
             self._run_training_stream()
+            if self._draining or self.tds.draining:
+                # graceful drain: the stream ended at a task boundary
+                # (current task reported done); flush + deregister,
+                # never fetch more work
+                self._finish_drain()
+                return
             self._drain_out_of_band()
             if self.tds.train_end_task is not None:
                 task = self.tds.train_end_task
@@ -1116,7 +1258,13 @@ class Worker:
 
         while True:
             self._check_mesh_epoch()
+            if self._draining:
+                self._finish_drain()
+                return
             task = self._mc.get_task(task_type)
+            if getattr(task, "draining", False):
+                self._finish_drain()
+                return
             if task.task_id == 0:
                 if task.type == pb.WAIT:
                     time.sleep(0.2)

@@ -18,9 +18,19 @@ workers as real OS processes occupying slots.
            (their in-flight tasks are recovered by the liveness
            monitor) and starts job 2 on the freed slots; whichever job
            finishes first hands its slots back to the other.
+- autoscale (--mode autoscale, ISSUE 7): no hardcoded kills — each
+           job runs the real ElasticController + DrainManager
+           (master/autoscaler.py). Job arrival/completion only moves
+           the jobs' max_workers budgets; the controllers decide when
+           to grow (sustained backlog per worker), when to shrink
+           (over budget / idle tail), and WHO to shrink (slowest
+           step-time EWMA), and scale-down victims drain gracefully:
+           SIGTERM -> finish current task -> join async push ->
+           deregister. Every resize lands in the event journal as a
+           scale_decision with the signals that fired.
 
-Prints one JSON line: makespans, job-2 wait, and the elastic speedup.
-CPU backend; runs in ~4-8 min.
+Prints one JSON line: makespans, job-2 wait, and the speedup of the
+chosen elastic mode over gang. CPU backend; runs in ~4-8 min.
 """
 
 import json
@@ -29,6 +39,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -37,12 +48,70 @@ sys.path.insert(0, REPO)
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 
+class SlotPool:
+    """The fixed worker-slot budget both jobs share in autoscale mode.
+    A slot stays occupied until its worker PROCESS exits — a draining
+    victim holds its slot through the flush, so the arriving job's
+    growth is honestly gated on the drain completing."""
+
+    def __init__(self, slots):
+        self.slots = slots
+        self.jobs = []
+        # both jobs' controller threads reserve slots concurrently; an
+        # unlocked check-then-spawn would let them oversubscribe the
+        # budget (and score the autoscale run on more capacity than
+        # the gang baseline it must beat)
+        self.lock = threading.Lock()
+
+    def register(self, job):
+        self.jobs.append(job)
+
+    def available(self):
+        return self.slots - sum(j.live_workers() for j in self.jobs)
+
+
+class _ProcScaler:
+    """ElasticController's scaler protocol over a Job's worker
+    subprocesses: scale_up spawns (bounded by the shared SlotPool),
+    remove_worker delivers SIGTERM — the worker's graceful-drain hook
+    (worker/drain.py) takes it from there."""
+
+    def __init__(self, job):
+        self._job = job
+
+    def worker_ids(self):
+        return [
+            idx for idx, proc in self._job.workers.items()
+            if proc.poll() is None
+        ]
+
+    def scale_up(self, count):
+        if self._job.pool is not None:
+            # atomic check-then-spawn: spawn_worker registers the proc
+            # in job.workers, so the next holder sees the slots taken
+            with self._job.pool.lock:
+                count = min(count, max(0, self._job.pool.available()))
+                return [
+                    self._job.spawn_worker() for _ in range(count)
+                ]
+        return [self._job.spawn_worker() for _ in range(count)]
+
+    def remove_worker(self, worker_id):
+        proc = self._job.workers.get(worker_id)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            return True
+        return False
+
+
 class Job:
     """One training job: in-process master + PS subprocesses + a set of
-    worker subprocesses this script grows/shrinks."""
+    worker subprocesses this script (or, in autoscale mode, the job's
+    own ElasticController) grows/shrinks."""
 
     def __init__(self, name, train_dir, tmp, records_per_task=256,
-                 num_epochs=2):
+                 num_epochs=2, autoscale=False, pool=None,
+                 max_workers=4, scale_step=2):
         from elasticdl_tpu.common.grpc_utils import (
             build_server, find_free_port,
         )
@@ -58,6 +127,7 @@ class Job:
         self.name = name
         self.tmp = tmp
         self.train_dir = train_dir
+        self.pool = pool
         reader = RecordIODataReader(data_dir=train_dir)
         self.dispatcher = TaskDispatcher(
             training_shards=reader.create_shards(),
@@ -65,10 +135,48 @@ class Job:
             num_epochs=num_epochs,
             seed=0,
         )
-        self.servicer = MasterServicer(self.dispatcher, None)
+        # autoscale mode (ISSUE 7): this job's resizes are decided by
+        # the real control loop — fleet telemetry in, scale_decision
+        # events out, scale-down via graceful drain
+        self.controller = None
+        self.drain = None
+        fleet = None
+        if autoscale:
+            from elasticdl_tpu.master.autoscaler import (
+                DrainManager, ElasticController,
+            )
+            from elasticdl_tpu.master.fleet import FleetMonitor
+
+            fleet = FleetMonitor()
+        self.servicer = MasterServicer(
+            self.dispatcher, None, fleet_monitor=fleet
+        )
+        if autoscale:
+            self.drain = DrainManager(
+                self.dispatcher, servicer=self.servicer, fleet=fleet,
+                deadline_secs=30.0,
+            )
+            self.servicer.drain_manager = self.drain
+            self.controller = ElasticController(
+                self.dispatcher,
+                _ProcScaler(self),
+                self.drain,
+                fleet=fleet,
+                min_workers=1,
+                max_workers=max_workers,
+                step=scale_step,
+                cooldown_secs=3.0,
+                hold_secs=1.0,
+                backlog_per_worker=2.0,
+                # local subprocess workers skip the pod-boot +
+                # jit-compile wait the production default budgets for
+                gain_settle_secs=15.0,
+                tag=name,
+            )
         self.monitor = TaskMonitor(
             self.dispatcher, self.servicer,
             liveness_timeout_secs=8.0, scan_interval_secs=0.5,
+            drain_manager=self.drain, autoscaler=self.controller,
         )
         self.server = build_server()
         add_master_servicer_to_server(self.servicer, self.server)
@@ -87,6 +195,8 @@ class Job:
         self.next_idx = 0
         self.started = time.time()
         self.finished_at = None
+        if pool is not None:
+            pool.register(self)
 
     def spawn_worker(self):
         from scripts.convergence_elastic import _spawn_worker
@@ -97,6 +207,7 @@ class Job:
             idx, self.master_port, self.ps_addrs, self.train_dir,
             os.path.join(self.tmp, "%s_w%d.log" % (self.name, idx)),
         )
+        return idx
 
     def kill_worker(self):
         live = sorted(
@@ -219,6 +330,78 @@ def run_elastic(train1, train2, tmp, slots, **job_kw):
             job2.shutdown()
 
 
+def run_autoscale(train1, train2, tmp, slots, **job_kw):
+    """ISSUE 7: the autoscaler, not this script, makes every resize.
+    This harness only moves the jobs' max_workers BUDGETS (job 2
+    arriving halves job 1's; a completion hands the ceiling back) —
+    the controllers do the rest: grow on sustained backlog, shrink the
+    over-budget job by draining its slowest workers gracefully, shrink
+    the idle tail at each job's end."""
+    t0 = time.time()
+    pool = SlotPool(slots)
+    half = slots // 2
+    job1 = Job(
+        "as1", train1, tmp, autoscale=True, pool=pool,
+        max_workers=slots, scale_step=max(1, half), **job_kw
+    )
+    job2 = None
+    job2_arrives = t0 + 10.0
+    job2_start = None
+    handed1 = handed2 = False
+    try:
+        while True:
+            now = time.time()
+            if job2 is None and now >= job2_arrives:
+                # budget move: job 1 is now over budget and its
+                # controller drains victims; job 2's controller grows
+                # into the slots the drains free up
+                job1.controller.set_limits(max_workers=slots - half)
+                job2 = Job(
+                    "as2", train2, tmp, autoscale=True, pool=pool,
+                    max_workers=half, scale_step=max(1, half),
+                    **job_kw
+                )
+                job2_start = time.time()
+            done1 = job1.finished()
+            done2 = job2.finished() if job2 is not None else False
+            if done1 and job2 is not None and not done2 and not handed2:
+                job2.controller.set_limits(max_workers=slots)
+                handed2 = True
+            if done2 and not done1 and not handed1:
+                job1.controller.set_limits(max_workers=slots)
+                handed1 = True
+            if done1 and done2:
+                break
+            time.sleep(0.5)
+        end = time.time()
+        return {
+            "makespan_s": round(end - t0, 1),
+            "job1_s": round(job1.finished_at - t0, 1),
+            "job2_wait_s": round(job2_start - job2_arrives, 1),
+        }
+    finally:
+        job1.shutdown()
+        if job2 is not None:
+            job2.shutdown()
+
+
+def _load_scale_decisions(events_dir):
+    from tests.test_utils import load_journal
+
+    decisions = []
+    drain_acks = 0
+    for event in load_journal(events_dir):
+        if event.get("event") == "scale_decision":
+            decisions.append({
+                k: event.get(k)
+                for k in ("tag", "direction", "delta",
+                          "workers", "queue_depth", "reasons")
+            })
+        elif event.get("event") == "drain_ack":
+            drain_acks += 1
+    return decisions, drain_acks
+
+
 def main():
     import argparse
 
@@ -227,6 +410,13 @@ def main():
     parser.add_argument("--records", type=int, default=4096)
     parser.add_argument("--records_per_task", type=int, default=256)
     parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument(
+        "--mode", choices=("both", "elastic", "autoscale", "all"),
+        default="both",
+        help="both = gang + hardcoded elastic (the §B reproduction); "
+        "autoscale = gang + the ISSUE-7 control loop making every "
+        "resize; all = the three-way comparison",
+    )
     args = parser.parse_args()
 
     from tests.test_utils import create_ctr_recordio
@@ -245,19 +435,69 @@ def main():
         records_per_task=args.records_per_task,
         num_epochs=args.num_epochs,
     )
-    gang = run_gang(dirs[0], dirs[1], tmp, args.slots, **job_kw)
-    print("[gang]    %s" % gang, flush=True)
-    elastic = run_elastic(dirs[0], dirs[1], tmp, args.slots, **job_kw)
-    print("[elastic] %s" % elastic, flush=True)
+    want_elastic = args.mode in ("both", "elastic", "all")
+    want_autoscale = args.mode in ("autoscale", "all")
+    events_dir = None
+    if want_autoscale:
+        # the acceptance contract: every resize must be explained by a
+        # scale_decision in the journal (workers journal their drain
+        # acks into the same dir)
+        events_dir = os.path.join(tmp, "events")
+        os.makedirs(events_dir, exist_ok=True)
+        # unconditional: an inherited EDL_EVENTS_DIR (e.g. ci.sh's
+        # earlier tiers export one) would point the acceptance gate at
+        # a shared journal full of other runs' scale events
+        os.environ["EDL_EVENTS_DIR"] = events_dir
+        from elasticdl_tpu.observability import events
 
-    print(json.dumps({
-        "slots": args.slots,
-        "gang": gang,
-        "elastic": elastic,
-        "makespan_speedup": round(
+        events.configure("bench-master")
+
+    gang = run_gang(dirs[0], dirs[1], tmp, args.slots, **job_kw)
+    print("[gang]      %s" % gang, flush=True)
+    summary = {"slots": args.slots, "mode": args.mode, "gang": gang}
+    if want_elastic:
+        elastic = run_elastic(
+            dirs[0], dirs[1], tmp, args.slots, **job_kw
+        )
+        print("[elastic]   %s" % elastic, flush=True)
+        summary["elastic"] = elastic
+        summary["makespan_speedup"] = round(
             gang["makespan_s"] / elastic["makespan_s"], 2
-        ),
-    }))
+        )
+    if want_autoscale:
+        autoscale = run_autoscale(
+            dirs[0], dirs[1], tmp, args.slots, **job_kw
+        )
+        print("[autoscale] %s" % autoscale, flush=True)
+        decisions, drain_acks = _load_scale_decisions(events_dir)
+        for decision in decisions:
+            print("[scale_decision] %s" % json.dumps(decision),
+                  flush=True)
+        summary["autoscale"] = autoscale
+        summary["autoscale_speedup"] = round(
+            gang["makespan_s"] / autoscale["makespan_s"], 2
+        )
+        summary["scale_decisions"] = decisions
+        summary["drain_acks"] = drain_acks
+        summary["beats_gang"] = (
+            autoscale["makespan_s"] < gang["makespan_s"]
+        )
+
+    print(json.dumps(summary))
+    if want_autoscale:
+        # the autoscaled run must beat the static gang baseline AND be
+        # able to explain every resize — a silent scaler is a bug even
+        # when it happens to win
+        if not summary["beats_gang"]:
+            raise SystemExit(
+                "FAIL: autoscale makespan %.1fs did not beat gang "
+                "%.1fs"
+                % (autoscale["makespan_s"], gang["makespan_s"])
+            )
+        if not decisions:
+            raise SystemExit(
+                "FAIL: no scale_decision events journaled"
+            )
 
 
 if __name__ == "__main__":

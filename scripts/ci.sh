@@ -257,6 +257,95 @@ grep -q "master_restarted" /tmp/_chaos_pm.out
 grep -q "task_dispatch" /tmp/_chaos_pm.out
 grep -q "worker_register" /tmp/_chaos_pm.out
 
+echo "== tier 1e+: scale-down under SIGTERM (graceful drain) =="
+# ISSUE 7: a live master + worker; the worker is SIGTERMed mid-job
+# (what a scale-down pod delete / spot preemption delivers). Its
+# SIGTERM chain (flight-recorder dump -> worker/drain.py) must finish
+# the current task, deregister (the drain ack), and exit 0 — and the
+# master must remove it with NO task_requeue for the drained worker's
+# last task; a replacement worker then finishes the job.
+DRAIN_DIR="$(mktemp -d)"
+export DRAIN_DIR
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, signal, socket, subprocess, sys, tempfile, time
+sys.path.insert(0, "tests")
+from test_utils import create_mnist_recordio, load_journal
+from elasticdl_tpu.common.grpc_utils import find_free_port
+
+events_dir = os.path.join(os.environ["DRAIN_DIR"], "events")
+os.makedirs(events_dir)
+train = tempfile.mkdtemp()
+create_mnist_recordio(train + "/f0.rec", num_records=768, seed=0)
+mport = find_free_port()
+base_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+            "EDL_EVENTS_DIR": events_dir,
+            "EDL_DRAIN_DEADLINE_SECS": "120"}
+master = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.master.main",
+    "--model_zoo", "elasticdl_tpu.models.mnist",
+    "--training_data", train, "--records_per_task", "64",
+    "--num_epochs", "1", "--port", str(mport),
+    "--task_timeout_secs", "120",
+], env=base_env)
+
+def wait_port(port, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port)); return
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    raise TimeoutError(port)
+
+def spawn_worker(idx):
+    return subprocess.Popen([
+        sys.executable, "-m", "elasticdl_tpu.worker.main",
+        "--master_addr", "localhost:%d" % mport,
+        "--worker_id", str(idx),
+        "--model_zoo", "elasticdl_tpu.models.mnist",
+        "--training_data", train, "--minibatch_size", "32",
+    ], env=base_env)
+
+wait_port(mport)
+victim = spawn_worker(0)
+# SIGTERM once the victim holds a task mid-job (dispatch journaled,
+# job not yet near its end)
+deadline = time.time() + 120
+while time.time() < deadline:
+    reports = [e for e in load_journal(events_dir)
+               if e["event"] == "task_report"]
+    if len(reports) >= 2:
+        break
+    time.sleep(0.5)
+assert len(reports) >= 2, "victim made no progress"
+victim.send_signal(signal.SIGTERM)
+rc = victim.wait(timeout=120)
+assert rc == 0, "drained worker exited rc=%s (graceful exit expected)" % rc
+merged = load_journal(events_dir)
+acks = [e for e in merged if e["event"] == "drain_ack"]
+assert acks, "no drain_ack journaled"
+assert any(a.get("worker") == 0 for a in acks), acks
+# done-exactly-once: the drained worker's last task completed inside
+# the drain, so NOTHING the victim held was requeued
+requeues = [e for e in merged if e["event"] == "task_requeue"]
+assert requeues == [], requeues
+# a replacement finishes the job; the master exits 0
+finisher = spawn_worker(1)
+rc = master.wait(timeout=300)
+assert rc == 0, "master did not finish the job (rc=%s)" % rc
+# generous: on a loaded 1-core box the finisher's post-job exit (retry
+# budget against the gone master) can straggle past 120s
+finisher.wait(timeout=240)
+print("drain smoke OK: SIGTERM -> drain_ack, zero requeues")
+PYEOF
+# the drain threads through the postmortem timeline too
+python scripts/postmortem.py "$DRAIN_DIR/events" 2>/dev/null | tee /tmp/_drain_pm.out | head -5 || true
+grep -q "worker_draining" /tmp/_drain_pm.out
+grep -q "drain_ack" /tmp/_drain_pm.out
+
 echo "== tier 1f: wire-path perf smoke (micro + EDL_WIRE_DTYPE opt-in) =="
 # Microbenchmark of the ISSUE-5 wire fast paths vs the legacy paths
 # they replaced: packed ids_blob vs repeated-varint serialization,

@@ -1,0 +1,654 @@
+"""Elasticity control loop (ISSUE 7): ElasticController decision
+semantics (hysteresis, cooldown, bounds, marginal-gain guard, victim
+selection), DrainManager begin/ack/expiry, FleetMonitor drain hygiene
+(an on-purpose removal must never alert), and the worker's graceful
+drain end-to-end over real gRPC."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.master.autoscaler import DrainManager, ElasticController
+from elasticdl_tpu.master.fleet import FleetMonitor
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+class FakeDispatcher:
+    def __init__(self, queue=0, epochs_left=0, doing=0, eval_queue=0):
+        self.queue = queue
+        self.epochs_left = epochs_left
+        self.doing = doing
+        self.eval_queue = eval_queue
+        self.recovered = []
+
+    def stats(self):
+        return {
+            "pending": {"training": self.queue},
+            "doing": {"training": self.doing},
+            "done": {},
+            "queue_depth": {
+                "training": self.queue,
+                "evaluation": self.eval_queue,
+            },
+            "epochs_left": self.epochs_left,
+        }
+
+    def queue_counts(self):
+        return {
+            "queue_depth": {
+                "training": self.queue,
+                "evaluation": self.eval_queue,
+            },
+            "doing": self.doing,
+            "epochs_left": self.epochs_left,
+        }
+
+    def recover_tasks(self, worker_id):
+        self.recovered.append(worker_id)
+
+
+class FakeScaler:
+    def __init__(self, ids=()):
+        self.ids = list(ids)
+        self.grown = []
+        self.removed = []
+        self._next = max(self.ids, default=-1) + 1
+
+    def worker_ids(self):
+        return list(self.ids)
+
+    def scale_up(self, count):
+        started = []
+        for _ in range(count):
+            self.ids.append(self._next)
+            started.append(self._next)
+            self._next += 1
+        self.grown.append(started)
+        return started
+
+    def remove_worker(self, worker_id):
+        self.ids.remove(worker_id)
+        self.removed.append(worker_id)
+        return True
+
+
+class FakeFleet:
+    def __init__(self, ewmas=None, throughput=0.0):
+        self.ewmas = dict(ewmas or {})
+        self.throughput = throughput
+        self.draining = []
+        self.drained = []
+
+    def worker_step_ewmas(self):
+        return dict(self.ewmas)
+
+    def fleet_examples_per_sec(self):
+        return self.throughput
+
+    def mark_draining(self, worker_id):
+        self.draining.append(worker_id)
+
+    def mark_drained(self, worker_id, reason=""):
+        self.drained.append((worker_id, reason))
+
+
+def controller(dispatcher, scaler, drain=None, fleet=None, **kw):
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 8)
+    kw.setdefault("step", 2)
+    kw.setdefault("cooldown_secs", 10.0)
+    kw.setdefault("hold_secs", 3.0)
+    kw.setdefault("backlog_per_worker", 2.0)
+    if drain is None:
+        drain = DrainManager(dispatcher, fleet=fleet, deadline_secs=60)
+    return ElasticController(dispatcher, scaler, drain, fleet=fleet, **kw)
+
+
+# ---------------------------------------------------------------------------
+# ElasticController decisions
+
+
+def test_grow_needs_sustained_backlog_and_respects_cooldown():
+    dispatcher = FakeDispatcher(queue=40, epochs_left=0, doing=2)
+    scaler = FakeScaler(ids=[0, 1])
+    ctl = controller(dispatcher, scaler)
+    t0 = 1000.0
+    ctl.tick(t0)  # starts the hold window, no action yet
+    assert scaler.grown == []
+    ctl.tick(t0 + 3.0)  # held >= hold_secs -> grow by step
+    assert scaler.grown == [[2, 3]]
+    # cooldown: the backlog is still deep, but no second grow yet
+    ctl.tick(t0 + 6.5)
+    ctl.tick(t0 + 9.5)
+    assert scaler.grown == [[2, 3]]
+    # cooldown over + the hold window (re-armed at the last grow)
+    ctl.tick(t0 + 14.0)
+    assert len(scaler.grown) == 2
+
+
+def test_backlog_blip_does_not_buy_pods():
+    dispatcher = FakeDispatcher(queue=40)
+    scaler = FakeScaler(ids=[0, 1])
+    ctl = controller(dispatcher, scaler)
+    t0 = 1000.0
+    ctl.tick(t0)
+    dispatcher.queue = 0  # the blip clears before the hold elapses
+    ctl.tick(t0 + 2.0)
+    dispatcher.queue = 40
+    ctl.tick(t0 + 3.5)  # hold restarted: still not held long enough
+    assert scaler.grown == []
+
+
+def test_grow_caps_at_max_workers():
+    dispatcher = FakeDispatcher(queue=1000)
+    scaler = FakeScaler(ids=[0, 1, 2])
+    ctl = controller(dispatcher, scaler, max_workers=4, step=8)
+    ctl.tick(1000.0)
+    ctl.tick(1003.0)
+    assert scaler.grown == [[3]]  # 3 live, ceiling 4 -> +1 only
+
+
+def test_grow_ceiling_counts_draining_pods_as_real():
+    # 2 of 6 pods are mid-drain; their pods still exist, so a deep
+    # backlog must not buy pods past EDL_MAX_WORKERS in TOTAL — a grow
+    # gated on the live count would hold 8 real pods against a quota
+    # of 6 for the whole drain window
+    dispatcher = FakeDispatcher(queue=1000, doing=4)
+    scaler = FakeScaler(ids=[0, 1, 2, 3, 4, 5])
+    drain = DrainManager(dispatcher, deadline_secs=60)
+    ctl = controller(
+        dispatcher, scaler, drain=drain, max_workers=6, step=4
+    )
+    drain.begin_drain(4, reason="preemption")
+    drain.begin_drain(5, reason="preemption")
+    ctl.tick(1000.0)
+    ctl.tick(1003.0)
+    assert scaler.grown == []
+    # the drains resolve and the watch prunes the pods: the freed
+    # capacity buys workers again, exactly up to the ceiling
+    for wid in (4, 5):
+        drain.deregister(
+            pb.DeregisterWorkerRequest(worker_id=wid, reason="preemption")
+        )
+    scaler.ids = [0, 1, 2, 3]
+    ctl.tick(1010.0)
+    ctl.tick(1013.0)
+    assert scaler.grown == [[6, 7]]  # 4 live + 2 = ceiling, not +step
+
+
+def test_shrink_idle_tail_picks_slowest_ewma_victims():
+    dispatcher = FakeDispatcher(queue=0, epochs_left=0, doing=1)
+    scaler = FakeScaler(ids=[0, 1, 2])
+    fleet = FakeFleet(ewmas={0: 0.1, 1: 0.9, 2: 0.4})
+    drain = DrainManager(dispatcher, fleet=fleet, deadline_secs=60)
+    ctl = controller(
+        dispatcher, scaler, drain=drain, fleet=fleet, step=2,
+        min_workers=1,
+    )
+    t0 = 1000.0
+    ctl.tick(t0)
+    assert scaler.removed == []
+    ctl.tick(t0 + 3.0)
+    # target = max(min_workers, doing) = 1 -> shrink by 2, slowest first
+    assert scaler.removed == [1, 2]
+    assert drain.is_draining(1) and drain.is_draining(2)
+    assert fleet.draining == [1, 2]
+    state = ctl.state()
+    assert state["last_decision"]["direction"] == "shrink"
+    assert state["last_decision"]["victims"] == [1, 2]
+
+
+def test_lowered_budget_shrinks_without_hold():
+    dispatcher = FakeDispatcher(queue=50, doing=3)  # busy job
+    scaler = FakeScaler(ids=[0, 1, 2, 3])
+    fleet = FakeFleet(ewmas={0: 0.2, 1: 0.2, 2: 0.2, 3: 0.8})
+    drain = DrainManager(dispatcher, fleet=fleet, deadline_secs=60)
+    ctl = controller(
+        dispatcher, scaler, drain=drain, fleet=fleet, step=4
+    )
+    ctl.set_limits(max_workers=2)
+    ctl.tick(1000.0)  # immediate: budget is an order, not a signal
+    assert len(scaler.removed) == 2
+    assert scaler.removed[0] == 3  # slowest EWMA drains first
+
+
+def test_budget_below_min_floor_never_drains_whole_fleet():
+    """A ceiling below the floor (max_workers=0 typo, or a budget move
+    that undercuts min_workers) must not drain below min_workers: with
+    zero workers the grow gate ``effective < max_workers`` can never
+    fire again, wedging queued tasks forever with no alarm."""
+    dispatcher = FakeDispatcher(queue=50, doing=3)
+    scaler = FakeScaler(ids=[0, 1, 2, 3])
+    fleet = FakeFleet(ewmas={0: 0.2, 1: 0.2, 2: 0.2, 3: 0.8})
+    drain = DrainManager(dispatcher, fleet=fleet, deadline_secs=60)
+    ctl = controller(
+        dispatcher, scaler, drain=drain, fleet=fleet, step=8,
+        min_workers=2,
+    )
+    ctl.set_limits(max_workers=0)
+    ctl.tick(1000.0)
+    assert len(scaler.removed) == 2  # down to the min floor, not zero
+    # at the floor the controller sits quiet (no grow: over budget;
+    # no further shrink: at min_workers)
+    ctl.tick(1001.0)
+    assert len(scaler.removed) == 2
+
+
+class LaggyScaler(FakeScaler):
+    """``remove_worker`` deletes the pod, but the watch's DELETED
+    event — which is what prunes ``worker_ids()`` — lands seconds
+    later."""
+
+    def remove_worker(self, worker_id):
+        self.removed.append(worker_id)
+        return True
+
+    def deliver_deleted(self):
+        self.ids = [i for i in self.ids if i not in self.removed]
+
+
+def test_over_budget_shrink_does_not_refire_in_ack_to_deleted_lag():
+    dispatcher = FakeDispatcher(queue=50, doing=4)
+    scaler = LaggyScaler(ids=[0, 1, 2, 3, 4, 5])
+    fleet = FakeFleet(ewmas={i: 0.2 for i in range(6)})
+    drain = DrainManager(dispatcher, fleet=fleet, deadline_secs=60)
+    ctl = controller(
+        dispatcher, scaler, drain=drain, fleet=fleet, step=8
+    )
+    ctl.set_limits(max_workers=4)
+    ctl.tick(1000.0)
+    assert len(scaler.removed) == 2
+    # both victims flush and ack; their pods still show in worker_ids()
+    for wid in list(scaler.removed):
+        drain.deregister(
+            pb.DeregisterWorkerRequest(worker_id=wid, reason="scale_down")
+        )
+    assert drain.draining_ids() == set()
+    # the over-budget branch skips hold AND cooldown: without departed
+    # tracking it would see 6 ids / 0 draining and drain 2 MORE healthy
+    # workers, taking the real fleet to 2 under a budget of 4
+    ctl.tick(1020.0)
+    assert len(scaler.removed) == 2
+    # the watch catches up; departed ids are pruned, fleet sits at
+    # exactly the budget, and the controller stays quiet
+    scaler.deliver_deleted()
+    ctl.tick(1040.0)
+    assert len(scaler.removed) == 2
+    assert drain.departed_ids(scaler.worker_ids()) == set()
+
+
+def test_eval_backlog_blocks_idle_tail_shrink_and_buys_workers():
+    # 0 training tasks and 0 epochs left, but 50 evaluation tasks
+    # queued: the idle-tail shrink must not serialize the eval tail
+    # onto a shrunken fleet, and the deep eval-only backlog is real
+    # work that can buy workers
+    dispatcher = FakeDispatcher(
+        queue=0, epochs_left=0, doing=1, eval_queue=50
+    )
+    scaler = FakeScaler(ids=[0, 1, 2])
+    ctl = controller(dispatcher, scaler)
+    t0 = 1000.0
+    ctl.tick(t0)
+    ctl.tick(t0 + 3.0)
+    ctl.tick(t0 + 6.0)
+    assert scaler.removed == []
+    assert scaler.grown == [[3, 4]]
+
+
+def test_marginal_gain_guard_sets_ceiling():
+    dispatcher = FakeDispatcher(queue=100)
+    scaler = FakeScaler(ids=[0, 1])
+    fleet = FakeFleet(throughput=200.0)
+    ctl = controller(
+        dispatcher, scaler, fleet=fleet, step=2, max_workers=16,
+        gain_settle_secs=5.0, cooldown_secs=1.0,
+    )
+    t0 = 1000.0
+    ctl.tick(t0)
+    ctl.tick(t0 + 3.0)  # grow 2 -> 4; gain measurement armed
+    assert scaler.grown == [[2, 3]]
+    # the grow bought nothing: throughput unchanged at measurement time
+    ctl.tick(t0 + 8.5)  # settles the gain -> ceiling at 4
+    assert ctl.state()["gain_ceiling"] == 4
+    ctl.tick(t0 + 9.0)
+    ctl.tick(t0 + 13.0)  # backlog still deep, but growth stopped paying
+    assert scaler.grown == [[2, 3]]
+
+
+def test_grow_never_jumps_past_the_gain_ceiling():
+    """Deaths can drop the fleet below a learned ceiling with a step
+    big enough to overshoot it; the regrow must stop AT the ceiling,
+    not sail past the size already proven unprofitable."""
+    dispatcher = FakeDispatcher(queue=100)
+    scaler = FakeScaler(ids=[0, 1])
+    fleet = FakeFleet(throughput=200.0)
+    ctl = controller(
+        dispatcher, scaler, fleet=fleet, step=4, max_workers=16,
+        gain_settle_secs=5.0, cooldown_secs=1.0,
+    )
+    t0 = 1000.0
+    ctl.tick(t0)
+    ctl.tick(t0 + 3.0)  # grow 2 -> 6
+    assert scaler.grown == [[2, 3, 4, 5]]
+    ctl.tick(t0 + 8.5)  # flat throughput -> ceiling at 6
+    assert ctl.state()["gain_ceiling"] == 6
+    # three workers die: effective 3, backlog deep, step would add 4
+    for wid in (3, 4, 5):
+        scaler.ids.remove(wid)
+    ctl.tick(t0 + 20.0)
+    ctl.tick(t0 + 24.0)  # held + out of cooldown -> regrow
+    assert scaler.grown[-1] == [6, 7, 8], (
+        "regrow must cap at the ceiling (+3 to 6), not add the full "
+        "step of 4"
+    )
+
+
+def test_maybe_create_requires_env_and_scaler(monkeypatch):
+    dispatcher = FakeDispatcher()
+    drain = DrainManager(dispatcher, deadline_secs=60)
+    monkeypatch.delenv("EDL_AUTOSCALE", raising=False)
+    assert ElasticController.maybe_create(
+        dispatcher, FakeScaler(), drain
+    ) is None
+    monkeypatch.setenv("EDL_AUTOSCALE", "1")
+    assert ElasticController.maybe_create(
+        dispatcher, None, drain
+    ) is None
+    assert ElasticController.maybe_create(
+        dispatcher, FakeScaler(), drain
+    ) is not None
+
+
+def test_draining_workers_do_not_count_toward_fleet_size():
+    dispatcher = FakeDispatcher(queue=0, epochs_left=0, doing=0)
+    scaler = FakeScaler(ids=[0, 1])
+    drain = DrainManager(dispatcher, deadline_secs=60)
+    ctl = controller(
+        dispatcher, scaler, drain=drain, min_workers=1, step=4
+    )
+    drain.begin_drain(1, reason="scale_down")
+    t0 = 1000.0
+    ctl.tick(t0)
+    ctl.tick(t0 + 3.0)
+    # effective fleet is already at min (worker 0): no second victim
+    assert scaler.removed == []
+
+
+# ---------------------------------------------------------------------------
+# DrainManager
+
+
+def test_drain_ack_cleans_up_without_requeue_or_alert():
+    dispatcher = FakeDispatcher()
+    fleet = FleetMonitor(dead_air_secs=0.2)
+    drain = DrainManager(dispatcher, fleet=fleet, deadline_secs=60)
+    fleet.observe(3, None)
+    assert drain.begin_drain(3, reason="scale_down")
+    assert not drain.begin_drain(3)  # idempotent
+    assert drain.is_draining(3)
+    # the victim goes quiet while it flushes: still no dead-air alert
+    time.sleep(0.3)
+    assert fleet.evaluate() == []
+    request = pb.DeregisterWorkerRequest(
+        worker_id=3, reason="scale_down", pushes_joined=True,
+        tier_flushed=True,
+    )
+    drain.deregister(request)
+    assert not drain.is_draining(3)
+    assert dispatcher.recovered == [3]  # leftovers requeue (uncounted)
+    assert fleet.evaluate() == []  # tombstone is silent
+    snapshot = fleet.snapshot()
+    (tomb,) = snapshot["drained"].values()
+    assert tomb["drained"] is True and tomb["worker_id"] == 3
+    assert snapshot["alerts"] == []
+
+
+def test_drain_expiry_falls_back_to_requeue_on_death():
+    dispatcher = FakeDispatcher()
+    fleet = FleetMonitor(dead_air_secs=30.0)
+    fleet.observe(5, None)
+    drain = DrainManager(dispatcher, fleet=fleet, deadline_secs=0.0)
+    drain.begin_drain(5, reason="scale_down")
+    expired = drain.take_expired(time.time() + 1.0)
+    assert expired == [5]
+    assert not drain.is_draining(5)
+    # the task monitor routes expired drains through mark_worker_dead;
+    # the fleet tombstone then carries drained: true (late intentional
+    # removal, not a surprise death)
+    fleet.mark_dead(5)
+    (alert,) = fleet.alerts()
+    assert alert["alert"] == "dead_air"
+    assert alert["evicted"] is True and alert["drained"] is True
+
+
+def test_servicer_gate_and_inline_deregister(tmp_path):
+    """The get_task drain gate answers WAIT(draining=true) and a bare
+    servicer (no DrainManager) still honors deregister_worker."""
+    from elasticdl_tpu.data.readers import RecordIODataReader
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from tests.test_utils import create_mnist_recordio
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_mnist_recordio(
+        str(train_dir / "f0.rec"), num_records=64, seed=0
+    )
+    reader = RecordIODataReader(data_dir=str(train_dir))
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(), records_per_task=32,
+        num_epochs=1, seed=0,
+    )
+    fleet = FleetMonitor(dead_air_secs=30.0)
+    servicer = MasterServicer(dispatcher, None, fleet_monitor=fleet)
+    drain = DrainManager(dispatcher, servicer=servicer, fleet=fleet,
+                         deadline_secs=60)
+    servicer.drain_manager = drain
+
+    task = servicer.get_task(pb.GetTaskRequest(worker_id=7))
+    assert task.task_id != 0 and not task.draining
+    drain.begin_drain(7)
+    gated = servicer.get_task(pb.GetTaskRequest(worker_id=7))
+    assert gated.task_id == 0 and gated.type == pb.WAIT
+    assert gated.draining is True
+    # the ack requeues the held task uncounted and forgets the worker
+    servicer.deregister_worker(
+        pb.DeregisterWorkerRequest(worker_id=7, reason="scale_down")
+    )
+    assert 7 not in servicer.worker_liveness()
+    assert dispatcher.stats()["doing"] == {}
+    assert fleet.evaluate() == []
+
+    # bare servicer without a drain manager: inline fallback path
+    servicer.drain_manager = None
+    task = servicer.get_task(pb.GetTaskRequest(worker_id=8))
+    assert task.task_id != 0
+    servicer.deregister_worker(
+        pb.DeregisterWorkerRequest(worker_id=8, reason="sigterm")
+    )
+    assert 8 not in servicer.worker_liveness()
+    assert dispatcher.stats()["doing"] == {}
+
+
+# ---------------------------------------------------------------------------
+# FleetMonitor drain hygiene (the satellite regression)
+
+
+def test_draining_worker_is_exempt_from_straggler_and_dead_air():
+    fleet = FleetMonitor(straggler_factor=2.0, dead_air_secs=0.2)
+
+    def blob(role, ewma):
+        return pb.TelemetryBlob(role=role, step_time_ewma=ewma)
+
+    fleet.observe(0, blob("worker-0", 0.1))
+    fleet.observe(1, blob("worker-1", 0.1))
+    fleet.observe(2, blob("worker-2", 5.0))  # flagrant straggler
+    kinds = {a["alert"] for a in fleet.evaluate()}
+    assert "straggler" in kinds
+    # draining: the straggler alert clears and stays clear
+    fleet.mark_draining(2)
+    assert fleet.evaluate() == []
+    # ...and its silence while flushing never reads as dead air
+    time.sleep(0.3)
+    fleet.observe(0, blob("worker-0", 0.1))
+    fleet.observe(1, blob("worker-1", 0.1))
+    assert all(
+        a["worker_id"] != 2 for a in fleet.evaluate()
+    ), fleet.evaluate()
+    # clean ack: silent tombstone, flagged drained in /statusz
+    fleet.mark_drained(2, reason="scale_down")
+    assert fleet.evaluate() == []
+    snapshot = fleet.snapshot()
+    assert snapshot["drained"]["worker-2"]["drained"] is True
+    assert snapshot["drained"]["worker-2"]["reason"] == "scale_down"
+    # a reused id re-registers fresh: tombstone clears
+    fleet.observe(2, blob("worker-2", 0.1))
+    assert fleet.snapshot()["drained"] == {}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a real worker drains gracefully over gRPC
+
+
+def test_worker_graceful_drain_finishes_task_and_deregisters(
+    tmp_path, monkeypatch,
+):
+    """begin_drain (what the SIGTERM hook calls) mid-job: the worker
+    finishes its current task (reported DONE, never requeued), sends
+    the drain ack, and exits its run loop; a second worker completes
+    the job — every task done exactly once."""
+    from elasticdl_tpu.common.grpc_utils import (
+        build_server, find_free_port,
+    )
+    from elasticdl_tpu.data.readers import RecordIODataReader
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.observability import events
+    from elasticdl_tpu.proto.services import (
+        add_master_servicer_to_server,
+    )
+    from elasticdl_tpu.worker.master_client import MasterClient
+    from elasticdl_tpu.worker.worker import Worker
+    from tests.test_utils import create_mnist_recordio
+
+    events_dir = tmp_path / "events"
+    events_dir.mkdir()
+    monkeypatch.setenv(events.EVENTS_DIR_ENV, str(events_dir))
+    events.configure("master")
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_mnist_recordio(
+        str(train_dir / "f0.rec"), num_records=512, seed=0
+    )
+    reader = RecordIODataReader(data_dir=str(train_dir))
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(), records_per_task=64,
+        num_epochs=1, seed=0,
+    )
+    fleet = FleetMonitor(dead_air_secs=30.0)
+    servicer = MasterServicer(dispatcher, None, fleet_monitor=fleet)
+    drain = DrainManager(dispatcher, servicer=servicer, fleet=fleet,
+                         deadline_secs=90)
+    servicer.drain_manager = drain
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    try:
+        worker = Worker(
+            MasterClient("localhost:%d" % port, worker_id=0),
+            "elasticdl_tpu.models.mnist",
+            reader,
+            minibatch_size=32,
+            wait_sleep_secs=0.1,
+        )
+        runner = threading.Thread(target=worker.run, daemon=True)
+        runner.start()
+        # master-initiated drain once the worker holds a task
+        deadline = time.time() + 60
+        while time.time() < deadline and not dispatcher.doing_tasks():
+            time.sleep(0.05)
+        assert dispatcher.doing_tasks(), "worker never took a task"
+        drain.begin_drain(0, reason="scale_down")
+        runner.join(timeout=90)
+        assert not runner.is_alive(), "draining worker never exited"
+        assert worker._drain_done
+        # clean removal: nothing left assigned to it, no liveness entry
+        assert all(
+            wid != 0 for wid, _ in dispatcher.doing_tasks().values()
+        )
+        assert 0 not in servicer.worker_liveness()
+        assert not dispatcher.finished()  # work remains for a peer
+
+        # a second worker finishes the job
+        worker2 = Worker(
+            MasterClient("localhost:%d" % port, worker_id=1),
+            "elasticdl_tpu.models.mnist",
+            reader,
+            minibatch_size=32,
+            wait_sleep_secs=0.1,
+        )
+        worker2.run()
+        assert dispatcher.finished()
+        assert not dispatcher.job_failed()
+    finally:
+        server.stop(0)
+        events.flush()
+        events._reset_for_tests()
+
+    from tests.test_utils import load_journal
+
+    merged = load_journal(events_dir)
+    acks = [e for e in merged if e["event"] == "drain_ack"]
+    assert acks and acks[0]["worker"] == 0
+    assert acks[0]["handed_back"] == 0, (
+        "clean drain must finish its task, not hand it back"
+    )
+    # done-exactly-once: the drained worker's tasks were never requeued
+    requeues = [e for e in merged if e["event"] == "task_requeue"]
+    assert requeues == [], requeues
+
+
+def test_drain_fast_honors_drain_request():
+    """A drain landing during the MaxSteps fast-drain tail must route
+    to _finish_drain: the master's gate answers WAIT(draining=true)
+    forever once this worker is a victim, so looping on it would wedge
+    until the watchdog os._exit(1)s a healthy drain."""
+    from elasticdl_tpu.worker.worker import Worker
+
+    class FakeMC:
+        def __init__(self):
+            self.calls = 0
+
+        def get_task(self, task_type=None):
+            self.calls += 1
+            if self.calls > 5:
+                raise AssertionError(
+                    "fast-drain looped on WAIT(draining=true)"
+                )
+            return pb.Task(task_id=0, type=pb.WAIT, draining=True)
+
+    class Stub:
+        pass
+
+    # master-initiated: the gate's draining flag ends the loop
+    victim = Stub()
+    victim._draining = False
+    victim._mc = FakeMC()
+    finished = []
+    victim._finish_drain = lambda: finished.append("master")
+    Worker._drain_fast(victim)
+    assert finished == ["master"]
+    assert victim._mc.calls == 1
+
+    # worker-initiated (SIGTERM flag): short-circuits before any RPC
+    sigtermed = Stub()
+    sigtermed._draining = True
+    sigtermed._mc = None  # must not be consulted
+    sigtermed._finish_drain = lambda: finished.append("sigterm")
+    Worker._drain_fast(sigtermed)
+    assert finished == ["master", "sigterm"]

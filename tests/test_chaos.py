@@ -743,23 +743,304 @@ def test_ps_sigkill_auto_restore_and_worker_resync(
         events._reset_for_tests()
 
     # --- flight recorder: restore + resync are journaled ---
-    def load_events(prefix):
-        merged = []
-        for name in os.listdir(str(events_dir)):
-            if name.startswith(prefix) and name.endswith(".events.ndjson"):
-                with open(str(events_dir / name)) as f:
-                    for line in f:
-                        try:
-                            merged.append(json.loads(line))
-                        except ValueError:
-                            pass
-        return merged
+    from tests.test_utils import load_journal
 
-    ps_events = load_events("ps-0")
+    ps_events = load_journal(events_dir, "ps-0")
     restored = [e for e in ps_events if e["event"] == "ps_restored"]
     assert restored, "relaunched PS journaled no ps_restored event"
     assert restored[0]["version"] >= restored_floor
-    worker_events = load_events("worker-0")
+    worker_events = load_journal(events_dir, "worker-0")
     resynced = [e for e in worker_events if e["event"] == "worker_resynced"]
     assert resynced, "worker journaled no worker_resynced event"
     assert resynced[0]["restored"] == restored[0]["version"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: graceful drain under preemption
+
+
+@pytest.mark.slow
+def test_worker_drain_under_async_push_and_device_tier_tier_ps_parity(
+    tmp_path, monkeypatch,
+):
+    """ISSUE 7 acceptance (graceful path): drain a worker mid-job under
+    EDL_ASYNC_PUSH + EDL_DEVICE_TIER — begin_drain is exactly what the
+    SIGTERM hook calls. The drain must (a) finish the current task
+    (done-exactly-once: zero task_requeue events end to end), (b) join
+    the in-flight push and flush dirty tier rows so every resident
+    row's device value matches the PS (tier<->PS parity), and (c)
+    deregister so the removal stays alert-silent."""
+    import numpy as np
+
+    from elasticdl_tpu.master.autoscaler import DrainManager
+    from elasticdl_tpu.master.fleet import FleetMonitor
+    from elasticdl_tpu.observability import events
+    from elasticdl_tpu.worker.ps_client import PSClient
+    from tests.test_utils import create_ctr_recordio
+
+    events_dir = tmp_path / "events"
+    events_dir.mkdir()
+    monkeypatch.setenv(events.EVENTS_DIR_ENV, str(events_dir))
+    monkeypatch.setenv("EDL_ASYNC_PUSH", "1")
+    monkeypatch.setenv("EDL_DEVICE_TIER", "1")
+    monkeypatch.setenv("EDL_DEVICE_TIER_ROWS", "256")
+    monkeypatch.setenv("EDL_DEVICE_TIER_PROMOTE", "2")
+    monkeypatch.setenv("EDL_DEVICE_TIER_OPT", "adam")
+    monkeypatch.setenv("EDL_DEVICE_TIER_OPT_ARGS", "lr=0.01")
+    # the drain watchdog must not fire under full-suite CPU contention
+    monkeypatch.setenv("EDL_DRAIN_DEADLINE_SECS", "300")
+    events.configure("master")
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_ctr_recordio(str(train_dir / "f0.rec"), num_records=1152,
+                        seed=0)
+    reader = RecordIODataReader(data_dir=str(train_dir))
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(),
+        records_per_task=128, num_epochs=2, seed=0,
+    )
+    fleet = FleetMonitor(dead_air_secs=60.0)
+    servicer = MasterServicer(dispatcher, None, fleet_monitor=fleet)
+    drain = DrainManager(dispatcher, servicer=servicer, fleet=fleet,
+                         deadline_secs=240.0)
+    servicer.drain_manager = drain
+    monitor = TaskMonitor(
+        dispatcher, servicer, liveness_timeout_secs=60.0,
+        scan_interval_secs=0.5, fleet_monitor=fleet,
+        drain_manager=drain,
+    )
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    master_port = find_free_port()
+    server.add_insecure_port("localhost:%d" % master_port)
+    server.start()
+    monitor.start()
+
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    ps_port = free_port()
+    ps_proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "elasticdl_tpu.ps.server",
+            "--ps_id", "0", "--num_ps_pods", "1",
+            "--port", str(ps_port),
+            # async PS: EDL_ASYNC_PUSH's supported mode (a sync PS
+            # rejects the second worker's post-drain pushes as stale)
+            "--use_async", "1",
+            "--opt_type", "adam", "--opt_args", "lr=0.01",
+        ],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             events.EVENTS_DIR_ENV: str(events_dir)},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    _wait_port(ps_port)
+    try:
+        worker = Worker(
+            MasterClient("localhost:%d" % master_port, worker_id=0),
+            "elasticdl_tpu.models.deepfm",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=64, wait_sleep_secs=0.1,
+            ps_addrs=["localhost:%d" % ps_port],
+        )
+        runner = threading.Thread(target=worker.run, daemon=True)
+        runner.start()
+        # drain once real progress exists: tasks done AND tier traffic
+        deadline = time.time() + 120
+        while time.time() < deadline and (
+            dispatcher.stats()["done"].get("training", 0) < 2
+        ):
+            time.sleep(0.2)
+        assert dispatcher.stats()["done"].get("training", 0) >= 2, (
+            "worker made no progress"
+        )
+        drain.begin_drain(0, reason="scale_down")
+        runner.join(timeout=180)
+        assert not runner.is_alive(), "draining worker never exited"
+        assert worker._drain_done
+
+        # (b) tier<->PS parity: every resident row's device value must
+        # equal what the PS stores — the drain's flush landed
+        tier = worker.trainer.device_tier
+        assert tier is not None, "EDL_DEVICE_TIER did not engage"
+        probe = PSClient(["localhost:%d" % ps_port])
+        compared = 0
+        for table in ("deepfm_emb", "deepfm_linear"):
+            ids, rows = tier.table_rows(table)
+            if not ids.size:
+                continue
+            np.testing.assert_allclose(
+                probe.pull_embedding_vectors(table, ids), rows,
+                rtol=1e-5, atol=1e-6,
+            )
+            compared += ids.size
+        assert compared > 0, "tier held no rows to compare"
+
+        # (c) alert-silent removal, and work remains for a peer
+        assert fleet.evaluate() == []
+        assert 0 not in servicer.worker_liveness()
+        assert not dispatcher.finished()
+
+        # a second worker finishes the job (fresh id: the drained id's
+        # tombstone must not block a replacement either)
+        worker2 = Worker(
+            MasterClient("localhost:%d" % master_port, worker_id=1),
+            "elasticdl_tpu.models.deepfm",
+            RecordIODataReader(data_dir=str(train_dir)),
+            minibatch_size=64, wait_sleep_secs=0.1,
+            ps_addrs=["localhost:%d" % ps_port],
+        )
+        worker2.run()
+        assert dispatcher.finished()
+        assert not dispatcher.job_failed()
+    finally:
+        monitor.stop()
+        server.stop(0)
+        if ps_proc.poll() is None:
+            ps_proc.kill()
+        events.flush()
+        events._reset_for_tests()
+
+    from tests.test_utils import load_journal
+
+    merged = load_journal(events_dir)
+    acks = [e for e in merged if e["event"] == "drain_ack"]
+    assert acks and acks[0]["worker"] == 0
+    assert acks[0]["pushes_joined"] and acks[0]["tier_flushed"]
+    assert acks[0]["handed_back"] == 0
+    # (a) done-exactly-once: nothing was ever requeued
+    assert [e for e in merged if e["event"] == "task_requeue"] == []
+    assert [e for e in merged if e["event"] == "drain_expired"] == []
+
+
+STUCK_WORKER = r"""
+import signal, sys, time
+sys.path.insert(0, %(repo)r)
+signal.signal(signal.SIGTERM, signal.SIG_IGN)  # a wedged victim
+from elasticdl_tpu.worker.master_client import MasterClient
+mc = MasterClient(%(addr)r, worker_id=0)
+mc.reset_worker()
+task = mc.get_task()
+assert task.task_id != 0, "no task to hold"
+print("HOLDING", flush=True)
+time.sleep(600)  # never reports, never drains
+"""
+
+
+@pytest.mark.slow
+def test_drain_deadline_expiry_falls_back_to_requeue_on_death(
+    tmp_path, monkeypatch,
+):
+    """ISSUE 7 acceptance (fallback path): a scale-down victim that
+    ignores SIGTERM and never acks. The master's drain deadline expires
+    -> requeue-on-death (drain_expired journaled, the held task
+    requeues UNCOUNTED, the tombstone says drained: true), the SIGKILL
+    fallback reaps the pod, and a surviving worker completes the job —
+    done-exactly-once still holds."""
+    from elasticdl_tpu.master.autoscaler import DrainManager
+    from elasticdl_tpu.master.fleet import FleetMonitor
+    from elasticdl_tpu.observability import events
+
+    events_dir = tmp_path / "events"
+    events_dir.mkdir()
+    monkeypatch.setenv(events.EVENTS_DIR_ENV, str(events_dir))
+    events.configure("master")
+
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    create_mnist_recordio(str(train_dir / "f0.rec"), num_records=256,
+                          seed=0)
+    reader = RecordIODataReader(data_dir=str(train_dir))
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(), records_per_task=64,
+        num_epochs=1, seed=0,
+    )
+    fleet = FleetMonitor(dead_air_secs=120.0)
+    servicer = MasterServicer(dispatcher, None, fleet_monitor=fleet)
+    drain = DrainManager(dispatcher, servicer=servicer, fleet=fleet,
+                         deadline_secs=3.0)
+    servicer.drain_manager = drain
+    monitor = TaskMonitor(
+        dispatcher, servicer, liveness_timeout_secs=120.0,
+        scan_interval_secs=0.2, fleet_monitor=fleet,
+        drain_manager=drain,
+    )
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    monitor.start()
+    proc = None
+    try:
+        script = STUCK_WORKER % {
+            "repo": os.path.dirname(os.path.dirname(__file__)),
+            "addr": "localhost:%d" % port,
+        }
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.PIPE,
+        )
+        deadline = time.time() + 60
+        while time.time() < deadline and not dispatcher.doing_tasks():
+            time.sleep(0.1)
+        held = dispatcher.doing_tasks()
+        assert held, "stuck worker never took a task"
+        (held_task,) = held
+
+        # scale-down decision: drain, deliver SIGTERM (ignored)
+        drain.begin_drain(0, reason="scale_down")
+        proc.send_signal(signal.SIGTERM)
+        # the deadline expires on the monitor scan -> requeue fallback
+        deadline = time.time() + 30
+        while time.time() < deadline and dispatcher.doing_tasks():
+            time.sleep(0.2)
+        assert not dispatcher.doing_tasks(), "task never recovered"
+        assert not drain.is_draining(0)
+        # SIGKILL fallback (kubelet's grace-period kill)
+        proc.kill()
+        proc.wait(timeout=30)
+
+        # the eviction alerted, flagged as a LATE intentional removal
+        alerts = fleet.alerts()
+        assert any(
+            a["alert"] == "dead_air" and a.get("drained") is True
+            for a in alerts
+        ), alerts
+
+        # a surviving worker drains the job; the held task runs exactly
+        # once more (its original holder never trained it)
+        worker = Worker(
+            MasterClient("localhost:%d" % port, worker_id=2),
+            "elasticdl_tpu.models.mnist", reader,
+            minibatch_size=32, wait_sleep_secs=0.1,
+        )
+        worker.run()
+        assert dispatcher.finished()
+        assert not dispatcher.job_failed(), (
+            "the drain fallback burned the retry cap"
+        )
+    finally:
+        monitor.stop()
+        server.stop(0)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        events.flush()
+        events._reset_for_tests()
+
+    from tests.test_utils import load_journal
+
+    merged = load_journal(events_dir)
+    expired = [e for e in merged if e["event"] == "drain_expired"]
+    assert expired and expired[0]["worker"] == 0
+    requeues = [e for e in merged if e["event"] == "task_requeue"]
+    assert [e["task"] for e in requeues] == [held_task]
+    assert all(e["counted"] is False for e in requeues)

@@ -197,6 +197,188 @@ def test_membership_feeds_rendezvous_sorted_by_start_time():
     assert client.get_worker_service_address(0) in rendezvous.hosts()
 
 
+def test_scale_up_mid_job_recomputes_rendezvous(  # ISSUE 7 satellite
+):
+    api = FakeApi()
+    rendezvous = MeshRendezvous()
+    client, dispatcher, manager = _manager(
+        api, num_workers=2, num_ps=0, rendezvous=rendezvous
+    )
+    manager.start_workers()
+    manager._event_cb(
+        "MODIFIED", _running(api.pods["elasticdl-job1-worker-0"], "t0")
+    )
+    manager._event_cb(
+        "MODIFIED", _running(api.pods["elasticdl-job1-worker-1"], "t1")
+    )
+    epoch_before = rendezvous.mesh_epoch
+    started = manager.scale_up(2)
+    assert started == [2, 3]
+    assert "elasticdl-job1-worker-2" in api.pods
+    assert sorted(manager.worker_ids()) == [0, 1, 2, 3]
+    # new pods join the alive-host list as they reach Running, sorted
+    # by start time -> stable ranks for the incumbents
+    manager._event_cb(
+        "MODIFIED", _running(api.pods["elasticdl-job1-worker-2"], "t2")
+    )
+    manager._event_cb(
+        "MODIFIED", _running(api.pods["elasticdl-job1-worker-3"], "t3")
+    )
+    assert rendezvous.mesh_epoch > epoch_before
+    assert rendezvous.hosts() == [
+        client.get_worker_service_address(i) for i in range(4)
+    ]
+
+
+def test_scale_down_drained_pod_not_relaunched():
+    """ISSUE 7 satellite: an intentionally-removed worker must not be
+    relaunched by its own DELETED event, must not trip
+    all_workers_failed while peers live, and must leave the rendezvous
+    alive-host list."""
+    api = FakeApi()
+    rendezvous = MeshRendezvous()
+    client, dispatcher, manager = _manager(
+        api, num_workers=2, num_ps=0, rendezvous=rendezvous
+    )
+    manager.start_workers()
+    for idx in (0, 1):
+        manager._event_cb(
+            "MODIFIED",
+            _running(api.pods["elasticdl-job1-worker-%d" % idx],
+                     "t%d" % idx),
+        )
+    assert manager.remove_worker(1)
+    pod = dict(api.pods.get("elasticdl-job1-worker-1") or {})
+    assert "elasticdl-job1-worker-1" not in api.pods  # deleted
+    # the watch delivers the DELETED event for the removed pod
+    pod = {
+        "metadata": {
+            "name": "elasticdl-job1-worker-1",
+            "labels": {"elasticdl-tpu-replica-type": "worker"},
+        },
+        "status": {"phase": "Running", "startTime": "t1"},
+    }
+    manager._event_cb("DELETED", pod)
+    # no replacement, no recovery sweep (the drain handled the tasks),
+    # no all-failed abort, and the host left the mesh
+    assert set(api.pods) == {"elasticdl-job1-worker-0"}
+    assert dispatcher.recovered == []
+    assert not manager.all_workers_failed
+    assert rendezvous.hosts() == [client.get_worker_service_address(0)]
+    assert manager.worker_ids() == [0]
+    # removing an unknown id is a no-op
+    assert not manager.remove_worker(99)
+
+
+def test_scale_down_victim_that_dies_nonzero_is_still_intentional():
+    """A wedged drain ends in the watchdog's exit(1) or kubelet's
+    SIGKILL, so the watch can deliver MODIFIED phase=Failed BEFORE the
+    DELETED event. That is still an intentional removal: no recovery
+    sweep, no replacement (which would defeat the scale-down), no
+    all_workers_failed — and the later DELETED must stay a no-op."""
+    api = FakeApi()
+    client, dispatcher, manager = _manager(api, num_workers=2, num_ps=0)
+    manager.start_workers()
+    for idx in (0, 1):
+        manager._event_cb(
+            "MODIFIED",
+            _running(api.pods["elasticdl-job1-worker-%d" % idx],
+                     "t%d" % idx),
+        )
+    assert manager.remove_worker(1)
+    pod = {
+        "metadata": {
+            "name": "elasticdl-job1-worker-1",
+            "labels": {"elasticdl-tpu-replica-type": "worker"},
+        },
+        "status": {"phase": "Failed", "startTime": "t1"},
+    }
+    manager._event_cb("MODIFIED", pod)
+    assert set(api.pods) == {"elasticdl-job1-worker-0"}  # no relaunch
+    assert dispatcher.recovered == []
+    assert not manager.all_workers_failed
+    assert manager.worker_ids() == [0]
+    # the DELETED that follows the Failed phase changes nothing
+    manager._event_cb("DELETED", pod)
+    assert set(api.pods) == {"elasticdl-job1-worker-0"}
+    assert dispatcher.recovered == []
+
+
+def test_failed_scale_down_delete_keeps_mark_for_fallback_delete():
+    """A transient API error on the scale-down delete must KEEP the
+    intentional mark: the victim is condemned (its get_task gate
+    answers WAIT), and the drain-deadline fallback
+    (``on_worker_presumed_dead``) deletes the pod again later. That
+    later DELETED event must still read as intentional — relaunching a
+    replacement would undo the shrink and loop (fallback delete →
+    replacement → over-budget → drain → ...)."""
+    api = FakeApi()
+    client, dispatcher, manager = _manager(api, num_workers=2, num_ps=0)
+    manager.start_workers()
+    for idx in (0, 1):
+        manager._event_cb(
+            "MODIFIED",
+            _running(api.pods["elasticdl-job1-worker-%d" % idx],
+                     "t%d" % idx),
+        )
+    real_delete = api.delete_pod
+
+    def flaky_delete(name, grace_period_seconds=0):
+        raise RuntimeError("transient apiserver error")
+
+    api.delete_pod = flaky_delete
+    assert manager.remove_worker(1)
+    assert "elasticdl-job1-worker-1" in api.pods  # delete failed
+    api.delete_pod = real_delete
+    # drain deadline expires → the presumed-dead fallback deletes the
+    # pod via the client (no mark of its own), then the watch delivers
+    # DELETED
+    client.delete_worker(1)
+    pod = {
+        "metadata": {
+            "name": "elasticdl-job1-worker-1",
+            "labels": {"elasticdl-tpu-replica-type": "worker"},
+        },
+        "status": {"phase": "Running", "startTime": "t1"},
+    }
+    manager._event_cb("DELETED", pod)
+    # intentional path: no replacement, no recovery sweep (the drain
+    # deadline already requeued), no all-failed abort
+    assert set(api.pods) == {"elasticdl-job1-worker-0"}
+    assert dispatcher.recovered == []
+    assert not manager.all_workers_failed
+    assert manager.worker_ids() == [0]
+
+
+def test_oom_killed_pod_never_relaunched_after_scale_events():
+    """Scale churn must not erode the OOM rule: after a scale_up, an
+    OOM-killed pod still gets no replacement (a bigger pod is an
+    operator decision) while its tasks recover."""
+    api = FakeApi()
+    client, dispatcher, manager = _manager(api, num_workers=1, num_ps=0)
+    manager.start_workers()
+    manager.scale_up(1)
+    pods_before = set(api.pods)
+    pod = api.pods["elasticdl-job1-worker-1"]
+    pod["status"] = {
+        "phase": "Failed",
+        "containerStatuses": [
+            {
+                "state": {
+                    "terminated": {"exitCode": 137, "reason": "OOMKilled"}
+                }
+            }
+        ],
+    }
+    manager._event_cb("MODIFIED", pod)
+    assert dispatcher.recovered == [1]
+    # no replacement pod appeared (the failed pod object itself stays
+    # in the fake API; only relaunches create new names)
+    assert set(api.pods) == pods_before
+    assert manager.worker_ids() == [0]
+    assert not manager.all_workers_failed  # worker 0 lives
+
+
 def test_job_monitor_phases():
     api = FakeApi()
     api.create_pod(

@@ -379,6 +379,30 @@ def test_emit_unknown_event_type_raises(journal_dir):
         events.emit("not_a_real_event")
 
 
+def test_emit_survives_reentrant_write(journal_dir):
+    """The SIGTERM drain hook emits while the interrupted thread may be
+    inside this journal's own file.write(); Python raises RuntimeError
+    ('reentrant call') on the nested write. emit() must swallow it —
+    losing one line beats crashing the drain, and the record is still
+    in the ring for the crash dump."""
+    journal = events.configure("worker-0")
+    events.emit("role_start", worker=0)  # opens the file
+
+    class ReentrantFile:
+        def write(self, line):
+            raise RuntimeError("reentrant call inside TextIOWrapper")
+
+        def flush(self):
+            raise RuntimeError("reentrant call inside TextIOWrapper")
+
+        def close(self):
+            pass
+
+    journal._file = ReentrantFile()
+    events.emit("worker_draining", worker=0, reason="sigterm")
+    assert journal._ring[-1]["event"] == "worker_draining"
+
+
 def test_journal_inert_without_events_dir(monkeypatch, tmp_path):
     monkeypatch.delenv(events.EVENTS_DIR_ENV, raising=False)
     assert events.configure("worker-0") is None

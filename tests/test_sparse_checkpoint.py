@@ -164,3 +164,74 @@ def test_optimizer_swap_restores_weights_only():
         # further training must stay finite (fresh adagrad accumulator)
         restored.push_gradients("t", ids, np.ones((4, 4), np.float32))
         assert np.isfinite(restored.lookup("t", ids)).all()
+
+
+def test_graceful_stop_flushes_round_and_rejects_late_pushes(tmp_path):
+    """ISSUE 7 PS SIGTERM satellite: graceful_stop applies the
+    buffered partial round and saves a final COMPLETE checkpoint —
+    and a push handler that loses the lock race against it (gRPC
+    keeps running handlers admitted before server.stop()) must be
+    REJECTED: buffering after the flush would ACK an update into a
+    round buffer nobody will ever apply again, silently missing from
+    the state the successor restores."""
+    from elasticdl_tpu.common.tensor_utils import ndarray_to_blob
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.ps.servicer import PserverServicer
+
+    def push(version, worker_id):
+        request = pb.PushGradientsRequest()
+        request.gradients.version = version
+        slices = request.gradients.embedding_tables["t"]
+        ndarray_to_blob(np.ones((2, 4), np.float32), slices.concat_tensors)
+        slices.ids.extend([0, 1])
+        request.worker_id = worker_id
+        return request
+
+    store = make_store()
+    before = store.lookup("t", np.array([0, 1], np.int64)).copy()
+    saver = SparseCheckpointSaver(
+        str(tmp_path / "ckpt"), shard_id=0, shard_num=1
+    )
+    servicer = PserverServicer(
+        store, use_async=False, grads_to_wait=2, checkpoint_saver=saver,
+    )
+    # one buffered push: an under-filled round when SIGTERM arrives
+    assert servicer.push_gradients(push(0, worker_id=0)).accepted
+    servicer.graceful_stop()
+    # the partial round was applied (not lost) and checkpointed
+    after = store.lookup("t", np.array([0, 1], np.int64))
+    assert not np.allclose(before, after)
+    restored = make_store(seed=1)
+    assert saver.restore(restored) == store.version
+    np.testing.assert_array_equal(
+        restored.lookup("t", np.array([0, 1], np.int64)), after
+    )
+    # late pushes — sync buffering path and a second stop — are inert
+    late = servicer.push_gradients(push(store.version, worker_id=1))
+    assert not late.accepted
+    np.testing.assert_array_equal(
+        store.lookup("t", np.array([0, 1], np.int64)), after
+    )
+    # device-tier writebacks reject too: importing rows now would ACK
+    # a flush the final checkpoint never saw (the client raises on the
+    # rejection, so a draining worker reports tier_flushed=False)
+    rows = pb.Model()
+    slices = rows.embedding_tables["t"]
+    ndarray_to_blob(np.full((2, 4), 9.0, np.float32), slices.concat_tensors)
+    slices.ids.extend([0, 1])
+    assert not servicer.push_embedding_rows(rows).accepted
+    np.testing.assert_array_equal(
+        store.lookup("t", np.array([0, 1], np.int64)), after
+    )
+    servicer.graceful_stop()  # idempotent
+
+    # the lock-free async path rejects too
+    async_store = make_store()
+    async_servicer = PserverServicer(async_store, use_async=True)
+    async_servicer.graceful_stop()
+    resp = async_servicer.push_gradients(push(0, worker_id=0))
+    assert not resp.accepted
+    np.testing.assert_array_equal(
+        async_store.lookup("t", np.array([0, 1], np.int64)),
+        make_store().lookup("t", np.array([0, 1], np.int64)),
+    )

@@ -409,6 +409,44 @@ def test_retry_no_jitter_quiet_with_jitter_or_constant_sleep():
     """)
 
 
+def test_sigterm_no_chain_flags_overwriting_handler():
+    findings = findings_for("""
+        import signal
+        import sys
+
+        def install_stop_hook(server):
+            def _on_term(signum, frame):
+                server.stop(grace=1.0)
+                sys.exit(0)
+            # BUG: severs the flight-recorder/drain chain behind it
+            signal.signal(signal.SIGTERM, _on_term)
+    """)
+    assert rules_of(findings) == {"ft-sigterm-no-chain"}
+
+
+def test_sigterm_no_chain_quiet_when_previous_captured_or_other_signal():
+    assert not findings_for("""
+        import signal
+        import sys
+
+        def install_chained_hook(server):
+            previous = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                server.stop(grace=1.0)
+                if callable(previous):
+                    previous(signum, frame)
+                else:
+                    sys.exit(0)
+
+            signal.signal(signal.SIGTERM, _on_term)
+
+        def install_usr1_dump():
+            # non-TERM signals don't participate in the eviction chain
+            signal.signal(signal.SIGUSR1, lambda s, f: None)
+    """)
+
+
 # ---------------------------------------------------------------------------
 # perf-varint-ids
 

@@ -107,3 +107,24 @@ def spawn_ps_process(ps_id=0, num_ps_pods=1, opt_type="adam",
             time.sleep(0.3)
     proc.kill()
     raise TimeoutError("PS process never opened its port")
+
+
+def load_journal(events_dir, prefix=""):
+    """Merge every flight-recorder journal under ``events_dir``
+    (``<role>-<pid>.events.ndjson``, optionally filtered by role
+    ``prefix``) into one event list, skipping torn tails from SIGKILLed
+    writers. The one journal reader for every test/bench that asserts
+    over events."""
+    import json
+    import os
+
+    merged = []
+    for name in sorted(os.listdir(str(events_dir))):
+        if name.startswith(prefix) and name.endswith(".events.ndjson"):
+            with open(os.path.join(str(events_dir), name)) as f:
+                for line in f:
+                    try:
+                        merged.append(json.loads(line))
+                    except ValueError:
+                        pass
+    return merged
