@@ -23,6 +23,10 @@ for (or refuses to pay for):
   rows (``for i in ids: table[i]``) inside hot functions; use a
   vectorized gather (``table[ids]``/``np.take``) or the fused
   device-tier kernels (``ops/embedding_tier.py``).
+- ``serve-unbounded-queue`` — no unbounded ``queue.Queue()`` /
+  ``deque()`` constructors in the serving package: the serving tier's
+  contract is admission control, so every queue carries a bound
+  (maxsize/maxlen) and overload sheds instead of buffering.
 - ``xhost-determinism``   — no set-ordered or filesystem-ordered
   iteration in checkpoint/export/gradient-aggregation paths, where
   ordering must match across hosts.

@@ -223,6 +223,7 @@ def _rules_by_name(names=None):
         obs_hot_path,
         perf_gather,
         perf_wire,
+        serve_queue,
     )
 
     registry = {
@@ -231,6 +232,7 @@ def _rules_by_name(names=None):
         "obs-hot-path": obs_hot_path.run,
         "perf-varint-ids": perf_wire.run,
         "perf-host-gather": perf_gather.run,
+        "serve-unbounded-queue": serve_queue.run,
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
         "ft-retry-no-jitter": fault_tolerance.run_retry_no_jitter,
@@ -251,6 +253,7 @@ RULE_NAMES = (
     "obs-hot-path",
     "perf-varint-ids",
     "perf-host-gather",
+    "serve-unbounded-queue",
     "ft-swallowed-except",
     "ft-grpc-timeout",
     "ft-retry-no-jitter",

@@ -1,0 +1,102 @@
+"""serve-unbounded-queue: unbounded queues on the serving request path.
+
+The serving tier's whole contract is admission control: a request
+either enters a BOUNDED queue or is shed with a clean
+RESOURCE_EXHAUSTED (docs/SERVING.md). An unbounded ``queue.Queue()`` /
+``collections.deque()`` anywhere in ``elasticdl_tpu/serve/`` silently
+converts overload into unbounded latency + memory — the failure mode
+load shedding exists to prevent — so the constructor itself is the
+lint target, not the usage.
+
+What fires, in files under a ``serve/`` package directory only:
+
+- ``queue.Queue()`` / ``queue.SimpleQueue()`` / ``queue.LifoQueue()`` /
+  ``queue.PriorityQueue()`` with no ``maxsize`` (positional or
+  keyword), or an explicit ``maxsize=0`` (queue's spelling of
+  "unbounded");
+- ``collections.deque(...)`` / ``deque(...)`` with no ``maxlen=``.
+
+A bound that is a variable is accepted — the rule pins the CONSTRUCT,
+the depth knob's value is config.
+"""
+
+import ast
+import os
+
+from elasticdl_tpu.analysis.core import Finding, walk_with_scope
+
+RULE = "serve-unbounded-queue"
+
+_QUEUE_CLASSES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+
+def _in_serve_package(path):
+    parts = path.replace(os.sep, "/").split("/")
+    return "serve" in parts
+
+
+def _call_name(node):
+    """("queue", "Queue") for queue.Queue(...); (None, "deque") for a
+    bare deque(...); (None, None) otherwise."""
+    func = node.func
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return func.value.id, func.attr
+    if isinstance(func, ast.Name):
+        return None, func.id
+    return None, None
+
+
+def _is_zero(node):
+    return isinstance(node, ast.Constant) and node.value == 0
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        if not _in_serve_package(unit.path):
+            continue
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            base, name = _call_name(node)
+            if name in _QUEUE_CLASSES and base in ("queue", None):
+                # bare names only count when queue.* was imported that
+                # way; 'Queue' alone is rare enough to flag regardless
+                # — a false positive is one suppression comment
+                maxsize = None
+                if node.args:
+                    maxsize = node.args[0]
+                for kw in node.keywords:
+                    if kw.arg == "maxsize":
+                        maxsize = kw.value
+                if maxsize is not None and not _is_zero(maxsize):
+                    continue
+                code = "%s()" % (
+                    "%s.%s" % (base, name) if base else name
+                )
+            elif name == "deque" and base in ("collections", None):
+                if any(kw.arg == "maxlen" for kw in node.keywords):
+                    continue
+                if len(node.args) >= 2:  # deque(iterable, maxlen)
+                    continue
+                code = "%s()" % (
+                    "%s.%s" % (base, name) if base else name
+                )
+            else:
+                continue
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.path,
+                    line=node.lineno,
+                    symbol=scope,
+                    code=code,
+                    message=(
+                        "unbounded queue on the serving path: %s has no "
+                        "size bound, so overload becomes unbounded "
+                        "latency/memory instead of a shed request; pass "
+                        "maxsize/maxlen (the admission depth knob)" % code
+                    ),
+                )
+            )
+    return findings

@@ -88,7 +88,158 @@ def evaluate(parsed):
 
 
 def predict(parsed):
+    if getattr(parsed, "serving_addr", ""):
+        return _predict_online(parsed)
+    if not parsed.checkpoint_dir_for_init:
+        raise ValueError(
+            "predict needs --checkpoint_dir_for_init (batch job) or "
+            "--serving_addr (online, against a live serving role)"
+        )
     return _submit_job(parsed, "predict")
+
+
+def _predict_online(parsed):
+    """Stream the prediction data through a LIVE serving role's
+    Predict RPC (ISSUE 8) — no job submission, no cluster, no
+    checkpoint restore: the serving tier already holds the model. Rows
+    route through the model-zoo ``dataset_fn`` exactly like the batch
+    path, land on ``PredictionOutputsProcessor`` when the module
+    defines one, and are returned as a list of per-batch output
+    arrays (the LocalExecutor.predict contract)."""
+    import numpy as np
+
+    from elasticdl_tpu.common.args import (
+        parse_params_string,
+        symbol_overrides_from_args,
+    )
+    from elasticdl_tpu.data.pipeline import batch_real_count
+    from elasticdl_tpu.data.readers import create_data_reader
+    from elasticdl_tpu.models.registry import get_model_spec
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.serve.client import ServeClient
+
+    spec = get_model_spec(
+        parsed.model_zoo,
+        model_def=parsed.model_def,
+        model_params=parsed.model_params,
+        symbol_overrides=symbol_overrides_from_args(parsed),
+    )
+    reader = create_data_reader(
+        parsed.prediction_data,
+        **parse_params_string(parsed.data_reader_params),
+    )
+
+    def records():
+        for shard_name, (start, count) in reader.create_shards().items():
+            task = pb.Task(
+                shard_name=shard_name, start=start, end=start + count
+            )
+            yield from reader.read_records(task)
+
+    from elasticdl_tpu.data.pipeline import Dataset
+
+    client = ServeClient(parsed.serving_addr)
+    # the server rejects requests larger than its compiled batch shape
+    # (INVALID_ARGUMENT), so clamp our batching to its advertised cap —
+    # --minibatch_size's default (64) exceeds the serve default (32)
+    batch_size = parsed.minibatch_size
+    server_max = client.model_info().get("max_batch", 0)
+    if server_max and server_max < batch_size:
+        logger.info(
+            "clamping --minibatch_size %d to the serving role's "
+            "max_batch %d", batch_size, server_max,
+        )
+        batch_size = server_max
+    dataset = spec.dataset_fn(
+        Dataset(records), "prediction", reader.metadata
+    ).batch(batch_size)
+    processor_cls = spec.prediction_outputs_processor
+    processor = processor_cls() if processor_cls else None
+    results = []
+    try:
+        for batch in dataset:
+            real = batch_real_count(batch)
+            features = batch["features"]
+            if isinstance(features, dict):
+                features = {
+                    k: np.asarray(v)[:real] for k, v in features.items()
+                }
+            else:
+                features = np.asarray(features)[:real]
+            outputs, _, _ = client.predict(features)
+            if processor is not None:
+                processor.process(outputs, 0)
+            results.append(outputs["output"])
+        if processor is not None and hasattr(processor, "close"):
+            processor.close()
+    finally:
+        client.close()
+    logger.info(
+        "served %d prediction batches through %s",
+        len(results), parsed.serving_addr,
+    )
+    return results
+
+
+def serve(parsed):
+    """Submit the online serving role's pod (or dump YAML): the
+    ``elasticdl predict`` job type grown into a long-running
+    low-latency tier (docs/SERVING.md)."""
+    command = [
+        "python", "-m", "elasticdl_tpu.serve.main",
+        "--serve_id=0",
+        "--port=%d" % parsed.port,
+        "--model_zoo=%s" % parsed.model_zoo,
+        "--export_dir=%s" % parsed.export_dir,
+    ]
+    for flag in ("model_def", "model_params", "ps_addrs", "master_addr",
+                 "compute_dtype"):
+        value = getattr(parsed, flag, "")
+        if value:
+            command.append("--%s=%s" % (flag, value))
+    if parsed.max_batch:
+        command.append("--max_batch=%d" % parsed.max_batch)
+    if parsed.max_delay_ms >= 0:
+        command.append("--max_delay_ms=%s" % parsed.max_delay_ms)
+    if parsed.queue_depth:
+        command.append("--queue_depth=%d" % parsed.queue_depth)
+    if parsed.deadline_ms >= 0:
+        command.append("--deadline_ms=%s" % parsed.deadline_ms)
+    if parsed.metrics_port:
+        command.append("--metrics_port=%d" % parsed.metrics_port)
+
+    from elasticdl_tpu.k8s.client import Client
+
+    api = _make_api(parsed)
+    client = Client(
+        api,
+        parsed.job_name,
+        image_name=parsed.image_name,
+        cluster_spec=getattr(parsed, "cluster_spec", ""),
+    )
+    manifest = client.build_pod_manifest(
+        "elasticdl-%s-serve-0" % parsed.job_name,
+        "serve",
+        0,
+        command,
+        resource_requests=client_args.parse_resource_string(
+            parsed.worker_resource_request
+        ),
+        resource_limits=client_args.parse_resource_string(
+            parsed.worker_resource_limit
+        )
+        or None,
+        env=client_args.parse_envs_string(parsed.envs),
+        restart_policy="Always",  # a serving pod is a long-running tier
+        priority_class=parsed.worker_pod_priority or None,
+        volumes=client_args.parse_volume_string(parsed.volume),
+        image_pull_policy=parsed.image_pull_policy or None,
+    )
+    return _emit_or_submit(
+        parsed, api, manifest, "serve",
+        "Submitted serving role for job %s on port %d"
+        % (parsed.job_name, parsed.port),
+    )
 
 
 def _submit_job(parsed, job_kind):
@@ -146,23 +297,27 @@ def _submit_job(parsed, job_kind):
         volumes=client_args.parse_volume_string(parsed.volume),
         image_pull_policy=parsed.image_pull_policy or None,
     )
+    return _emit_or_submit(
+        parsed, api, manifest, "master",
+        "Submitted %s job %s (master pod %s)"
+        % (job_kind, parsed.job_name, client.get_master_pod_name()),
+    )
+
+
+def _emit_or_submit(parsed, api, manifest, what, submitted_msg):
+    """Shared tail of every pod-submitting command: dump the manifest
+    (--dry_run prints, --yaml writes) or create the pod for real."""
     if parsed.dry_run or parsed.yaml:
         text = yaml.safe_dump(manifest, sort_keys=False)
         if parsed.yaml:
             with open(parsed.yaml, "w") as f:
                 f.write(text)
-            logger.info("Wrote master pod manifest to %s", parsed.yaml)
+            logger.info("Wrote %s pod manifest to %s", what, parsed.yaml)
         else:
             print(text)
         return manifest
-    api_obj = client._api  # real submission path
-    api_obj.create_pod(manifest)
-    logger.info(
-        "Submitted %s job %s (master pod %s)",
-        job_kind,
-        parsed.job_name,
-        client.get_master_pod_name(),
-    )
+    api.create_pod(manifest)
+    logger.info(submitted_msg)
     return manifest
 
 

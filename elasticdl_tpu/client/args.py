@@ -213,12 +213,45 @@ def add_predict_arguments(parser):
     parser.add_argument("--data_reader_params", default="")
     parser.add_argument("--minibatch_size", type=int, default=64)
     parser.add_argument("--records_per_task", type=int, default=1024)
-    parser.add_argument("--checkpoint_dir_for_init", required=True)
+    # required for the batch-job path; the online path
+    # (--serving_addr) restores nothing client-side, so the check is
+    # deferred to api.predict
+    parser.add_argument("--checkpoint_dir_for_init", default="")
     parser.add_argument("--compute_dtype", default="bfloat16")
     parser.add_argument(
         "--num_minibatches_per_task", type=int, default=0
     )
+    parser.add_argument(
+        "--serving_addr",
+        default="",
+        help="host:port of a live serving role (ISSUE 8): stream the "
+        "prediction data through its Predict RPC instead of submitting "
+        "a batch prediction job",
+    )
     _add_model_symbol_and_log_arguments(parser)
+
+
+def add_serve_arguments(parser):
+    """``edl serve``: submit the online serving role (ISSUE 8) —
+    loads a train/export.py artifact, serves Predict, hot-swaps new
+    export versions with zero downtime (docs/SERVING.md)."""
+    parser.add_argument("--model_zoo", required=True)
+    parser.add_argument("--model_def", default="")
+    parser.add_argument("--model_params", default="")
+    parser.add_argument(
+        "--export_dir", required=True,
+        help="train/export.py artifact directory (typically a shared "
+        "volume the training job exports into)",
+    )
+    parser.add_argument("--ps_addrs", default="")
+    parser.add_argument("--master_addr", default="")
+    parser.add_argument("--port", type=int, default=50052)
+    parser.add_argument("--compute_dtype", default="")
+    parser.add_argument("--max_batch", type=int, default=0)
+    parser.add_argument("--max_delay_ms", type=float, default=-1.0)
+    parser.add_argument("--queue_depth", type=int, default=0)
+    parser.add_argument("--deadline_ms", type=float, default=-1.0)
+    parser.add_argument("--metrics_port", type=int, default=0)
 
 
 # flags that belong to the client only and must NOT be forwarded to the
@@ -227,6 +260,8 @@ _CLIENT_ONLY = {
     "namespace",
     "dry_run",
     "yaml",
+    # online-predict mode runs entirely client-side (api.predict)
+    "serving_addr",
     "docker_base_url",
     "docker_tlscert",
     "docker_tlskey",

@@ -39,10 +39,24 @@ def build_parser():
     client_args.add_evaluate_arguments(p)
     p.set_defaults(func=api.evaluate)
 
-    p = subparsers.add_parser("predict")
+    p = subparsers.add_parser(
+        "predict",
+        help="batch prediction job, or --serving_addr for online "
+        "predictions against a live serving role",
+    )
     client_args.add_common_arguments(p)
     client_args.add_predict_arguments(p)
     p.set_defaults(func=api.predict)
+
+    p = subparsers.add_parser(
+        "serve",
+        help="long-running online serving role over a train export "
+        "(micro-batched Predict RPC, zero-downtime version swap; "
+        "docs/SERVING.md)",
+    )
+    client_args.add_common_arguments(p)
+    client_args.add_serve_arguments(p)
+    p.set_defaults(func=api.serve)
 
     return parser
 

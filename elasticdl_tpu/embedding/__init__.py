@@ -1,0 +1,12 @@
+"""Reusable embedding pull/cache stack (ISSUE 8).
+
+Extracted from the worker's training preparer so that consumers outside
+the training loop — the online serving tier first — ride the exact same
+fused ``pull_embedding_batch`` + ``HotRowCache`` code path the worker
+trains through, instead of forking it.
+"""
+
+from elasticdl_tpu.embedding.client import (  # noqa: F401
+    EmbeddingClient,
+    HotRowCache,
+)

@@ -1,0 +1,323 @@
+"""Embedding pull/cache stack, shared by training and serving.
+
+This is the worker's pre-step pull path (ISSUE 5's fused
+``pull_embedding_batch``, fronted by the ``HotRowCache``) extracted
+into a library usable outside the training loop (ROADMAP item 2's
+refactor). ``train/sparse.SparseBatchPreparer`` delegates here for the
+training path; the serving tier (``elasticdl_tpu/serve``) resolves its
+requests' sparse features through the same client — one pull/cache
+stack, no fork.
+
+Two cache disciplines, because the two consumers have different
+threading realities:
+
+- **Training** (the preparer): a logical prepare-counter clock.
+  Exactly one thread ever mutates the cache (the pulling thread;
+  train_stream serializes prepares on one lookahead thread), and
+  PS-relaunch invalidation is *deferred* to that thread
+  (``SparseBatchPreparer._cache_dirty``) because the detection can fire
+  on the async-push executor.
+- **Serving** (read-only, ``thread_safe=True`` + ``ttl_secs``): there
+  is no push thread bounding row staleness, so freshness is wall-clock
+  TTL, and batcher/warmer/watcher threads may hit the cache
+  concurrently — every operation takes the cache lock, and a PS
+  restored-stamp change may invalidate from ANY thread mid-read
+  (regression-tested in tests/test_embedding_client.py).
+"""
+
+import concurrent.futures
+import contextlib
+import threading
+import time
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.embedding.client")
+
+
+class HotRowCache:
+    """Bounded-staleness host cache of pulled embedding rows.
+
+    The sparse analogue of the reference's ``get_model_steps``
+    amortization (worker.py:287-295, which trained local steps between
+    PS syncs): a pulled row may be reused for up to ``staleness``
+    subsequent prepares even though pushes have since updated it on the
+    PS. CTR id distributions are Zipfian — the hot ids recur in every
+    batch — so this removes most pull bytes. Only sound against the
+    async PS (whose training already tolerates stale rows by design);
+    keep it disabled under the sync PS, where stale rows would be
+    version-rejected anyway.
+
+    ``ttl_secs`` switches the clock from logical prepares to wall-clock
+    seconds (``staleness`` is then ignored): the serving tier has no
+    prepare cadence, so "how stale may a served row be" is a time
+    budget. ``thread_safe`` wraps every operation in a lock for
+    consumers with concurrent readers and cross-thread invalidation
+    (serving); the training preparer keeps the lock-free single-writer
+    contract and its deferred-clear discipline.
+    """
+
+    def __init__(self, staleness=1, capacity=1_000_000, ttl_secs=None,
+                 thread_safe=False):
+        if ttl_secs is None and staleness < 1:
+            raise ValueError("staleness must be >= 1")
+        if ttl_secs is not None and ttl_secs <= 0:
+            raise ValueError("ttl_secs must be > 0")
+        self.staleness = int(staleness)
+        self.capacity = int(capacity)
+        self.ttl_secs = ttl_secs
+        self._clock = 0
+        # name -> (sorted ids [n], rows [n, dim], pull stamps [n]);
+        # vectorized (searchsorted/merge) — per-id dict loops cost
+        # ~10 ms/step at CTR batch sizes
+        self._tables = {}
+        self.hits = 0
+        self.misses = 0
+        self._lock = (
+            threading.RLock() if thread_safe else contextlib.nullcontext()
+        )
+
+    def _now(self):
+        if self.ttl_secs is not None:
+            return time.monotonic()
+        return self._clock
+
+    def _horizon(self):
+        """Oldest stamp still fresh at this instant."""
+        if self.ttl_secs is not None:
+            return time.monotonic() - self.ttl_secs
+        return self._clock - self.staleness
+
+    def advance(self):
+        """Tick the logical clock (one call per prepare); no-op under a
+        wall-clock TTL, where time advances itself."""
+        if self.ttl_secs is None:
+            self._clock += 1
+
+    def split(self, name, unique):
+        """Partition ``unique`` (sorted) ids into fresh-cached and
+        to-pull.
+
+        Returns (cached_mask [n] bool, cached_rows [hits, dim] or None).
+        """
+        with self._lock:
+            entry = self._tables.get(name)
+            if entry is None:
+                self.misses += int(unique.size)
+                return np.zeros(unique.shape, dtype=bool), None
+            ids, rows, stamps = entry
+            pos = np.searchsorted(ids, unique)
+            pos_clipped = np.minimum(pos, max(ids.size - 1, 0))
+            found = (pos < ids.size) & (ids[pos_clipped] == unique)
+            # stamp records PULL time, not last use: staleness bounds
+            # the age of the VALUE, so a hit must not refresh it. >= so
+            # that staleness=1 reuses a row for exactly one subsequent
+            # prepare (the documented "up to `staleness` subsequent
+            # prepares")
+            fresh = found & (stamps[pos_clipped] >= self._horizon())
+            n_hit = int(fresh.sum())
+            self.hits += n_hit
+            self.misses += int(unique.size) - n_hit
+            if n_hit == 0:
+                return np.zeros(unique.shape, dtype=bool), None
+            return fresh, rows[pos_clipped[fresh]]
+
+    def clear(self):
+        """Invalidate every cached row (e.g. the PS they were pulled
+        from relaunched); hit/miss tallies are kept."""
+        with self._lock:
+            self._tables.clear()
+
+    def hit_rate(self):
+        """Lifetime hit fraction (0.0 before any traffic)."""
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def put(self, name, new_ids, new_rows):
+        new_ids = np.asarray(new_ids, dtype=np.int64)
+        new_rows = np.asarray(new_rows, dtype=np.float32)
+        if new_ids.size and np.any(np.diff(new_ids) <= 0):
+            # callers normally pass np.unique output; normalize otherwise
+            new_ids, first = np.unique(new_ids, return_index=True)
+            new_rows = new_rows[first]
+        stamp_dtype = np.float64 if self.ttl_secs is not None else np.int64
+        with self._lock:
+            new_stamps = np.full(new_ids.shape, self._now(),
+                                 dtype=stamp_dtype)
+            entry = self._tables.get(name)
+            if entry is not None:
+                old_ids, old_rows, old_stamps = entry
+                # new entries win on duplicate ids (unique keeps the
+                # first occurrence per id, so concatenate new-first)
+                all_ids = np.concatenate([new_ids, old_ids])
+                merged, first = np.unique(all_ids, return_index=True)
+                all_rows = np.concatenate([new_rows, old_rows], axis=0)
+                all_stamps = np.concatenate([new_stamps, old_stamps])
+                new_ids = merged  # np.unique returns sorted ids
+                new_rows = all_rows[first]
+                new_stamps = all_stamps[first].astype(stamp_dtype)
+            if new_ids.size > self.capacity:
+                # evict the oldest pulls (and, implicitly, everything
+                # already past staleness)
+                keep = np.argpartition(
+                    -new_stamps, self.capacity - 1
+                )[: self.capacity]
+                keep.sort()  # restore sorted-id order after partition
+                new_ids = new_ids[keep]
+                new_rows = new_rows[keep]
+                new_stamps = new_stamps[keep]
+            self._tables[name] = (new_ids, new_rows, new_stamps)
+
+
+def _rows_f32(values):
+    values = np.asarray(values)
+    if values.dtype != np.float32:
+        return values.astype(np.float32)
+    return values
+
+
+class EmbeddingClient:
+    """Pulls embedding rows through an optional ``HotRowCache``, riding
+    the fused multi-table RPC when the PS client serves it.
+
+    ``ps_client`` is anything with ``pull_embedding_vectors(name, ids)``
+    (``worker.PSClient``, ``ps.LocalPSClient``); a client that also has
+    ``pull_embedding_batch`` gets all tables' cache misses in one RPC
+    per PS shard. ``read_only=True`` declares the consumer never pushes
+    (serving): it is purely an assertion hook today — pulls are the
+    only RPCs this class makes either way — but lets the serving tier
+    state its contract in code.
+    """
+
+    def __init__(self, ps_client, cache=None, read_only=False):
+        self._ps = ps_client
+        self._cache = cache
+        self.read_only = bool(read_only)
+        # table-level fan-out pool for clients without the fused batch
+        # pull; created only if that path ever runs
+        self._table_pool = None
+        self._pool_lock = threading.Lock()
+
+    @property
+    def ps_num(self):
+        return getattr(self._ps, "ps_num", 1)
+
+    @property
+    def ps_client(self):
+        return self._ps
+
+    @property
+    def cache(self):
+        return self._cache
+
+    def advance(self):
+        """Tick the cache's logical clock (training: once per prepare)."""
+        if self._cache is not None:
+            self._cache.advance()
+
+    def invalidate(self):
+        """Drop every cached row — the backing PS restarted, so cached
+        values no longer reflect its store. Thread-safe when the cache
+        was built ``thread_safe=True`` (serving); the training preparer
+        calls this only from its pulling thread (deferred-clear
+        discipline, see SparseBatchPreparer._cache_dirty)."""
+        if self._cache is not None:
+            self._cache.clear()
+
+    def hit_rate(self):
+        return self._cache.hit_rate() if self._cache is not None else 0.0
+
+    # ------------------------------------------------------------------
+    def _assemble(self, name, unique, cached_mask, cached_rows, fetched):
+        """Merge cache hits and one fresh fetch into [n_unique, dim]
+        fp32, recording the fetched rows in the cache. The single home
+        of the cache-fill protocol — the per-table and batched pull
+        paths both end here, so a staleness/fill rule change cannot
+        fork between them."""
+        if cached_rows is not None:
+            dim = cached_rows.shape[1]
+        else:
+            dim = np.asarray(fetched).shape[1]
+        rows = np.empty((unique.size, dim), dtype=np.float32)
+        if cached_rows is not None:
+            rows[cached_mask] = cached_rows
+        missing = unique[~cached_mask]
+        if missing.size:
+            fetched = _rows_f32(fetched)
+            rows[~cached_mask] = fetched
+            self._cache.put(name, missing, fetched)
+        return rows
+
+    def pull(self, name, unique):
+        """Rows for one table's unique ids, consulting the cache;
+        returns [n_unique, dim] float32."""
+        unique = np.asarray(unique, dtype=np.int64)
+        if self._cache is None:
+            return _rows_f32(self._ps.pull_embedding_vectors(name, unique))
+        cached_mask, cached_rows = self._cache.split(name, unique)
+        missing = unique[~cached_mask]
+        fetched = None
+        if missing.size:
+            fetched = self._ps.pull_embedding_vectors(name, missing)
+        return self._assemble(name, unique, cached_mask, cached_rows,
+                              fetched)
+
+    def _fan_out(self, ids_by_table):
+        """Per-table thread fan-out for clients without the fused batch
+        pull, so an old server still gets table-level concurrency."""
+        if len(ids_by_table) == 1:
+            name, ids = next(iter(ids_by_table.items()))
+            return {name: self.pull(name, ids)}
+        with self._pool_lock:
+            if self._table_pool is None:
+                self._table_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=max(4, len(ids_by_table)),
+                    thread_name_prefix="emb-table-pull",
+                )
+            pool = self._table_pool
+        futures = {
+            name: pool.submit(self.pull, name, ids)
+            for name, ids in ids_by_table.items()
+        }
+        return {name: future.result() for name, future in futures.items()}
+
+    def pull_tables(self, ids_by_table):
+        """``{table: unique int64 ids}`` in, ``{table: rows [n, dim]
+        float32}`` out (row order matches each table's input ids).
+        Every table's cache misses ride ONE fused
+        ``pull_embedding_batch`` call — ps_num RPCs for the whole set
+        instead of tables x ps_num — against a batch-capable client;
+        otherwise the per-table fan-out."""
+        ids_by_table = {
+            name: np.asarray(ids, dtype=np.int64)
+            for name, ids in ids_by_table.items()
+            if np.asarray(ids).size
+        }
+        if not ids_by_table:
+            return {}
+        batch_pull = getattr(self._ps, "pull_embedding_batch", None)
+        if batch_pull is None:
+            return self._fan_out(ids_by_table)
+        if self._cache is None:
+            fetched = batch_pull(ids_by_table)
+            return {
+                name: _rows_f32(fetched[name]) for name in ids_by_table
+            }
+        to_pull = {}
+        cache_parts = {}  # name -> (cached_mask, cached_rows)
+        for name, unique in ids_by_table.items():
+            cached_mask, cached_rows = self._cache.split(name, unique)
+            cache_parts[name] = (cached_mask, cached_rows)
+            missing = unique[~cached_mask]
+            if missing.size:
+                to_pull[name] = missing
+        fetched = batch_pull(to_pull) if to_pull else {}
+        out = {}
+        for name, unique in ids_by_table.items():
+            cached_mask, cached_rows = cache_parts[name]
+            out[name] = self._assemble(
+                name, unique, cached_mask, cached_rows, fetched.get(name)
+            )
+        return out

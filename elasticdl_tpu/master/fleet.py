@@ -181,6 +181,12 @@ class FleetMonitor:
                 "tier_hits": int(blob.tier_hits),
                 "tier_misses": int(blob.tier_misses),
                 "tier_evictions": int(blob.tier_evictions),
+                # online serving tier (ISSUE 8): the serve role's
+                # 5 s poll puts the inference side next to the
+                # training side in /statusz
+                "serve_qps": round(float(blob.serve_qps), 2),
+                "serve_queue_depth": int(blob.serve_queue_depth),
+                "serve_shed_total": int(blob.serve_shed_total),
             }
             # stuck-round bookkeeping: the clock restarts whenever the
             # fill grows or the store version advances

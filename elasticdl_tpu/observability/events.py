@@ -95,6 +95,18 @@ EVENT_TYPES = frozenset({
     # fleet detectors (+ alert, target)
     "alert_raised",
     "alert_cleared",
+    # online serving tier (ISSUE 8)
+    "model_loaded",          # serve role loaded its first export
+                             #   (+ step, stamp, path)
+    "version_swapped",       # hot swap completed; in-flight requests
+                             #   finished on the old version
+                             #   (+ from_step, to_step, stamp)
+    "requests_shed",         # admission control shed load — RATE-
+                             #   LIMITED to ~1 line/s (+ reason, count
+                             #   since last line, total)
+    "serve_drained",         # SIGTERM drain: admissions stopped, queue
+                             #   flushed (+ reason, flushed, served,
+                             #   shed)
 })
 
 

@@ -47,6 +47,8 @@ def _known_methods():
         ("Master", name) for name in services._MASTER_METHODS
     ] + [
         ("Pserver", name) for name in services._PSERVER_METHODS
+    ] + [
+        ("Serve", name) for name in services._SERVE_METHODS
     ]
 
 

@@ -14,6 +14,7 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 _MASTER_SERVICE = "elasticdl_tpu.Master"
 _PSERVER_SERVICE = "elasticdl_tpu.Pserver"
+_SERVE_SERVICE = "elasticdl_tpu.Serve"
 
 # method name -> (request class, response class)
 _MASTER_METHODS = {
@@ -59,6 +60,17 @@ _PSERVER_METHODS = {
     "push_embedding_rows": (pb.Model, pb.PushGradientsResponse),
 }
 
+# Online serving tier (ISSUE 8): a serve role loads an exported model
+# and answers Predict over the same wire stack. predict rides the
+# admission-controlled micro-batcher (RESOURCE_EXHAUSTED when the
+# bounded queue sheds, DEADLINE_EXCEEDED when a request's budget
+# expires while queued); model_info answers the loaded artifact's
+# identity (the hot-swap contract's observable).
+_SERVE_METHODS = {
+    "predict": (pb.PredictRequest, pb.PredictResponse),
+    "model_info": (pb.Empty, pb.ModelInfoResponse),
+}
+
 
 class _Stub:
     """Builds unary-unary callables for each method of a service."""
@@ -86,6 +98,11 @@ class PserverStub(_Stub):
         super().__init__(channel, _PSERVER_SERVICE, _PSERVER_METHODS)
 
 
+class ServeStub(_Stub):
+    def __init__(self, channel):
+        super().__init__(channel, _SERVE_SERVICE, _SERVE_METHODS)
+
+
 def _add_service(server, servicer, service_name, methods):
     handlers = {}
     for name, (req_cls, resp_cls) in methods.items():
@@ -105,3 +122,7 @@ def add_master_servicer_to_server(servicer, server):
 
 def add_pserver_servicer_to_server(servicer, server):
     _add_service(server, servicer, _PSERVER_SERVICE, _PSERVER_METHODS)
+
+
+def add_serve_servicer_to_server(servicer, server):
+    _add_service(server, servicer, _SERVE_SERVICE, _SERVE_METHODS)

@@ -1,0 +1,23 @@
+"""Online serving tier (ISSUE 8): the fourth role.
+
+``elasticdl train`` produces an export (``train/export.py``); this
+package serves it — the ``elasticdl predict`` job type of the reference
+(PAPER.md L8) grown into a low-latency online tier:
+
+- ``model.py``    — load an export, re-apply the model-zoo module,
+  resolve sparse features through the extracted embedding client
+  (``elasticdl_tpu/embedding``) against the live PS, one jitted
+  forward.
+- ``batcher.py``  — admission-controlled micro-batching: bounded queue
+  with load shedding, max-size-or-max-delay batch formation,
+  per-request deadlines honored (a late request is shed, never served
+  late).
+- ``engine.py``   — model-version lifecycle: export watcher, background
+  warm-up, atomic hot swap (in-flight requests finish on the version
+  that admitted them).
+- ``servicer.py`` / ``client.py`` — the gRPC Predict surface.
+- ``main.py``     — the role entry point (probes, flight recorder,
+  SIGTERM graceful drain, optional fleet-telemetry piggyback).
+
+See docs/SERVING.md for topology and knobs.
+"""

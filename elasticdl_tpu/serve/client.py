@@ -1,0 +1,67 @@
+"""Client for the Serve service: the ``edl predict --serving_addr``
+path, the bench load generator, and tests all speak through this."""
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.common.grpc_utils import build_channel
+from elasticdl_tpu.common.tensor_utils import blob_to_ndarray, ndarray_to_blob
+from elasticdl_tpu.observability.grpc_metrics import instrument_channel
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.services import ServeStub
+from elasticdl_tpu.serve.model import SINGLE_INPUT_KEY
+
+
+class ServeClient:
+    def __init__(self, addr):
+        self._channel = instrument_channel(build_channel(addr))
+        self._stub = ServeStub(self._channel)
+
+    def predict(self, features, deadline_secs=None, deadline_ms=0):
+        """``features``: dict of batch-leading arrays, or one bare
+        array (single-input models). Returns (outputs dict, model
+        step, model stamp). ``deadline_secs`` sets the gRPC deadline;
+        ``deadline_ms`` rides in-message. The server sheds (never
+        serves late) a request that outlives the TIGHTER of the two —
+        so deadline_ms is honored even under this client's default
+        transport timeout."""
+        request = pb.PredictRequest(deadline_ms=int(deadline_ms))
+        if not isinstance(features, dict):
+            features = {SINGLE_INPUT_KEY: features}
+        for name, value in features.items():
+            ndarray_to_blob(np.asarray(value), request.features[name])
+        response = self._stub.predict(
+            request,
+            timeout=(
+                deadline_secs if deadline_secs is not None
+                else GRPC.DEFAULT_RPC_TIMEOUT_SECS
+            ),
+        )
+        outputs = {
+            name: blob_to_ndarray(blob)
+            for name, blob in response.outputs.items()
+        }
+        return outputs, response.model_step, response.model_stamp
+
+    def model_info(self):
+        response = self._stub.model_info(
+            pb.Empty(), timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+        )
+        return {
+            "loaded": response.loaded,
+            "step": response.step,
+            "stamp": response.stamp,
+            "model_zoo": response.model_zoo,
+            # 0 from a pre-ISSUE-8-review server: treat as unknown
+            "max_batch": response.max_batch,
+        }
+
+    def close(self):
+        self._channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,297 @@
+"""Model-version lifecycle: load, watch, warm, atomically swap.
+
+The engine owns the active ``ServingModel`` reference and the shared
+embedding cache. A watcher thread polls the export artifact's signature
+(``EDL_SERVE_WATCH_SECS``); when it changes, the replacement version is
+built and WARMED in the background — export load, jit compile, one
+template predict — while the active version keeps serving, then swapped
+in with one reference assignment. A batch that already entered
+``_run_batch`` holds its own model reference, so in-flight requests
+finish on the version that admitted them and none fail across a swap
+(the bench hard-gates this).
+
+A PS relaunch (restored-stamp change on the pull path, the PR 4/6
+identity machinery) invalidates the shared cache from whatever thread
+detected it — the cache is built ``thread_safe=True`` for exactly this.
+"""
+
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.embedding import HotRowCache
+from elasticdl_tpu.models.registry import get_model_spec
+from elasticdl_tpu.observability import events, metrics
+from elasticdl_tpu.serve.batcher import MicroBatcher, _env_num
+from elasticdl_tpu.serve.model import ServingModel, export_signature
+
+logger = _logger_factory("elasticdl_tpu.serve.engine")
+
+WATCH_SECS_ENV = "EDL_SERVE_WATCH_SECS"
+CACHE_TTL_ENV = "EDL_SERVE_CACHE_TTL_SECS"
+
+# requests_shed journal lines are rate-limited to one per window: under
+# real overload sheds arrive at request rate, and a write-through
+# journal line per shed would amplify exactly the pressure shedding
+# exists to relieve
+_SHED_EVENT_WINDOW_SECS = 1.0
+
+
+class ServingEngine:
+    def __init__(self, model_zoo, export_dir, ps_client=None,
+                 model_def="", model_params="", symbol_overrides=None,
+                 compute_dtype=None, max_batch=None, max_delay_ms=None,
+                 queue_depth=None, deadline_ms=None, cache_ttl_secs=None,
+                 cache_capacity=1_000_000, watch_secs=None,
+                 registry=None):
+        self.model_zoo = model_zoo
+        self.export_dir = export_dir
+        self._ps = ps_client
+        self._compute_dtype = compute_dtype
+        self.spec = get_model_spec(
+            model_zoo, model_def=model_def, model_params=model_params,
+            symbol_overrides=symbol_overrides,
+        )
+        if cache_ttl_secs is None:
+            cache_ttl_secs = _env_num(CACHE_TTL_ENV, 2.0, float)
+        self.cache = None
+        if (
+            self.spec.sparse_embedding_specs
+            and ps_client is not None
+            and cache_ttl_secs > 0
+        ):
+            # serving has no push thread bounding row age, so freshness
+            # is wall-clock TTL; thread_safe because batcher, warmer
+            # and the PS-restart hook all touch it
+            self.cache = HotRowCache(
+                capacity=cache_capacity,
+                ttl_secs=cache_ttl_secs,
+                thread_safe=True,
+            )
+        if watch_secs is None:
+            watch_secs = _env_num(WATCH_SECS_ENV, 2.0, float)
+        self._watch_secs = float(watch_secs)
+        self._model = None          # the active ServingModel
+        self._swap_lock = threading.Lock()  # serializes load/swap only
+        self._template = None       # (features, rows) of a recent batch
+        self._stopped = threading.Event()
+        self.swaps = 0
+        self._last_shed_event = 0.0
+        self._shed_at_last_event = 0
+        reg = registry or metrics.default_registry()
+        self._m_model_info = reg.gauge(
+            "edl_serve_model_info",
+            "1 for the loaded model version (export step), 0 for "
+            "versions served earlier in this process's life",
+            ("version",),
+        )
+        self._m_swaps = reg.counter(
+            "edl_serve_version_swaps_total",
+            "Completed model-version hot swaps",
+        )
+        self._m_cache_hit_rate = reg.gauge(
+            "edl_serve_cache_hit_rate",
+            "Lifetime hit fraction of the serving embedding row cache",
+        )
+        self.batcher = MicroBatcher(
+            self._run_batch,
+            max_batch=max_batch,
+            max_delay_ms=max_delay_ms,
+            queue_depth=queue_depth,
+            default_deadline_ms=deadline_ms,
+            on_shed=self._on_shed,
+            registry=reg,
+        )
+        # PS-restart identity hook (PR 4/6): chain the engine's shared-
+        # cache invalidation onto whatever hook the client already
+        # carries (a co-resident trainer's, or None). Read-only
+        # preparers never take the hook slot (train/sparse), so one
+        # chain here covers every ServingModel build.
+        self._chain_resync_hook()
+        self._watcher = threading.Thread(
+            target=self._watch_loop, name="edl-serve-watcher", daemon=True
+        )
+
+    # ------------------------------------------------------------------
+    def start(self, block=False):
+        """Try the initial load, then start the export watcher. With
+        ``block`` the call waits for a loadable artifact (tests);
+        otherwise readiness (/readyz) simply stays false until the
+        watcher sees one."""
+        while True:
+            try:
+                self._load_and_swap()
+            except FileNotFoundError:
+                if not block:
+                    logger.info(
+                        "no export at %s yet; serving unready until one "
+                        "appears", self.export_dir,
+                    )
+                    break
+                time.sleep(0.2)
+                continue
+            break
+        self._watcher.start()
+        return self
+
+    @property
+    def loaded(self):
+        return self._model is not None
+
+    @property
+    def model(self):
+        return self._model
+
+    def model_info(self):
+        model = self._model
+        return {
+            "loaded": model is not None,
+            "step": model.step if model is not None else -1,
+            "stamp": model.stamp if model is not None else "",
+            "model_zoo": str(self.model_zoo),
+            "max_batch": self.batcher.max_batch,
+        }
+
+    # ------------------------------------------------------------------
+    def _chain_resync_hook(self):
+        """Wrap whatever resync hook the shared PS client currently
+        carries so a PS relaunch ALSO clears the shared serving cache
+        immediately, from whatever thread detected it. Serving-side
+        (read-only) preparers never install their own hook, so this
+        chain survives every ServingModel build."""
+        if self._ps is None or not hasattr(self._ps, "resync_hook"):
+            return
+        inner = self._ps.resync_hook
+
+        def _chained(shard, _inner=inner):
+            if _inner is not None:
+                _inner(shard)
+            self._on_ps_restart(shard)
+
+        self._ps.resync_hook = _chained
+
+    def _build(self):
+        return ServingModel(
+            self.spec,
+            self.export_dir,
+            max_batch=self.batcher.max_batch,
+            ps_client=self._ps,
+            cache=self.cache,
+            compute_dtype=self._compute_dtype,
+        )
+
+    def _load_and_swap(self):
+        with self._swap_lock:
+            previous = self._model
+            replacement = self._build()
+            if previous is not None and replacement.stamp == previous.stamp:
+                return False
+            # warm BEFORE the swap: the compile (and the cache priming
+            # pull) happens while the old version still takes traffic,
+            # so the swap itself is one reference assignment
+            template = self._template
+            if template is not None:
+                try:
+                    replacement.warm(template[0], template[1])
+                except Exception:
+                    logger.exception(
+                        "warm-up of export %s failed; swapping cold",
+                        replacement.stamp,
+                    )
+            self._model = replacement
+            self._m_model_info.labels(
+                version=str(replacement.step)
+            ).set(1)
+            if previous is not None:
+                self._m_model_info.labels(
+                    version=str(previous.step)
+                ).set(0)
+                self.swaps += 1
+                self._m_swaps.inc()
+                events.emit(
+                    "version_swapped",
+                    from_step=previous.step,
+                    to_step=replacement.step,
+                    stamp=replacement.stamp,
+                )
+                logger.info(
+                    "model version swapped: step %d -> %d (%s)",
+                    previous.step, replacement.step, replacement.stamp,
+                )
+            else:
+                events.emit(
+                    "model_loaded",
+                    step=replacement.step,
+                    stamp=replacement.stamp,
+                    path=str(self.export_dir),
+                )
+                logger.info(
+                    "model loaded: step %d (%s)",
+                    replacement.step, replacement.stamp,
+                )
+            return True
+
+    def _watch_loop(self):
+        while not self._stopped.wait(self._watch_secs):
+            try:
+                signature = export_signature(self.export_dir)
+                model = self._model
+                if signature is None:
+                    continue
+                if model is not None and signature == model.stamp:
+                    continue
+                self._load_and_swap()
+            except Exception:
+                # a torn mid-write artifact read fails here and
+                # succeeds on a later tick; the active version keeps
+                # serving either way
+                logger.exception("export watch tick failed")
+
+    # ------------------------------------------------------------------
+    def _run_batch(self, features, rows):
+        model = self._model  # one read: in-flight batches keep theirs
+        if model is None:
+            raise RuntimeError("no model loaded")
+        # remember a schema template for warming future versions (tiny:
+        # one max_batch-row feature set)
+        if self._template is None:
+            self._template = (features, rows)
+        outputs = model.predict(features, rows)
+        if self.cache is not None:
+            self._m_cache_hit_rate.set(model.embedding_hit_rate)
+        return outputs, model.step, model.stamp
+
+    def predict(self, features, rows, deadline_secs=None):
+        """The servicer's entry: admission -> batch -> forward."""
+        return self.batcher.submit(features, rows, deadline_secs)
+
+    def _on_shed(self, reason, total):
+        now = time.monotonic()
+        if now - self._last_shed_event < _SHED_EVENT_WINDOW_SECS:
+            return
+        shed_since = total - self._shed_at_last_event
+        self._last_shed_event = now
+        self._shed_at_last_event = total
+        events.emit(
+            "requests_shed", reason=reason, count=shed_since, total=total
+        )
+
+    def _on_ps_restart(self, shard):
+        if self.cache is not None:
+            # safe from any thread (thread_safe cache): rows cached
+            # from the dead process must not serve another request
+            self.cache.clear()
+            logger.warning(
+                "PS shard %s relaunched; serving embedding cache dropped",
+                shard,
+            )
+
+    # ------------------------------------------------------------------
+    def drain(self, timeout=30.0):
+        """SIGTERM: stop admitting, flush the queue, stop the watcher.
+        Returns the flushed-request count."""
+        self._stopped.set()
+        flushed = self.batcher.drain(timeout=timeout)
+        if self._watcher.is_alive():
+            self._watcher.join(timeout=self._watch_secs + 1.0)
+        return flushed

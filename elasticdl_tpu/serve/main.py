@@ -1,0 +1,266 @@
+"""Serving role entry point.
+
+Usage: python -m elasticdl_tpu.serve.main --model_zoo=... \
+    --export_dir=/artifacts/model --port=50052 [--ps_addrs=...]
+
+The full platform treatment of the other roles: /metrics /healthz
+/readyz (ready = model loaded), flight-recorder journal, deterministic
+fault injection, SIGTERM graceful drain (stop admitting -> flush the
+queue -> deregister from the journal's point of view -> exit 0), and —
+when a master is running — the same 5 s telemetry piggyback the PS
+rides, so /statusz shows the inference side of the fleet.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.serve.main")
+
+
+def parse_serve_args(argv=None):
+    parser = argparse.ArgumentParser("elasticdl_tpu serve")
+    parser.add_argument("--serve_id", type=int, default=0)
+    parser.add_argument("--port", type=int, default=50052)
+    parser.add_argument("--model_zoo", required=True)
+    parser.add_argument("--model_def", default="")
+    parser.add_argument("--model_params", default="")
+    parser.add_argument(
+        "--export_dir", required=True,
+        help="train/export.py artifact directory (watched for new "
+        "versions; hot-swapped with zero request failures)",
+    )
+    parser.add_argument(
+        "--ps_addrs", default="",
+        help="comma-separated PS addresses for sparse-embedding models",
+    )
+    parser.add_argument(
+        "--master_addr", default="",
+        help="optional: piggyback serving telemetry on the master's "
+        "fleet view (/statusz)",
+    )
+    # must match the training job's compute dtype for prediction parity
+    parser.add_argument("--compute_dtype", default="")
+    parser.add_argument(
+        "--max_batch", type=int, default=0,
+        help="rows per formed batch (0 = EDL_SERVE_MAX_BATCH or 32)",
+    )
+    parser.add_argument(
+        "--max_delay_ms", type=float, default=-1.0,
+        help="batch formation window (<0 = EDL_SERVE_MAX_DELAY_MS or 5)",
+    )
+    parser.add_argument(
+        "--queue_depth", type=int, default=0,
+        help="admission bound; beyond it requests shed "
+        "(0 = EDL_SERVE_QUEUE_DEPTH or 256)",
+    )
+    parser.add_argument(
+        "--deadline_ms", type=float, default=-1.0,
+        help="default per-request budget when the RPC carries none "
+        "(<0 = EDL_SERVE_DEADLINE_MS or 1000)",
+    )
+    parser.add_argument(
+        "--cache_ttl_secs", type=float, default=-1.0,
+        help="embedding row cache TTL (<0 = EDL_SERVE_CACHE_TTL_SECS "
+        "or 2; 0 disables the cache)",
+    )
+    parser.add_argument(
+        "--watch_secs", type=float, default=-1.0,
+        help="export watch interval (<0 = EDL_SERVE_WATCH_SECS or 2)",
+    )
+    parser.add_argument("--metrics_port", type=int, default=0)
+    return parser.parse_args(argv)
+
+
+class ServeRole:
+    def __init__(self, args):
+        from elasticdl_tpu.serve.engine import ServingEngine
+
+        self.args = args
+        ps_client = None
+        if args.ps_addrs:
+            from elasticdl_tpu.worker.ps_client import PSClient
+
+            ps_client = PSClient(args.ps_addrs)
+        self.engine = ServingEngine(
+            args.model_zoo,
+            args.export_dir,
+            ps_client=ps_client,
+            model_def=args.model_def,
+            model_params=args.model_params,
+            compute_dtype=args.compute_dtype or None,
+            max_batch=args.max_batch or None,
+            max_delay_ms=(
+                args.max_delay_ms if args.max_delay_ms >= 0 else None
+            ),
+            queue_depth=args.queue_depth or None,
+            deadline_ms=(
+                args.deadline_ms if args.deadline_ms >= 0 else None
+            ),
+            cache_ttl_secs=(
+                args.cache_ttl_secs if args.cache_ttl_secs >= 0 else None
+            ),
+            watch_secs=args.watch_secs if args.watch_secs >= 0 else None,
+        )
+        self._master_client = None
+        if args.master_addr:
+            from elasticdl_tpu.worker.master_client import MasterClient
+
+            # worker_host="": the serve role is not a mesh member; the
+            # negative id namespace keeps it out of the worker id space
+            # (the PS uses -(ps_id+1); serving sits below at -1000)
+            self._master_client = MasterClient(
+                args.master_addr,
+                worker_id=-(1000 + args.serve_id),
+                worker_host="",
+            )
+            if os.environ.get("EDL_TELEMETRY", "") != "0":
+                self._master_client.telemetry_provider = self.telemetry_blob
+        self.server = None
+        self.observability = None
+        self._drained = threading.Event()
+        self._qps_window = (time.monotonic(), 0)  # (ts, served_total)
+
+    def telemetry_blob(self):
+        from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+        batcher = self.engine.batcher
+        now = time.monotonic()
+        last_ts, last_served = self._qps_window
+        served = batcher.served_total
+        elapsed = max(now - last_ts, 1e-6)
+        self._qps_window = (now, served)
+        info = self.engine.model_info()
+        return pb.TelemetryBlob(
+            role="serve-%d" % self.args.serve_id,
+            serve_qps=(served - last_served) / elapsed,
+            serve_queue_depth=batcher.pending_count(),
+            serve_shed_total=batcher.shed_total,
+            model_version=max(info["step"], 0),
+            tier_hit_rate=(
+                self.engine.cache.hit_rate()
+                if self.engine.cache is not None
+                else 0.0
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    def prepare(self):
+        from elasticdl_tpu.common.grpc_utils import build_server
+        from elasticdl_tpu.observability import events, http_server, trace
+        from elasticdl_tpu.proto.services import (
+            add_serve_servicer_to_server,
+        )
+        from elasticdl_tpu.serve.servicer import ServeServicer
+
+        role = "serve-%d" % self.args.serve_id
+        trace.configure(role)
+        events.configure(role)
+        events.emit("role_start", port=self.args.port)
+        self.engine.start()
+        self.server = build_server()
+        add_serve_servicer_to_server(ServeServicer(self.engine), self.server)
+        self.server.add_insecure_port("[::]:%d" % self.args.port)
+        self.server.start()
+        self.observability = http_server.maybe_start(
+            role, cli_port=self.args.metrics_port
+        )
+        if self.observability is not None:
+            # readiness milestone: a loaded model — before it, predict
+            # answers FAILED_PRECONDITION and the pod must hold traffic
+            self.observability.add_readiness_check(
+                "model_loaded", lambda: self.engine.loaded
+            )
+        self._install_sigterm_drain()
+        logger.info(
+            "serve %d on :%d (export %s)",
+            self.args.serve_id, self.args.port, self.args.export_dir,
+        )
+        return self
+
+    def _install_sigterm_drain(self):
+        previous = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            self.drain(reason="sigterm")
+            if callable(previous):
+                previous(signum, frame)
+            else:
+                sys.exit(0)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            logger.warning(
+                "not on main thread; serve SIGTERM drain not installed"
+            )
+
+    def drain(self, reason="shutdown"):
+        """Stop admitting, flush the queue, stop the server. Idempotent
+        (the SIGTERM handler and an orderly exit may both arrive)."""
+        from elasticdl_tpu.observability import events
+
+        if self._drained.is_set():
+            return
+        self._drained.set()
+        flushed = self.engine.drain()
+        try:
+            if self.server is not None:
+                self.server.stop(grace=2.0)
+        except Exception:
+            logger.exception("server stop at drain failed")
+        events.emit(
+            "serve_drained", reason=reason, flushed=flushed,
+            served=self.engine.batcher.served_total,
+            shed=self.engine.batcher.shed_total,
+        )
+        events.emit("role_stop", reason=reason)
+        events.flush()
+
+    def run(self, poll_secs=5.0):
+        """Serve until stopped. Unlike the PS, a master going away does
+        NOT stop serving — the inference tier outlives training jobs;
+        the poll exists only to feed fleet telemetry while a master is
+        around."""
+        if self._master_client is None:
+            self.server.wait_for_termination()
+            return 0
+        while not self._drained.is_set():
+            time.sleep(poll_secs)
+            try:
+                self._master_client.get_comm_info()
+            except Exception:
+                logger.debug("telemetry poll failed (master gone?)")
+        return 0
+
+
+def main(argv=None):
+    from elasticdl_tpu.common.platform import apply_platform_overrides
+
+    apply_platform_overrides()
+    args = parse_serve_args(argv)
+    from elasticdl_tpu.testing import faults
+
+    faults.set_role("serve-%d" % args.serve_id)
+    if args.metrics_port:
+        from elasticdl_tpu.observability import http_server
+
+        # publish before any instrument is constructed: the registry
+        # decides enabled/no-op at first touch
+        os.environ[http_server.PORT_ENV] = str(args.metrics_port)
+    from elasticdl_tpu.observability import events
+
+    # SIGTERM chain order (the PS pattern): crash hooks install first,
+    # prepare()'s drain handler registers last so it runs FIRST — stop
+    # admitting + flush — then chains into the ring dump + exit 0
+    events.install_crash_hooks()
+    return ServeRole(args).prepare().run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,174 @@
+"""One loaded, servable model version.
+
+A ``ServingModel`` is immutable once built: the export's dense params
+re-applied through the model-zoo module, a jitted eval forward (the
+SAME ``make_eval_step`` the trainer scores with — served predictions
+are bit-exact with the trainer's eval forward on the same batch,
+test-enforced), and for sparse models a read-only
+``SparseBatchPreparer`` resolving ids through the extracted embedding
+client against the live PS. Version hot-swap builds a NEW ServingModel
+and swaps the engine's reference; in-flight batches keep serving from
+the instance that admitted them.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.data.pipeline import MASK_KEY, normalize_outputs
+from elasticdl_tpu.train.export import load_exported
+from elasticdl_tpu.train.step_fns import make_eval_step
+from elasticdl_tpu.train.train_state import TrainState, resolve_dtype
+
+logger = _logger_factory("elasticdl_tpu.serve.model")
+
+# single-input models (features is one bare array, not a dict) wire
+# their tensor under this reserved feature key
+SINGLE_INPUT_KEY = "__input__"
+
+
+def export_signature(path):
+    """Identity stamp of an export artifact: ``"<step>:<npz mtime_ns>"``
+    or None while the artifact is absent/incomplete. The version
+    watcher polls this; a changed stamp is a new servable version.
+    Stat-only (no parse): manifest.json is written AFTER model.npz
+    (train/export.py), so its presence implies a complete bundle."""
+    manifest = os.path.join(path, "manifest.json")
+    npz = os.path.join(path, "model.npz")
+    try:
+        manifest_stat = os.stat(manifest)
+        npz_stat = os.stat(npz)
+    except OSError:
+        return None
+    import json
+
+    try:
+        with open(manifest) as f:
+            step = int(json.load(f).get("step", -1))
+    except (OSError, ValueError):
+        return None
+    return "%d:%d:%d" % (step, npz_stat.st_mtime_ns, manifest_stat.st_mtime_ns)
+
+
+class ServingModel:
+    """One export, loaded and jit-compiled, behind a padded-batch
+    ``predict``.
+
+    ``max_batch`` fixes the compiled batch shape: every formed batch is
+    zero-padded to it (padding rows ride the trainer's own ``MASK_KEY``
+    machinery, so padded ids never pull or materialize PS rows), and
+    XLA compiles the forward exactly once per version.
+    """
+
+    def __init__(self, spec, export_path, max_batch,
+                 ps_client=None, cache=None, compute_dtype=None):
+        self.spec = spec
+        self.export_path = export_path
+        self.max_batch = int(max_batch)
+        self.stamp = export_signature(export_path)
+        if self.stamp is None:
+            raise FileNotFoundError(
+                "no complete export at %r (model.npz + manifest.json)"
+                % export_path
+            )
+        params, model_state, step = load_exported(export_path)
+        self.step = int(step)
+        model = spec.custom_model()
+        self._eval_fn = jax.jit(
+            make_eval_step(model, resolve_dtype(compute_dtype))
+        )
+        # opt_state is the trainer's business; the eval forward reads
+        # only params + model_state
+        self.state = TrainState(
+            step=jnp.asarray(self.step, jnp.int32),
+            params=params,
+            model_state=model_state,
+            opt_state=(),
+        )
+        self._preparer = None
+        if spec.sparse_embedding_specs:
+            if ps_client is None:
+                raise ValueError(
+                    "model %r declares sparse embedding tables; serving "
+                    "it needs a PS client (--ps_addrs)" % (
+                        getattr(spec.module, "__name__", spec.module),
+                    )
+                )
+            from elasticdl_tpu.train.sparse import SparseBatchPreparer
+
+            # read_only: tables were created by the training job; a PS
+            # relaunch invalidates the cache but registers nothing. The
+            # preparer IS the trainer's — same unique/indices planning,
+            # same EmbeddingClient pull/cache stack (ISSUE 8's no-fork
+            # contract).
+            self._preparer = SparseBatchPreparer(
+                spec.sparse_embedding_specs(batch_size=self.max_batch),
+                ps_client,
+                cache=cache,
+                read_only=True,
+            )
+
+    @property
+    def sparse(self):
+        return self._preparer is not None
+
+    @property
+    def embedding_hit_rate(self):
+        if self._preparer is None:
+            return 0.0
+        return self._preparer._embedding.hit_rate()
+
+    # ------------------------------------------------------------------
+    def _pad(self, features, rows):
+        """Zero-pad every feature's leading dim to max_batch and build
+        the row mask padding rides under."""
+        if rows > self.max_batch:
+            raise ValueError(
+                "batch of %d rows exceeds max_batch %d"
+                % (rows, self.max_batch)
+            )
+
+        def pad(leaf):
+            leaf = np.asarray(leaf)
+            if leaf.shape[0] == self.max_batch:
+                return leaf
+            fill = np.zeros(
+                (self.max_batch - leaf.shape[0],) + leaf.shape[1:],
+                leaf.dtype,
+            )
+            return np.concatenate([leaf, fill], axis=0)
+
+        mask = np.zeros((self.max_batch,), np.float32)
+        mask[:rows] = 1.0
+        if isinstance(features, dict):
+            return {k: pad(v) for k, v in features.items()}, mask
+        return pad(features), mask
+
+    def predict(self, features, rows):
+        """``features``: dict of batch-leading arrays (or one bare
+        array for single-input models) with ``rows`` real rows;
+        returns ``{output name: array[rows, ...]}``."""
+        padded, mask = self._pad(features, rows)
+        if self._preparer is not None:
+            # the trainer's own prepare path: unique ids -> cached/
+            # fused-pulled rows + indices features; MASK_KEY keeps the
+            # padding rows' zero-ids out of the unique set entirely
+            batch = {"features": dict(padded), MASK_KEY: mask}
+            prepared, _ = self._preparer.prepare(batch)
+            padded = prepared["features"]
+        outputs = self._eval_fn(self.state, padded)
+        outputs = jax.tree_util.tree_map(np.asarray, outputs)
+        return normalize_outputs(outputs, rows)
+
+    def warm(self, template_features=None, template_rows=1):
+        """Compile (and prime the embedding cache for) this version
+        before it takes traffic: one predict on the template — the hot
+        swap's no-cold-start half. Without a template (nothing served
+        yet) the first real request compiles instead."""
+        if template_features is None:
+            return False
+        self.predict(template_features, template_rows)
+        return True

@@ -26,6 +26,13 @@ from elasticdl_tpu.common.annotations import hot_path
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
 from elasticdl_tpu.data.pipeline import MASK_KEY
+# HotRowCache lives in the extracted embedding-client library (ISSUE 8)
+# so the serving tier shares the training pull/cache stack; re-exported
+# here for the long-standing import path.
+from elasticdl_tpu.embedding.client import (  # noqa: F401
+    EmbeddingClient,
+    HotRowCache,
+)
 from elasticdl_tpu.train.losses import masked_mean
 from elasticdl_tpu.train.train_state import (
     TrainState,
@@ -116,100 +123,6 @@ def embedding_lookup(features, name, combiner=None):
     return combine_gathered(gathered, w, combiner)
 
 
-class HotRowCache:
-    """Bounded-staleness host cache of pulled embedding rows.
-
-    The sparse analogue of the reference's ``get_model_steps``
-    amortization (worker.py:287-295, which trained local steps between
-    PS syncs): a pulled row may be reused for up to ``staleness``
-    subsequent prepares even though pushes have since updated it on the
-    PS. CTR id distributions are Zipfian — the hot ids recur in every
-    batch — so this removes most pull bytes. Only sound against the
-    async PS (whose training already tolerates stale rows by design);
-    keep it disabled under the sync PS, where stale rows would be
-    version-rejected anyway.
-    """
-
-    def __init__(self, staleness, capacity=1_000_000):
-        if staleness < 1:
-            raise ValueError("staleness must be >= 1")
-        self.staleness = int(staleness)
-        self.capacity = int(capacity)
-        self._clock = 0
-        # name -> (sorted ids [n], rows [n, dim], pull stamps [n]);
-        # vectorized (searchsorted/merge) — per-id dict loops cost
-        # ~10 ms/step at CTR batch sizes
-        self._tables = {}
-        self.hits = 0
-        self.misses = 0
-
-    def advance(self):
-        self._clock += 1
-
-    def split(self, name, unique):
-        """Partition ``unique`` (sorted) ids into fresh-cached and
-        to-pull.
-
-        Returns (cached_mask [n] bool, cached_rows [hits, dim] or None).
-        """
-        entry = self._tables.get(name)
-        if entry is None:
-            self.misses += int(unique.size)
-            return np.zeros(unique.shape, dtype=bool), None
-        ids, rows, stamps = entry
-        pos = np.searchsorted(ids, unique)
-        pos_clipped = np.minimum(pos, max(ids.size - 1, 0))
-        found = (pos < ids.size) & (ids[pos_clipped] == unique)
-        # stamp records PULL time, not last use: staleness bounds the
-        # age of the VALUE, so a hit must not refresh it. >= so that
-        # staleness=1 reuses a row for exactly one subsequent prepare
-        # (the documented "up to `staleness` subsequent prepares")
-        fresh = found & (
-            stamps[pos_clipped] >= self._clock - self.staleness
-        )
-        n_hit = int(fresh.sum())
-        self.hits += n_hit
-        self.misses += int(unique.size) - n_hit
-        if n_hit == 0:
-            return np.zeros(unique.shape, dtype=bool), None
-        return fresh, rows[pos_clipped[fresh]]
-
-    def clear(self):
-        """Invalidate every cached row (e.g. the PS they were pulled
-        from relaunched); hit/miss tallies are kept."""
-        self._tables.clear()
-
-    def put(self, name, new_ids, new_rows):
-        new_ids = np.asarray(new_ids, dtype=np.int64)
-        new_rows = np.asarray(new_rows, dtype=np.float32)
-        if new_ids.size and np.any(np.diff(new_ids) <= 0):
-            # callers normally pass np.unique output; normalize otherwise
-            new_ids, first = np.unique(new_ids, return_index=True)
-            new_rows = new_rows[first]
-        new_stamps = np.full(new_ids.shape, self._clock, dtype=np.int64)
-        entry = self._tables.get(name)
-        if entry is not None:
-            old_ids, old_rows, old_stamps = entry
-            # new entries win on duplicate ids (unique keeps the first
-            # occurrence per id, so concatenate new-first)
-            all_ids = np.concatenate([new_ids, old_ids])
-            merged, first = np.unique(all_ids, return_index=True)
-            all_rows = np.concatenate([new_rows, old_rows], axis=0)
-            all_stamps = np.concatenate([new_stamps, old_stamps])
-            new_ids = merged  # np.unique returns sorted ids
-            new_rows = all_rows[first]
-            new_stamps = all_stamps[first]
-        if new_ids.size > self.capacity:
-            # evict the oldest pulls (and, implicitly, everything
-            # already past staleness)
-            keep = np.argpartition(
-                -new_stamps, self.capacity - 1
-            )[: self.capacity]
-            keep.sort()  # restore sorted-id order after partition
-            new_ids = new_ids[keep]
-            new_rows = new_rows[keep]
-            new_stamps = new_stamps[keep]
-        self._tables[name] = (new_ids, new_rows, new_stamps)
 
 
 class PullInfo(dict):
@@ -234,10 +147,16 @@ class SparseBatchPreparer:
     how often hot rows are re-pulled.
     """
 
-    def __init__(self, specs, ps_client, cache=None, device_tier=None):
+    def __init__(self, specs, ps_client, cache=None, device_tier=None,
+                 read_only=False):
         self._specs = list(specs)
         self._ps = ps_client
         self._registered = False
+        # Read-only consumers (the serving tier, ISSUE 8) never write:
+        # table infos are not pushed (the tables were created by the
+        # training job this serves), and a PS relaunch only invalidates
+        # the cache — there is no model to re-register.
+        self._read_only = bool(read_only)
         if cache is not None and device_tier is not None:
             # The tier SUPERSEDES the hot-row cache: resident rows are
             # served from device, and the residual misses are
@@ -255,26 +174,32 @@ class SparseBatchPreparer:
                 "promoted as authoritative tier values"
             )
             cache = None
-        self._cache = cache
+        # the extracted pull/cache stack (ISSUE 8): this preparer and
+        # the serving tier ride the same EmbeddingClient — cache
+        # consult/fill, fused multi-table pull, per-table fallback all
+        # live there, once
+        self._embedding = EmbeddingClient(
+            ps_client, cache=cache, read_only=self._read_only
+        )
         self._tier = device_tier
         # set by _on_ps_restart (possibly from the async-push thread),
         # consumed at the top of prepare() on the pulling thread
         self._cache_dirty = False
-        if hasattr(ps_client, "resync_hook"):
+        if not self._read_only and hasattr(ps_client, "resync_hook"):
             # PS crash recovery: when the client detects a relaunched
             # shard (version regression on a push response), re-push the
             # embedding-table infos on the next prepare — a PS that
             # restored nothing must not lazily create tables with
             # default dims/initializers — and drop cached rows that no
-            # longer reflect the restored store
+            # longer reflect the restored store. The hook slot is
+            # single-owner (last writer wins), so a READ-ONLY preparer
+            # must not take it: it has no tables to re-register and no
+            # device tier, and its deferred cache clear is redundant
+            # with the serving engine's own thread-safe hook
+            # (serve/engine._chain_resync_hook) — installing here would
+            # clobber a co-resident trainer's hook on every
+            # ServingModel build.
             ps_client.resync_hook = self._on_ps_restart
-
-        self._pull_pool = None
-        if len(self._specs) > 1:
-            self._pull_pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=len(self._specs),
-                thread_name_prefix="sparse-pull",
-            )
 
     @property
     def ps_num(self):
@@ -282,10 +207,11 @@ class SparseBatchPreparer:
 
     @property
     def cache(self):
-        return self._cache
+        return self._embedding.cache
 
     def _on_ps_restart(self, shard):
-        self._registered = False
+        if not self._read_only:
+            self._registered = False
         # cached rows were pulled from the dead process's store;
         # staleness bounds don't cover a whole relaunch. The clear is
         # DEFERRED to the next prepare(): under async push this hook
@@ -305,102 +231,31 @@ class SparseBatchPreparer:
             self._tier.mark_restart()
 
     def register_tables(self):
+        if self._read_only:
+            return
         if not self._registered:
             self._ps.push_embedding_table_infos(
                 [(s.name, s.dim, _wire_initializer(s)) for s in self._specs]
             )
             self._registered = True
 
-    def _assemble_rows(self, spec, unique, cached_mask, cached_rows,
-                       fetched):
-        """Merge cache hits and one fresh fetch into [n_unique, dim]
-        fp32, recording the fetched rows in the cache. The single home
-        of the cache-fill protocol — the per-table and batched pull
-        paths both end here, so a staleness/fill rule change cannot
-        fork between them."""
-        rows = np.empty((unique.size, spec.dim), dtype=np.float32)
-        if cached_rows is not None:
-            rows[cached_mask] = cached_rows
-        missing = unique[~cached_mask]
-        if missing.size:
-            fetched = np.asarray(fetched, dtype=np.float32)
-            rows[~cached_mask] = fetched
-            self._cache.put(spec.name, missing, fetched)
-        return rows
-
-    def _pull_rows(self, spec, unique):
-        """Pull rows for the unique ids of one table, consulting the
-        hot cache; returns [n_unique, dim] float32."""
-        if self._cache is None:
-            return np.asarray(
-                self._ps.pull_embedding_vectors(spec.name, unique),
-                dtype=np.float32,
-            )
-        cached_mask, cached_rows = self._cache.split(spec.name, unique)
-        missing = unique[~cached_mask]
-        fetched = None
-        if missing.size:
-            fetched = self._ps.pull_embedding_vectors(spec.name, missing)
-        return self._assemble_rows(
-            spec, unique, cached_mask, cached_rows, fetched
-        )
-
     def _pull_tables(self, plans):
         """Pull every table's unique rows for this batch; returns
-        {name: (capacity, rows [n_unique, dim] float32)}.
-
-        Against a batch-capable client (PSClient, LocalPSClient) the
-        cache-missing ids of ALL tables ride one fused
-        pull_embedding_batch call — ps_num RPCs per step instead of
-        tables x ps_num (DeepFM: 3 tables over 2 shards went 6 -> 2).
-        A client without the batch surface falls back to the per-table
-        thread fan-out."""
-        batch_pull = getattr(self._ps, "pull_embedding_batch", None)
-        if batch_pull is None:
-            if self._pull_pool is not None and len(plans) > 1:
-                futures = [
-                    (spec, capacity,
-                     self._pull_pool.submit(self._pull_rows, spec, unique))
-                    for spec, unique, capacity in plans
-                    if unique.size
-                ]
-                return {
-                    spec.name: (capacity, future.result())
-                    for spec, capacity, future in futures
-                }
-            return {
-                spec.name: (capacity, self._pull_rows(spec, unique))
-                for spec, unique, capacity in plans
-                if unique.size
-            }
-        to_pull = {}
-        cache_parts = {}  # name -> (cached_mask, cached_rows)
-        for spec, unique, capacity in plans:
-            if not unique.size:
-                continue
-            if self._cache is None:
-                to_pull[spec.name] = unique
-                continue
-            cached_mask, cached_rows = self._cache.split(spec.name, unique)
-            cache_parts[spec.name] = (cached_mask, cached_rows)
-            missing = unique[~cached_mask]
-            if missing.size:
-                to_pull[spec.name] = missing
-        fetched = batch_pull(to_pull) if to_pull else {}
-        pulled = {}
-        for spec, unique, capacity in plans:
-            if not unique.size:
-                continue
-            if self._cache is None:
-                rows = np.asarray(fetched[spec.name], dtype=np.float32)
-            else:
-                cached_mask, cached_rows = cache_parts[spec.name]
-                rows = self._assemble_rows(
-                    spec, unique, cached_mask, cached_rows,
-                    fetched.get(spec.name),
-                )
-            pulled[spec.name] = (capacity, rows)
-        return pulled
+        {name: (capacity, rows [n_unique, dim] float32)}. The pull
+        itself — cache consult/fill, fused multi-table RPC, per-table
+        fan-out fallback — is the extracted EmbeddingClient's job
+        (embedding/client.py); only the capacity bookkeeping is
+        training-specific."""
+        rows = self._embedding.pull_tables({
+            spec.name: unique
+            for spec, unique, _ in plans
+            if unique.size
+        })
+        return {
+            spec.name: (capacity, rows[spec.name])
+            for spec, unique, capacity in plans
+            if unique.size
+        }
 
     def prepare(self, batch):
         """Returns (batch with rows/indices features, pull_info) where
@@ -408,12 +263,12 @@ class SparseBatchPreparer:
         ids without a device tier; only the un-promoted misses with
         one)."""
         self.register_tables()
-        if self._cache is not None:
+        if self.cache is not None:
             if self._cache_dirty:
                 # deferred PS-relaunch invalidation (_on_ps_restart)
                 self._cache_dirty = False
-                self._cache.clear()
-            self._cache.advance()
+                self._embedding.invalidate()
+            self._embedding.advance()
         if self._tier is not None:
             self._tier.advance()
         features = dict(batch["features"])

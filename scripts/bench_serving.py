@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Serving-tier bench: open-loop Zipfian load at fixed QPS, with a
+mid-run zero-downtime version swap (ISSUE 8).
+
+Topology: a deepfm model trained briefly in-process (LocalExecutor),
+exported, then served through the REAL stack — gRPC Serve service,
+admission-controlled micro-batcher, read-only embedding client with
+TTL cache against the trained store. The load generator is OPEN-LOOP
+(requests fire on a fixed schedule regardless of completions — the
+only honest way to measure a serving tier: closed-loop generators
+self-throttle exactly when the server degrades) with Zipfian ids, the
+id distribution the hot-row stack exists for.
+
+Mid-run, the trainer exports a NEWER version into the watched
+directory. The HARD GATE (exit 1): the swap must complete and ZERO
+requests may fail or shed across the whole run — in-flight requests
+finish on the version that admitted them, new ones ride the warmed
+replacement. p50/p99 latency and QPS/chip are REPORT-ONLY (journaled
+by ci.sh tier 1f like the wire and tier benches; absolute numbers
+flake across boxes).
+
+Env knobs: BENCH_SERVING_QPS (default 150), BENCH_SERVING_SECS (8),
+BENCH_SERVING_SWAP_AT (0.5 = mid-run fraction).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+import numpy as np  # noqa: E402
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax  # noqa: E402 (after platform pin)
+
+    from test_utils import create_ctr_recordio  # noqa: E402
+    from elasticdl_tpu.common.grpc_utils import (  # noqa: E402
+        build_server,
+        find_free_port,
+    )
+    from elasticdl_tpu.observability import events  # noqa: E402
+    from elasticdl_tpu.proto.services import (  # noqa: E402
+        add_serve_servicer_to_server,
+    )
+    from elasticdl_tpu.serve.client import ServeClient  # noqa: E402
+    from elasticdl_tpu.serve.engine import ServingEngine  # noqa: E402
+    from elasticdl_tpu.serve.servicer import ServeServicer  # noqa: E402
+    from elasticdl_tpu.train.export import export_train_state  # noqa: E402
+    from elasticdl_tpu.train.local_executor import LocalExecutor  # noqa: E402
+
+    events.configure("bench-serving")
+
+    qps = _env_float("BENCH_SERVING_QPS", 150.0)
+    duration = _env_float("BENCH_SERVING_SECS", 8.0)
+    swap_at = _env_float("BENCH_SERVING_SWAP_AT", 0.5)
+    vocab = 1000
+    zipf_a = 1.3
+    rows_per_request = 4
+    fields = 10
+
+    # ---- train + export ------------------------------------------------
+    tmp = tempfile.mkdtemp(prefix="edl-bench-serving-")
+    create_ctr_recordio(
+        tmp + "/f0.rec", num_records=256, vocab=vocab, seed=0
+    )
+    executor = LocalExecutor(
+        "elasticdl_tpu.models.deepfm", training_data=tmp,
+        minibatch_size=32, num_epochs=1,
+    )
+    executor.train()
+    export_dir = os.path.join(tmp, "export")
+    export_train_state(executor.state, export_dir)
+
+    # ---- serve through the real stack ----------------------------------
+    engine = ServingEngine(
+        "elasticdl_tpu.models.deepfm", export_dir,
+        ps_client=executor.trainer.preparer._ps,
+        max_batch=64, max_delay_ms=3.0, queue_depth=512,
+        deadline_ms=5000.0, cache_ttl_secs=2.0, watch_secs=0.25,
+    ).start(block=True)
+    server = build_server()
+    add_serve_servicer_to_server(ServeServicer(engine), server)
+    port = find_free_port()
+    server.add_insecure_port("[::]:%d" % port)
+    server.start()
+    client = ServeClient("localhost:%d" % port)
+    first_step = engine.model.step
+
+    # warm the compiled shape out of the measurement
+    warm_ids = np.ones((rows_per_request, fields), np.int64)
+    client.predict({"ids": warm_ids}, deadline_secs=60)
+
+    # ---- open-loop load ------------------------------------------------
+    rng = np.random.RandomState(42)
+    total = int(qps * duration)
+    latencies = [None] * total
+    steps_seen = [0] * total
+    failures = []
+    swap_window = []  # (start, end) of the swap, filled by the swapper
+    done = threading.Semaphore(0)
+    pool_lock = threading.Lock()
+    inflight = 0
+    max_inflight = 0
+
+    def zipf_ids():
+        raw = rng.zipf(zipf_a, size=(rows_per_request, fields))
+        return np.minimum(raw, vocab - 1).astype(np.int64)
+
+    def fire(i, ids):
+        nonlocal inflight, max_inflight
+        start = time.perf_counter()
+        try:
+            _, step, _ = client.predict({"ids": ids}, deadline_secs=10)
+            latencies[i] = time.perf_counter() - start
+            steps_seen[i] = step
+        except Exception as e:  # the hard gate counts every failure
+            failures.append((i, repr(e)))
+        finally:
+            with pool_lock:
+                inflight -= 1
+            done.release()
+
+    def swapper():
+        time.sleep(duration * swap_at)
+        t0 = time.monotonic()
+        # train a few more steps so the exported step really moves
+        batches = []
+        for batch in executor._batches(executor._train_reader, "training"):
+            batches.append(batch)
+            if len(batches) >= 3:
+                break
+        for batch in batches:
+            executor.state, _ = executor.trainer.train_step(
+                executor.state, batch
+            )
+        export_train_state(executor.state, export_dir)
+        while engine.swaps == 0 and time.monotonic() - t0 < 30:
+            time.sleep(0.02)
+        swap_window.append((t0, time.monotonic()))
+
+    swap_thread = threading.Thread(target=swapper, daemon=True)
+    swap_thread.start()
+
+    interval = 1.0 / qps
+    t_start = time.monotonic()
+    for i in range(total):
+        target = t_start + i * interval
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        ids = zipf_ids()
+        with pool_lock:
+            inflight += 1
+            max_inflight = max(max_inflight, inflight)
+        threading.Thread(target=fire, args=(i, ids), daemon=True).start()
+    for _ in range(total):
+        done.acquire()
+    wall = time.monotonic() - t_start
+    swap_thread.join(timeout=60)
+
+    server.stop(0)
+    client.close()
+    engine.drain(timeout=10)
+
+    # ---- report --------------------------------------------------------
+    served = [lat for lat in latencies if lat is not None]
+    # all-failed runs must still reach the hard-gate diagnostics (and
+    # the journaled report) instead of crashing on an empty percentile
+    if served:
+        lat_ms = np.asarray(served) * 1e3
+        p50_ms = round(float(np.percentile(lat_ms, 50)), 2)
+        p99_ms = round(float(np.percentile(lat_ms, 99)), 2)
+    else:
+        p50_ms = p99_ms = None
+    chips = max(jax.device_count(), 1)
+    new_step = engine.model.step
+    report = {
+        "qps_target": qps,
+        "qps_achieved": round(len(served) / wall, 1),
+        "qps_per_chip": round(len(served) / wall / chips, 1),
+        "requests": total,
+        "served": len(served),
+        "failed": len(failures),
+        "shed": engine.batcher.shed_total,
+        "max_inflight": max_inflight,
+        "p50_ms": p50_ms,
+        "p99_ms": p99_ms,
+        "swap": {
+            "completed": engine.swaps >= 1,
+            "from_step": int(first_step),
+            "to_step": int(new_step),
+            "secs": (
+                round(swap_window[0][1] - swap_window[0][0], 2)
+                if swap_window else None
+            ),
+        },
+        "cache_hit_rate": round(engine.model.embedding_hit_rate, 3),
+    }
+    # compact single line: ci.sh tees stdout into the NDJSON bench
+    # journal (one record per line, like the wire/tier benches)
+    print(json.dumps(report))
+
+    # ---- hard gates ----------------------------------------------------
+    failed = []
+    if not report["swap"]["completed"]:
+        failed.append("version swap never completed")
+    if new_step <= first_step:
+        failed.append(
+            "swap did not advance the step (%s -> %s)"
+            % (first_step, new_step)
+        )
+    if failures:
+        failed.append(
+            "%d requests FAILED across the run (first: %s) — the "
+            "zero-downtime swap contract does not hold"
+            % (len(failures), failures[0][1])
+        )
+    if engine.batcher.shed_total:
+        failed.append(
+            "%d requests shed at this modest load — admission control "
+            "is misconfigured for the bench envelope"
+            % engine.batcher.shed_total
+        )
+    post_swap = [s for s in steps_seen if s == new_step]
+    if report["swap"]["completed"] and not post_swap:
+        failed.append("no request was served by the new version")
+    if failed:
+        print("BENCH GATE FAILED:", file=sys.stderr)
+        for reason in failed:
+            print("  - %s" % reason, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

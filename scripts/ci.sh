@@ -346,6 +346,144 @@ python scripts/postmortem.py "$DRAIN_DIR/events" 2>/dev/null | tee /tmp/_drain_p
 grep -q "worker_draining" /tmp/_drain_pm.out
 grep -q "drain_ack" /tmp/_drain_pm.out
 
+echo "== tier 1e++: serving smoke (PS + serve role over a fresh export) =="
+# ISSUE 8: the full serving topology as subprocesses — a real PS seeded
+# with trained embedding rows, a serve role loading a fresh
+# train/export.py artifact. Predict RPCs answer through the
+# micro-batcher; a past-deadline request is SHED server-side (the shed
+# counter moves — it was never served late); /metrics and /readyz
+# answer; SIGTERM drains cleanly (admissions stop, queue flushes,
+# serve_drained journaled, exit 0).
+SERVE_DIR="$(mktemp -d)"
+export SERVE_DIR
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import os, signal, subprocess, sys, tempfile, time, urllib.request
+sys.path.insert(0, "tests")
+import numpy as np
+from test_utils import create_ctr_recordio, load_journal
+from elasticdl_tpu.common.grpc_utils import find_free_port
+
+events_dir = os.path.join(os.environ["SERVE_DIR"], "events")
+os.makedirs(events_dir)
+train = tempfile.mkdtemp()
+create_ctr_recordio(train + "/f0.rec", num_records=128, seed=0)
+
+# train briefly in-process, export the dense bundle
+from elasticdl_tpu.train.local_executor import LocalExecutor
+from elasticdl_tpu.train.export import export_train_state
+executor = LocalExecutor(
+    "elasticdl_tpu.models.deepfm", training_data=train,
+    minibatch_size=32, num_epochs=1,
+)
+executor.train()
+export_dir = os.path.join(os.environ["SERVE_DIR"], "export")
+export_train_state(executor.state, export_dir)
+
+base_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+            "EDL_EVENTS_DIR": events_dir}
+pport, sport, mport = find_free_port(), find_free_port(), find_free_port()
+ps = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.ps.server", "--ps_id", "0",
+    "--num_ps_pods", "1", "--port", str(pport),
+    "--opt_type", "adam", "--opt_args", "lr=0.001", "--use_async", "1",
+], env=base_env)
+
+def wait_port(port, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        import socket
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port)); return
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    raise TimeoutError(port)
+
+wait_port(pport)
+# seed the PS with the trained rows (the deepfm tables live on the PS
+# in the distributed topology; locally they trained in-process)
+from elasticdl_tpu.worker.ps_client import PSClient
+from elasticdl_tpu.models import deepfm
+seed_client = PSClient(["localhost:%d" % pport])
+specs = deepfm.sparse_embedding_specs(batch_size=32)
+seed_client.push_embedding_table_infos(
+    [(s.name, s.dim, str(float(s.init_scale))) for s in specs]
+)
+store = executor.trainer.preparer._ps.store
+seed_client.push_embedding_rows({
+    s.name: store.export_table(s.name) for s in specs
+})
+
+serve = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.serve.main", "--serve_id", "0",
+    "--port", str(sport), "--model_zoo", "elasticdl_tpu.models.deepfm",
+    "--export_dir", export_dir, "--ps_addrs", "localhost:%d" % pport,
+    "--metrics_port", str(mport),
+    "--max_batch", "32", "--max_delay_ms", "60", "--queue_depth", "64",
+    "--deadline_ms", "2000",
+], env=base_env)
+wait_port(sport)
+# readiness flips once the export is loaded
+deadline = time.time() + 120
+ready = False
+while time.time() < deadline:
+    try:
+        ready = urllib.request.urlopen(
+            "http://localhost:%d/readyz" % mport, timeout=2
+        ).status == 200
+        if ready:
+            break
+    except Exception:
+        pass
+    time.sleep(0.3)
+assert ready, "serve role never became ready"
+
+from elasticdl_tpu.serve.client import ServeClient
+import grpc
+client = ServeClient("localhost:%d" % sport)
+rng = np.random.RandomState(0)
+# generous first deadline: the first request compiles the forward
+for i, budget in enumerate([120, 10, 10, 10, 10]):
+    ids = rng.randint(0, 1000, size=(4, 10)).astype(np.int64)
+    outputs, step, _ = client.predict({"ids": ids}, deadline_secs=budget)
+    assert outputs["output"].shape == (4,)
+    assert np.isfinite(outputs["output"]).all()
+print("serving smoke: %d predicts OK (model step %d)" % (i + 1, step))
+
+# a request whose budget (20 ms) is INSIDE the 60 ms formation window
+# must be shed server-side, never served late
+try:
+    client.predict({"ids": ids}, deadline_secs=0.02)
+    raise AssertionError("past-deadline request was served")
+except grpc.RpcError as e:
+    assert e.code() == grpc.StatusCode.DEADLINE_EXCEEDED, e.code()
+time.sleep(1.0)  # let the batcher's shed land in /metrics
+metrics = urllib.request.urlopen(
+    "http://localhost:%d/metrics" % mport, timeout=5
+).read().decode()
+for series in (
+    "edl_serve_request_seconds", "edl_serve_model_info",
+    'edl_serve_requests_shed_total{reason="deadline"} 1',
+    "edl_serve_batch_size",
+):
+    assert series in metrics, "missing series: %s" % series
+print("serving smoke: past-deadline request shed server-side")
+
+serve.send_signal(signal.SIGTERM)
+rc = serve.wait(timeout=60)
+assert rc == 0, "serve role exited rc=%s (clean drain expected)" % rc
+merged = load_journal(events_dir, prefix="serve")
+names = [e["event"] for e in merged]
+assert "model_loaded" in names, names
+drained = [e for e in merged if e["event"] == "serve_drained"]
+assert drained and drained[0]["reason"] == "sigterm", merged
+assert drained[0]["served"] >= 5
+ps.terminate(); ps.wait(timeout=30)
+print("serving smoke OK: clean SIGTERM drain journaled")
+PYEOF
+
 echo "== tier 1f: wire-path perf smoke (micro + EDL_WIRE_DTYPE opt-in) =="
 # Microbenchmark of the ISSUE-5 wire fast paths vs the legacy paths
 # they replaced: packed ids_blob vs repeated-varint serialization,
@@ -359,6 +497,18 @@ printf '{"ts": "%s", "wire_micro": %s}\n' \
   "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_wire_micro.json)" \
   >> /tmp/ci_wire_micro.jsonl
 echo "wire-micro numbers journaled to /tmp/ci_wire_micro.jsonl"
+
+# Serving-tier bench (ISSUE 8): open-loop Zipfian load at fixed QPS
+# through the real gRPC serve stack, with a mid-run version swap.
+# p50/p99 latency and QPS/chip are REPORT-ONLY (journaled below); the
+# script hard-fails only on the swap contract — a request failed or
+# shed across the run, the swap never completing, or the new version
+# taking no traffic.
+JAX_PLATFORMS=cpu python scripts/bench_serving.py | tee /tmp/_serving.json
+printf '{"ts": "%s", "serving": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_serving.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "serving bench journaled to /tmp/ci_wire_micro.jsonl"
 
 # Device-tier A-B (ISSUE 6): deepfm steps/s with the HBM hot set on vs
 # off under an emulated per-row wire cost, plus the warm-phase hit

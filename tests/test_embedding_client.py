@@ -1,0 +1,263 @@
+"""Extracted embedding client (ISSUE 8): TTL/thread-safe cache modes
+and the pull stack shared between training prepare and serving resolve.
+
+The headline regression here is the PR 6 note the extraction surfaced:
+``HotRowCache`` invalidation used to be tied to the pulling thread
+(train/sparse.py defers a PS-relaunch clear to the next prepare because
+the unlocked cache races). Serving has no such thread — its cache is
+built ``thread_safe=True`` and invalidation may land from ANY thread
+while readers are mid-split; the concurrency test pins that this is
+now safe.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding import EmbeddingClient, HotRowCache
+from elasticdl_tpu.ps.local_client import LocalPSClient
+
+
+def _rows(n, dim=4, base=0.0):
+    return (np.arange(n * dim, dtype=np.float32) + base).reshape(n, dim)
+
+
+# ---------------------------------------------------------------------------
+# HotRowCache: TTL mode
+
+
+def test_ttl_mode_serves_fresh_and_expires():
+    cache = HotRowCache(capacity=100, ttl_secs=0.15, thread_safe=True)
+    ids = np.array([3, 7], np.int64)
+    cache.put("t", ids, _rows(2))
+    mask, cached = cache.split("t", ids)
+    assert mask.all()
+    np.testing.assert_array_equal(cached, _rows(2))
+    time.sleep(0.2)  # past the TTL: every row is stale
+    mask, cached = cache.split("t", ids)
+    assert not mask.any() and cached is None
+
+
+def test_ttl_mode_advance_is_a_noop():
+    cache = HotRowCache(capacity=100, ttl_secs=60.0)
+    ids = np.array([1], np.int64)
+    cache.put("t", ids, _rows(1))
+    for _ in range(50):
+        cache.advance()  # the logical clock must not age TTL entries
+    mask, _ = cache.split("t", ids)
+    assert mask.all()
+
+
+def test_ttl_validation():
+    with pytest.raises(ValueError):
+        HotRowCache(ttl_secs=0)
+    with pytest.raises(ValueError):
+        HotRowCache(staleness=0)
+
+
+def test_hit_rate():
+    cache = HotRowCache(staleness=2)
+    ids = np.array([1, 2], np.int64)
+    cache.split("t", ids)  # 2 misses
+    cache.put("t", ids, _rows(2))
+    cache.advance()
+    cache.split("t", ids)  # 2 hits
+    assert cache.hit_rate() == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# the satellite regression: concurrent readers during invalidation
+
+
+@pytest.mark.parametrize("writers", [1, 2])
+def test_concurrent_readers_during_invalidation(writers):
+    """Readers split() while other threads put() and clear() — the
+    serving topology (batcher thread reads, PS-restart hook
+    invalidates, warm-up thread fills). Every observed (mask, rows)
+    pair must be internally consistent and no operation may raise."""
+    cache = HotRowCache(capacity=10_000, ttl_secs=60.0, thread_safe=True)
+    ids = np.arange(512, dtype=np.int64)
+    cache.put("t", ids, _rows(512))
+    stop = time.monotonic() + 1.0
+    errors = []
+
+    def reader():
+        try:
+            while time.monotonic() < stop:
+                mask, rows = cache.split("t", ids)
+                if rows is None:
+                    assert not mask.any()
+                else:
+                    # a torn read (clear between mask and gather) would
+                    # break this pairing
+                    assert rows.shape[0] == int(mask.sum())
+        except Exception as e:  # pragma: no cover - the regression
+            errors.append(e)
+
+    def invalidator():
+        try:
+            while time.monotonic() < stop:
+                cache.clear()
+                time.sleep(0.001)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def writer():
+        try:
+            while time.monotonic() < stop:
+                cache.put("t", ids, _rows(512))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = (
+        [threading.Thread(target=reader) for _ in range(3)]
+        + [threading.Thread(target=invalidator)]
+        + [threading.Thread(target=writer) for _ in range(writers)]
+    )
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+
+
+# ---------------------------------------------------------------------------
+# EmbeddingClient pull stack
+
+
+class _CountingClient(LocalPSClient):
+    """Counts wire-level pulls; LocalPSClient's batch pull delegates to
+    its per-table pull internally, so a flag keeps the inner calls out
+    of the single-pull tally."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.single_pulls = 0
+        self.batch_pulls = 0
+        self.pulled_ids = 0
+        self._in_batch = False
+
+    def pull_embedding_vectors(self, name, ids):
+        if not self._in_batch:
+            self.single_pulls += 1
+            self.pulled_ids += int(np.asarray(ids).size)
+        return super().pull_embedding_vectors(name, ids)
+
+    def pull_embedding_batch(self, ids_by_table):
+        self.batch_pulls += 1
+        self.pulled_ids += int(
+            sum(np.asarray(i).size for i in ids_by_table.values())
+        )
+        self._in_batch = True
+        try:
+            return super().pull_embedding_batch(ids_by_table)
+        finally:
+            self._in_batch = False
+
+
+def _tables(ps):
+    ps.push_embedding_table_infos([("a", 4, "0.05"), ("b", 4, "0.05")])
+
+
+def test_pull_tables_rides_fused_batch_and_fills_cache():
+    ps = _CountingClient(seed=0)
+    _tables(ps)
+    client = EmbeddingClient(
+        ps, cache=HotRowCache(ttl_secs=60.0, thread_safe=True),
+        read_only=True,
+    )
+    ids = np.arange(16, dtype=np.int64)
+    first = client.pull_tables({"a": ids, "b": ids})
+    assert set(first) == {"a", "b"}
+    assert ps.batch_pulls == 1 and ps.single_pulls == 0
+    again = client.pull_tables({"a": ids, "b": ids})
+    # all rows cache-fresh: no new RPC of either kind
+    assert ps.batch_pulls == 1 and ps.single_pulls == 0
+    for name in ("a", "b"):
+        np.testing.assert_array_equal(first[name], again[name])
+
+
+def test_pull_tables_partial_miss_pulls_only_misses():
+    ps = _CountingClient(seed=0)
+    _tables(ps)
+    client = EmbeddingClient(ps, cache=HotRowCache(ttl_secs=60.0))
+    client.pull_tables({"a": np.arange(8, dtype=np.int64)})
+    before = ps.pulled_ids
+    rows = client.pull_tables({"a": np.arange(12, dtype=np.int64)})
+    assert ps.pulled_ids - before == 4  # only ids 8..11 hit the wire
+    direct = ps.store.lookup("a", np.arange(12, dtype=np.int64))
+    np.testing.assert_array_equal(rows["a"], direct)
+
+
+def test_fan_out_without_batch_pull_matches():
+    class _NoBatch(LocalPSClient):
+        pull_embedding_batch = None
+
+        def __getattribute__(self, name):
+            if name == "pull_embedding_batch":
+                raise AttributeError(name)
+            return super().__getattribute__(name)
+
+    ps = _NoBatch(seed=0)
+    _tables(ps)
+    client = EmbeddingClient(ps)
+    ids = np.arange(6, dtype=np.int64)
+    rows = client.pull_tables({"a": ids, "b": ids})
+    np.testing.assert_array_equal(rows["a"], ps.store.lookup("a", ids))
+    np.testing.assert_array_equal(rows["b"], ps.store.lookup("b", ids))
+
+
+def test_invalidate_drops_rows_from_any_thread():
+    ps = _CountingClient(seed=0)
+    _tables(ps)
+    client = EmbeddingClient(
+        ps, cache=HotRowCache(ttl_secs=60.0, thread_safe=True)
+    )
+    ids = np.arange(4, dtype=np.int64)
+    client.pull_tables({"a": ids})
+    thread = threading.Thread(target=client.invalidate)
+    thread.start()
+    thread.join()
+    before = ps.pulled_ids
+    client.pull_tables({"a": ids})
+    assert ps.pulled_ids - before == 4  # cache was really dropped
+
+
+# ---------------------------------------------------------------------------
+# read-only preparer (the serving resolve path)
+
+
+def test_read_only_preparer_never_writes():
+    class _ReadOnlyGuard(LocalPSClient):
+        def push_embedding_table_infos(self, infos):
+            raise AssertionError("read-only consumer pushed table infos")
+
+        def push_gradients(self, *a, **k):
+            raise AssertionError("read-only consumer pushed gradients")
+
+    from elasticdl_tpu.train.sparse import (
+        SparseBatchPreparer,
+        SparseEmbeddingSpec,
+    )
+
+    ps = _ReadOnlyGuard(seed=0)
+    # tables exist already (created by "training")
+    LocalPSClient.push_embedding_table_infos(ps, [("t", 4, "0.05")])
+    preparer = SparseBatchPreparer(
+        [SparseEmbeddingSpec("t", 4, feature_key="ids", capacity=32)],
+        ps,
+        cache=HotRowCache(ttl_secs=60.0, thread_safe=True),
+        read_only=True,
+    )
+    batch = {
+        "features": {
+            "ids": np.arange(8, dtype=np.int64).reshape(4, 2)
+        }
+    }
+    prepared, _ = preparer.prepare(batch)
+    assert prepared["features"]["t__rows"].shape == (32, 4)
+    # a PS-relaunch hook must not re-arm registration either
+    preparer._on_ps_restart(0)
+    preparer.prepare(batch)
