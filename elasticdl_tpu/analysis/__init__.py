@@ -13,6 +13,10 @@ for (or refuses to pay for):
   construction (Counter/Gauge/Histogram lookup) inside hot functions;
   instruments are hoisted to module/init scope, only
   inc/set/observe on the step path.
+- ``obs-span-no-context`` — no gRPC stub calls inside ``span(...)``
+  blocks in modules that bypass ``build_channel``: the trace context
+  propagates only through the channel interceptor, so a raw-channel
+  stub call orphans the remote half of the trace.
 - ``ft-swallowed-except`` / ``ft-grpc-timeout`` — fault-tolerance
   hygiene: no broad except that swallows without logging/re-raising,
   no gRPC stub call without a deadline.

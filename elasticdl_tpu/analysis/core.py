@@ -221,6 +221,7 @@ def _rules_by_name(names=None):
         hot_path,
         lock_discipline,
         obs_hot_path,
+        obs_span,
         perf_gather,
         perf_wire,
         serve_queue,
@@ -230,6 +231,7 @@ def _rules_by_name(names=None):
         "lock-discipline": lock_discipline.run,
         "jax-hot-path": hot_path.run,
         "obs-hot-path": obs_hot_path.run,
+        "obs-span-no-context": obs_span.run,
         "perf-varint-ids": perf_wire.run,
         "perf-host-gather": perf_gather.run,
         "serve-unbounded-queue": serve_queue.run,
@@ -251,6 +253,7 @@ RULE_NAMES = (
     "lock-discipline",
     "jax-hot-path",
     "obs-hot-path",
+    "obs-span-no-context",
     "perf-varint-ids",
     "perf-host-gather",
     "serve-unbounded-queue",

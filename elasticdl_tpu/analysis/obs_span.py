@@ -1,0 +1,152 @@
+"""obs-span-no-context: stub calls inside span blocks off the
+propagating channel.
+
+The ISSUE-9 trace context crosses the process boundary only when the
+stub's channel came from ``common/grpc_utils.build_channel``, which
+installs the ``edl-traceparent`` client interceptor
+(observability/trace_propagation.py). A gRPC stub call site sitting
+INSIDE a ``with span(...)`` / ``root_span(...)`` block but speaking
+through a hand-rolled ``grpc.insecure_channel`` silently drops the
+context: the trace LOOKS complete (the client span records) while the
+remote half is orphaned — the worst failure mode for a tracing system,
+because nobody notices until the one incident where the missing half
+mattered.
+
+What fires:
+
+- a call whose receiver chain contains a ``stub``-named part
+  (``stub.get_task(...)``, ``self._stubs[shard].push_gradients(...)``,
+  ``self._stub.predict(...)``) lexically inside a ``with`` block whose
+  context expression is ``span(...)``, ``root_span(...)``,
+  ``trace.span(...)`` or ``trace.root_span(...)`` —
+- in a module that never references ``build_channel`` (importing or
+  calling it anywhere in the module is the exemption: every stub in
+  such a module rides the propagating channel).
+
+The module-level exemption is deliberately coarse: the rule pins the
+PATTERN (span + stub + raw channel), and a rare false positive is one
+``# edlint: disable=obs-span-no-context`` away.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import Finding, attr_chain
+
+RULE = "obs-span-no-context"
+
+_SPAN_NAMES = {"span", "root_span"}
+
+
+def _module_uses_build_channel(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if any(a.name == "build_channel" for a in node.names):
+                return True
+        elif isinstance(node, ast.Name) and node.id == "build_channel":
+            return True
+        elif (
+            isinstance(node, ast.Attribute)
+            and node.attr == "build_channel"
+        ):
+            return True
+    return False
+
+
+def _is_span_item(item):
+    """True for ``with span(...)`` / ``with trace.root_span(...)``."""
+    call = item.context_expr
+    if not isinstance(call, ast.Call):
+        return False
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id in _SPAN_NAMES
+    if isinstance(func, ast.Attribute):
+        return func.attr in _SPAN_NAMES
+    return False
+
+
+def _stub_calls(node):
+    """Call nodes under ``node`` whose receiver chain names a stub."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        if not isinstance(sub.func, ast.Attribute):
+            continue
+        chain = attr_chain(sub.func)
+        if chain is None:
+            continue
+        parts = chain.split(".")
+        # the final part is the method being called; a stub must be in
+        # the receiver ("self._stubs.push_gradients" via the
+        # subscript-collapsing attr_chain)
+        if any("stub" in part.lower() for part in parts[:-1]):
+            yield sub, chain
+
+
+def _scope_of(tree, target):
+    """Innermost def/class chain containing ``target`` (linear scan —
+    the rule only runs this for actual findings)."""
+    scope = "<module>"
+
+    def rec(node, chain):
+        nonlocal scope
+        for child in ast.iter_child_nodes(node):
+            child_chain = chain
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                child_chain = (
+                    chain + "." + child.name
+                    if chain != "<module>"
+                    else child.name
+                )
+            if child is target:
+                scope = child_chain
+                return True
+            if rec(child, child_chain):
+                return True
+        return False
+
+    rec(tree, "<module>")
+    return scope
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        if _module_uses_build_channel(unit.tree):
+            continue
+        span_blocks = [
+            node
+            for node in ast.walk(unit.tree)
+            if isinstance(node, (ast.With, ast.AsyncWith))
+            and any(_is_span_item(item) for item in node.items)
+        ]
+        if not span_blocks:
+            continue
+        seen_lines = set()
+        for block in span_blocks:
+            for call, chain in _stub_calls(block):
+                if call.lineno in seen_lines:
+                    continue  # nested span blocks see the call twice
+                seen_lines.add(call.lineno)
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.path,
+                        line=call.lineno,
+                        symbol=_scope_of(unit.tree, call),
+                        code=chain,
+                        message=(
+                            "gRPC stub call inside a span(...) block in "
+                            "a module that never uses build_channel: "
+                            "%s bypasses the trace-propagating channel, "
+                            "so the remote half of this span's trace is "
+                            "orphaned; build the channel with "
+                            "common/grpc_utils.build_channel (or move "
+                            "the call out of the traced block)" % chain
+                        ),
+                    )
+                )
+    return findings

@@ -12,6 +12,7 @@ import grpc
 
 from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import trace as _trace
 
 logger = _logger_factory("elasticdl_tpu.common.grpc_utils")
 
@@ -85,8 +86,19 @@ def retry_call(fn, what, budget_secs, retryable=RETRYABLE_CODES,
     draw = (rng or random).uniform
     deadline = time.monotonic() + budget_secs
     ceiling = base_delay
+    attempt = 0
     while True:
+        attempt += 1
         try:
+            # each attempt is its OWN child span (ISSUE 9): a retried
+            # RPC shows as N sibling spans — the failed attempts carry
+            # error/code args — never one span double-ended, and the
+            # propagated parent the server sees is the attempt that
+            # actually reached it
+            if _trace.enabled():
+                with _trace.span("rpc_attempt", what=what,
+                                 attempt=attempt):
+                    return fn()
             return fn()
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
@@ -112,6 +124,16 @@ def retry_call(fn, what, budget_secs, retryable=RETRYABLE_CODES,
 
 def build_channel(addr: str) -> grpc.Channel:
     channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+    # trace-context propagation (observability/trace_propagation.py):
+    # identity pass-through unless EDL_TRACE_DIR is set with a nonzero
+    # sample rate. Inner of the fault interceptor on purpose: a
+    # client-side injected fault fails before "sending", so it must
+    # not reach the wire-facing layers.
+    from elasticdl_tpu.observability.trace_propagation import (
+        intercept_trace_channel,
+    )
+
+    channel = intercept_trace_channel(channel)
     # deterministic fault injection (testing/faults.py): identity
     # pass-through unless EDL_FAULT_SPEC names this role's client calls
     from elasticdl_tpu.testing.faults import intercept_client_channel

@@ -107,6 +107,9 @@ EVENT_TYPES = frozenset({
     "serve_drained",         # SIGTERM drain: admissions stopped, queue
                              #   flushed (+ reason, flushed, served,
                              #   shed)
+    # distributed tracing (ISSUE 9)
+    "trace_flushed",         # a drain path flushed the trace buffer to
+                             #   EDL_TRACE_DIR (+ reason)
 })
 
 

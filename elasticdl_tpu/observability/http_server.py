@@ -31,6 +31,10 @@ logger = _logger_factory("elasticdl_tpu.observability.http_server")
 PORT_ENV = metrics_mod.PORT_ENV
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+EXEMPLARS_ENV = metrics_mod.EXEMPLARS_ENV
 
 
 def resolve_port(cli_port=None):
@@ -93,8 +97,33 @@ class ObservabilityServer:
             def do_GET(self):  # noqa: N802 (BaseHTTPRequestHandler API)
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
-                    body = server.registry.render().encode("utf-8")
-                    self._reply(200, body, CONTENT_TYPE)
+                    # exemplars (ISSUE 9) ride only the content-
+                    # negotiated OpenMetrics path or the explicit env
+                    # opt-in. Negotiation is deliberately EXCLUSIVE: a
+                    # stock Prometheus advertises openmetrics AND a
+                    # text/plain fallback in its default Accept, and
+                    # switching it onto this pragmatic exposition
+                    # (0.0.4 naming + exemplar suffixes) would regress
+                    # a consumer that parsed fine yesterday — so any
+                    # client offering a text/plain fallback gets plain
+                    # 0.0.4, and only a deliberate openmetrics-only
+                    # Accept (an operator chasing an exemplar) switches.
+                    accept = self.headers.get("Accept", "") or ""
+                    negotiated = (
+                        "application/openmetrics-text" in accept
+                        and "text/plain" not in accept
+                    )
+                    env_gated = os.environ.get(
+                        EXEMPLARS_ENV, ""
+                    ) not in ("", "0")
+                    text = server.registry.render(
+                        exemplars=negotiated or env_gated
+                    )
+                    content_type = CONTENT_TYPE
+                    if negotiated:
+                        text += "# EOF\n"
+                        content_type = OPENMETRICS_CONTENT_TYPE
+                    self._reply(200, text.encode("utf-8"), content_type)
                 elif path == "/healthz":
                     self._reply(200, b"ok\n")
                 elif path == "/readyz":

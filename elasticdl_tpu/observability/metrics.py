@@ -20,9 +20,17 @@ this reason.
 
 import os
 import threading
+import time
 
 ENABLE_ENV = "EDL_METRICS"
 PORT_ENV = "EDL_METRICS_PORT"
+EXEMPLARS_ENV = "EDL_METRICS_EXEMPLARS"
+
+# a histogram series' exemplar is the SLOWEST recent observation that
+# happened inside a sampled trace; "recent" is this window — past it
+# any traced observation replaces the stale exemplar, so the linked
+# trace_id always points at a trace an operator can still find
+EXEMPLAR_WINDOW_SECS = 60.0
 
 # exponential latency buckets (seconds), prometheus client defaults —
 # spans sub-ms in-process RPCs up to the 120 s PS retry budget
@@ -275,6 +283,9 @@ class Histogram:
         self._lock = threading.Lock()
         # labelvalues tuple -> [per-bucket counts, sum, count]
         self._series = {}
+        # labelvalues tuple -> (value, trace_id, unix ts): the slowest
+        # recent observation made under a sampled span context
+        self._exemplars = {}
 
     def labels(self, *values, **kv):
         key = _label_key(self.name, self.labelnames, values, kv)
@@ -298,6 +309,9 @@ class Histogram:
 
     def _observe(self, key, value):
         value = float(value)
+        # exemplar candidacy costs one thread-local read when no trace
+        # is active (the overwhelmingly common case)
+        ctx = _trace_context()
         with self._lock:
             counts, _sum, _n = series = self._touch_locked(key)
             for i, bound in enumerate(self.buckets):
@@ -305,8 +319,23 @@ class Histogram:
                     counts[i] += 1
             series[1] = _sum + value
             series[2] = _n + 1
+            if ctx is not None and ctx.sampled:
+                now = time.time()
+                exemplar = self._exemplars.get(key)
+                if (
+                    exemplar is None
+                    or value >= exemplar[0]
+                    or now - exemplar[2] > EXEMPLAR_WINDOW_SECS
+                ):
+                    self._exemplars[key] = (value, ctx.trace_id, now)
 
-    def render(self):
+    def render(self, exemplars=False):
+        """Prometheus 0.0.4 lines; with ``exemplars`` each series'
+        exemplar rides its bucket line in OpenMetrics syntax
+        (``... # {trace_id="..."} value ts``). Exemplars are OFF on the
+        default path on purpose: the ``#`` suffix is an OpenMetrics
+        construct some 0.0.4 consumers reject, so only the
+        content-negotiated/env-gated exposition carries them."""
         lines = [
             "# HELP %s %s" % (self.name, self.help),
             "# TYPE %s histogram" % self.name,
@@ -316,20 +345,30 @@ class Histogram:
                 key: (list(counts), s, n)
                 for key, (counts, s, n) in self._series.items()
             }
+            exemplar_snapshot = dict(self._exemplars) if exemplars else {}
         for key in sorted(snapshot):
             counts, total, n = snapshot[key]
+            exemplar = exemplar_snapshot.get(key)
             for bound, count in zip(self.buckets, counts):
-                lines.append(
-                    "%s_bucket%s %d"
-                    % (
-                        self.name,
-                        _format_labels(
-                            self.labelnames, key,
-                            extra=(("le", _format_value(bound)),),
-                        ),
-                        count,
-                    )
+                line = "%s_bucket%s %d" % (
+                    self.name,
+                    _format_labels(
+                        self.labelnames, key,
+                        extra=(("le", _format_value(bound)),),
+                    ),
+                    count,
                 )
+                # the exemplar attaches to the FIRST bucket containing
+                # its value (OpenMetrics: an exemplar must lie within
+                # its bucket's range)
+                if exemplar is not None and exemplar[0] <= bound:
+                    line += ' # {trace_id="%s"} %s %.3f' % (
+                        exemplar[1],
+                        _format_value(exemplar[0]),
+                        exemplar[2],
+                    )
+                    exemplar = None
+                lines.append(line)
             labels = _format_labels(self.labelnames, key)
             lines.append("%s_sum%s %s" % (self.name, labels,
                                           _format_value(total)))
@@ -380,13 +419,18 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
-    def render(self):
-        """Prometheus text exposition format 0.0.4."""
+    def render(self, exemplars=False):
+        """Prometheus text exposition format 0.0.4; ``exemplars=True``
+        adds OpenMetrics exemplar suffixes to histogram bucket lines
+        (the /metrics content-negotiated path, http_server.py)."""
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         lines = []
         for metric in metrics:
-            lines.extend(metric.render())
+            if isinstance(metric, Histogram):
+                lines.extend(metric.render(exemplars=exemplars))
+            else:
+                lines.extend(metric.render())
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -435,3 +479,21 @@ def _logger():
     from elasticdl_tpu.common.log_utils import default_logger
 
     return default_logger("elasticdl_tpu.observability.metrics")
+
+
+# trace.current_context bound once on first observation: metrics must
+# stay importable before (and without) the trace module, but the
+# per-observe cost must be one global read + the thread-local lookup,
+# not import machinery on every histogram observation
+_current_context = None
+
+
+def _trace_context():
+    """Active sampled-trace context, for exemplar candidacy."""
+    global _current_context
+    read = _current_context
+    if read is None:
+        from elasticdl_tpu.observability import trace
+
+        read = _current_context = trace.current_context
+    return read()

@@ -18,6 +18,7 @@ from elasticdl_tpu.common.tensor_utils import (
     deduplicate_indexed_slices,
     wire_dtype,
 )
+from elasticdl_tpu.observability import trace
 from elasticdl_tpu.ps.embedding_store import create_store, parse_initializer
 
 
@@ -54,8 +55,12 @@ class LocalPSClient:
         return False, 0, {}
 
     def pull_embedding_vectors(self, name, ids):
-        rows = self.store.lookup(name, np.asarray(ids, dtype=np.int64))
-        return _wire_round_trip(rows)
+        # role="ps": this process plays both roles, so the span carries
+        # the PS side explicitly — the local trace then attributes
+        # pull/apply the same way a real worker<->PS topology does
+        with trace.span("ps_pull", role="ps", table=name):
+            rows = self.store.lookup(name, np.asarray(ids, dtype=np.int64))
+            return _wire_round_trip(rows)
 
     def pull_embedding_batch(self, ids_by_table):
         """{table: ids} -> {table: rows}; the in-process analogue of
@@ -87,11 +92,18 @@ class LocalPSClient:
         # lr_scale multiplies the store optimizer's configured LR; 0
         # means "no scaling" (mirrors PSClient/the wire field).
         lr_scale = lr_scale if lr_scale > 0 else 1.0
-        for name, (values, ids) in grads_by_table.items():
-            values, ids = deduplicate_indexed_slices(
-                np.asarray(values), np.asarray(ids, dtype=np.int64)
-            )
-            values = _wire_round_trip(np.asarray(values, dtype=np.float32))
-            self.store.push_gradients(name, ids, values, lr_scale=lr_scale)
-        self.store.bump_version()
+        with trace.span(
+            "ps_apply_push", role="ps", version=model_version
+        ):
+            for name, (values, ids) in grads_by_table.items():
+                values, ids = deduplicate_indexed_slices(
+                    np.asarray(values), np.asarray(ids, dtype=np.int64)
+                )
+                values = _wire_round_trip(
+                    np.asarray(values, dtype=np.float32)
+                )
+                self.store.push_gradients(
+                    name, ids, values, lr_scale=lr_scale
+                )
+            self.store.bump_version()
         return True, self.store.version

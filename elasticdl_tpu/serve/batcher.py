@@ -27,7 +27,7 @@ import time
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
-from elasticdl_tpu.observability import metrics
+from elasticdl_tpu.observability import metrics, trace
 
 logger = _logger_factory("elasticdl_tpu.serve.batcher")
 
@@ -85,7 +85,7 @@ def _schema(features):
 class _Request:
     __slots__ = (
         "features", "rows", "deadline", "enqueued", "done",
-        "outputs", "error", "keys",
+        "outputs", "error", "keys", "adopt_trace",
     )
 
     def __init__(self, features, rows, deadline):
@@ -97,6 +97,12 @@ class _Request:
         self.outputs = None
         self.error = None
         self.keys = _schema(features)
+        # span-context snapshot from the admitting RPC thread: the
+        # formation thread adopts the batch HEAD's so the forward (and
+        # its PS pulls) lands in the head request's trace (ISSUE 9 —
+        # batch-level work is attributed to the request that opened
+        # the formation window)
+        self.adopt_trace = trace.capture_context()
 
     def resolve(self, outputs):
         self.outputs = outputs
@@ -307,8 +313,12 @@ class MicroBatcher:
                     for key in live[0].features
                 }
             total = sum(r.rows for r in live)
-            self._m_batch_size.observe(total)
-            outputs, step, stamp = self._runner(features, total)
+            with live[0].adopt_trace():
+                self._m_batch_size.observe(total)
+                with trace.span(
+                    "serve_batch_run", requests=len(live), rows=total
+                ):
+                    outputs, step, stamp = self._runner(features, total)
             offset = 0
             for request in live:
                 request.resolve((

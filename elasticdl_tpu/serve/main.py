@@ -203,12 +203,20 @@ class ServeRole:
     def drain(self, reason="shutdown"):
         """Stop admitting, flush the queue, stop the server. Idempotent
         (the SIGTERM handler and an orderly exit may both arrive)."""
-        from elasticdl_tpu.observability import events
+        from elasticdl_tpu.observability import events, trace
 
         if self._drained.is_set():
             return
         self._drained.set()
         flushed = self.engine.drain()
+        # trace flush ARMS here, before the crash hooks run (ISSUE 9):
+        # the queue just finished flushing, so every request span is
+        # final — a SIGKILL-grace-window race after this line loses
+        # nothing. The chained install_crash_hooks handler flushes
+        # again; TraceWriter.flush is idempotent on an empty buffer.
+        trace.flush()
+        if trace.enabled():
+            events.emit("trace_flushed", reason=reason)
         try:
             if self.server is not None:
                 self.server.stop(grace=2.0)

@@ -15,7 +15,7 @@ import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import blob_to_ndarray, ndarray_to_blob
-from elasticdl_tpu.observability import metrics
+from elasticdl_tpu.observability import metrics, trace
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.serve import batcher as batcher_mod
 from elasticdl_tpu.serve.model import SINGLE_INPUT_KEY
@@ -44,9 +44,23 @@ class ServeServicer:
     # ------------------------------------------------------------------
     def _abort(self, context, code, detail):
         self._m_requests.labels(code=code.name).inc()
+        # grpc's abort raises a bare Exception carrying no status, so
+        # stamp the code onto the open serve_predict root span here —
+        # critical_path.py classifies sheds by this arg
+        trace.annotate(code=code.name)
         context.abort(code, detail)
 
     def predict(self, request, context):
+        # the serve-side trace root, opened at ADMISSION time
+        # (ISSUE 9): queue wait, batch formation, forward, and the
+        # EmbeddingClient's PS pulls all become children; a shed
+        # surfaces as this span failing with the abort's status code.
+        # If the CALLER propagated a context, root_span degrades to a
+        # child span so the client's trace stays whole.
+        with trace.root_span("serve_predict", role="serve"):
+            return self._predict(request, context)
+
+    def _predict(self, request, context):
         start = time.perf_counter()
         if not self._engine.loaded:
             self._abort(

@@ -118,13 +118,28 @@ class LocalExecutor:
 
     # ------------------------------------------------------------------
     def train(self):
+        from elasticdl_tpu.observability import trace
+
         losses = []
+        step = 0
         for epoch in range(self._num_epochs):
             for batch in self._batches(self._train_reader, "training"):
                 t0 = self._timing.start()
-                self.state, loss = self.trainer.train_step(self.state, batch)
+                # the local run traces like the distributed one
+                # (ISSUE 9): each step is a root span, and the
+                # in-process LocalPSClient's apply/pull spans (tagged
+                # role="ps") chain under it through the thread-local
+                # context — so merge_trace + critical_path report the
+                # same worker/PS attribution a real topology yields
+                with trace.root_span(
+                    "train_batch", role="worker", step=step
+                ):
+                    self.state, loss = self.trainer.train_step(
+                        self.state, batch
+                    )
                 losses.append(float(loss))
                 self._timing.end_record("batch_process", t0)
+                step += 1
             logger.info(
                 "Epoch %d done; last-batch loss %.4f", epoch, losses[-1]
             )

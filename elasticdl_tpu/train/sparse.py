@@ -26,6 +26,7 @@ from elasticdl_tpu.common.annotations import hot_path
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
 from elasticdl_tpu.data.pipeline import MASK_KEY
+from elasticdl_tpu.observability import trace
 # HotRowCache lives in the extracted embedding-client library (ISSUE 8)
 # so the serving tier shares the training pull/cache stack; re-exported
 # here for the long-standing import path.
@@ -873,8 +874,12 @@ class SparseTrainer:
             # exactly one push of staleness, the async-PS envelope.
             with self.timing.timeit("sparse_push"):
                 self.join_pushes()
+            # bind_context: the async push runs on the executor thread
+            # AFTER this step's root span closed; binding keeps its
+            # ps_push / RPC-attempt spans children of the step that
+            # produced the gradients, not orphans (ISSUE 9)
             self._push_future = self._async_pool.submit(
-                self.preparer.push_gradients,
+                trace.bind_context(self.preparer.push_gradients),
                 row_grads,
                 pull_info,
                 model_version=self._version,

@@ -330,13 +330,17 @@ class PSClient:
         shard_of = ids % self.ps_num
         futures = {}
         positions = {}
+        # bind_context: the per-shard futures run on pool threads; the
+        # step's span context must ride along or the propagation
+        # interceptor has nothing to serialize (ISSUE 9)
+        call = trace.bind_context(_call_with_retry)
         for shard in np.unique(shard_of):
             pos = np.nonzero(shard_of == shard)[0]
             positions[int(shard)] = pos
             request = self._pull_request(name, ids[pos])
             stub = self._stubs[int(shard)]
             futures[int(shard)] = self._pool.submit(
-                _call_with_retry,
+                call,
                 lambda stub=stub, request=request:
                     stub.pull_embedding_vectors(
                         request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
@@ -375,10 +379,9 @@ class PSClient:
                 max_workers=max(4, len(ids_by_table)),
                 thread_name_prefix="ps-table-pull",
             )
+        pull = trace.bind_context(self._pull_embedding_vectors)
         futures = {
-            name: self._table_pool.submit(
-                self._pull_embedding_vectors, name, ids
-            )
+            name: self._table_pool.submit(pull, name, ids)
             for name, ids in ids_by_table.items()
         }
         return {name: future.result() for name, future in futures.items()}
@@ -408,12 +411,13 @@ class PSClient:
                     ids[pos]
                 )
         futures = {}
+        call = trace.bind_context(_call_with_retry)
         for shard, request in enumerate(requests):
             if not request.tables:
                 continue
             stub = self._stubs[shard]
             futures[shard] = self._pool.submit(
-                _call_with_retry,
+                call,
                 lambda stub=stub, request=request:
                     stub.pull_embedding_batch(
                         request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
@@ -490,13 +494,14 @@ class PSClient:
                     packed=not self._legacy_ids,
                 )
         futures = []
+        call = trace.bind_context(_call_with_retry)
         for shard, (stub, request) in enumerate(
             zip(self._stubs, requests)
         ):
             if not request.embedding_tables:
                 continue
             futures.append((shard, self._pool.submit(
-                _call_with_retry,
+                call,
                 lambda stub=stub, request=request:
                     stub.push_embedding_rows(
                         request, timeout=PS_RETRY_BUDGET_SECS
@@ -595,6 +600,7 @@ class PSClient:
                     packed=not self._legacy_ids,
                 )
         futures = []
+        call = trace.bind_context(_call_with_retry)
         for shard, (stub, request) in enumerate(zip(self._stubs, per_ps)):
             if not request.gradients.embedding_tables and not force_empty:
                 continue
@@ -614,7 +620,7 @@ class PSClient:
             # minibatch.
             futures.append(
                 (shard, self._pool.submit(
-                    _call_with_retry,
+                    call,
                     lambda stub=stub, request=request:
                         stub.push_gradients(
                             request, timeout=PS_RETRY_BUDGET_SECS

@@ -640,16 +640,20 @@ class Worker:
 
     def _traced_train_step(self, batch):
         """One train step, timed (Timing bridge feeds the step-time
-        gauge) and — when EDL_TRACE_DIR is set — wrapped in a
-        task_id-carrying span so the PS client's pull/push spans nested
-        inside it inherit the correlation key."""
+        gauge) and — when EDL_TRACE_DIR is set — the ROOT SPAN of a
+        distributed trace (ISSUE 9): the PS client's pull/push spans
+        become its children, the propagated context crosses the gRPC
+        hop, and the PS-side apply lands in the same trace. The
+        task_id context rides along as the coarse correlation key."""
         t0 = self._timing.start()
         if not trace.enabled():
             self.state, loss = self.trainer.train_step(self.state, batch)
             self._timing.end_record_sync("batch_process", t0, loss)
             return loss
         with trace.task_context(self.tds.current_task_id()):
-            with trace.span("train_batch", version=self._version):
+            with trace.root_span(
+                "train_batch", role="worker", version=self._version
+            ):
                 self.state, loss = self.trainer.train_step(
                     self.state, batch
                 )

@@ -66,6 +66,54 @@ with tempfile.TemporaryDirectory() as tmp:
 print("observability smoke OK")
 PYEOF
 
+echo "== tier 1d (tracing): distributed-trace smoke (merge + critical path) =="
+# ISSUE 9: a deepfm local-executor run with EDL_TRACE_DIR + head
+# sampling on must yield one trace per step whose worker root span has
+# PS-side child spans linked via propagated context; merge_trace +
+# critical_path then produce a per-segment attribution report. The
+# report numbers are REPORT-ONLY (journaled below, like tier 1f); the
+# hard gate is structural: every step trace spans >= 2 roles (worker
+# AND ps), or cross-role propagation broke.
+TRACE_DIR="$(mktemp -d)"
+export TRACE_DIR
+JAX_PLATFORMS=cpu EDL_TRACE_DIR="$TRACE_DIR" EDL_TRACE_SAMPLE=1 \
+python - <<'PYEOF'
+import sys, tempfile
+sys.path.insert(0, "tests")
+from test_utils import create_ctr_recordio
+from elasticdl_tpu.train.local_executor import LocalExecutor
+from elasticdl_tpu.observability import trace
+
+with tempfile.TemporaryDirectory() as tmp:
+    create_ctr_recordio(tmp + "/f0.rec", num_records=128, seed=0)
+    executor = LocalExecutor(
+        "elasticdl_tpu.models.deepfm", training_data=tmp,
+        minibatch_size=32, num_epochs=1,
+    )
+    executor.train()
+    trace.flush()
+print("traced deepfm run OK")
+PYEOF
+python scripts/merge_trace.py "$TRACE_DIR"
+# both consumers read the file merge_trace just wrote (no re-merge)
+python scripts/trace_summary.py "$TRACE_DIR/merged.trace.json" --slowest 3
+python scripts/critical_path.py "$TRACE_DIR/merged.trace.json" 2>/dev/null > /tmp/_critical_path.json
+printf '{"ts": "%s", "critical_path": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_critical_path.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+python - <<'PYEOF'
+import json
+report = json.load(open("/tmp/_critical_path.json"))
+step = report.get("step")
+assert step and step["count"] >= 2, report
+# the gate: every step trace carries spans from BOTH roles
+assert step["multi_role_traces"] == step["count"], step
+assert {"worker", "ps"} <= set(step["roles"]), step
+assert {"compute", "apply"} <= set(step["segments"]), step
+print("tracing smoke OK: %d step traces, roles %s, segments %s"
+      % (step["count"], step["roles"], sorted(step["segments"])))
+PYEOF
+
 echo "== tier 1d+: flight recorder smoke (/statusz /alerts + postmortem) =="
 # a real master + in-process worker with EDL_EVENTS_DIR set: the master
 # must serve the fleet snapshot and alert list, the roles must journal

@@ -1,14 +1,19 @@
 #!/usr/bin/env python
 """Merge per-role EDL trace files into one Perfetto-loadable timeline.
 
-Each role (master / worker-N / ps-N) buffers Chrome trace events to
-``$EDL_TRACE_DIR/<role>-<pid>.trace.json``
+Each role (master / worker-N / ps-N / serve-N) buffers Chrome trace
+events to ``$EDL_TRACE_DIR/<role>-<pid>.trace.json``
 (elasticdl_tpu/observability/trace.py). Timestamps are already
 wall-clock microseconds, so merging is concatenation — plus flow
-events threaded through every span that carries the same ``task_id``,
-which is what makes a single task's dispatch (master) → pull/train/push
-(worker) → apply (PS) hop visibly connected when the merged file is
-opened in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+events that make the cross-role hops visible arrows in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing.
+
+Flows thread by the PROPAGATED trace context first (ISSUE 9): spans
+carrying ``trace_id``/``span_id``/``parent_id`` args — one worker step
+or one serve predict request spanning worker → PS / client → serve →
+PS — are grouped exactly, parent to child, no heuristics. Spans
+WITHOUT a trace context (older trace files, standalone spans) fall
+back to the PR-2 ``task_id`` correlation so old captures keep merging.
 
 Usage:
     python scripts/merge_trace.py TRACE_DIR [-o merged.trace.json]
@@ -17,6 +22,7 @@ Usage:
 import argparse
 import json
 import os
+import re
 import sys
 
 
@@ -68,18 +74,104 @@ def load_role_files(trace_dir):
     return loaded
 
 
+# shared helpers for the consumers sitting on top of a capture
+# (trace_summary.py, critical_path.py) — one definition, one behavior
+
+
+def load_events(path):
+    """Events from a trace DIR (merged in-memory) or a merged file."""
+    if os.path.isdir(path):
+        merged, _names = merge(path)
+        return merged["traceEvents"]
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    return data
+
+
+def role_by_pid(events):
+    """pid -> role name, from the process_name metadata events."""
+    return {
+        e["pid"]: (e.get("args") or {}).get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+
+
+def normalize_role(role):
+    # "worker-3" -> "worker", "ps-0" -> "ps", "serve-1" -> "serve"
+    return re.sub(r"-\d+$", "", str(role))
+
+
+def percentile(values, q):
+    """Nearest-rank percentile; None on an empty list."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    index = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return ordered[index]
+
+
+def context_flow_events(events):
+    """Flow (s/t/f) events threading every span of one TRACE (same
+    propagated ``trace_id``) across processes, in timestamp order —
+    the exact grouping the span context carried over gRPC metadata."""
+    by_trace = {}
+    for event in events:
+        if event.get("ph") != "X":
+            continue
+        trace_id = (event.get("args") or {}).get("trace_id")
+        if not trace_id:
+            continue
+        by_trace.setdefault(trace_id, []).append(event)
+    flows = []
+    for trace_id, spans in sorted(by_trace.items()):
+        if len(spans) < 2:
+            continue
+        spans.sort(key=lambda e: e["ts"])
+        for i, event in enumerate(spans):
+            phase = "s" if i == 0 else ("f" if i == len(spans) - 1 else "t")
+            flow = {
+                "name": "trace",
+                "cat": "trace",
+                "ph": phase,
+                "id": trace_id[:16],
+                "ts": event["ts"],
+                "pid": event["pid"],
+                "tid": event["tid"],
+            }
+            if phase == "f":
+                flow["bp"] = "e"  # bind to the enclosing slice
+            flows.append(flow)
+    return flows
+
+
 def task_flow_events(events):
     """Flow (s/t/f) events connecting same-task_id spans across
-    processes, in timestamp order. Perfetto draws these as arrows from
-    the master's dispatch span through the worker's train/push spans."""
+    processes, in timestamp order. Task groups whose EVERY span also
+    carries a trace context are skipped (context_flow_events already
+    threads them exactly); mixed groups still thread fully — the
+    master's ``dispatch`` span has a task_id but no trace context (the
+    worker's get_task poll runs outside any root span), and dropping
+    the worker's context-carrying train/push spans from its group
+    would orphan the dispatch arrow the PR-2 timeline promises."""
     by_task = {}
     for event in events:
         if event.get("ph") != "X":
             continue
-        task_id = (event.get("args") or {}).get("task_id")
+        args = event.get("args") or {}
+        task_id = args.get("task_id")
         if task_id in (None, ""):
             continue
         by_task.setdefault(task_id, []).append(event)
+    by_task = {
+        task_id: spans
+        for task_id, spans in by_task.items()
+        if any(
+            not (e.get("args") or {}).get("trace_id") for e in spans
+        )
+    }
     flows = []
     for task_id, spans in sorted(by_task.items(), key=lambda kv: str(kv[0])):
         if len(spans) < 2:
@@ -109,6 +201,7 @@ def merge(trace_dir):
     events = []
     for _name, role_events in role_files:
         events.extend(role_events)
+    events.extend(context_flow_events(events))
     events.extend(task_flow_events(events))
     # stable display: metadata first, then time order
     events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))

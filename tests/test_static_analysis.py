@@ -677,6 +677,83 @@ def test_cli_exits_zero_on_clean_file(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# obs-span-no-context (ISSUE 9)
+
+def test_obs_span_flags_stub_call_on_raw_channel():
+    findings = findings_for("""
+        import grpc
+        from elasticdl_tpu.observability import trace
+
+        class Client:
+            def __init__(self, addr):
+                self._stubs = [Stub(grpc.insecure_channel(addr))]
+
+            def pull(self, request, shard):
+                with trace.span("ps_pull"):
+                    return self._stubs[shard].pull_embedding_vectors(
+                        request, timeout=5
+                    )                                       # BUG
+    """, rules=["obs-span-no-context"])
+    assert len(findings) == 1, findings
+    assert findings[0].code == "self._stubs.pull_embedding_vectors"
+    assert findings[0].symbol == "Client.pull"
+
+
+def test_obs_span_flags_root_span_blocks_too():
+    findings = findings_for("""
+        from elasticdl_tpu.observability.trace import root_span
+
+        def predict(stub, request):
+            with root_span("serve_predict"):
+                return stub.predict(request, timeout=5)  # BUG
+    """, rules=["obs-span-no-context"])
+    assert len(findings) == 1
+    assert findings[0].code == "stub.predict"
+
+
+def test_obs_span_quiet_with_build_channel_module():
+    # the module obtains its channels from build_channel: every stub
+    # rides the propagating interceptor, span blocks are fine
+    assert not findings_for("""
+        from elasticdl_tpu.common.grpc_utils import build_channel
+        from elasticdl_tpu.observability import trace
+
+        class Client:
+            def __init__(self, addr):
+                self._stub = Stub(build_channel(addr))
+
+            def pull(self, request):
+                with trace.span("ps_pull"):
+                    return self._stub.pull(request, timeout=5)
+    """, rules=["obs-span-no-context"])
+
+
+def test_obs_span_quiet_outside_span_blocks():
+    assert not findings_for("""
+        import grpc
+
+        class Client:
+            def __init__(self, addr):
+                self._stub = Stub(grpc.insecure_channel(addr))
+
+            def pull(self, request):
+                return self._stub.pull(request, timeout=5)
+    """, rules=["obs-span-no-context"])
+
+
+def test_obs_span_suppression_comment_works():
+    assert not findings_for("""
+        import grpc
+        from elasticdl_tpu.observability import trace
+
+        def probe(stub, request):
+            with trace.span("probe"):
+                # edlint: disable=obs-span-no-context
+                return stub.check(request, timeout=5)
+    """, rules=["obs-span-no-context"])
+
+
+# ---------------------------------------------------------------------------
 # the gate
 
 @pytest.mark.lint
