@@ -223,6 +223,7 @@ def _rules_by_name(names=None):
         obs_hot_path,
         obs_span,
         perf_gather,
+        perf_gil,
         perf_wire,
         serve_queue,
     )
@@ -234,6 +235,7 @@ def _rules_by_name(names=None):
         "obs-span-no-context": obs_span.run,
         "perf-varint-ids": perf_wire.run,
         "perf-host-gather": perf_gather.run,
+        "perf-gil-held-apply": perf_gil.run,
         "serve-unbounded-queue": serve_queue.run,
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
@@ -256,6 +258,7 @@ RULE_NAMES = (
     "obs-span-no-context",
     "perf-varint-ids",
     "perf-host-gather",
+    "perf-gil-held-apply",
     "serve-unbounded-queue",
     "ft-swallowed-except",
     "ft-grpc-timeout",

@@ -1,0 +1,127 @@
+"""perf-gil-held-apply: proto parsing and store apply under one lock.
+
+The idiom this rule keeps out of the PS servicer (the pre-ISSUE-11
+sync-path shape, hoisted in PR 5 and load-bearing ever since):
+
+    with self._push_lock:
+        values, ids = _deserialize_gradients(slices)   # pure CPU work
+        self._store.push_gradients(name, ids, values)  # the apply
+
+Deserialization is per-request CPU work that needs no shared state;
+doing it inside the push lock serializes every peer's push of a sync
+round behind one worker's decode — and with the native store's
+GIL-released applies (ISSUE 11) the lock becomes the ONLY remaining
+serialization point, so work smuggled under it is pure lost
+parallelism. The fix is mechanical: parse outside, take the lock for
+the apply alone.
+
+Scope: PS servicer modules only (path contains ``ps/`` or a
+``servicer`` basename). Elsewhere a lock around parse+apply can be a
+deliberate atomicity choice; on the PS push path it never is — the
+buffered-round design already separates the two.
+
+What fires: a ``with`` statement whose context expression mentions a
+lock (name/attribute containing ``lock``) and whose body contains BOTH
+a parse-ish call (``deserialize``/``unpack_ids``/``blob_to_ndarray``/
+``ParseFromString``/``FromString``/``frombuffer``) and a store apply
+(``push_gradients``/``push_gradients_blob``/``import_table``/
+``import_blob``/``import_table_full``) at any nesting depth inside
+that block.
+"""
+
+import ast
+import os
+
+from elasticdl_tpu.analysis.core import Finding, walk_with_scope
+
+RULE = "perf-gil-held-apply"
+
+_PARSE_NAMES = {
+    "deserialize_indexed_slices",
+    "_deserialize_gradients",
+    "unpack_ids",
+    "blob_to_ndarray",
+    "ParseFromString",
+    "FromString",
+    "frombuffer",
+}
+
+_APPLY_NAMES = {
+    "push_gradients",
+    "push_gradients_blob",
+    "import_table",
+    "import_table_full",
+    "import_blob",
+}
+
+
+def _call_name(node):
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _mentions_lock(expr):
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and "lock" in node.attr.lower():
+            return True
+        if isinstance(node, ast.Name) and "lock" in node.id.lower():
+            return True
+    return False
+
+
+def _servicer_module(path):
+    normalized = path.replace(os.sep, "/")
+    return (
+        "/ps/" in normalized
+        or "servicer" in os.path.basename(normalized)
+    )
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        if not _servicer_module(unit.path):
+            continue
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(
+                _mentions_lock(item.context_expr) for item in node.items
+            ):
+                continue
+            parses, applies = set(), set()
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    name = _call_name(sub)
+                    if name in _PARSE_NAMES:
+                        parses.add(name)
+                    elif name in _APPLY_NAMES:
+                        applies.add(name)
+            if parses and applies:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.path,
+                        line=node.lineno,
+                        symbol=scope,
+                        code="with lock: %s + %s" % (
+                            sorted(parses)[0], sorted(applies)[0]
+                        ),
+                        message=(
+                            "proto parsing (%s) and store apply (%s) "
+                            "under one lock: the decode is per-request "
+                            "CPU work that serializes every concurrent "
+                            "push behind this lock — parse outside, "
+                            "lock only the apply"
+                            % (", ".join(sorted(parses)),
+                               ", ".join(sorted(applies)))
+                        ),
+                    )
+                )
+    return findings

@@ -3,6 +3,7 @@
 Reference parity: elasticdl/python/common/grpc_utils.py:22-40.
 """
 
+import os
 import random
 import socket
 import time
@@ -122,7 +123,61 @@ def retry_call(fn, what, budget_secs, retryable=RETRYABLE_CODES,
             ceiling = min(ceiling * 2, max_delay)
 
 
+# Zero-copy local transport (ISSUE 11): on a TPU-VM host the PS is
+# co-located with its workers/serve pods, and the localhost TCP hop is
+# pure overhead (checksums, nagle, loopback copies). When
+# EDL_PS_UDS_DIR is set, a PS binds gRPC on a unix-domain socket named
+# by its TCP port next to the TCP listener, and clients building a
+# channel to a LOCAL host:port transparently prefer the socket when it
+# exists — TCP stays the fallback (env unset, socket absent, or a
+# remote host). The port-derived name is the advertisement: both ends
+# already know the port, so co-location needs no extra wiring beyond
+# sharing the env var (docs/PERFORMANCE.md "Native data plane").
+UDS_DIR_ENV = "EDL_PS_UDS_DIR"
+
+
+def uds_socket_path(port, uds_dir=None):
+    """The socket path a PS serving on ``port`` binds under
+    EDL_PS_UDS_DIR, or None when the knob is unset."""
+    directory = uds_dir or os.environ.get(UDS_DIR_ENV, "")
+    if not directory:
+        return None
+    return os.path.join(
+        os.path.abspath(directory), "edl-ps-%d.sock" % int(port)
+    )
+
+
+def _is_local_host(host):
+    host = host.strip("[]")
+    if host in ("localhost", "127.0.0.1", "::1", ""):
+        return True
+    try:
+        return host == socket.gethostname()
+    except OSError:
+        return False
+
+
+def maybe_uds_addr(addr):
+    """``host:port`` -> ``unix:<path>`` when EDL_PS_UDS_DIR names a
+    live socket for that port AND the host is this machine; None
+    otherwise (caller keeps the TCP address). Existence is checked at
+    channel-build time only — after that the channel owns the path, so
+    a PS SIGKILL + relaunch on the same socket reconnects without the
+    client rebuilding anything."""
+    host, _, port = addr.rpartition(":")
+    if not port.isdigit() or not _is_local_host(host):
+        return None
+    path = uds_socket_path(int(port))
+    if path and os.path.exists(path):
+        return "unix:" + path
+    return None
+
+
 def build_channel(addr: str) -> grpc.Channel:
+    uds = maybe_uds_addr(addr)
+    if uds is not None:
+        logger.info("channel to %s riding the local socket %s", addr, uds)
+        addr = uds
     channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
     # trace-context propagation (observability/trace_propagation.py):
     # identity pass-through unless EDL_TRACE_DIR is set with a nonzero

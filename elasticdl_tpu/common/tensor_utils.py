@@ -142,6 +142,20 @@ def pack_ids(ids) -> bytes:
     return np.ascontiguousarray(ids, dtype=_IDS_WIRE_DTYPE).tobytes()
 
 
+def normalize_id_tables(ids_by_table):
+    """``{table: ids}`` -> ``{table: contiguous int64 ndarray}`` with
+    empty tables dropped — ONE conversion per table (the
+    convert-inside-a-filter idiom built a second throwaway array per
+    table per step). Shared by every batch-pull front door
+    (PSClient / EmbeddingClient / LocalPSClient)."""
+    converted = {}
+    for name, ids in ids_by_table.items():
+        ids = np.asarray(ids, dtype=np.int64)
+        if ids.size:
+            converted[name] = ids
+    return converted
+
+
 def unpack_ids(message) -> np.ndarray:
     """ids from any message carrying the ids/ids_blob field pair
     (IndexedSlicesProto, PullEmbeddingVectorsRequest). Packed wins when

@@ -33,6 +33,7 @@ import time
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.common.tensor_utils import normalize_id_tables
 
 logger = _logger_factory("elasticdl_tpu.embedding.client")
 
@@ -290,11 +291,7 @@ class EmbeddingClient:
         ``pull_embedding_batch`` call — ps_num RPCs for the whole set
         instead of tables x ps_num — against a batch-capable client;
         otherwise the per-table fan-out."""
-        ids_by_table = {
-            name: np.asarray(ids, dtype=np.int64)
-            for name, ids in ids_by_table.items()
-            if np.asarray(ids).size
-        }
+        ids_by_table = normalize_id_tables(ids_by_table)
         if not ids_by_table:
             return {}
         batch_pull = getattr(self._ps, "pull_embedding_batch", None)

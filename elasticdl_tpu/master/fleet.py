@@ -187,6 +187,10 @@ class FleetMonitor:
                 "serve_qps": round(float(blob.serve_qps), 2),
                 "serve_queue_depth": int(blob.serve_queue_depth),
                 "serve_shed_total": int(blob.serve_shed_total),
+                # native data plane (ISSUE 11): which embedding-store
+                # backend a PS shard ran — the first thing a
+                # postmortem checks on an apply-latency regression
+                "ps_native_store": bool(blob.ps_native_store),
             }
             # stuck-round bookkeeping: the clock restarts whenever the
             # fill grows or the store version advances

@@ -12,7 +12,24 @@
 // — unbounded and hash-addressed — stays host-side.
 //
 // Exposed as a C API for ctypes (no pybind11 in this environment).
+// ctypes releases the GIL for the duration of every call, so a whole
+// deserialize+dedup+apply (edl_store_apply_blob) or a batched
+// lookup/export runs GIL-free — that, not micro-optimization, is why
+// the wire fast paths live behind single C entry points.
+//
+// FLOAT SEMANTICS (ISSUE 11): every kernel here is BIT-IDENTICAL to
+// NumpyEmbeddingStore under numpy 2 / NEP 50. That pins three rules:
+//   1. optimizer hyperparameters are carried as double (the python
+//      float the twin stores) and rounded to float exactly where
+//      numpy's weak-scalar promotion rounds them — e.g. Adam's
+//      (1 - beta1) is float(1.0 - beta1_double), NOT 1.0f - beta1f;
+//   2. elementwise math stays in float with numpy's operator order
+//      (the Makefile passes -ffp-contract=off so gcc cannot fuse
+//      a*b+c into fma and change the rounding);
+//   3. bias corrections use libm pow on doubles, the same call
+//      CPython's float.__pow__ makes.
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
@@ -30,6 +47,185 @@ namespace {
 
 enum class OptType { kSGD = 0, kMomentum = 1, kAdagrad = 2, kAdam = 3 };
 
+// Wire payload dtypes the blob entry points understand. Values match
+// BLOB_DTYPE_CODES in ps/embedding_store.py.
+enum WireDtype { kF32 = 0, kBF16 = 1, kF16 = 2 };
+
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t u = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &u, 4);
+  return f;
+}
+
+// Round-to-nearest-even f32 -> bf16, matching ml_dtypes/Eigen
+// (numpy's astype(bfloat16)): NaN keeps sign + a set mantissa bit.
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  if ((u & 0x7fffffffu) > 0x7f800000u) {
+    return static_cast<uint16_t>((u >> 16) | 0x0040u);
+  }
+  const uint32_t bias = 0x7fffu + ((u >> 16) & 1u);
+  return static_cast<uint16_t>((u + bias) >> 16);
+}
+
+inline float f16_to_f32(uint16_t h) {
+  const uint32_t sign = static_cast<uint32_t>(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1fu;
+  uint32_t man = h & 0x3ffu;
+  uint32_t u;
+  if (exp == 0) {
+    if (man == 0) {
+      u = sign;  // +-0
+    } else {
+      // subnormal half: renormalize into the f32 exponent range
+      int shift = 0;
+      while (!(man & 0x400u)) {
+        man <<= 1;
+        ++shift;
+      }
+      man &= 0x3ffu;
+      // man * 2^-24 normalized: 1.f * 2^(-14 - shift) -> biased 113-shift
+      u = sign | (static_cast<uint32_t>(113 - shift) << 23) | (man << 13);
+    }
+  } else if (exp == 31) {
+    u = sign | 0x7f800000u | (man << 13);  // inf / nan
+  } else {
+    u = sign | ((exp + 112u) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &u, 4);
+  return f;
+}
+
+// Round-to-nearest-even f32 -> f16 (numpy npy_half semantics),
+// including subnormal results and overflow-to-inf.
+inline uint16_t f32_to_f16(float ff) {
+  uint32_t f;
+  std::memcpy(&f, &ff, 4);
+  const uint32_t sign = f & 0x80000000u;
+  f ^= sign;
+  uint16_t out;
+  if (f >= ((127u + 16u) << 23)) {  // overflow, inf, nan
+    out = (f > (255u << 23)) ? 0x7e00u : 0x7c00u;
+  } else if (f < (113u << 23)) {
+    // subnormal f16 result: the "denorm magic" add performs the
+    // shift-and-round in float hardware (Giesen's rtne construction)
+    const uint32_t denorm_magic = ((127u - 15u) + (23u - 10u) + 1u) << 23;
+    float tmp;
+    std::memcpy(&tmp, &f, 4);
+    float magic;
+    std::memcpy(&magic, &denorm_magic, 4);
+    tmp += magic;
+    uint32_t t;
+    std::memcpy(&t, &tmp, 4);
+    out = static_cast<uint16_t>(t - denorm_magic);
+  } else {
+    const uint32_t mant_odd = (f >> 13) & 1u;
+    f += (static_cast<uint32_t>(15 - 127) << 23) + 0xfffu;
+    f += mant_odd;
+    out = static_cast<uint16_t>(f >> 13);
+  }
+  return static_cast<uint16_t>(out | (sign >> 16));
+}
+
+inline int wire_itemsize(int dtype) {
+  switch (dtype) {
+    case kF32: return 4;
+    case kBF16: return 2;
+    case kF16: return 2;
+  }
+  return -1;
+}
+
+// Decode one wire row into fp32 (upcast is exact for bf16/f16).
+inline void decode_row(const uint8_t* src, int dtype, int64_t dim,
+                       float* dst) {
+  switch (dtype) {
+    case kF32:
+      std::memcpy(dst, src, sizeof(float) * dim);
+      break;
+    case kBF16: {
+      const uint16_t* h = reinterpret_cast<const uint16_t*>(src);
+      for (int64_t d = 0; d < dim; ++d) dst[d] = bf16_to_f32(h[d]);
+      break;
+    }
+    case kF16: {
+      const uint16_t* h = reinterpret_cast<const uint16_t*>(src);
+      for (int64_t d = 0; d < dim; ++d) dst[d] = f16_to_f32(h[d]);
+      break;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------
+// numpy pairwise summation over rows, bit-for-bit. np.add.reduceat's
+// segment reduce is NOT a sequential left fold: it seeds the output
+// with row 0, then reduces rows 1..n-1 with numpy's blocked pairwise
+// algorithm (loops_utils.h pairwise_sum: < 8 rows sequential from
+// 0.0, <= 128 rows eight running accumulators combined as
+// ((r0+r1)+(r2+r3))+((r4+r5)+(r6+r7)), larger split in half rounded
+// down to a multiple of 8). The dedup fast path must reproduce that
+// exact association or fp32 segment sums drift by an ulp and the
+// parity suite (tests/test_native_parity.py) catches it.
+void pairwise_sum_rows(const float* a, int64_t n, int64_t dim,
+                       float* out) {
+  if (n <= 0) {
+    std::memset(out, 0, sizeof(float) * dim);
+    return;
+  }
+  if (n < 8) {
+    for (int64_t d = 0; d < dim; ++d) {
+      float res = 0.0f;
+      for (int64_t i = 0; i < n; ++i) res += a[i * dim + d];
+      out[d] = res;
+    }
+    return;
+  }
+  if (n <= 128) {
+    std::vector<float> r(8 * dim);
+    std::memcpy(r.data(), a, sizeof(float) * 8 * dim);
+    int64_t i = 8;
+    for (; i + 8 <= n; i += 8) {
+      for (int j = 0; j < 8; ++j) {
+        float* rj = r.data() + j * dim;
+        const float* aj = a + (i + j) * dim;
+        for (int64_t d = 0; d < dim; ++d) rj[d] += aj[d];
+      }
+    }
+    for (int64_t d = 0; d < dim; ++d) {
+      out[d] = ((r[0 * dim + d] + r[1 * dim + d]) +
+                (r[2 * dim + d] + r[3 * dim + d])) +
+               ((r[4 * dim + d] + r[5 * dim + d]) +
+                (r[6 * dim + d] + r[7 * dim + d]));
+    }
+    for (; i < n; ++i) {
+      const float* ai = a + i * dim;
+      for (int64_t d = 0; d < dim; ++d) out[d] += ai[d];
+    }
+    return;
+  }
+  int64_t h = n / 2;
+  h -= h % 8;
+  std::vector<float> right(dim);
+  pairwise_sum_rows(a, h, dim, out);
+  pairwise_sum_rows(a + h * dim, n - h, dim, right.data());
+  for (int64_t d = 0; d < dim; ++d) out[d] += right[d];
+}
+
+// reduceat segment semantics: out = rows[0] + pairwise_sum(rows[1:]).
+void reduceat_segment(const float* rows, int64_t n, int64_t dim,
+                      float* out) {
+  if (n == 1) {
+    std::memcpy(out, rows, sizeof(float) * dim);
+    return;
+  }
+  std::vector<float> rest(dim);
+  pairwise_sum_rows(rows + dim, n - 1, dim, rest.data());
+  for (int64_t d = 0; d < dim; ++d) out[d] = rows[d] + rest[d];
+}
+
 // Row initializers (reference go/pkg/common/initializer.go:25-155:
 // Zero/Constant/Uniform/Normal/TruncatedNormal). kConstant covers Zero
 // via param=0.
@@ -42,11 +238,13 @@ enum class InitKind {
 
 struct OptConfig {
   OptType type = OptType::kSGD;
-  float lr = 0.01f;
-  float momentum = 0.9f;
-  float beta1 = 0.9f;
-  float beta2 = 0.999f;
-  float epsilon = 1e-8f;
+  // doubles: the exact python floats NumpyEmbeddingStore holds —
+  // rounded to f32 only where numpy's weak-scalar promotion rounds
+  double lr = 0.01;
+  double momentum = 0.9;
+  double beta1 = 0.9;
+  double beta2 = 0.999;
+  double epsilon = 1e-8;
   // variants (reference go/pkg/ps/optimizer.go supports
   // Momentum+nesterov and Adam+amsgrad)
   bool nesterov = false;
@@ -131,35 +329,41 @@ struct Store {
   }
 };
 
+// ``lr`` arrives as DOUBLE (opt.lr * lr_scale computed in double by
+// the caller) and rounds to f32 once here — numpy computes the same
+// product in python floats and rounds it at the weak-scalar op.
 void apply_row(const OptConfig& opt, float* row, const float* grad,
-               int64_t dim, float lr, int64_t step) {
+               int64_t dim, double lr, int64_t step) {
   float* w = row;
+  const float lrf = static_cast<float>(lr);
   switch (opt.type) {
     case OptType::kSGD: {
-      for (int64_t d = 0; d < dim; ++d) w[d] -= lr * grad[d];
+      for (int64_t d = 0; d < dim; ++d) w[d] -= lrf * grad[d];
       break;
     }
     case OptType::kMomentum: {
       float* vel = row + dim;
+      const float mu = static_cast<float>(opt.momentum);
       if (opt.nesterov) {
         // lookahead step: w -= lr * (g + mu * vel_new)
         for (int64_t d = 0; d < dim; ++d) {
-          vel[d] = opt.momentum * vel[d] + grad[d];
-          w[d] -= lr * (grad[d] + opt.momentum * vel[d]);
+          vel[d] = mu * vel[d] + grad[d];
+          w[d] -= lrf * (grad[d] + mu * vel[d]);
         }
       } else {
         for (int64_t d = 0; d < dim; ++d) {
-          vel[d] = opt.momentum * vel[d] + grad[d];
-          w[d] -= lr * vel[d];
+          vel[d] = mu * vel[d] + grad[d];
+          w[d] -= lrf * vel[d];
         }
       }
       break;
     }
     case OptType::kAdagrad: {
       float* acc = row + dim;
+      const float eps = static_cast<float>(opt.epsilon);
       for (int64_t d = 0; d < dim; ++d) {
         acc[d] += grad[d] * grad[d];
-        w[d] -= lr * grad[d] / (std::sqrt(acc[d]) + opt.epsilon);
+        w[d] -= lrf * grad[d] / (std::sqrt(acc[d]) + eps);
       }
       break;
     }
@@ -167,11 +371,23 @@ void apply_row(const OptConfig& opt, float* row, const float* grad,
       float* m = row + dim;
       float* v = row + 2 * dim;
       float* vmax = opt.amsgrad ? row + 3 * dim : nullptr;
-      const float bc1 = 1.0f - std::pow(opt.beta1, (float)step);
-      const float bc2 = 1.0f - std::pow(opt.beta2, (float)step);
+      const float b1 = static_cast<float>(opt.beta1);
+      const float b2 = static_cast<float>(opt.beta2);
+      // numpy rounds (1 - beta1) from the DOUBLE, which is not
+      // 1.0f - b1 (e.g. beta1=0.9: f32(0.1) != 1.0f - 0.9f)
+      const float omb1 = static_cast<float>(1.0 - opt.beta1);
+      const float omb2 = static_cast<float>(1.0 - opt.beta2);
+      const float eps = static_cast<float>(opt.epsilon);
+      // bias corrections in double (libm pow = CPython float.__pow__)
+      // then rounded, the same value the numpy store's weak python
+      // scalar takes inside its float32 division
+      const float bc1 = static_cast<float>(
+          1.0 - std::pow(opt.beta1, static_cast<double>(step)));
+      const float bc2 = static_cast<float>(
+          1.0 - std::pow(opt.beta2, static_cast<double>(step)));
       for (int64_t d = 0; d < dim; ++d) {
-        m[d] = opt.beta1 * m[d] + (1.0f - opt.beta1) * grad[d];
-        v[d] = opt.beta2 * v[d] + (1.0f - opt.beta2) * grad[d] * grad[d];
+        m[d] = b1 * m[d] + omb1 * grad[d];
+        v[d] = b2 * v[d] + omb2 * grad[d] * grad[d];
         const float mhat = m[d] / bc1;
         float vv = v[d];
         if (vmax) {
@@ -180,7 +396,7 @@ void apply_row(const OptConfig& opt, float* row, const float* grad,
           vv = vmax[d];
         }
         const float vhat = vv / bc2;
-        w[d] -= lr * mhat / (std::sqrt(vhat) + opt.epsilon);
+        w[d] -= lrf * mhat / (std::sqrt(vhat) + eps);
       }
       break;
     }
@@ -191,6 +407,15 @@ void apply_row(const OptConfig& opt, float* row, const float* grad,
 
 extern "C" {
 
+// ABI clock for the ctypes loader (ps/embedding_store.py): bumped on
+// every signature/semantics change of this C surface. A loader that
+// finds a different value (or no symbol at all — pre-clock builds)
+// rebuilds the .so or falls back to numpy instead of calling through
+// a drifted ABI. History: 1 = float hyperparameters, no blob entry
+// points; 2 = double hyperparameters + apply_blob/lookup_cast/
+// import_blob.
+int64_t edl_store_abi_version(void) { return 2; }
+
 void* edl_store_create(uint64_t seed) {
   auto* store = new Store();
   store->seed = seed;
@@ -199,9 +424,9 @@ void* edl_store_create(uint64_t seed) {
 
 void edl_store_destroy(void* handle) { delete static_cast<Store*>(handle); }
 
-int edl_store_set_optimizer(void* handle, const char* type, float lr,
-                            float momentum, float beta1, float beta2,
-                            float epsilon) {
+int edl_store_set_optimizer(void* handle, const char* type, double lr,
+                            double momentum, double beta1, double beta2,
+                            double epsilon) {
   auto* store = static_cast<Store*>(handle);
   {
     // Rows size their slot memory from the optimizer at table-creation
@@ -276,19 +501,164 @@ int edl_store_lookup(void* handle, const char* name, const int64_t* ids,
 }
 
 // Sparse apply: grads is [n, dim] row-major, one row per id. lr_scale
-// multiplies the configured LR (staleness modulation hook).
+// multiplies the configured LR (staleness modulation hook). Duplicate
+// ids apply SEQUENTIALLY, one optimizer step per occurrence — the
+// NumpyEmbeddingStore per-id-loop semantics; deduplicated single-apply
+// semantics live in edl_store_apply_blob.
 int edl_store_push_gradients(void* handle, const char* name,
                              const int64_t* ids, const float* grads,
-                             int64_t n, float lr_scale) {
+                             int64_t n, double lr_scale) {
   auto* store = static_cast<Store*>(handle);
   Table* table = store->find(name);
   if (table == nullptr) return -1;
-  const float lr = store->opt.lr * lr_scale;
+  const double lr = store->opt.lr * lr_scale;
   std::unique_lock<std::shared_mutex> lock(table->mu);
   for (int64_t i = 0; i < n; ++i) {
     float* row = table->get_or_init(ids[i]);
     int64_t step = ++table->row_steps[ids[i]];
     apply_row(store->opt, row, grads + i * table->dim, table->dim, lr, step);
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// Wire-blob fast path (ISSUE 11): one C call per table covering the
+// whole deserialize + dedup + apply a push used to spread across
+// python. ``ids`` points straight at the request's packed ids_blob
+// (int64, host-endian == little on every deployment target) and
+// ``grads`` at the TensorBlob payload bytes at ``grad_dtype``
+// (kF32/kBF16/kF16; reduced dtypes upcast to fp32 exactly, matching
+// numpy astype). ``dedup`` != 0 merges duplicate ids with a
+// stable-sort + sequential segment sum — bit-identical to
+// tensor_utils.deduplicate_indexed_slices (sort + np.add.reduceat) —
+// then applies ONE optimizer step per unique id in ascending-id
+// order, which is exactly what the numpy pipeline
+// (deduplicate_indexed_slices -> NumpyEmbeddingStore.push_gradients)
+// computes. Returns 0, -1 unknown table, -2 bad dtype.
+int edl_store_apply_blob(void* handle, const char* name,
+                         const int64_t* ids, int64_t n,
+                         const void* grads, int grad_dtype,
+                         double lr_scale, int dedup) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  const int itemsize = wire_itemsize(grad_dtype);
+  if (itemsize < 0) return -2;
+  if (n <= 0) return 0;
+  const int64_t dim = table->dim;
+  const double lr = store->opt.lr * lr_scale;
+  const uint8_t* bytes = static_cast<const uint8_t*>(grads);
+  const int64_t row_bytes = dim * itemsize;
+
+  if (!dedup) {
+    std::vector<float> scratch(dim);
+    std::unique_lock<std::shared_mutex> lock(table->mu);
+    for (int64_t i = 0; i < n; ++i) {
+      decode_row(bytes + i * row_bytes, grad_dtype, dim, scratch.data());
+      float* row = table->get_or_init(ids[i]);
+      int64_t step = ++table->row_steps[ids[i]];
+      apply_row(store->opt, row, scratch.data(), dim, lr, step);
+    }
+    return 0;
+  }
+
+  // stable sort of input positions by id: duplicates keep input order,
+  // so the segment sums below add in exactly reduceat's order
+  std::vector<int64_t> order(n);
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(),
+                   [ids](int64_t a, int64_t b) { return ids[a] < ids[b]; });
+
+  std::vector<float> seg;     // decoded duplicate group, [len, dim]
+  std::vector<float> scratch(dim);
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  int64_t s = 0;
+  while (s < n) {
+    const int64_t id = ids[order[s]];
+    int64_t e = s + 1;
+    while (e < n && ids[order[e]] == id) ++e;
+    const int64_t len = e - s;
+    const float* grad_row;
+    if (len == 1 && grad_dtype == kF32) {
+      // singleton f32 segment: apply straight from the wire buffer
+      grad_row = reinterpret_cast<const float*>(bytes +
+                                                order[s] * row_bytes);
+    } else {
+      seg.resize(len * dim);
+      for (int64_t k = 0; k < len; ++k) {
+        decode_row(bytes + order[s + k] * row_bytes, grad_dtype, dim,
+                   seg.data() + k * dim);
+      }
+      reduceat_segment(seg.data(), len, dim, scratch.data());
+      grad_row = scratch.data();
+    }
+    float* row = table->get_or_init(id);
+    int64_t step = ++table->row_steps[id];
+    apply_row(store->opt, row, grad_row, dim, lr, step);
+    s = e;
+  }
+  return 0;
+}
+
+// Batched lookup emitting rows directly at the wire dtype: the f32 ->
+// bf16/f16 downcast (round-to-nearest-even, numpy-astype-exact)
+// happens inside this one GIL-released call instead of a separate
+// python astype pass. out must hold n * dim * wire_itemsize bytes.
+int edl_store_lookup_cast(void* handle, const char* name,
+                          const int64_t* ids, int64_t n, void* out,
+                          int out_dtype) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  const int itemsize = wire_itemsize(out_dtype);
+  if (itemsize < 0) return -2;
+  const int64_t dim = table->dim;
+  uint8_t* bytes = static_cast<uint8_t*>(out);
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    const float* row = table->get_or_init(ids[i]);
+    uint8_t* dst = bytes + i * dim * itemsize;
+    switch (out_dtype) {
+      case kF32:
+        std::memcpy(dst, row, sizeof(float) * dim);
+        break;
+      case kBF16: {
+        uint16_t* h = reinterpret_cast<uint16_t*>(dst);
+        for (int64_t d = 0; d < dim; ++d) h[d] = f32_to_bf16(row[d]);
+        break;
+      }
+      case kF16: {
+        uint16_t* h = reinterpret_cast<uint16_t*>(dst);
+        for (int64_t d = 0; d < dim; ++d) h[d] = f32_to_f16(row[d]);
+        break;
+      }
+    }
+  }
+  return 0;
+}
+
+// Raw row import straight from wire bytes (device-tier writebacks,
+// push_embedding_rows): values at ``dtype`` upcast to the fp32 master
+// rows, duplicate ids resolve last-write-wins in input order (the
+// import_table loop's semantics). No optimizer math, no version bump.
+int edl_store_import_blob(void* handle, const char* name,
+                          const int64_t* ids, int64_t n,
+                          const void* values, int dtype, int shard_id,
+                          int shard_num) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  const int itemsize = wire_itemsize(dtype);
+  if (itemsize < 0) return -2;
+  const int64_t dim = table->dim;
+  const uint8_t* bytes = static_cast<const uint8_t*>(values);
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    if (shard_num > 0 &&
+        (ids[i] % shard_num + shard_num) % shard_num != shard_id)
+      continue;
+    float* row = table->get_or_init(ids[i]);
+    decode_row(bytes + i * dim * itemsize, dtype, dim, row);
   }
   return 0;
 }

@@ -17,22 +17,32 @@
 extern "C" {
 void* edl_store_create(uint64_t seed);
 void edl_store_destroy(void* handle);
-int edl_store_set_optimizer(void* handle, const char* type, float lr,
-                            float momentum, float beta1, float beta2,
-                            float epsilon);
+int edl_store_set_optimizer(void* handle, const char* type, double lr,
+                            double momentum, double beta1, double beta2,
+                            double epsilon);
 int edl_store_create_table(void* handle, const char* name, int64_t dim,
                            float init_scale);
 int edl_store_lookup(void* handle, const char* name, const int64_t* ids,
                      int64_t n, float* out);
 int edl_store_push_gradients(void* handle, const char* name,
                              const int64_t* ids, const float* grads,
-                             int64_t n, float lr_scale);
+                             int64_t n, double lr_scale);
 int64_t edl_store_version(void* handle);
 void edl_store_bump_version(void* handle);
 int64_t edl_store_export_full(void* handle, const char* name,
                               int64_t* out_ids, float* out_values,
                               int64_t* out_steps, int64_t capacity);
 int edl_store_table_slots(void* handle, const char* name);
+int edl_store_apply_blob(void* handle, const char* name,
+                         const int64_t* ids, int64_t n, const void* grads,
+                         int grad_dtype, double lr_scale, int dedup);
+int edl_store_lookup_cast(void* handle, const char* name,
+                          const int64_t* ids, int64_t n, void* out,
+                          int out_dtype);
+int edl_store_import_blob(void* handle, const char* name,
+                          const int64_t* ids, int64_t n, const void* values,
+                          int dtype, int shard_id, int shard_num);
+int64_t edl_store_abi_version(void);
 }
 
 namespace {
@@ -87,17 +97,71 @@ void worker(void* store, int tid) {
     }
   }
 }
+
+// ISSUE 11 interleave: the wire-blob fast paths (deserialize+dedup+
+// apply, cast lookups, raw imports) hammered from many threads
+// concurrently with the classic worker() traffic above — the apply
+// fan-out (EDL_PS_APPLY_THREADS) runs exactly this shape in the
+// servicer. Duplicate-heavy id streams on purpose: the dedup path's
+// sort/segment-sum scratch is per-call, so only the table state is
+// shared.
+void blob_worker(void* store, int tid) {
+  int64_t ids[kIdsPerOp];
+  uint16_t half_grads[kIdsPerOp * kDim];
+  float f32_grads[kIdsPerOp * kDim];
+  uint8_t cast_out[kIdsPerOp * kDim * 4];
+  for (int i = 0; i < kIdsPerOp * kDim; ++i) {
+    half_grads[i] = 0x3c00;  // 1.0 in f16
+    f32_grads[i] = 0.01f;
+  }
+  uint64_t rng = 0xda942042e4dd58b5ull * (tid + 3);
+  for (int iter = 0; iter < kIters; ++iter) {
+    rng = rng * 6364136223846793005ull + 1442695040888963407ull;
+    const char* table = kTables[(rng >> 33) & 1];
+    for (int i = 0; i < kIdsPerOp; ++i) {
+      // % 64: dense duplicates, so dedup's segment sums really merge
+      ids[i] = (int64_t)((rng >> (i % 24)) % 64);
+    }
+    switch ((rng >> 20) % 4) {
+      case 0:
+        if (edl_store_apply_blob(store, table, ids, kIdsPerOp, f32_grads,
+                                 /*kF32=*/0, 1.0, /*dedup=*/1) != 0)
+          std::abort();
+        break;
+      case 1:
+        if (edl_store_apply_blob(store, table, ids, kIdsPerOp, half_grads,
+                                 /*kF16=*/2, 0.5, /*dedup=*/1) != 0)
+          std::abort();
+        break;
+      case 2:
+        if (edl_store_lookup_cast(store, table, ids, kIdsPerOp, cast_out,
+                                  /*kBF16=*/1) != 0)
+          std::abort();
+        break;
+      case 3:
+        if (edl_store_import_blob(store, table, ids, kIdsPerOp, f32_grads,
+                                  /*kF32=*/0, 0, 0) != 0)
+          std::abort();
+        break;
+    }
+  }
+}
 }  // namespace
 
 int main() {
+  if (edl_store_abi_version() < 2) return 4;
   void* store = edl_store_create(7);
-  edl_store_set_optimizer(store, "adam", 0.01f, 0.9f, 0.9f, 0.999f, 1e-8f);
+  edl_store_set_optimizer(store, "adam", 0.01, 0.9, 0.9, 0.999, 1e-8);
   for (const char* table : kTables) {
     if (edl_store_create_table(store, table, kDim, 0.05f) != 0) return 2;
   }
   std::vector<std::thread> threads;
+  // half classic push/pull/export traffic, half wire-blob traffic —
+  // the mixed interleave is the state a UDS-fronted PS under
+  // EDL_PS_APPLY_THREADS actually runs
   for (int t = 0; t < kThreads; ++t) {
     threads.emplace_back(worker, store, t);
+    threads.emplace_back(blob_worker, store, t);
   }
   for (auto& t : threads) t.join();
   if (edl_store_version(store) <= 0) return 3;

@@ -10,6 +10,7 @@ exists and the reference semantics for tests.
 import ctypes
 import os
 import subprocess
+import sys
 import threading
 
 import numpy as np
@@ -20,6 +21,26 @@ logger = _logger_factory("elasticdl_tpu.ps.embedding_store")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libedl_embedding.so"))
+
+# ABI clock this binding targets (edl_store_abi_version in
+# native/embedding_store.cc). A .so reporting anything else — or
+# missing the symbol entirely (pre-clock builds) — is a stale artifact
+# from another tree: the loader rebuilds it once, and on any failure
+# falls back to the numpy store instead of raising mid-job.
+_EXPECTED_ABI = 2
+
+# TensorBlob wire dtype name -> WireDtype enum in embedding_store.cc;
+# the only payload dtypes the blob fast paths accept — anything else
+# routes through the numpy-array slow path. BLOB_ITEMSIZE is the
+# companion bytes-per-element table: every size computation derives
+# from it (servicer gate included) so a new wire dtype cannot desync
+# the shape checks.
+BLOB_DTYPE_CODES = {"float32": 0, "bfloat16": 1, "float16": 2}
+BLOB_ITEMSIZE = {"float32": 4, "bfloat16": 2, "float16": 2}
+
+# the packed wire encoding is little-endian int64; the native fast
+# paths read it as host int64, so they are only offered on LE hosts
+_LITTLE_ENDIAN = sys.byteorder == "little"
 
 OPTIMIZER_DEFAULTS = dict(
     lr=0.01, momentum=0.9, beta1=0.9, beta2=0.999, epsilon=1e-8
@@ -79,14 +100,67 @@ def _normalize_opt_type(opt_type, kwargs):
     return opt_type
 
 
+def _build_native(force=False):
+    cmd = ["make", "-C", os.path.abspath(_NATIVE_DIR)]
+    if force:
+        cmd.insert(1, "-B")
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _cdll_fresh(path):
+    """CDLL through a temp copy. dlopen dedups by pathname, so
+    re-loading ``_SO_PATH`` after an in-place rebuild returns the
+    ALREADY-MAPPED stale library and the ABI re-check could never
+    pass. A copy at a fresh path (new name, new inode) forces a
+    genuinely new mapping; the dirent is unlinked immediately — the
+    mapping keeps the file alive for the process lifetime."""
+    import shutil
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(prefix="libedl_embedding-", suffix=".so")
+    os.close(fd)
+    try:
+        shutil.copy2(path, tmp)
+        return ctypes.CDLL(tmp)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _abi_of(lib):
+    """The loaded .so's ABI clock, or None when the symbol is absent
+    (a pre-clock build — ABI 1 by definition, still a mismatch)."""
+    try:
+        fn = lib.edl_store_abi_version
+    except AttributeError:
+        return None
+    fn.restype = ctypes.c_int64
+    fn.argtypes = []
+    return int(fn())
+
+
 def _load_native():
+    """Build/load/bind the native store, or return None (numpy
+    fallback). NEVER raises: a missing toolchain, an undefined symbol
+    from a half-built .so, or ABI drift from a stale artifact all log
+    once (native_lib caches the failure) and degrade — a PS must not
+    crash mid-job because its cached .so predates this binding."""
+    try:
+        return _load_native_checked()
+    except Exception as e:  # truly defensive: any surprise degrades
+        logger.warning(
+            "Native embedding store unavailable (%s); using the numpy "
+            "store", e,
+        )
+        return None
+
+
+def _load_native_checked():
     if not os.path.exists(_SO_PATH):
         try:
-            subprocess.run(
-                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                check=True,
-                capture_output=True,
-            )
+            _build_native()
         except Exception as e:
             logger.warning("Native embedding store build failed: %s", e)
             return None
@@ -95,17 +169,61 @@ def _load_native():
     except OSError as e:
         logger.warning("Native embedding store load failed: %s", e)
         return None
+    abi = _abi_of(lib)
+    if abi != _EXPECTED_ABI:
+        # stale .so (another tree / older release): rebuild once from
+        # the sources next to it, then re-check
+        logger.warning(
+            "Native embedding store ABI drift (have %s, want %d); "
+            "rebuilding %s", abi, _EXPECTED_ABI, _SO_PATH,
+        )
+        try:
+            _build_native(force=True)
+            # NOT a plain CDLL(_SO_PATH): that path is already mapped
+            # (the stale load above) and dlopen would return the old
+            # library — load the rebuilt file through a fresh copy
+            lib = _cdll_fresh(_SO_PATH)
+        except Exception as e:
+            logger.warning(
+                "Native embedding store rebuild failed (%s); using the "
+                "numpy store", e,
+            )
+            return None
+        abi = _abi_of(lib)
+        if abi != _EXPECTED_ABI:
+            logger.warning(
+                "Native embedding store still at ABI %s after rebuild "
+                "(want %d); using the numpy store", abi, _EXPECTED_ABI,
+            )
+            return None
+    try:
+        _bind_native(lib)
+    except AttributeError as e:
+        # a symbol this binding needs is missing: fall back instead of
+        # surfacing an AttributeError from deep inside a push RPC
+        logger.warning(
+            "Native embedding store is missing a symbol (%s); using "
+            "the numpy store", e,
+        )
+        return None
+    return lib
+
+
+def _bind_native(lib):
     lib.edl_store_create.restype = ctypes.c_void_p
     lib.edl_store_create.argtypes = [ctypes.c_uint64]
     lib.edl_store_destroy.argtypes = [ctypes.c_void_p]
     lib.edl_store_set_optimizer.argtypes = [
         ctypes.c_void_p,
         ctypes.c_char_p,
-        ctypes.c_float,
-        ctypes.c_float,
-        ctypes.c_float,
-        ctypes.c_float,
-        ctypes.c_float,
+        # doubles, not floats: the kernels round each hyperparameter
+        # to f32 exactly where numpy's weak-scalar promotion does, so
+        # they need the python float's full value (ABI 2)
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.c_double,
+        ctypes.c_double,
     ]
     lib.edl_store_create_table.argtypes = [
         ctypes.c_void_p,
@@ -133,7 +251,35 @@ def _load_native():
         ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_float),
         ctypes.c_int64,
-        ctypes.c_float,
+        ctypes.c_double,
+    ]
+    lib.edl_store_apply_blob.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_double,
+        ctypes.c_int,
+    ]
+    lib.edl_store_lookup_cast.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_int,
+    ]
+    lib.edl_store_import_blob.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
     ]
     lib.edl_store_table_size.restype = ctypes.c_int64
     lib.edl_store_table_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -185,6 +331,36 @@ def _load_native():
         ctypes.c_int,
     ]
     return lib
+
+
+def _as_i64(ids):
+    """int64 C-contiguous view of ``ids``, converting ONLY when the
+    caller doesn't already hold one. Wire-path callers pass
+    ``np.frombuffer`` views of packed id blobs (read-only is fine —
+    the native side never writes through these pointers), and the old
+    unconditional ``ascontiguousarray`` re-walked those through
+    numpy's conversion machinery on every call."""
+    a = ids if isinstance(ids, np.ndarray) else np.asarray(ids)
+    if a.dtype == np.int64 and a.flags.c_contiguous:
+        return a
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+def _as_f32(values):
+    """float32 C-contiguous view of ``values``; same contract as
+    :func:`_as_i64`."""
+    a = values if isinstance(values, np.ndarray) else np.asarray(values)
+    if a.dtype == np.float32 and a.flags.c_contiguous:
+        return a
+    return np.ascontiguousarray(a, dtype=np.float32)
+
+
+def _i64_ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32_ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
 _native_lib = None
@@ -254,31 +430,121 @@ class NativeEmbeddingStore:
         self._dims[name] = dim
 
     def lookup(self, name, ids):
-        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        ids = _as_i64(ids)
         dim = self._dims[name]
         out = np.empty((ids.size, dim), dtype=np.float32)
         rc = self._lib.edl_store_lookup(
             self._handle,
             name.encode(),
-            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _i64_ptr(ids),
             ids.size,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            _f32_ptr(out),
         )
         if rc != 0:
             raise KeyError(name)
         return out
 
+    def lookup_blob(self, name, ids, wire_dtype_name=None):
+        """Batched lookup emitted directly at the wire dtype: one
+        GIL-released C call does lazy-init + gather + (bf16/fp16)
+        downcast, returning the payload bytes a TensorBlob carries.
+        Returns ``(content bytes, dtype name)``; the downcast is
+        round-to-nearest-even, bit-identical to numpy ``astype``."""
+        dtype_name = wire_dtype_name or "float32"
+        code = BLOB_DTYPE_CODES[dtype_name]
+        ids = _as_i64(ids)
+        dim = self._dims[name]
+        out = np.empty(
+            ids.size * dim * BLOB_ITEMSIZE[dtype_name], dtype=np.uint8
+        )
+        rc = self._lib.edl_store_lookup_cast(
+            self._handle,
+            name.encode(),
+            _i64_ptr(ids),
+            ids.size,
+            out.ctypes.data_as(ctypes.c_void_p),
+            code,
+        )
+        if rc != 0:
+            raise KeyError(name)
+        return out.tobytes(), dtype_name
+
     def push_gradients(self, name, ids, grads, lr_scale=1.0):
-        ids = np.ascontiguousarray(ids, dtype=np.int64)
-        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        ids = _as_i64(ids)
+        grads = _as_f32(grads)
         rc = self._lib.edl_store_push_gradients(
             self._handle,
             name.encode(),
-            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            _i64_ptr(ids),
+            _f32_ptr(grads),
             ids.size,
             lr_scale,
         )
+        if rc != 0:
+            raise KeyError(name)
+
+    def push_gradients_blob(self, name, ids, content, dtype_name,
+                            lr_scale=1.0, dedup=True):
+        """Wire-blob fast path: deserialize (+fp32 upcast), dedup, and
+        apply one table's pushed gradients in a single GIL-released C
+        call. ``ids``: int64 array (a read-only ``np.frombuffer`` view
+        of the request's packed ids_blob is the intended input);
+        ``content``: the TensorBlob payload bytes at ``dtype_name``
+        ([n, dim] row-major). ``dedup=True`` merges duplicate ids with
+        the sort+reduceat-equivalent segment sum before the single
+        optimizer apply per unique id — bit-identical to
+        ``deduplicate_indexed_slices`` + the numpy store's apply."""
+        code = BLOB_DTYPE_CODES[dtype_name]
+        ids = _as_i64(ids)
+        buf = np.frombuffer(content, dtype=np.uint8)
+        expected = ids.size * self._dims[name] * BLOB_ITEMSIZE[dtype_name]
+        if buf.size != expected:
+            raise ValueError(
+                "push_gradients_blob: %d payload bytes for %d ids of "
+                "table %r (want %d)" % (buf.size, ids.size, name, expected)
+            )
+        rc = self._lib.edl_store_apply_blob(
+            self._handle,
+            name.encode(),
+            _i64_ptr(ids),
+            ids.size,
+            buf.ctypes.data_as(ctypes.c_void_p),
+            code,
+            lr_scale,
+            1 if dedup else 0,
+        )
+        if rc == -2:
+            raise ValueError("unsupported blob dtype %r" % dtype_name)
+        if rc != 0:
+            raise KeyError(name)
+
+    def import_blob(self, name, ids, content, dtype_name,
+                    shard_id=0, shard_num=0):
+        """Raw row import straight from wire bytes (device-tier
+        writebacks): values at ``dtype_name`` upcast into the fp32
+        master rows, last-write-wins on duplicate ids, optional id-mod
+        shard filter — one GIL-released C call."""
+        code = BLOB_DTYPE_CODES[dtype_name]
+        ids = _as_i64(ids)
+        buf = np.frombuffer(content, dtype=np.uint8)
+        expected = ids.size * self._dims[name] * BLOB_ITEMSIZE[dtype_name]
+        if buf.size != expected:
+            raise ValueError(
+                "import_blob: %d payload bytes for %d ids of table %r "
+                "(want %d)" % (buf.size, ids.size, name, expected)
+            )
+        rc = self._lib.edl_store_import_blob(
+            self._handle,
+            name.encode(),
+            _i64_ptr(ids),
+            ids.size,
+            buf.ctypes.data_as(ctypes.c_void_p),
+            code,
+            shard_id,
+            shard_num,
+        )
+        if rc == -2:
+            raise ValueError("unsupported blob dtype %r" % dtype_name)
         if rc != 0:
             raise KeyError(name)
 
@@ -324,13 +590,13 @@ class NativeEmbeddingStore:
         return ids[:got], values[:got]
 
     def import_table(self, name, ids, values, shard_id=0, shard_num=0):
-        ids = np.ascontiguousarray(ids, dtype=np.int64)
-        values = np.ascontiguousarray(values, dtype=np.float32)
+        ids = _as_i64(ids)
+        values = _as_f32(values)
         rc = self._lib.edl_store_import(
             self._handle,
             name.encode(),
-            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            _i64_ptr(ids),
+            _f32_ptr(values),
             ids.size,
             shard_id,
             shard_num,
@@ -372,15 +638,15 @@ class NativeEmbeddingStore:
         """Inverse of export_table_full; a slot-layout mismatch (the
         optimizer changed between save and restore) degrades to a
         weights-only import."""
-        ids = np.ascontiguousarray(ids, dtype=np.int64)
-        rows = np.ascontiguousarray(rows, dtype=np.float32)
-        steps = np.ascontiguousarray(steps, dtype=np.int64)
+        ids = _as_i64(ids)
+        rows = _as_f32(rows)
+        steps = _as_i64(steps)
         rc = self._lib.edl_store_import_full(
             self._handle,
             name.encode(),
-            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            steps.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _i64_ptr(ids),
+            _f32_ptr(rows),
+            _i64_ptr(steps),
             ids.size,
             rows.shape[1] if rows.ndim == 2 else 0,
             shard_id,

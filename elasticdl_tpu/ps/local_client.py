@@ -16,6 +16,7 @@ import numpy as np
 
 from elasticdl_tpu.common.tensor_utils import (
     deduplicate_indexed_slices,
+    normalize_id_tables,
     wire_dtype,
 )
 from elasticdl_tpu.observability import trace
@@ -67,8 +68,7 @@ class LocalPSClient:
         the fused multi-table pull RPC."""
         return {
             name: self.pull_embedding_vectors(name, ids)
-            for name, ids in ids_by_table.items()
-            if np.asarray(ids).size
+            for name, ids in normalize_id_tables(ids_by_table).items()
         }
 
     def push_embedding_rows(self, rows_by_table):

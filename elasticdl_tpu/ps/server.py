@@ -15,7 +15,7 @@ import time
 import grpc
 
 from elasticdl_tpu.common.args import add_bool_argument
-from elasticdl_tpu.common.grpc_utils import build_server
+from elasticdl_tpu.common.grpc_utils import build_server, uds_socket_path
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import events, http_server, trace
 from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
@@ -181,6 +181,36 @@ class ParameterServer:
             )
         add_pserver_servicer_to_server(servicer, self.server)
         self.server.add_insecure_port("[::]:%d" % self.args.port)
+        # Zero-copy local transport (ISSUE 11): under EDL_PS_UDS_DIR,
+        # also serve on a unix-domain socket named by this TCP port —
+        # co-located clients (build_channel) prefer it, remote clients
+        # keep TCP. A stale socket from a SIGKILLed predecessor is
+        # unlinked first so the same-path relaunch binds cleanly and
+        # surviving workers reconnect on the path they already hold.
+        self._uds_path = uds_socket_path(self.args.port)
+        if self._uds_path is not None:
+            try:
+                os.makedirs(os.path.dirname(self._uds_path), exist_ok=True)
+                try:
+                    os.unlink(self._uds_path)
+                except FileNotFoundError:
+                    pass
+                if self.server.add_insecure_port("unix:" + self._uds_path):
+                    logger.info(
+                        "PS %d also serving on %s", self.args.ps_id,
+                        self._uds_path,
+                    )
+                else:
+                    logger.warning(
+                        "could not bind %s; serving TCP only",
+                        self._uds_path,
+                    )
+                    self._uds_path = None
+            except OSError as e:
+                logger.warning(
+                    "UDS bind failed (%s); serving TCP only", e
+                )
+                self._uds_path = None
         self.server.start()
         role = "ps-%d" % self.args.ps_id
         trace.configure(role)
@@ -216,6 +246,23 @@ class ParameterServer:
         )
         return self
 
+    def _cleanup_uds(self):
+        """Unlink this PS's unix socket on ORDERLY shutdown. Leaving
+        it behind would make a later build_channel to a reused local
+        port rewrite onto the dead socket and fail UNAVAILABLE forever
+        while a live TCP listener sits on that port — the rewrite
+        keys on path existence alone. (A SIGKILL still leaves the
+        file; that case is owned by the same-path relaunch, which
+        unlinks before rebinding.)"""
+        path = getattr(self, "_uds_path", None)
+        if path is None:
+            return
+        self._uds_path = None
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
     def _install_sigterm_stop(self):
         previous = signal.getsignal(signal.SIGTERM)
 
@@ -226,6 +273,7 @@ class ParameterServer:
                 self.server.stop(grace=1.0)
             except Exception:
                 logger.exception("server stop at SIGTERM failed")
+            self._cleanup_uds()
             self.servicer.graceful_stop()
             events.emit("role_stop", reason="sigterm_drain")
             events.flush()
@@ -269,6 +317,7 @@ class ParameterServer:
                 if misses >= gone_polls:
                     logger.info("Master gone; PS exiting")
                     self.server.stop(grace=1.0)
+                    self._cleanup_uds()
                     events.emit("role_stop", reason="master_gone")
                     events.flush()
                     return 0

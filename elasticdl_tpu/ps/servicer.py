@@ -16,6 +16,9 @@ Reference parity: elasticdl/python/ps/servicer.py and go/pkg/ps/server.go
   step-based evaluation triggering.
 """
 
+import concurrent.futures
+import os
+import sys
 import threading
 import time
 
@@ -34,8 +37,23 @@ from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.observability import trace
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.ps.embedding_store import (
+    BLOB_DTYPE_CODES,
+    BLOB_ITEMSIZE,
+)
 
 logger = _logger_factory("elasticdl_tpu.ps.servicer")
+
+# Per-table apply fan-out width for the async push path (ISSUE 11).
+# Only pays off with the native store: its blob applies release the
+# GIL and lock per TABLE, so a multi-table push really applies in
+# parallel; the numpy store holds one store-wide lock (and the GIL),
+# so >1 here is wasted threads, not wrong results.
+APPLY_THREADS_ENV = "EDL_PS_APPLY_THREADS"
+
+# packed-id blobs are little-endian; the native fast paths read them
+# as host int64, so they are only taken on LE hosts
+_LITTLE_ENDIAN = sys.byteorder == "little"
 
 
 def _deserialize_gradients(slices):
@@ -48,6 +66,26 @@ def _deserialize_gradients(slices):
     if values.dtype != np.float32:
         values = values.astype(np.float32)
     return values, ids
+
+
+def _blob_fast_path_ok(store, name, slices):
+    """True when one table's pushed slices can route through the
+    native store's single-call deserialize+dedup+apply: packed ids, a
+    payload dtype the C side decodes, and a shape that matches the
+    table — anything else falls back to the numpy-array path (which
+    handles legacy repeated ids, exotic dtypes, and ragged junk)."""
+    if not _LITTLE_ENDIAN or not slices.ids_blob:
+        return False
+    blob = slices.concat_tensors
+    if blob.dtype not in BLOB_DTYPE_CODES:
+        return False
+    itemsize = BLOB_ITEMSIZE[blob.dtype]
+    try:
+        dim = store.table_dim(name)
+    except KeyError:
+        return False
+    n = len(slices.ids_blob) // 8
+    return len(blob.content) == n * dim * itemsize
 
 
 class PserverServicer:
@@ -75,6 +113,33 @@ class PserverServicer:
         # RPC: a PS that passes health probes while every pull raises
         # would crash-loop its workers instead of itself
         wire_dtype()
+        # Native data plane (ISSUE 11): when the store exposes the
+        # wire-blob C entry points, push/pull payloads route through
+        # them — one GIL-released call per table covering
+        # deserialize + dedup + apply (or lookup + wire-dtype cast).
+        # Duck-typed, not isinstance: tests wrap stores.
+        self._native_store = all(
+            callable(getattr(store, method, None))
+            for method in
+            ("push_gradients_blob", "lookup_blob", "import_blob")
+        )
+        self._backend = "native" if self._native_store else "numpy"
+        # Per-table apply fan-out for the async path: with the GIL
+        # released inside the native applies, a small pool turns a
+        # multi-table push into parallel per-table applies (each
+        # guarded by its table's shared_mutex). 0/1/unset = inline.
+        try:
+            apply_threads = int(
+                os.environ.get(APPLY_THREADS_ENV, "") or 1
+            )
+        except ValueError:
+            apply_threads = 1
+        self._apply_pool = None
+        if apply_threads > 1:
+            self._apply_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=apply_threads,
+                thread_name_prefix="ps-apply",
+            )
         # checkpoint version this PS auto-restored at boot, stamped on
         # push/pull responses (wire encoding: version + 1, 0 = none) so
         # workers detecting a version regression know what state the
@@ -175,6 +240,20 @@ class PserverServicer:
             "edl_ps_rows_written_total",
             "Embedding rows overwritten by device-tier writebacks",
         )
+        # Native data plane (ISSUE 11): which store backend this shard
+        # runs (the first postmortem question for a slow PS), and the
+        # apply latency it delivers — labeled by backend so an A-B or
+        # a mid-fleet native rollout reads directly off one series.
+        self._m_apply_seconds = obs_metrics.histogram(
+            "edl_ps_apply_seconds",
+            "Wall seconds per push's gradient deserialize+apply, by "
+            "store backend", ("backend",),
+        )
+        obs_metrics.gauge(
+            "edl_ps_native_active",
+            "1 when this PS runs the native (C++) embedding store, "
+            "0 on the numpy fallback",
+        ).set(1 if self._native_store else 0)
         # Fleet-telemetry source (ISSUE 3): plain-int tallies kept
         # INDEPENDENTLY of the metrics registry (telemetry must work
         # with /metrics off), read by telemetry_blob() on the PS's 5 s
@@ -213,6 +292,7 @@ class PserverServicer:
             round_buffer_fill=self._buffered_count(),
             push_bytes=self._t_push_bytes,
             pull_bytes=self._t_pull_bytes,
+            ps_native_store=self._native_store,
         )
 
     def _stamp(self, response):
@@ -295,11 +375,27 @@ class PserverServicer:
         ``reduced_ok=False`` pins the payload to fp32 — for legacy
         clients that predate the wire-dtype contract and cannot decode
         extension dtype names."""
-        values = self._store.lookup(name, ids)
-        blob = ndarray_to_blob(
-            values, blob,
-            wire_dtype=wire_dtype() if reduced_ok else None,
-        )
+        wd = wire_dtype() if reduced_ok else None
+        if (
+            self._native_store
+            and _LITTLE_ENDIAN
+            and (wd is None or wd.name in BLOB_DTYPE_CODES)
+        ):
+            # native fast path: lazy-init + gather + wire-dtype cast in
+            # one GIL-released C call, serialized straight into the
+            # response blob — no fp32 intermediate array, no astype
+            content, dtype_name = self._store.lookup_blob(
+                name, ids, wd.name if wd is not None else None
+            )
+            if blob is None:
+                blob = pb.TensorBlob()
+            blob.dtype = dtype_name
+            del blob.dims[:]
+            blob.dims.extend((int(ids.size), self._store.table_dim(name)))
+            blob.content = content
+        else:
+            values = self._store.lookup(name, ids)
+            blob = ndarray_to_blob(values, blob, wire_dtype=wd)
         payload = len(blob.content)
         self._t_pull_bytes += payload
         self._m_pull_bytes.labels(dtype=blob.dtype).inc(payload)
@@ -378,10 +474,13 @@ class PserverServicer:
             lr_scale = 1.0 / max(1, diff) if diff > 0 else 1.0
         if request.lr_scale > 0:
             lr_scale *= request.lr_scale
-        apply_start = time.time() if trace.enabled() else 0.0
-        for name, slices in request.gradients.embedding_tables.items():
-            values, ids = _deserialize_gradients(slices)
-            self._store.push_gradients(name, ids, values, lr_scale=lr_scale)
+        apply_start = time.time()
+        self._apply_tables(
+            request.gradients.embedding_tables.items(), lr_scale
+        )
+        self._m_apply_seconds.labels(backend=self._backend).observe(
+            time.time() - apply_start
+        )
         trace.complete("ps_apply_push", apply_start,
                        version=grad_version)
         self._store.bump_version()
@@ -391,6 +490,48 @@ class PserverServicer:
         return self._stamp(
             pb.PushGradientsResponse(accepted=True, version=version)
         )
+
+    def _apply_tables(self, items, lr_scale):
+        """Apply every table's pushed gradients, fanning out across
+        the EDL_PS_APPLY_THREADS pool when one is configured. Safe to
+        parallelize per table: the native store locks per table, the
+        numpy store serializes on its store lock — either way each
+        table's apply is atomic, and cross-table order never mattered
+        (tables are disjoint row spaces)."""
+        items = list(items)
+        if self._apply_pool is not None and len(items) > 1:
+            apply_one = trace.bind_context(self._apply_one)
+            list(self._apply_pool.map(
+                lambda pair: apply_one(pair[0], pair[1], lr_scale),
+                items,
+            ))
+            return
+        for name, slices in items:
+            self._apply_one(name, slices, lr_scale)
+
+    def _apply_one(self, name, slices, lr_scale):
+        """One table's deserialize+dedup+apply. Native store + packed
+        wire payload: a single GIL-released C call. Otherwise:
+        numpy-array path with the identical pipeline — dedup first,
+        then one vectorized optimizer apply per unique id. (Both
+        branches share the dedup-then-apply semantics on purpose: the
+        sync path's round merge already dedups, gradient summation
+        over duplicates is the IndexedSlices contract, and the parity
+        suite asserts the two branches bit-match.)"""
+        if self._native_store and _blob_fast_path_ok(
+            self._store, name, slices
+        ):
+            self._store.push_gradients_blob(
+                name,
+                np.frombuffer(slices.ids_blob, dtype="<i8"),
+                slices.concat_tensors.content,
+                slices.concat_tensors.dtype,
+                lr_scale=lr_scale,
+            )
+            return
+        values, ids = _deserialize_gradients(slices)
+        values, ids = deduplicate_indexed_slices(values, ids)
+        self._store.push_gradients(name, ids, values, lr_scale=lr_scale)
 
     def push_embedding_rows(self, request, context=None):
         """Device-tier writeback (ISSUE 6): raw row values overwrite
@@ -419,6 +560,18 @@ class PserverServicer:
             )
         )
         for name, slices in request.embedding_tables.items():
+            if self._native_store and _blob_fast_path_ok(
+                self._store, name, slices
+            ):
+                # raw-row import straight from the wire bytes: one
+                # GIL-released C call, no numpy intermediates
+                self._store.import_blob(
+                    name,
+                    np.frombuffer(slices.ids_blob, dtype="<i8"),
+                    slices.concat_tensors.content,
+                    slices.concat_tensors.dtype,
+                )
+                continue
             values, ids = _deserialize_gradients(slices)
             self._store.import_table(name, ids, values)
         return self._stamp(pb.PushGradientsResponse(

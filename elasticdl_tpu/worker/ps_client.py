@@ -24,6 +24,7 @@ from elasticdl_tpu.common.tensor_utils import (
     blob_to_ndarray,
     deduplicate_indexed_slices,
     ndarray_to_blob,
+    normalize_id_tables,
     pack_ids,
     serialize_indexed_slices,
     wire_dtype,
@@ -387,11 +388,7 @@ class PSClient:
         return {name: future.result() for name, future in futures.items()}
 
     def _pull_embedding_batch(self, ids_by_table):
-        ids_by_table = {
-            name: np.asarray(ids, dtype=np.int64)
-            for name, ids in ids_by_table.items()
-            if np.asarray(ids).size
-        }
+        ids_by_table = normalize_id_tables(ids_by_table)
         if not ids_by_table:
             return {}
         if not self._batch_pull_supported:

@@ -1,0 +1,179 @@
+"""Native PS data plane microbench: GIL-free deserialize+dedup+apply.
+
+The ISSUE-11 gate for the native embedding store: identical wire
+payloads (packed ids_blob + raw gradient rows, duplicate-heavy Zipfian
+id stream) pushed through
+
+- the NATIVE pipeline: one ``edl_store_apply_blob`` C call per table
+  (deserialize + dedup + optimizer apply with the GIL released), and
+- the NUMPY pipeline it replaces: ``unpack_ids`` + ``blob_to_ndarray``
+  + fp32 upcast + ``deduplicate_indexed_slices`` +
+  ``NumpyEmbeddingStore.push_gradients``,
+
+plus the same A-B for the pull side (``lookup_blob`` with the
+wire-dtype cast in C vs lookup + astype + tobytes).
+
+Prints ONE JSON line. Exit 1 when the native apply speedup is below
+``--min-speedup`` (default 2.0 — the acceptance floor; CI additionally
+journals the absolute numbers report-only). Measured best-of-``reps``
+so a loaded box underestimates, never flakes upward.
+
+The parity of the two pipelines is NOT this script's job — that is
+bit-exact-tested in tests/test_native_parity.py; this only measures.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from elasticdl_tpu.common.tensor_utils import (  # noqa: E402
+    blob_to_ndarray,
+    deduplicate_indexed_slices,
+    serialize_indexed_slices,
+    unpack_ids,
+)
+from elasticdl_tpu.ps.embedding_store import (  # noqa: E402
+    NativeEmbeddingStore,
+    NumpyEmbeddingStore,
+    native_lib,
+)
+
+
+def zipf_ids(rng, n, vocab, a=1.3):
+    """Duplicate-heavy Zipfian id stream: the CTR-shaped workload the
+    dedup path exists for (a few hot ids dominate every batch)."""
+    ids = rng.zipf(a, size=n)
+    return np.minimum(ids, vocab).astype(np.int64)
+
+
+def timeit(fn, reps):
+    best = float("inf")
+    for _ in range(reps):
+        start = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - start)
+    return best
+
+
+def build_store(cls, opt, dim, tables):
+    store = cls(seed=11)
+    store.set_optimizer(opt, lr=0.01)
+    for name in tables:
+        store.create_table(name, dim, init_scale=0.05)
+    return store
+
+
+def main():
+    parser = argparse.ArgumentParser(__doc__)
+    parser.add_argument("--rows", type=int, default=8192,
+                        help="ids per push per table")
+    parser.add_argument("--dim", type=int, default=16)
+    parser.add_argument("--vocab", type=int, default=200000)
+    parser.add_argument("--tables", type=int, default=2)
+    parser.add_argument("--pushes", type=int, default=8,
+                        help="pushes per timed round")
+    parser.add_argument("--reps", type=int, default=5,
+                        help="timed rounds; best is reported")
+    parser.add_argument("--opt", default="adam")
+    parser.add_argument("--min-speedup", type=float, default=2.0,
+                        help="hard floor for native/numpy apply "
+                             "throughput (0 disables the gate)")
+    args = parser.parse_args()
+
+    if native_lib() is None:
+        # no C++ toolchain on this box: report and succeed — the CI
+        # image has one, so the gate still runs where it matters
+        print(json.dumps({"skipped": "native store unavailable"}))
+        return 0
+
+    tables = ["t%d" % i for i in range(args.tables)]
+    rng = np.random.RandomState(0)
+    pushes = []
+    for _ in range(args.pushes):
+        push = {}
+        for name in tables:
+            ids = zipf_ids(rng, args.rows, args.vocab)
+            grads = rng.randn(args.rows, args.dim).astype(np.float32)
+            push[name] = serialize_indexed_slices(grads, ids)
+        pushes.append(push)
+    dup_rate = 1.0 - float(np.mean([
+        np.unique(unpack_ids(s)).size / args.rows
+        for push in pushes for s in push.values()
+    ]))
+
+    native = build_store(NativeEmbeddingStore, args.opt, args.dim, tables)
+    ref = build_store(NumpyEmbeddingStore, args.opt, args.dim, tables)
+
+    def native_apply():
+        for push in pushes:
+            for name, slices in push.items():
+                native.push_gradients_blob(
+                    name,
+                    np.frombuffer(slices.ids_blob, dtype="<i8"),
+                    slices.concat_tensors.content,
+                    slices.concat_tensors.dtype,
+                )
+
+    def numpy_apply():
+        for push in pushes:
+            for name, slices in push.items():
+                values, ids = blob_to_ndarray(slices.concat_tensors), \
+                    unpack_ids(slices)
+                if values.dtype != np.float32:
+                    values = values.astype(np.float32)
+                values, ids = deduplicate_indexed_slices(values, ids)
+                ref.push_gradients(name, ids, values)
+
+    rows_per_round = args.rows * args.tables * args.pushes
+    native_s = timeit(native_apply, args.reps)
+    numpy_s = timeit(numpy_apply, args.reps)
+
+    pull_ids = np.unique(zipf_ids(rng, args.rows, args.vocab))
+
+    def native_pull():
+        for name in tables:
+            native.lookup_blob(name, pull_ids)
+
+    def numpy_pull():
+        for name in tables:
+            ref.lookup(name, pull_ids).tobytes()
+
+    native_pull_s = timeit(native_pull, args.reps)
+    numpy_pull_s = timeit(numpy_pull, args.reps)
+
+    speedup = numpy_s / native_s if native_s > 0 else float("inf")
+    out = {
+        "rows_per_push": args.rows,
+        "dim": args.dim,
+        "tables": args.tables,
+        "opt": args.opt,
+        "duplicate_rate": round(dup_rate, 4),
+        "native_apply_rows_per_sec": round(rows_per_round / native_s),
+        "numpy_apply_rows_per_sec": round(rows_per_round / numpy_s),
+        "apply_speedup": round(speedup, 2),
+        "native_pull_rows_per_sec": round(
+            pull_ids.size * args.tables * 1.0 / native_pull_s
+        ),
+        "numpy_pull_rows_per_sec": round(
+            pull_ids.size * args.tables * 1.0 / numpy_pull_s
+        ),
+        "pull_speedup": round(numpy_pull_s / native_pull_s, 2),
+    }
+    print(json.dumps(out))
+    if args.min_speedup > 0 and speedup < args.min_speedup:
+        print(
+            "FAIL: native apply speedup %.2fx below the %.1fx floor"
+            % (speedup, args.min_speedup),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

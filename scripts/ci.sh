@@ -15,6 +15,12 @@ echo "== tier 1a: native store build + TSAN race stress =="
 make -C elasticdl_tpu/native
 make -C elasticdl_tpu/native tsan
 make -C elasticdl_tpu/native asan
+# store-parity gate (ISSUE 11): the suite must run against the .so
+# just built above — native and numpy stores bit-identical across all
+# optimizers x wire dtypes x duplicate streams, checkpoint interop
+# both directions, loader ABI-drift fallback
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_native_parity.py tests/test_embedding_store.py -q
 
 echo "== tier 1c: edlint static analysis =="
 # zero-findings gate (both lanes): new findings are fixed, suppressed
@@ -532,6 +538,92 @@ ps.terminate(); ps.wait(timeout=30)
 print("serving smoke OK: clean SIGTERM drain journaled")
 PYEOF
 
+echo "== tier 1e+++: UDS local transport smoke (co-located worker+PS) =="
+# ISSUE 11: a real master+PS+worker deepfm job with the PS and worker
+# sharing EDL_PS_UDS_DIR — the worker's PS channel must ride the unix
+# socket (asserted before the job starts), the job must complete, and
+# the TCP fallback must serve the same exchange with the env unset.
+UDS_DIR="$(mktemp -d)"
+export UDS_DIR
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import os, socket, subprocess, sys, tempfile, threading, time
+sys.path.insert(0, "tests")
+from test_utils import create_ctr_recordio
+from elasticdl_tpu.common.grpc_utils import (
+    find_free_port, maybe_uds_addr, uds_socket_path,
+)
+
+uds_dir = os.path.join(os.environ["UDS_DIR"], "sock")
+train = tempfile.mkdtemp()
+create_ctr_recordio(train + "/f0.rec", num_records=256, seed=0)
+mport, pport = find_free_port(), find_free_port()
+base_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+master = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.master.main",
+    "--model_zoo", "elasticdl_tpu.models.deepfm",
+    "--training_data", train, "--records_per_task", "64",
+    "--num_epochs", "1", "--port", str(mport),
+    "--task_timeout_secs", "60",
+], env=base_env)
+ps = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.ps.server", "--ps_id", "0",
+    "--num_ps_pods", "1", "--port", str(pport),
+    "--opt_type", "adam", "--opt_args", "lr=0.01", "--use_async", "1",
+], env={**base_env, "EDL_PS_UDS_DIR": uds_dir})
+
+def wait_port(port, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port)); return
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    raise TimeoutError(port)
+
+wait_port(mport); wait_port(pport)
+# the socket must exist and the client-side rewrite must take it
+os.environ["EDL_PS_UDS_DIR"] = uds_dir
+path = uds_socket_path(pport)
+deadline = time.time() + 30
+while not os.path.exists(path) and time.time() < deadline:
+    time.sleep(0.2)
+assert os.path.exists(path), "PS never bound its unix socket"
+assert maybe_uds_addr("localhost:%d" % pport) == "unix:" + path
+
+from elasticdl_tpu.data.readers import RecordIODataReader
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+mc = MasterClient("localhost:%d" % mport, worker_id=0)
+mc.reset_worker()
+worker = Worker(
+    mc, "elasticdl_tpu.models.deepfm",
+    RecordIODataReader(data_dir=train), minibatch_size=64,
+    wait_sleep_secs=0.1, ps_addrs=["localhost:%d" % pport],
+)
+runner = threading.Thread(target=worker.run, daemon=True)
+runner.start()
+rc = master.wait(timeout=300)
+assert rc == 0, "UDS job did not finish (rc=%s)" % rc
+runner.join(timeout=120)
+
+# TCP fallback: env unset -> the rewrite declines, the same PS still
+# serves the exchange over its TCP listener
+del os.environ["EDL_PS_UDS_DIR"]
+assert maybe_uds_addr("localhost:%d" % pport) is None
+import numpy as np
+from elasticdl_tpu.worker.ps_client import PSClient
+tcp_client = PSClient(["localhost:%d" % pport])
+rows = tcp_client.pull_embedding_batch(
+    {"deepfm_emb": np.arange(4, dtype=np.int64)}
+)
+assert rows["deepfm_emb"].shape[0] == 4
+ps.terminate(); ps.wait(timeout=30)
+print("UDS smoke OK: job over unix socket, fallback over TCP")
+PYEOF
+
 echo "== tier 1f: wire-path perf smoke (micro + EDL_WIRE_DTYPE opt-in) =="
 # Microbenchmark of the ISSUE-5 wire fast paths vs the legacy paths
 # they replaced: packed ids_blob vs repeated-varint serialization,
@@ -545,6 +637,18 @@ printf '{"ts": "%s", "wire_micro": %s}\n' \
   "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_wire_micro.json)" \
   >> /tmp/ci_wire_micro.jsonl
 echo "wire-micro numbers journaled to /tmp/ci_wire_micro.jsonl"
+
+# Native PS data plane bench (ISSUE 11): identical duplicate-heavy
+# Zipfian wire payloads through the native single-call pipeline vs
+# the numpy pipeline it replaces. Absolute rows/sec are report-only
+# (journaled below); the script hard-fails when the in-run native
+# apply speedup drops below its 2x floor — the acceptance gate, and
+# far stricter than the lane's usual >3x-regression rule.
+python scripts/bench_ps_apply.py | tee /tmp/_ps_apply.json
+printf '{"ts": "%s", "ps_apply": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_ps_apply.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "ps-apply bench journaled to /tmp/ci_wire_micro.jsonl"
 
 # Serving-tier bench (ISSUE 8): open-loop Zipfian load at fixed QPS
 # through the real gRPC serve stack, with a mid-run version swap.

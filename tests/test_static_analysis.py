@@ -487,6 +487,57 @@ def test_perf_varint_ids_quiet_on_vectorized_and_working_comprehensions():
 
 
 # ---------------------------------------------------------------------------
+# perf-gil-held-apply
+
+GIL_HELD_APPLY = """
+    class Servicer:
+        def push(self, request):
+            with self._push_lock:
+                values, ids = _deserialize_gradients(slices)  # BUG
+                self._store.push_gradients(name, ids, values)
+"""
+
+
+def test_perf_gil_held_apply_flags_parse_and_apply_under_lock():
+    findings = findings_for(
+        GIL_HELD_APPLY, path="elasticdl_tpu/ps/servicer.py",
+        rules=["perf-gil-held-apply"],
+    )
+    assert len(findings) == 1
+    assert findings[0].rule == "perf-gil-held-apply"
+    assert "_deserialize_gradients" in findings[0].message
+
+
+def test_perf_gil_held_apply_quiet_when_parse_hoisted():
+    assert not findings_for("""
+        class Servicer:
+            def push(self, request):
+                tables = {
+                    name: _deserialize_gradients(slices)
+                    for name, slices in request.tables.items()
+                }
+                with self._push_lock:
+                    for name, (values, ids) in tables.items():
+                        self._store.push_gradients(name, ids, values)
+
+            def non_lock_context(self, slices):
+                with trace.span("apply"):
+                    values, ids = _deserialize_gradients(slices)
+                    self._store.push_gradients("t", ids, values)
+    """, path="elasticdl_tpu/ps/servicer.py",
+        rules=["perf-gil-held-apply"])
+
+
+def test_perf_gil_held_apply_scoped_to_servicer_modules():
+    # same construct outside ps/servicer scope: a deliberate atomicity
+    # choice elsewhere is not this rule's business
+    assert not findings_for(
+        GIL_HELD_APPLY, path="elasticdl_tpu/train/device_tier.py",
+        rules=["perf-gil-held-apply"],
+    )
+
+
+# ---------------------------------------------------------------------------
 # xhost-determinism
 
 def test_determinism_flags_set_iteration_in_checkpoint_path():

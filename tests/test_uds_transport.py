@@ -1,0 +1,211 @@
+"""Zero-copy local transport (ISSUE 11): gRPC over unix-domain sockets.
+
+Under EDL_PS_UDS_DIR a PS binds a socket named by its TCP port beside
+the TCP listener, and ``build_channel`` to a LOCAL host:port prefers
+that socket when it exists. Proven here three ways:
+
+- a server bound ONLY on the socket still serves a channel built from
+  its host:port address — the channel really rides UDS;
+- with the env unset (or the host remote / the socket absent) the
+  rewrite declines and TCP is used — fallback semantics;
+- (slow) a real PS subprocess under UDS is SIGKILLed and relaunched on
+  the SAME socket path: the surviving client's channel reconnects and
+  the restored-stamp resync fires, no channel rebuild — the chaos
+  contract TCP already had.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.grpc_utils import (
+    build_channel,
+    build_server,
+    find_free_port,
+    maybe_uds_addr,
+    uds_socket_path,
+)
+from elasticdl_tpu.common.tensor_utils import pack_ids
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.services import (
+    PserverStub,
+    add_pserver_servicer_to_server,
+)
+from elasticdl_tpu.ps.embedding_store import NumpyEmbeddingStore
+from elasticdl_tpu.ps.servicer import PserverServicer
+
+
+def _uds_only_server(tmp_path, port):
+    store = NumpyEmbeddingStore(seed=0)
+    store.set_optimizer("sgd", lr=0.1)
+    servicer = PserverServicer(store, use_async=True)
+    server = build_server()
+    add_pserver_servicer_to_server(servicer, server)
+    path = uds_socket_path(port, str(tmp_path))
+    assert server.add_insecure_port("unix:" + path)
+    server.start()
+    return server, store
+
+
+def test_channel_rides_uds_when_socket_exists(tmp_path, monkeypatch):
+    monkeypatch.setenv("EDL_PS_UDS_DIR", str(tmp_path))
+    port = find_free_port()
+    # NO TCP listener on `port`: an RPC succeeding proves UDS carried it
+    server, _ = _uds_only_server(tmp_path, port)
+    try:
+        expected = "unix:" + uds_socket_path(port, str(tmp_path))
+        assert maybe_uds_addr("localhost:%d" % port) == expected
+        stub = PserverStub(build_channel("localhost:%d" % port))
+        infos = pb.Model()
+        infos.embedding_table_infos.add(name="t", dim=4,
+                                        initializer="0.05")
+        stub.push_embedding_table_infos(infos, timeout=10)
+        blob = stub.pull_embedding_vectors(
+            pb.PullEmbeddingVectorsRequest(
+                name="t",
+                ids_blob=pack_ids(np.arange(3, dtype=np.int64)),
+            ),
+            timeout=10,
+        )
+        assert list(blob.dims) == [3, 4]
+    finally:
+        server.stop(0)
+
+
+def test_rewrite_declines_without_env(monkeypatch):
+    monkeypatch.delenv("EDL_PS_UDS_DIR", raising=False)
+    assert maybe_uds_addr("localhost:50002") is None
+    assert uds_socket_path(50002) is None
+
+
+def test_rewrite_declines_for_remote_host_and_missing_socket(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("EDL_PS_UDS_DIR", str(tmp_path))
+    # no socket file yet -> TCP even though the env is set
+    assert maybe_uds_addr("localhost:50002") is None
+    # a remote host never rewrites, socket or not
+    path = uds_socket_path(50002)
+    with open(path, "w"):
+        pass
+    assert maybe_uds_addr("ps-pod-7.svc.cluster.local:50002") is None
+    assert maybe_uds_addr("localhost:50002") == "unix:" + path
+
+
+def test_tcp_fallback_serves_when_env_unset(monkeypatch):
+    """The same topology with the knob unset must work over plain TCP
+    (the CI smoke's fallback proof, in-process here)."""
+    monkeypatch.delenv("EDL_PS_UDS_DIR", raising=False)
+    store = NumpyEmbeddingStore(seed=0)
+    store.set_optimizer("sgd", lr=0.1)
+    servicer = PserverServicer(store, use_async=True)
+    server = build_server()
+    add_pserver_servicer_to_server(servicer, server)
+    port = find_free_port()
+    assert server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    try:
+        stub = PserverStub(build_channel("localhost:%d" % port))
+        infos = pb.Model()
+        infos.embedding_table_infos.add(name="t", dim=4,
+                                        initializer="0.05")
+        stub.push_embedding_table_infos(infos, timeout=10)
+        assert store.table_names() == ["t"]
+    finally:
+        server.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL the PS under UDS, relaunch on the same socket path
+
+
+def _spawn_ps(port, uds_dir, checkpoint_dir):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "EDL_PS_UDS_DIR": uds_dir,
+    }
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "elasticdl_tpu.ps.server",
+            "--ps_id", "0", "--num_ps_pods", "1",
+            "--port", str(port),
+            "--opt_type", "sgd", "--opt_args", "lr=0.1",
+            "--checkpoint_dir", checkpoint_dir,
+            "--checkpoint_steps", "1",
+            "--use_native_store", "0",
+        ],
+        env=env,
+    )
+
+
+@pytest.mark.slow
+def test_ps_sigkill_relaunch_same_socket(tmp_path, monkeypatch):
+    uds_dir = str(tmp_path / "uds")
+    ckpt_dir = str(tmp_path / "ckpt")
+    monkeypatch.setenv("EDL_PS_UDS_DIR", uds_dir)
+    port = find_free_port()
+    ps = _spawn_ps(port, uds_dir, ckpt_dir)
+    try:
+        path = uds_socket_path(port)
+        deadline = time.time() + 60
+        while not os.path.exists(path) and time.time() < deadline:
+            time.sleep(0.2)
+        assert os.path.exists(path), "PS never bound its socket"
+
+        from elasticdl_tpu.worker.ps_client import PSClient
+
+        client = PSClient(["localhost:%d" % port])
+        # the channel must be riding UDS (socket existed at build time)
+        assert maybe_uds_addr("localhost:%d" % port) == "unix:" + path
+        client.push_embedding_table_infos([("t", 4, 0.05)])
+        ids = np.arange(6, dtype=np.int64)
+        # batch pull: its response carries the restored stamp the
+        # resync detection below reads
+        rows = client.pull_embedding_batch({"t": ids})["t"]
+        grads = np.ones((6, 4), dtype=np.float32)
+        result = client.push_gradients({"t": (grads, ids)})
+        assert result.accepted and result.version >= 1
+
+        ps.send_signal(signal.SIGKILL)
+        ps.wait(timeout=30)
+        # socket file lingers after SIGKILL; the relaunch unlinks and
+        # rebinds the SAME path, and the surviving client's channel
+        # reconnects to it without being rebuilt
+        assert os.path.exists(path)
+        ps = _spawn_ps(port, uds_dir, ckpt_dir)
+        resynced = []
+        client.resync_hook = lambda shard: resynced.append(shard)
+        deadline = time.time() + 90
+        rows2 = None
+        while time.time() < deadline:
+            try:
+                rows2 = client.pull_embedding_batch({"t": ids})["t"]
+                if resynced:
+                    break
+            except grpc.RpcError:
+                pass
+            time.sleep(0.5)
+        assert resynced, "restored-stamp resync never fired over UDS"
+        # the relaunched PS auto-restored its checkpoint: the applied
+        # push survives across the kill
+        assert rows2 is not None
+        np.testing.assert_allclose(rows2, rows - 0.1)
+
+        # orderly SIGTERM drain must UNLINK the socket: a lingering
+        # file would hijack later channels to a reused local port
+        # (maybe_uds_addr keys on path existence alone)
+        ps.send_signal(signal.SIGTERM)
+        assert ps.wait(timeout=60) == 0
+        assert not os.path.exists(path), "drained PS left its socket"
+        assert maybe_uds_addr("localhost:%d" % port) is None
+    finally:
+        if ps.poll() is None:
+            ps.kill()
+            ps.wait(timeout=30)
