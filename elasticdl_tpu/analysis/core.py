@@ -226,6 +226,7 @@ def _rules_by_name(names=None):
         perf_gil,
         perf_wire,
         serve_queue,
+        unbounded_vocab,
     )
 
     registry = {
@@ -241,6 +242,7 @@ def _rules_by_name(names=None):
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
         "ft-retry-no-jitter": fault_tolerance.run_retry_no_jitter,
         "ft-sigterm-no-chain": fault_tolerance.run_sigterm_no_chain,
+        "ft-unbounded-vocab": unbounded_vocab.run,
         "xhost-determinism": determinism.run,
     }
     if names is None:
@@ -264,6 +266,7 @@ RULE_NAMES = (
     "ft-grpc-timeout",
     "ft-retry-no-jitter",
     "ft-sigterm-no-chain",
+    "ft-unbounded-vocab",
     "xhost-determinism",
 )
 

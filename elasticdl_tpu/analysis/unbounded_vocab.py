@@ -1,0 +1,170 @@
+"""ft-unbounded-vocab: id-keyed container growth with no eviction bound.
+
+The failure class behind ISSUE 12: production CTR streams carry
+unbounded vocabularies, and any table/dict/set that grows one entry per
+raw stream id — with no admission gate and no eviction entry point —
+is a slow memory leak by design. The embedding stores paid exactly this
+(every novel id materialized a row forever) until the lifecycle manager
+landed; this rule keeps the class from creeping back into the hot
+store/stream/cache layers.
+
+What fires, in files under a ``ps/``, ``stream/``, or ``embedding/``
+package directory only:
+
+- a ``for`` loop whose iterable's dotted name ends in an id-stream
+  spelling (``ids``, ``id_list``, ``unique_ids``, ...), containing a
+  statement that GROWS a container keyed by the loop variable:
+  ``d[i] = ...`` / ``d[int(i)] = ...`` subscript assignment,
+  ``d.setdefault(i, ...)``, or ``s.add(i)``;
+- UNLESS the growth is bounded by construction: the enclosing class
+  (or module, for top-level code) defines an eviction/admission entry
+  point — any of ``drop_rows``, ``drop_table``, ``sweep``, ``evict``,
+  or ``clear`` with a capacity bound is out of scope (caches with
+  ``capacity``/``maxlen`` discipline define ``clear``).
+
+A store that CAN delete rows is allowed to insert them — the rule pins
+"grows forever with no way to shrink", not "inserts". False positives
+are one ``# edlint: disable=ft-unbounded-vocab`` away, with the
+justification the suppression comment forces.
+"""
+
+import ast
+import os
+
+from elasticdl_tpu.analysis.core import Finding, attr_chain
+
+RULE = "ft-unbounded-vocab"
+
+_SCOPED_DIRS = {"ps", "stream", "embedding"}
+
+# iterable spellings that mean "raw stream ids flow here"
+_ID_TAILS = ("ids", "id_list", "id_set")
+
+# an enclosing class/module with any of these defines a way to shrink:
+# growth is then lifecycle-managed, not unbounded
+_EVICTION_METHODS = {
+    "drop_rows", "drop_table", "sweep", "evict", "evict_rows", "clear",
+}
+
+
+def _in_scope(path):
+    parts = path.replace(os.sep, "/").split("/")
+    return bool(_SCOPED_DIRS & set(parts))
+
+
+def _is_id_stream(iter_node):
+    """True when the for-loop iterable reads as an id stream: a dotted
+    name whose last component ends in an id spelling, or such a name
+    through zip()/enumerate()/np.asarray()-style wrappers."""
+    if isinstance(iter_node, ast.Call):
+        return any(
+            _is_id_stream(arg) for arg in iter_node.args
+        )
+    chain = attr_chain(iter_node)
+    if not chain:
+        return False
+    tail = chain.rsplit(".", 1)[-1].lower()
+    return tail.endswith(_ID_TAILS)
+
+
+def _loop_target_names(target):
+    if isinstance(target, ast.Name):
+        return {target.id}
+    if isinstance(target, (ast.Tuple, ast.List)):
+        names = set()
+        for element in target.elts:
+            names |= _loop_target_names(element)
+        return names
+    return set()
+
+
+def _key_uses(node, names):
+    """The subscript/argument key derives from a loop variable —
+    directly, or through int()/str()-style conversion calls."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+    return False
+
+
+def _growth_statements(loop, names):
+    """Yield (lineno, code) for container growth keyed by ``names``
+    inside the loop body (nested loops included — the loop var is
+    still in scope)."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Subscript)
+                    and _key_uses(target.slice, names)
+                ):
+                    chain = attr_chain(target.value) or "<container>"
+                    yield node.lineno, "%s[...] =" % chain
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("setdefault", "add")
+                and node.args
+                and _key_uses(node.args[0], names)
+            ):
+                chain = attr_chain(func.value) or "<container>"
+                yield node.lineno, "%s.%s()" % (chain, func.attr)
+
+
+def _scope_methods(unit):
+    """{qualname prefix: defined method/function names} for every class
+    and the module: the eviction-entry-point lookup."""
+    scopes = {"<module>": set()}
+    for node in ast.walk(unit.tree):
+        if isinstance(node, ast.ClassDef):
+            scopes[node.name] = {
+                child.name
+                for child in node.body
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+            }
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes["<module>"].add(node.name)
+    return scopes
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        if not _in_scope(unit.path):
+            continue
+        scopes = _scope_methods(unit)
+        from elasticdl_tpu.analysis.core import walk_with_scope
+
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            if not _is_id_stream(node.iter):
+                continue
+            names = _loop_target_names(node.target)
+            if not names:
+                continue
+            # the eviction lookup keys on the enclosing class (first
+            # scope component) or the module for top-level loops
+            owner = scope.split(".", 1)[0]
+            defined = scopes.get(owner, scopes["<module>"])
+            if defined & _EVICTION_METHODS:
+                continue
+            for lineno, code in _growth_statements(node, names):
+                findings.append(Finding(
+                    rule=RULE,
+                    path=unit.path,
+                    line=lineno,
+                    symbol=scope,
+                    code=code,
+                    message=(
+                        "container grows one entry per raw stream id "
+                        "with no admission/eviction bound (no "
+                        "drop_rows/sweep/evict/clear on %r) — an "
+                        "unbounded-vocab stream leaks memory here; "
+                        "bound it or route through the embedding "
+                        "lifecycle" % owner
+                    ),
+                ))
+    return findings

@@ -1,0 +1,34 @@
+"""Env-knob parsing, once. Every role reads numeric EDL_* knobs; the
+repo had grown five near-identical try/int(os.environ...) copies with
+diverging behavior on a typo'd value. One pair, log-and-default."""
+
+import os
+
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+
+logger = _logger_factory("elasticdl_tpu.common.env_utils")
+
+
+def env_int(name, default):
+    """int(os.environ[name]) with ``default`` for unset/empty; a
+    non-numeric value logs a warning (a typo'd knob must be loud, not
+    silently the default) and falls back."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return int(default)
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name, raw)
+        return int(default)
+
+
+def env_float(name, default):
+    raw = os.environ.get(name, "")
+    if not raw:
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name, raw)
+        return float(default)

@@ -36,6 +36,7 @@ import os
 import threading
 import time
 
+from elasticdl_tpu.common.env_utils import env_float as _env_float
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
@@ -50,13 +51,6 @@ VERSION_LAG_MAX_ENV = "EDL_VERSION_LAG_MAX"
 ALERT_KINDS = ("straggler", "dead_air", "stuck_round", "version_lag")
 
 
-def _env_float(name, default):
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        logger.warning("ignoring non-numeric %s=%r", name,
-                       os.environ.get(name))
-        return float(default)
 
 
 class _RoleState:
@@ -191,6 +185,15 @@ class FleetMonitor:
                 # backend a PS shard ran — the first thing a
                 # postmortem checks on an apply-latency regression
                 "ps_native_store": bool(blob.ps_native_store),
+                # embedding lifecycle (ISSUE 12): admission/eviction
+                # health — resident rows is the bounded-memory
+                # contract's number; tracked ids is the "how many
+                # novel ids are knocking" pressure signal
+                "ps_rows_admitted": int(blob.ps_rows_admitted),
+                "ps_rows_evicted_ttl": int(blob.ps_rows_evicted_ttl),
+                "ps_rows_evicted_lfu": int(blob.ps_rows_evicted_lfu),
+                "ps_tracked_ids": int(blob.ps_tracked_ids),
+                "ps_resident_rows": int(blob.ps_resident_rows),
             }
             # stuck-round bookkeeping: the clock restarts whenever the
             # fill grows or the store version advances

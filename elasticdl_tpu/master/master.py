@@ -79,6 +79,16 @@ class Master:
         self.job_type = self._infer_job_type(
             training_data, validation_data, prediction_data
         )
+        # Continual streaming mode (ISSUE 12): EDL_STREAM selects a
+        # stream source — tasks are then minted from arriving windows
+        # by the StreamFeeder instead of one shuffled epoch at a time,
+        # and training_data is the window spool (synthetic) or the
+        # replayed origin, never pre-sharded up front.
+        from elasticdl_tpu.stream.feeder import StreamFeeder, source_from_env
+
+        stream_source = source_from_env(
+            training_data, reader_params=reader_params
+        )
         # control-plane crash recovery (EDL_STATE_DIR): replay the
         # predecessor's journal so a relaunched master resumes the job
         # mid-epoch instead of forgetting dispatched/done shards
@@ -89,15 +99,26 @@ class Master:
             else None
         )
         self.task_dispatcher = TaskDispatcher(
-            training_shards=shards_of(training_data),
+            training_shards=(
+                {} if stream_source is not None
+                else shards_of(training_data)
+            ),
             evaluation_shards=shards_of(validation_data),
             prediction_shards=shards_of(prediction_data),
             records_per_task=records_per_task,
-            num_epochs=num_epochs,
+            num_epochs=0 if stream_source is not None else num_epochs,
             seed=seed,
             state_journal=self.state_journal,
             recovered=self._recovered,
+            stream=stream_source is not None,
         )
+        self.stream_feeder = None
+        if stream_source is not None:
+            self.stream_feeder = StreamFeeder(
+                self.task_dispatcher,
+                stream_source,
+                saved_model_path=saved_model_path or "",
+            )
         if saved_model_path and self.job_type != JobType.PREDICTION_ONLY:
             self.task_dispatcher.add_deferred_callback_create_train_end_task(
                 {"saved_model_path": saved_model_path}
@@ -308,6 +329,11 @@ class Master:
                             if self.autoscaler is not None
                             else None
                         ),
+                        "stream": (
+                            self.stream_feeder.state()
+                            if self.stream_feeder is not None
+                            else None
+                        ),
                     }
                 ),
             )
@@ -316,6 +342,10 @@ class Master:
             )
         if self.tensorboard_service is not None:
             self.tensorboard_service.start()
+        if self.stream_feeder is not None:
+            # after the journal replay settled the dispatcher: the
+            # feeder seeks the source to the journaled position
+            self.stream_feeder.start()
         self.task_monitor.start()
         if self.pod_manager is not None:
             self.pod_manager.start()
@@ -355,6 +385,8 @@ class Master:
         events.emit("role_stop")
         events.flush()
         trace.flush()
+        if self.stream_feeder is not None:
+            self.stream_feeder.stop()
         self.task_monitor.stop()
         if self.evaluation_service is not None:
             self.evaluation_service.stop()

@@ -331,12 +331,23 @@ class MasterServicer:
                 "epoch_base": self._restart_epoch_base,
             }
 
+    def _stream_watermark(self):
+        """The dispatcher's record watermark (streaming mode; 0
+        otherwise) — stamped on CommInfo so workers and PS shards
+        drive their checkpoint/flush cadence off its progress without
+        any extra RPC (the heartbeat/liveness poll already flows)."""
+        watermark = getattr(
+            self._task_dispatcher, "stream_watermark", None
+        )
+        return watermark() if callable(watermark) else 0
+
     def get_comm_info(self, request, context=None):
         self._observe(request)
         if self._rendezvous is None:
             return pb.CommInfo(
                 rank=0, world_size=1, mesh_epoch=0,
                 master_epoch=self._master_epoch,
+                stream_watermark=self._stream_watermark(),
             )
         if request.worker_host:
             with self._lock:
@@ -352,4 +363,5 @@ class MasterServicer:
             mesh_epoch=epoch,
             coordinator_addr=coordinator,
             master_epoch=self._master_epoch,
+            stream_watermark=self._stream_watermark(),
         )

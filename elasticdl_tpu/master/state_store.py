@@ -55,13 +55,20 @@ OP_TYPES = frozenset({
     "tasks_created",    # + tasks [[id,type,shard,start,end,mv]...],
                         #   queue ("train"|"eval"), epochs_left
     "dispatch",         # + task, worker
-    "done",             # + task, type
+    "done",             # + task, type [, records (stream watermark)]
     "requeue",          # + task, retries
     "job_failed",       # + task
     "grant",            # + worker, count (relaunch-epoch grant)
     "epoch_base",       # + base (servicer relaunch-epoch base)
     "version",          # + version (model version reports)
     "master_restarted",  # + master_epoch (bookkeeping; no state change)
+    # streaming mode (ISSUE 12): the watermark-task extension of
+    # done-exactly-once — a relaunched master resumes minting at the
+    # journaled source position instead of re-delivering windows
+    "stream_open",      # streaming dispatcher constructed
+    "stream_window",    # + pos (source windows minted so far), task
+                        #   [id,type,shard,start,end,mv]
+    "stream_close",     # source exhausted; drain contract takes over
 })
 
 
@@ -79,6 +86,14 @@ def empty_state():
         "worker_restarts": {},  # worker -> relaunch count
         "epoch_base": 0,
         "model_version": 0,
+        # streaming mode (ISSUE 12): source position + record
+        # accounting; "open" False means epoch semantics (the default)
+        "stream": {
+            "open": False,
+            "pos": 0,
+            "minted_records": 0,
+            "done_records": 0,
+        },
     }
 
 
@@ -136,6 +151,11 @@ def apply_op(state, op):
             state["done_counts"][task_type] = (
                 state["done_counts"].get(task_type, 0) + 1
             )
+            # stream watermark: records of completed window tasks.
+            # Guarded by the same task-known fence as the rest of this
+            # op, so a snapshot-covered duplicate can't double-count.
+            if op.get("records"):
+                state["stream"]["done_records"] += int(op["records"])
     elif kind == "requeue":
         task_id = op["task"]
         if task_id in state["tasks"]:
@@ -157,6 +177,25 @@ def apply_op(state, op):
         state["epoch_base"] = op["base"]
     elif kind == "version":
         state["model_version"] = op["version"]
+    elif kind == "stream_open":
+        state["stream"]["open"] = True
+    elif kind == "stream_window":
+        stream = state["stream"]
+        task = op["task"]
+        task_id = int(task[0])
+        # fence like tasks_created: a window the snapshot already
+        # reflects must not re-mint (done-exactly-once for watermark
+        # tasks); pos advances monotonically either way so the feeder
+        # resumes the SOURCE at the right offset
+        if task_id >= state["next_task_id"]:
+            state["tasks"][task_id] = list(task)
+            state["todo"].append(task_id)
+            state["next_task_id"] = task_id + 1
+            stream["minted_records"] += int(task[4]) - int(task[3])
+        stream["open"] = True
+        stream["pos"] = max(stream["pos"], int(op.get("pos", 0)))
+    elif kind == "stream_close":
+        state["stream"]["open"] = False
     elif kind == "master_restarted":
         pass  # bookkeeping only
     else:  # unreachable: append() validates

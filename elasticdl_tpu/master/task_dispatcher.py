@@ -58,6 +58,7 @@ class TaskDispatcher:
         seed=None,
         state_journal=None,
         recovered=None,
+        stream=False,
     ):
         self._lock = threading.Lock()
         # control-plane crash recovery (master/state_store.py): every
@@ -100,6 +101,18 @@ class TaskDispatcher:
         # task type -> successfully completed count (the "done" third
         # of the master's pending/doing/done task gauges)
         self._done_counts = {}
+        # Streaming mode (ISSUE 12): tasks are minted from arriving
+        # windows (add_stream_window) instead of epochs, and
+        # finished() is replaced by a drain contract — the job is over
+        # only once the feeder CLOSED the stream and the queue drained.
+        # The watermark (records of completed window tasks) is the
+        # job's durability clock: checkpoint/export cadence rides it
+        # where an epoch job rides epoch boundaries.
+        self._stream = bool(stream)
+        self._stream_open = bool(stream)
+        self._stream_pos = 0            # source windows minted
+        self._stream_minted_records = 0
+        self._stream_done_records = 0   # the watermark
 
         if recovered is not None:
             # authoritative even when empty: a journal that says "all
@@ -111,8 +124,14 @@ class TaskDispatcher:
             )
             self._todo.extend(ids)
             self._journal_tasks_locked(ids, "train")
-        elif self._training_shards:
+        elif self._training_shards and not self._stream:
             self._create_training_epoch_locked()
+        if (
+            self._stream
+            and recovered is None
+            and self._journal is not None
+        ):
+            self._journal_ops.append({"op": "stream_open"})
         self._flush_journal()
 
     # ------------------------------------------------------------------
@@ -196,11 +215,32 @@ class TaskDispatcher:
             for t, n in recovered.get("done_counts", {}).items()
         }
         self._job_failed = bool(recovered.get("job_failed", False))
+        stream = recovered.get("stream") or {}
+        if stream.get("open") or stream.get("pos"):
+            # the journal is authoritative about stream state: the
+            # relaunched feeder resumes the source at ``pos`` (no
+            # window re-minted — done-exactly-once extended to
+            # watermark tasks) and the watermark carries on where the
+            # predecessor's completions left it
+            self._stream = True
+            self._stream_open = bool(stream.get("open", False))
+            self._stream_pos = int(stream.get("pos", 0))
+            self._stream_minted_records = int(
+                stream.get("minted_records", 0)
+            )
+            self._stream_done_records = int(
+                stream.get("done_records", 0)
+            )
         logger.info(
             "Dispatcher resumed from journal: %d todo, %d eval, "
-            "%d requeued in-flight, epochs left %d",
+            "%d requeued in-flight, epochs left %d%s",
             len(self._todo), len(self._eval_todo),
             len(self._recovered_assignee), self._epochs_left,
+            (
+                ", stream pos %d watermark %d"
+                % (self._stream_pos, self._stream_done_records)
+                if self._stream else ""
+            ),
         )
 
     def export_state(self):
@@ -230,6 +270,12 @@ class TaskDispatcher:
                 "epochs_left": self._epochs_left,
                 "next_task_id": self._next_task_id,
                 "job_failed": self._job_failed,
+                "stream": {
+                    "open": self._stream_open,
+                    "pos": self._stream_pos,
+                    "minted_records": self._stream_minted_records,
+                    "done_records": self._stream_done_records,
+                },
             }
 
     # ------------------------------------------------------------------
@@ -284,6 +330,124 @@ class TaskDispatcher:
             count = len(ids)
         self._flush_journal()
         return count
+
+    # ------------------------------------------------------------------
+    # streaming mode (ISSUE 12)
+
+    def add_stream_window(self, shard_name, start, end, model_version=-1):
+        """Mint one TRAINING task from an arrived stream window. The
+        journal records the source position alongside the task, so a
+        relaunched master resumes minting at ``stream_pos()`` instead
+        of re-delivering windows a dead predecessor already minted
+        (done-exactly-once extended to watermark tasks). Returns the
+        task id."""
+        with self._lock:
+            if not self._stream_open:
+                raise RuntimeError(
+                    "add_stream_window on a closed/non-stream dispatcher"
+                )
+            task = pb.Task(
+                task_id=self._next_task_id,
+                type=pb.TRAINING,
+                shard_name=shard_name,
+                start=int(start),
+                end=int(end),
+                model_version=model_version,
+            )
+            self._records[task.task_id] = _TaskRecord(task)
+            self._next_task_id += 1
+            self._todo.append(task.task_id)
+            self._stream_pos += 1
+            self._stream_minted_records += int(end) - int(start)
+            if self._journal is not None:
+                self._journal_ops.append({
+                    "op": "stream_window",
+                    "pos": self._stream_pos,
+                    "task": [task.task_id, int(pb.TRAINING),
+                             shard_name, int(start), int(end),
+                             model_version],
+                })
+            task_id = task.task_id
+        self._flush_journal()
+        return task_id
+
+    def add_stream_export_task(self, extended_config=None):
+        """Mint an export (TRAIN_END_CALLBACK) task mid-stream: one
+        worker will join its pushes, flush its device tier, and write a
+        fresh export — the serving tier's watcher then hot-swaps onto
+        it. The streaming replacement for the end-of-job export."""
+        with self._lock:
+            task = pb.Task(
+                task_id=self._next_task_id,
+                type=pb.TRAIN_END_CALLBACK,
+                shard_name="",
+                start=0,
+                end=0,
+            )
+            for key, value in (extended_config or {}).items():
+                task.extended_config[key] = value
+            self._records[task.task_id] = _TaskRecord(task)
+            self._next_task_id += 1
+            self._todo.append(task.task_id)
+            self._journal_tasks_locked([task.task_id], "train")
+            task_id = task.task_id
+        self._flush_journal()
+        return task_id
+
+    def close_stream(self):
+        """Source exhausted (bounded replay over, operator stop): no
+        more windows will arrive. finished() can then report true once
+        the queue drains — the streaming drain contract."""
+        with self._lock:
+            if not self._stream_open:
+                return
+            self._stream_open = False
+            if self._journal is not None:
+                self._journal_ops.append({"op": "stream_close"})
+            if (
+                not self._todo
+                and not self._doing_training_locked()
+                and self._deferred_callbacks
+                and not self._records_have_train_end_locked()
+            ):
+                # the queue already drained while the stream was open:
+                # no further report() will arrive to fire the deferred
+                # train-end task, so the close must
+                self._fire_deferred_locked()
+        self._flush_journal()
+        logger.info(
+            "Stream closed at pos %d (%d records minted, watermark %d)",
+            self._stream_pos, self._stream_minted_records,
+            self._stream_done_records,
+        )
+
+    def stream_watermark(self):
+        """Records of COMPLETED stream-window tasks: every record below
+        the watermark has been trained and reported. 0 for non-stream
+        jobs (the proto default on CommInfo)."""
+        with self._lock:
+            return self._stream_done_records
+
+    def stream_pos(self):
+        """Source windows minted so far — where a (re)started feeder
+        seeks its source to."""
+        with self._lock:
+            return self._stream_pos
+
+    def stream_state(self):
+        """O(1) snapshot for /statusz + the feeder."""
+        with self._lock:
+            return {
+                "stream": self._stream,
+                "open": self._stream_open,
+                "pos": self._stream_pos,
+                "minted_records": self._stream_minted_records,
+                "watermark": self._stream_done_records,
+                "backlog_records": (
+                    self._stream_minted_records
+                    - self._stream_done_records
+                ),
+            }
 
     def add_deferred_callback_create_train_end_task(self, extended_config=None):
         """Register the train-end task, created once all training finishes.
@@ -446,16 +610,32 @@ class TaskDispatcher:
                 self._done_counts[task.type] = (
                     self._done_counts.get(task.type, 0) + 1
                 )
+                stream_records = 0
+                if self._stream and task.type == pb.TRAINING:
+                    # watermark advance: this window's records are now
+                    # trained; the journal carries the count so replay
+                    # reconstructs the same watermark
+                    stream_records = task.end - task.start
+                    self._stream_done_records += stream_records
                 if self._journal is not None:
-                    self._journal_ops.append({
+                    done_op = {
                         "op": "done", "task": task_id,
                         "type": task.type,
-                    })
+                    }
+                    if stream_records:
+                        done_op["records"] = stream_records
+                    self._journal_ops.append(done_op)
                 if not self._todo and not self._doing_training_locked():
                     if self._epochs_left > 0:
                         self._create_training_epoch_locked()
                     elif (
                         self._deferred_callbacks
+                        # an open stream draining its queue is not the
+                        # end of training — more windows are coming;
+                        # the deferred train-end task fires only after
+                        # close_stream (which handles the case where
+                        # the queue was already empty at close)
+                        and not self._stream_open
                         and not self._records_have_train_end_locked()
                     ):
                         self._fire_deferred_locked()
@@ -554,6 +734,10 @@ class TaskDispatcher:
                 and not self._doing
                 and self._epochs_left <= 0
                 and not self._deferred_callbacks
+                # streaming drain contract: an open stream is never
+                # finished — more windows are coming; once the feeder
+                # closes it, the normal drain conditions above decide
+                and not self._stream_open
             )
 
     def job_failed(self):

@@ -413,8 +413,9 @@ extern "C" {
 // rebuilds the .so or falls back to numpy instead of calling through
 // a drifted ABI. History: 1 = float hyperparameters, no blob entry
 // points; 2 = double hyperparameters + apply_blob/lookup_cast/
-// import_blob.
-int64_t edl_store_abi_version(void) { return 2; }
+// import_blob; 3 = drop_rows/drop_table (embedding lifecycle
+// eviction, ISSUE 12).
+int64_t edl_store_abi_version(void) { return 3; }
 
 void* edl_store_create(uint64_t seed) {
   auto* store = new Store();
@@ -660,6 +661,49 @@ int edl_store_import_blob(void* handle, const char* name,
     float* row = table->get_or_init(ids[i]);
     decode_row(bytes + i * dim * itemsize, dtype, dim, row);
   }
+  return 0;
+}
+
+// Embedding lifecycle eviction (ISSUE 12): delete rows outright —
+// weights, optimizer slots, AND per-row step counts, so a later
+// re-admission of the id starts from the initializer exactly like a
+// never-seen id (a leftover Adam step count would silently skew its
+// bias correction). Returns the number of rows actually dropped
+// (absent ids are not an error: a sweep may race a checkpoint
+// restore), or -1 for an unknown table. The table's RNG stream is
+// deliberately NOT rewound: eviction must not perturb the init draws
+// of unrelated future rows.
+int64_t edl_store_drop_rows(void* handle, const char* name,
+                            const int64_t* ids, int64_t n) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  int64_t dropped = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    dropped += static_cast<int64_t>(table->rows.erase(ids[i]));
+    table->row_steps.erase(ids[i]);
+  }
+  return dropped;
+}
+
+// Drop a whole table (rows, slots, steps, metadata). 0 on success,
+// -1 unknown table. NOT safe concurrently with traffic on the same
+// table: find() hands out raw Table pointers, so the caller must
+// quiesce RPCs first — this is an administrative entry point
+// (schema retirement, tests), not a sweep-path one; sweeps use
+// edl_store_drop_rows, which takes the per-table lock.
+int edl_store_drop_table(void* handle, const char* name) {
+  auto* store = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> lock(store->tables_mu);
+  auto it = store->tables.find(name);
+  if (it == store->tables.end()) return -1;
+  {
+    // drain in-flight holders that already locked the table; new
+    // finders are excluded by tables_mu held above
+    std::unique_lock<std::shared_mutex> table_lock(it->second->mu);
+  }
+  store->tables.erase(it);
   return 0;
 }
 

@@ -110,6 +110,20 @@ EVENT_TYPES = frozenset({
     # distributed tracing (ISSUE 9)
     "trace_flushed",         # a drain path flushed the trace buffer to
                              #   EDL_TRACE_DIR (+ reason)
+    # continual streaming training (ISSUE 12)
+    "row_admitted",          # ids passed frequency admission and
+                             #   materialized real rows (+ table,
+                             #   count, ids[:128])
+    "row_evicted",           # lifecycle sweep tombstone: rows deleted
+                             #   from the store (+ table, reason
+                             #   ttl|lfu, count, ids[:128]) — the
+                             #   postmortem answer to "why is this row
+                             #   cold"
+    "stream_watermark",      # watermark progress marker (+ watermark,
+                             #   minted, kind window|export|checkpoint
+                             #   |closed) — the streaming durability
+                             #   clock the checkpoint/export cadence
+                             #   rides
 })
 
 

@@ -27,7 +27,8 @@ _SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libedl_embedding.so"))
 # missing the symbol entirely (pre-clock builds) — is a stale artifact
 # from another tree: the loader rebuilds it once, and on any failure
 # falls back to the numpy store instead of raising mid-job.
-_EXPECTED_ABI = 2
+# ABI 3: drop_rows/drop_table (embedding lifecycle eviction, ISSUE 12).
+_EXPECTED_ABI = 3
 
 # TensorBlob wire dtype name -> WireDtype enum in embedding_store.cc;
 # the only payload dtypes the blob fast paths accept — anything else
@@ -281,6 +282,14 @@ def _bind_native(lib):
         ctypes.c_int,
         ctypes.c_int,
     ]
+    lib.edl_store_drop_rows.restype = ctypes.c_int64
+    lib.edl_store_drop_rows.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
+    lib.edl_store_drop_table.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.edl_store_table_size.restype = ctypes.c_int64
     lib.edl_store_table_size.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.edl_store_version.restype = ctypes.c_int64
@@ -548,6 +557,28 @@ class NativeEmbeddingStore:
         if rc != 0:
             raise KeyError(name)
 
+    def drop_rows(self, name, ids):
+        """Delete rows outright — weights, slots, AND per-row step
+        counts — so a later re-admission of the id starts from the
+        initializer like a never-seen id (lifecycle eviction, ISSUE
+        12). Absent ids are not an error (a sweep may race a restore);
+        returns the number of rows actually dropped."""
+        ids = _as_i64(ids)
+        dropped = self._lib.edl_store_drop_rows(
+            self._handle, name.encode(), _i64_ptr(ids), ids.size
+        )
+        if dropped < 0:
+            raise KeyError(name)
+        return int(dropped)
+
+    def drop_table(self, name):
+        """Drop a whole table (administrative; quiesce traffic first —
+        see edl_store_drop_table)."""
+        rc = self._lib.edl_store_drop_table(self._handle, name.encode())
+        if rc != 0:
+            raise KeyError(name)
+        self._dims.pop(name, None)
+
     def table_size(self, name):
         return int(self._lib.edl_store_table_size(self._handle, name.encode()))
 
@@ -715,6 +746,9 @@ class NumpyEmbeddingStore:
             self._steps[name] = {}
 
     def _table_rng(self, name):
+        # only reached from _init_row under _row_locked's callers, all
+        # of which hold self._lock; drop_table's locked pop made the
+        # analyzer notice the contrast
         rng = self._rngs.get(name)
         if rng is None:
             import zlib
@@ -723,7 +757,7 @@ class NumpyEmbeddingStore:
                 (self._seed * 1000003 + zlib.crc32(name.encode()))
                 % (2 ** 32)
             )
-            self._rngs[name] = rng
+            self._rngs[name] = rng  # edlint: disable=lock-discipline
         return rng
 
     def _init_row(self, name, dim, scale, kind):
@@ -872,6 +906,35 @@ class NumpyEmbeddingStore:
                 slot_map[i][:] = slots[k]
         for k, row in enumerate(rows):
             row[:] = w[k]
+
+    def drop_rows(self, name, ids):
+        """Native-store twin: delete weight row + slots + step count so
+        a re-admitted id re-initializes like a never-seen one. Returns
+        the number of rows actually dropped."""
+        if name not in self._meta:
+            raise KeyError(name)
+        dropped = 0
+        with self._lock:
+            table = self._tables[name]
+            slots = self._slots[name]
+            steps = self._steps[name]
+            for i in ids:
+                i = int(i)
+                if table.pop(i, None) is not None:
+                    dropped += 1
+                slots.pop(i, None)
+                steps.pop(i, None)
+        return dropped
+
+    def drop_table(self, name):
+        if name not in self._meta:
+            raise KeyError(name)
+        with self._lock:
+            self._meta.pop(name, None)
+            self._tables.pop(name, None)
+            self._slots.pop(name, None)
+            self._steps.pop(name, None)
+            self._rngs.pop(name, None)
 
     def table_size(self, name):
         return len(self._tables.get(name, {}))

@@ -133,6 +133,24 @@ class ParameterServer:
                 "PS %d auto-restored checkpoint version %d",
                 args.ps_id, self._restored_version,
             )
+        # Embedding lifecycle (ISSUE 12): admission/eviction policy
+        # from the EDL_EMB_* knobs; None when no policy is enabled.
+        # Built BEFORE the servicer so the admission gates exist from
+        # the first RPC, and re-anchored on the restored store below.
+        from elasticdl_tpu.stream.lifecycle import EmbeddingLifecycle
+
+        self.lifecycle = EmbeddingLifecycle.maybe_create(self.store)
+        if self.lifecycle is not None and self._restored_version is not None:
+            # a restore already materialized tables/rows: register them
+            # (the real initializer arrives later with the model's
+            # push_embedding_table_infos and updates the cold row) and
+            # re-anchor conservatively — every restored row admitted,
+            # sketch empty (no phantom rows, no lost admitted rows)
+            for name in self.store.table_names():
+                self.lifecycle.register_table(
+                    name, self.store.table_dim(name)
+                )
+            self.lifecycle.adopt_store()
         master_client = None
         if args.master_addr:
             from elasticdl_tpu.worker.master_client import MasterClient
@@ -159,6 +177,7 @@ class ParameterServer:
             sync_version_tolerance=args.sync_version_tolerance,
             staleness_modulation=bool(args.lr_staleness_modulation),
             restored_version=self._restored_version,
+            lifecycle=self.lifecycle,
         )
         if master_client is not None and self._telemetry_on:
             # piggyback this PS's telemetry (push/pull rates, version
@@ -294,9 +313,30 @@ class ParameterServer:
 
     def run(self, poll_secs=5.0):
         """Serve until the master stops answering (reference: PS pods poll
-        the master pod's status, parameter_server.py:129-153)."""
+        the master pod's status, parameter_server.py:129-153).
+
+        The poll is also the lifecycle clock (ISSUE 12): each tick runs
+        an eviction sweep (rate-limited by EDL_EMB_SWEEP_SECS) and, in
+        streaming mode, checks the master's record watermark against
+        the EDL_STREAM_CHECKPOINT_EVERY cadence — the streaming
+        replacement for epoch-boundary checkpoints."""
+        from elasticdl_tpu.common.env_utils import env_float, env_int
+
+        sweep_secs = env_float("EDL_EMB_SWEEP_SECS", poll_secs)
+        stream_ckpt_every = env_int("EDL_STREAM_CHECKPOINT_EVERY", 0)
+        last_sweep = time.time()
         if self._master_client is None:
-            self.server.wait_for_termination()
+            if self.lifecycle is None:
+                self.server.wait_for_termination()
+                return 0
+            # masterless (embedded/test) but lifecycle on: the sweep
+            # still needs a clock — and server termination must still
+            # end run() (an embedding host calling server.stop(), or a
+            # SIGTERM whose handler couldn't install off-main-thread).
+            # NB grpc's wait_for_termination(timeout) returns True on
+            # TIMEOUT (still serving) and False once terminated.
+            while self.server.wait_for_termination(timeout=sweep_secs):
+                self.servicer.lifecycle_tick()
             return 0
         # polls missed before concluding the master is gone for good:
         # must comfortably cover a master pod relaunch + state-journal
@@ -323,6 +363,17 @@ class ParameterServer:
                     return 0
             else:
                 misses = 0
+                if stream_ckpt_every > 0:
+                    self.servicer.maybe_stream_checkpoint(
+                        getattr(info, "stream_watermark", 0),
+                        stream_ckpt_every,
+                    )
+            if (
+                self.lifecycle is not None
+                and time.time() - last_sweep >= sweep_secs
+            ):
+                last_sweep = time.time()
+                self.servicer.lifecycle_tick()
 
 
 def main(argv=None):

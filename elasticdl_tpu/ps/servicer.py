@@ -106,9 +106,15 @@ class PserverServicer:
         grads_to_wait=1,
         sync_version_tolerance=0,
         restored_version=None,
+        lifecycle=None,
     ):
         self._store = store
         self._ps_id = ps_id
+        # Embedding lifecycle (ISSUE 12): frequency admission + TTL/LFU
+        # eviction over this shard's tables. None (the default) keeps
+        # every pre-lifecycle path byte-for-byte untouched — tables
+        # grow unbounded, as before.
+        self._lifecycle = lifecycle
         # fail a misconfigured EDL_WIRE_DTYPE at boot, not per pull
         # RPC: a PS that passes health probes while every pull raises
         # would crash-loop its workers instead of itself
@@ -281,7 +287,7 @@ class PserverServicer:
             push_rate = (push_count - prev_push) / window
             pull_rate = (pull_count - prev_pull) / window
         self._t_prev = (now, push_count, pull_count)
-        return pb.TelemetryBlob(
+        blob = pb.TelemetryBlob(
             role="ps-%d" % self._ps_id,
             push_rate=push_rate,
             pull_rate=pull_rate,
@@ -294,6 +300,18 @@ class PserverServicer:
             pull_bytes=self._t_pull_bytes,
             ps_native_store=self._native_store,
         )
+        # embedding lifecycle health (ISSUE 12): admission/eviction
+        # tallies + the resident-row gauge the bounded-memory contract
+        # is about, folded into the fleet /statusz beside the shard's
+        # push/pull rates
+        if self._lifecycle is not None:
+            stats = self._lifecycle.stats()
+            blob.ps_rows_admitted = stats["rows_admitted"]
+            blob.ps_rows_evicted_ttl = stats["rows_evicted_ttl"]
+            blob.ps_rows_evicted_lfu = stats["rows_evicted_lfu"]
+            blob.ps_tracked_ids = stats["tracked_ids"]
+            blob.ps_resident_rows = stats["resident_rows"]
+        return blob
 
     def _stamp(self, response):
         """Stamp the boot-restore marker on a push/pull response."""
@@ -339,6 +357,13 @@ class PserverServicer:
             self._store.create_table(
                 info.name, info.dim, init_scale=param, initializer=kind
             )
+            if self._lifecycle is not None:
+                # the lifecycle serves pre-admission pulls from the
+                # initializer's deterministic cold row, so it needs the
+                # parsed (kind, param) the store was created with
+                self._lifecycle.register_table(
+                    info.name, info.dim, init_kind=kind, init_param=param
+                )
             self._m_table_rows.labels(table=info.name).set_function(
                 lambda name=info.name: self._store.table_size(name)
             )
@@ -376,6 +401,24 @@ class PserverServicer:
         clients that predate the wire-dtype contract and cannot decode
         extension dtype names."""
         wd = wire_dtype() if reduced_ok else None
+        if self._lifecycle is not None:
+            mask = self._lifecycle.filter_pull(name, ids)
+            if not mask.all():
+                # mixed pull: admitted rows gather from the store,
+                # pre-admission ids get the initializer's cold row and
+                # NEVER touch the store (a pull is a sighting, not a
+                # materialization). The native single-call fast path
+                # only applies to all-admitted pulls.
+                values = self._lifecycle.cold_rows(name, ids.size)
+                if mask.any():
+                    values[mask] = self._store.lookup(name, ids[mask])
+                blob = ndarray_to_blob(values, blob, wire_dtype=wd)
+                payload = len(blob.content)
+                self._t_pull_bytes += payload
+                self._m_pull_bytes.labels(dtype=blob.dtype).inc(payload)
+                self._m_pull_requests.labels(table=name).inc()
+                self._m_pull_rows.labels(table=name).inc(int(ids.size))
+                return blob
         if (
             self._native_store
             and _LITTLE_ENDIAN
@@ -518,6 +561,24 @@ class PserverServicer:
         sync path's round merge already dedups, gradient summation
         over duplicates is the IndexedSlices contract, and the parity
         suite asserts the two branches bit-match.)"""
+        if self._lifecycle is not None:
+            req_ids = unpack_ids(slices)
+            mask = self._lifecycle.filter_push(name, req_ids)
+            if not mask.all():
+                # pre-admission gradients are DROPPED (the admission
+                # contract): apply only the admitted subset through
+                # the numpy path — the single-call blob path has no
+                # row filter
+                if not mask.any():
+                    return
+                values, ids = _deserialize_gradients(slices)
+                values, ids = deduplicate_indexed_slices(
+                    values[mask], ids[mask]
+                )
+                self._store.push_gradients(
+                    name, ids, values, lr_scale=lr_scale
+                )
+                return
         if self._native_store and _blob_fast_path_ok(
             self._store, name, slices
         ):
@@ -574,6 +635,14 @@ class PserverServicer:
                 continue
             values, ids = _deserialize_gradients(slices)
             self._store.import_table(name, ids, values)
+        if self._lifecycle is not None:
+            # writebacks are authoritative: the rows exist after the
+            # import, so they must be admitted (and TTL-refreshed) or
+            # the eviction bound would never see them age out — and
+            # the device tier's hot set can never be starved by a
+            # PS-side eviction racing its writeback
+            for name, slices in request.embedding_tables.items():
+                self._lifecycle.note_import(name, unpack_ids(slices))
         return self._stamp(pb.PushGradientsResponse(
             accepted=True, version=self._store.version
         ))
@@ -804,7 +873,7 @@ class PserverServicer:
             "ps_apply_round", version=self._store.version,
             pushes=len(entries),
         ):
-            self._merge_apply_locked(entries)
+            self._merge_apply_locked(entries, journal)
         journal.append((
             "round_close",
             dict(version=self._store.version, pushes=len(entries)),
@@ -821,7 +890,7 @@ class PserverServicer:
             )
             del self._round_groups[tag]
 
-    def _merge_apply_locked(self, entries):
+    def _merge_apply_locked(self, entries, journal=None):
         scales = [s for _, _, s in entries]
         apply_scale = sum(scales) / len(scales)
         merged = {}  # name -> ([values...], [ids...])
@@ -845,6 +914,15 @@ class PserverServicer:
             ids = np.concatenate(ids_list, axis=0)
             # merge duplicate ids across workers into one apply
             values, ids = deduplicate_indexed_slices(values, ids)
+            if self._lifecycle is not None:
+                # admission gate under the push lock: journal entries
+                # ride the round's journal list (emitted after release)
+                mask = self._lifecycle.filter_push(
+                    name, ids, journal=journal
+                )
+                if not mask.any():
+                    continue
+                values, ids = values[mask], ids[mask]
             self._store.push_gradients(
                 name, ids, values, lr_scale=apply_scale
             )
@@ -895,6 +973,56 @@ class PserverServicer:
             except Exception:
                 logger.exception("final sparse checkpoint failed")
         events.flush()
+
+    def lifecycle_tick(self):
+        """One TTL/LFU eviction sweep (ps/server.py calls this on its
+        5 s master poll). No-op without a lifecycle. Returns the
+        sweep's {"ttl": n, "lfu": n} eviction counts."""
+        if self._lifecycle is None:
+            return None
+        return self._lifecycle.sweep()
+
+    def maybe_stream_checkpoint(self, watermark, every):
+        """Watermark-driven sparse checkpoint cadence (ISSUE 12): in
+        streaming mode there are no epoch boundaries and the version
+        clock ticks at worker-push rate, so durability rides the
+        master's record watermark instead — one checkpoint each time
+        it crosses an ``every``-records boundary (EDL_STREAM_
+        CHECKPOINT_EVERY, threaded through ps/server.py's poll loop).
+        A fresh-boot PS saves from the first crossed boundary; a PS
+        that RESTORED a checkpoint anchors at its first observed
+        watermark instead — its predecessor already covered those
+        boundaries, and re-saving them would burn checkpoint slots on
+        state the restore just wrote."""
+        if (
+            self._checkpoint_saver is None
+            or every <= 0
+            or watermark <= 0
+        ):
+            return False
+        boundary = int(watermark) // int(every)
+        last = getattr(self, "_stream_ckpt_boundary", None)
+        if last is None:
+            last = boundary if self._restored_wire else 0
+            self._stream_ckpt_boundary = last
+        if boundary <= last:
+            return False
+        self._stream_ckpt_boundary = boundary
+        version = self._store.version
+        try:
+            self._checkpoint_saver.save(version, self._store)
+            events.emit("checkpoint_saved", version=version,
+                        kind="sparse_stream")
+            events.emit("stream_watermark", watermark=int(watermark),
+                        kind="checkpoint")
+            logger.info(
+                "stream checkpoint at watermark %d (version %d)",
+                watermark, version,
+            )
+            return True
+        except Exception:
+            logger.exception("stream sparse checkpoint failed")
+            return False
 
     def _maybe_checkpoint(self, version):
         if (

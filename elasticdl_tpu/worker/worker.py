@@ -358,6 +358,20 @@ class Worker:
         # last mesh epoch seen by the heartbeat; the training loop reads
         # this instead of issuing its own get_comm_info RPC per probe
         self._seen_mesh_epoch = None
+        # Streaming checkpoint cadence (ISSUE 12): the master's record
+        # watermark rides the heartbeat's CommInfo; each time it
+        # crosses an EDL_STREAM_CHECKPOINT_EVERY boundary this worker
+        # joins its in-flight async push, flushes dirty device-tier
+        # rows, and (when configured) saves its dense checkpoint —
+        # exactly the barrier set the epoch-boundary checkpoint runs,
+        # re-clocked from steps to stream records.
+        from elasticdl_tpu.common.env_utils import env_int
+
+        self._stream_ckpt_every = env_int(
+            "EDL_STREAM_CHECKPOINT_EVERY", 0
+        )
+        self._stream_ckpt_mark = None
+        self._seen_stream_watermark = 0
         # Fleet telemetry (ISSUE 3): a compact blob piggybacked on the
         # master RPCs this worker already makes — the master's
         # straggler/dead-air detectors compare these across the fleet.
@@ -381,6 +395,9 @@ class Worker:
                 info = self._mc.get_comm_info()
                 if info.mesh_epoch >= 0:
                     self._seen_mesh_epoch = info.mesh_epoch
+                    self._seen_stream_watermark = getattr(
+                        info, "stream_watermark", 0
+                    )
 
         self._heartbeat_thread = threading.Thread(
             target=beat, name="worker-heartbeat", daemon=True
@@ -638,6 +655,42 @@ class Worker:
         events.emit("checkpoint_saved", version=self._version,
                     kind="dense")
 
+    def maybe_stream_checkpoint(self):
+        """Watermark-driven checkpoint boundary (ISSUE 12): fires the
+        SAME barriers as a step-cadence checkpoint — async pushes
+        joined, device-tier rows flushed — each time the heartbeat's
+        cached watermark crosses an EDL_STREAM_CHECKPOINT_EVERY
+        boundary, so the PS-side state a stream checkpoint snapshots
+        carries every update this worker holds in flight. The first
+        observed boundary only anchors the marker (a freshly joined
+        worker must not burn a checkpoint on a watermark its peers
+        already covered). Returns True when a boundary fired."""
+        every = self._stream_ckpt_every
+        watermark = self._seen_stream_watermark
+        if every <= 0 or watermark <= 0:
+            return False
+        boundary = watermark // every
+        if self._stream_ckpt_mark is None:
+            self._stream_ckpt_mark = boundary
+            return False
+        if boundary <= self._stream_ckpt_mark:
+            return False
+        self._stream_ckpt_mark = boundary
+        if self._checkpoint_mgr is not None:
+            # _save_checkpoint already runs the join + flush barriers
+            self._save_checkpoint()
+        else:
+            # no dense checkpoint configured: the barriers still run —
+            # the PS's own stream checkpoint (cadenced off the same
+            # watermark) must carry the async push and tier rows
+            self._join_trainer_pushes()
+            self._flush_device_tier()
+        events.emit(
+            "stream_watermark", watermark=int(watermark),
+            kind="checkpoint",
+        )
+        return True
+
     def _traced_train_step(self, batch):
         """One train step, timed (Timing bridge feeds the step-time
         gauge) and — when EDL_TRACE_DIR is set — the ROOT SPAN of a
@@ -671,6 +724,7 @@ class Worker:
             and self._version % self._checkpoint_steps == 0
         ):
             self._save_checkpoint()
+        self.maybe_stream_checkpoint()
         real = batch_real_count(batch)
         if self._telemetry_on:
             self._update_step_telemetry(real)

@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Streaming-training proof scenario (ISSUE 12, tier 1f).
+
+A day-compressed simulated clickstream — Zipfian ids whose hot set
+DRIFTS every window, so the union vocabulary grows without bound —
+trained through the real PS servicer twice:
+
+- **baseline**: a plain store, no lifecycle — every novel id
+  materializes a row forever (the pre-ISSUE-12 behavior);
+- **lifecycle**: frequency admission (``admit_k``) + TTL/LFU eviction
+  bounding resident rows at ``max_rows``.
+
+The model is an embedding-only logistic regressor (one table, logit =
+sum over fields of the row mean), trained with hand-derived BCE
+gradients pushed through ``push_gradients`` — so admission drops and
+eviction tombstones act on REAL gradient traffic, and pulls ride the
+real cold-row path.
+
+Hard gates (the acceptance criteria; everything else is report-only):
+
+1. **bounded memory**: lifecycle resident rows <= max_rows after the
+   final sweep, while the baseline grew past ``unbounded_factor`` x
+   that bound (the "baseline grows unbounded" assertion);
+2. **holdout-tail quality**: BCE logloss on the UNSEEN tail windows
+   under the lifecycle store within ``loss_tolerance`` (relative) of
+   the unbounded baseline, and both better than predicting the base
+   rate (the stream was actually learned);
+3. **backend parity**: replaying the identical stream on the native
+   store's lifecycle yields bit-exact admitted rows vs numpy (skipped
+   with a loud note when no native lib is available).
+
+Output: one JSON object on stdout (journaled by ci.sh tier 1f).
+Exit 1 when a gate fails.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")  # run from the repo root, like ci.sh does
+
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.common.tensor_utils import (
+    blob_to_ndarray,
+    serialize_indexed_slices,
+)
+from elasticdl_tpu.ps.embedding_store import (
+    NumpyEmbeddingStore,
+    native_lib,
+)
+from elasticdl_tpu.ps.servicer import PserverServicer
+from elasticdl_tpu.stream.lifecycle import EmbeddingLifecycle
+from elasticdl_tpu.stream.source import SyntheticClickstreamSource
+
+DIM = 4
+FIELDS = 4
+WINDOW_RECORDS = 256
+TRAIN_WINDOWS = 120
+EVAL_WINDOWS = 12
+HOT_VOCAB = 1500
+DRIFT = 30                 # hot-set slide per window (vocab churn)
+ZIPF_A = 1.3
+# every training step pulls THEN pushes an id's occurrences, so one
+# appearance already counts two sightings; 4 means "appears at least
+# twice (or more than once in a window) before a row materializes" —
+# one-shot tail ids stay sketch-only and their gradients drop
+ADMIT_K = 4
+MAX_ROWS = 2000
+TTL_WINDOWS = 40           # synthetic seconds == windows
+SWEEP_EVERY = 5
+LR = 0.5
+LOSS_TOLERANCE = 0.10      # lifecycle tail logloss within 10% of baseline
+UNBOUNDED_FACTOR = 2.0     # baseline must outgrow the bound by this
+
+
+class _Run:
+    def __init__(self, backend, lifecycle_on, clock):
+        if backend == "native":
+            from elasticdl_tpu.ps.embedding_store import (
+                NativeEmbeddingStore,
+            )
+
+            self.store = NativeEmbeddingStore(seed=0)
+        else:
+            self.store = NumpyEmbeddingStore(seed=0)
+        self.store.set_optimizer("sgd", lr=LR)
+        self.lifecycle = None
+        if lifecycle_on:
+            self.lifecycle = EmbeddingLifecycle(
+                self.store, admit_k=ADMIT_K, max_rows=MAX_ROWS,
+                ttl_secs=float(TTL_WINDOWS), clock=clock,
+            )
+        self.servicer = PserverServicer(
+            self.store, use_async=True, lifecycle=self.lifecycle,
+            staleness_modulation=False,
+        )
+        infos = pb.Model()
+        infos.embedding_table_infos.add(
+            name="emb", dim=DIM, initializer="zeros"
+        )
+        self.servicer.push_embedding_table_infos(infos)
+
+    def pull(self, ids):
+        """[n] ids -> [n, DIM] rows through the real pull path (cold
+        rows for pre-admission ids included)."""
+        request = pb.PullEmbeddingVectorsRequest(name="emb")
+        request.ids_blob = np.ascontiguousarray(
+            ids, dtype="<i8"
+        ).tobytes()
+        return blob_to_ndarray(
+            self.servicer.pull_embedding_vectors(request)
+        )
+
+    def train_window(self, ids, labels):
+        """One window: forward from pulled rows, BCE gradient wrt each
+        row, one push (the servicer dedups + applies)."""
+        flat = ids.reshape(-1)
+        rows = self.pull(flat).reshape(ids.shape[0], FIELDS, DIM)
+        logits = rows.mean(axis=2).sum(axis=1)
+        p = 1.0 / (1.0 + np.exp(-logits))
+        # dL/d row[f, d] = (p - y) / DIM for every field's row
+        g = ((p - labels) / DIM).astype(np.float32)
+        grads = np.repeat(g, FIELDS)[:, None] * np.ones(
+            (1, DIM), np.float32
+        )
+        request = pb.PushGradientsRequest()
+        serialize_indexed_slices(
+            grads, flat, request.gradients.embedding_tables["emb"]
+        )
+        self.servicer.push_gradients(request)
+
+    def eval_logloss(self, windows):
+        total, n = 0.0, 0
+        for ids, labels in windows:
+            flat = ids.reshape(-1)
+            rows = self.pull(flat).reshape(ids.shape[0], FIELDS, DIM)
+            logits = rows.mean(axis=2).sum(axis=1)
+            p = np.clip(
+                1.0 / (1.0 + np.exp(-logits)), 1e-7, 1.0 - 1e-7
+            )
+            total += float(-(
+                labels * np.log(p) + (1 - labels) * np.log(1 - p)
+            ).sum())
+            n += labels.size
+        return total / max(1, n)
+
+
+def run_stream(backend, lifecycle_on, source):
+    clock = [0.0]
+    run = _Run(backend, lifecycle_on, clock=lambda: clock[0])
+    for w in range(TRAIN_WINDOWS):
+        clock[0] = float(w)
+        ids, labels = source.window_examples(w)
+        run.train_window(ids, labels)
+        if run.lifecycle is not None and (w + 1) % SWEEP_EVERY == 0:
+            run.servicer.lifecycle_tick()
+    if run.lifecycle is not None:
+        clock[0] = float(TRAIN_WINDOWS)
+        run.servicer.lifecycle_tick()
+    return run
+
+
+def main():
+    source = SyntheticClickstreamSource(
+        "/tmp/_bench_streaming_unused_spool",
+        records_per_window=WINDOW_RECORDS, num_features=FIELDS,
+        hot_vocab=HOT_VOCAB, zipf_a=ZIPF_A, drift_per_window=DRIFT,
+        seed=11,
+    )
+    holdout = [
+        source.window_examples(w)
+        for w in range(TRAIN_WINDOWS, TRAIN_WINDOWS + EVAL_WINDOWS)
+    ]
+    base_rate = float(np.mean([labels.mean() for _, labels in holdout]))
+    p0 = min(max(base_rate, 1e-7), 1 - 1e-7)
+    base_rate_logloss = float(
+        -(p0 * np.log(p0) + (1 - p0) * np.log(1 - p0))
+    )
+
+    baseline = run_stream("numpy", lifecycle_on=False, source=source)
+    lifecycle = run_stream("numpy", lifecycle_on=True, source=source)
+
+    baseline_rows = baseline.store.table_size("emb")
+    lifecycle_rows = lifecycle.store.table_size("emb")
+    # snapshot the trained state BEFORE eval: holdout pulls are
+    # sightings too (the real serving path), and the parity replay
+    # below trains only — it must compare against end-of-training
+    lifecycle_export = lifecycle.store.export_table_full("emb")
+    baseline_loss = baseline.eval_logloss(holdout)
+    lifecycle_loss = lifecycle.eval_logloss(holdout)
+    stats = lifecycle.lifecycle.stats()
+
+    failures = []
+    if lifecycle_rows > MAX_ROWS:
+        failures.append(
+            "resident rows %d exceed the %d bound"
+            % (lifecycle_rows, MAX_ROWS)
+        )
+    if baseline_rows < UNBOUNDED_FACTOR * MAX_ROWS:
+        failures.append(
+            "baseline only grew to %d rows (< %.1fx bound %d): the "
+            "stream no longer exercises unbounded growth"
+            % (baseline_rows, UNBOUNDED_FACTOR, MAX_ROWS)
+        )
+    if lifecycle_loss > baseline_loss * (1.0 + LOSS_TOLERANCE):
+        failures.append(
+            "holdout-tail logloss regressed: lifecycle %.4f vs "
+            "baseline %.4f (tolerance %.0f%%)"
+            % (lifecycle_loss, baseline_loss, 100 * LOSS_TOLERANCE)
+        )
+    if baseline_loss >= base_rate_logloss:
+        failures.append(
+            "baseline never beat the base rate (%.4f >= %.4f): the "
+            "stream is not learnable, the quality gate is vacuous"
+            % (baseline_loss, base_rate_logloss)
+        )
+
+    # backend parity: identical stream through the native lifecycle
+    parity = "skipped (no native lib)"
+    if native_lib() is not None:
+        native = run_stream("native", lifecycle_on=True, source=source)
+        want = lifecycle_export
+        got = native.store.export_table_full("emb")
+        order_w = np.argsort(want[0])
+        order_g = np.argsort(got[0])
+        if (
+            want[0].shape == got[0].shape
+            and (want[0][order_w] == got[0][order_g]).all()
+            and (want[1][order_w] == got[1][order_g]).all()
+            and (want[2][order_w] == got[2][order_g]).all()
+        ):
+            parity = "bit-exact (%d rows)" % want[0].size
+        else:
+            parity = "MISMATCH"
+            failures.append(
+                "numpy<->native lifecycle parity broke: %d vs %d rows"
+                % (want[0].size, got[0].size)
+            )
+
+    report = {
+        "train_windows": TRAIN_WINDOWS,
+        "records": TRAIN_WINDOWS * WINDOW_RECORDS,
+        "distinct_id_space": HOT_VOCAB + TRAIN_WINDOWS * DRIFT,
+        "max_rows_bound": MAX_ROWS,
+        "baseline_resident_rows": int(baseline_rows),
+        "lifecycle_resident_rows": int(lifecycle_rows),
+        "rows_admitted": stats["rows_admitted"],
+        "rows_evicted_ttl": stats["rows_evicted_ttl"],
+        "rows_evicted_lfu": stats["rows_evicted_lfu"],
+        "grad_rows_dropped": stats["grad_rows_dropped"],
+        "holdout_tail_logloss_baseline": round(baseline_loss, 5),
+        "holdout_tail_logloss_lifecycle": round(lifecycle_loss, 5),
+        "base_rate_logloss": round(base_rate_logloss, 5),
+        "parity": parity,
+        "failures": failures,
+    }
+    print(json.dumps(report))
+    if failures:
+        for failure in failures:
+            print("bench_streaming GATE FAILED: %s" % failure,
+                  file=sys.stderr)
+        return 1
+    print(
+        "bench_streaming OK: rows %d (bound %d) vs unbounded %d; "
+        "tail logloss %.4f vs %.4f; parity %s"
+        % (lifecycle_rows, MAX_ROWS, baseline_rows, lifecycle_loss,
+           baseline_loss, parity),
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

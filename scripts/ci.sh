@@ -624,6 +624,155 @@ ps.terminate(); ps.wait(timeout=30)
 print("UDS smoke OK: job over unix socket, fallback over TCP")
 PYEOF
 
+echo "== tier 1e++++: streaming smoke (synthetic clickstream, lifecycle PS) =="
+# ISSUE 12: a real master+PS+worker job over an unbounded-vocab
+# synthetic clickstream with the embedding lifecycle enabled. Hard
+# assertions: the job drains to rc 0 once the bounded stream closes, a
+# watermark-cadence sparse checkpoint lands at the PS, lifecycle
+# evictions fire (journaled tombstones), an evicted id re-admits
+# cleanly through fresh traffic, and the master's /statusz shows the
+# lifecycle gauges beside the stream watermark.
+STREAM_DIR="$(mktemp -d)"
+export STREAM_DIR
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, socket, subprocess, sys, threading, time, urllib.request
+sys.path.insert(0, "tests")
+from elasticdl_tpu.common.grpc_utils import find_free_port
+
+base = os.environ["STREAM_DIR"]
+spool = os.path.join(base, "spool"); os.makedirs(spool)
+events_dir = os.path.join(base, "events")
+ckpt = os.path.join(base, "ps-ckpt"); os.makedirs(ckpt)
+mport, pport, statz = find_free_port(), find_free_port(), find_free_port()
+env = {
+    **os.environ, "JAX_PLATFORMS": "cpu",
+    "EDL_EVENTS_DIR": events_dir,
+    "EDL_STREAM": "synthetic",
+    # sized so the job runs tens of seconds: the PS's 5 s poll must
+    # observe INTERMEDIATE watermarks (checkpoint cadence) and sweep
+    # mid-stream, and the backlog cap must keep minting progressive
+    # (an uncapped feeder would mint+close the whole bounded stream
+    # in one tick)
+    "EDL_STREAM_TOTAL_RECORDS": "16384",
+    "EDL_STREAM_WINDOW_RECORDS": "256",
+    "EDL_STREAM_MAX_BACKLOG": "1024",
+    "EDL_STREAM_FEATURES": "6",
+    "EDL_STREAM_HOT_VOCAB": "400",
+    "EDL_STREAM_DRIFT": "20",
+    "EDL_STREAM_CHECKPOINT_EVERY": "2048",
+    "EDL_EMB_ADMIT_K": "2",
+    "EDL_EMB_MAX_ROWS": "256",
+    "EDL_EMB_SWEEP_SECS": "1",
+}
+master = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.master.main",
+    "--model_zoo", "elasticdl_tpu.models.deepfm",
+    "--training_data", spool, "--records_per_task", "128",
+    "--num_epochs", "1", "--port", str(mport),
+    "--task_timeout_secs", "60", "--metrics_port", str(statz),
+], env=env)
+ps = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.ps.server", "--ps_id", "0",
+    "--num_ps_pods", "1", "--port", str(pport),
+    "--master_addr", "localhost:%d" % mport,
+    "--opt_type", "adam", "--opt_args", "lr=0.01", "--use_async", "1",
+    "--checkpoint_dir", ckpt, "--checkpoint_steps", "0",
+], env=env)
+
+def wait_port(port, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port)); return
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    raise TimeoutError(port)
+
+wait_port(mport); wait_port(pport)
+os.environ.update({k: env[k] for k in env if k.startswith("EDL_")})
+from elasticdl_tpu.data.readers import RecordIODataReader
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+mc = MasterClient("localhost:%d" % mport, worker_id=0)
+mc.reset_worker()
+worker = Worker(
+    mc, "elasticdl_tpu.models.deepfm",
+    RecordIODataReader(data_dir=spool), minibatch_size=32,
+    wait_sleep_secs=0.1, ps_addrs=["localhost:%d" % pport],
+)
+runner = threading.Thread(target=worker.run, daemon=True)
+runner.start()
+
+# mid-job: the fleet /statusz must show the PS lifecycle gauges and
+# the stream section (the PS telemetry rides its 5 s liveness poll)
+statusz = None
+deadline = time.time() + 180
+while time.time() < deadline:
+    try:
+        body = json.load(urllib.request.urlopen(
+            "http://127.0.0.1:%d/statusz" % statz, timeout=5))
+    except Exception:
+        time.sleep(1.0); continue
+    entry = body.get("fleet", {}).get("ps-0")
+    if entry and entry.get("ps_resident_rows", 0) > 0 and body.get("stream"):
+        statusz = body
+        break
+    if master.poll() is not None:
+        break
+    time.sleep(1.0)
+assert statusz is not None, "/statusz never showed lifecycle gauges"
+assert statusz["stream"]["minted_records"] > 0, statusz["stream"]
+print("statusz OK: ps_resident_rows=%d tracked=%d watermark=%d" % (
+    statusz["fleet"]["ps-0"]["ps_resident_rows"],
+    statusz["fleet"]["ps-0"]["ps_tracked_ids"],
+    statusz["stream"]["watermark"]))
+
+rc = master.wait(timeout=420)
+assert rc == 0, "streaming job did not drain cleanly (rc=%s)" % rc
+runner.join(timeout=120)
+
+# flight record: tombstones + a watermark-cadence sparse checkpoint
+from test_utils import load_journal
+events = load_journal(events_dir)
+kinds = {}
+for e in events:
+    kinds.setdefault(e.get("event"), []).append(e)
+assert "row_admitted" in kinds, sorted(kinds)
+assert "row_evicted" in kinds, sorted(kinds)
+stream_ckpts = [e for e in kinds.get("checkpoint_saved", ())
+                if e.get("kind") == "sparse_stream"]
+assert stream_ckpts, "no watermark-cadence sparse checkpoint landed"
+assert any(e.get("kind") == "closed"
+           for e in kinds.get("stream_watermark", ())), "stream never closed"
+assert os.listdir(ckpt), "checkpoint dir empty"
+
+# an evicted id re-admits cleanly through fresh traffic (the PS
+# outlives the master by its master-gone grace window)
+import numpy as np
+from elasticdl_tpu.worker.ps_client import PSClient
+evicted = kinds["row_evicted"][0]
+table, victim = evicted["table"], int(evicted["ids"][0])
+client = PSClient(["localhost:%d" % pport], worker_id=9)
+grads = {table: (np.full((1, 8 if table == "deepfm_emb" else 1), 0.1,
+                         np.float32), np.array([victim], np.int64))}
+for _ in range(6):
+    client.push_gradients(grads, model_version=0)
+    rows = client.pull_embedding_vectors(table, np.array([victim], np.int64))
+    if not np.allclose(rows, 0.0):
+        break
+assert not np.allclose(rows, 0.0), "evicted id never re-admitted"
+print("re-admission OK: %s/%d trains again after eviction" % (table, victim))
+
+ps.terminate(); ps.wait(timeout=30)
+print("streaming smoke OK: watermark checkpoints + tombstones + /statusz")
+PYEOF
+python scripts/postmortem.py "$STREAM_DIR/events" 2>/dev/null | tee /tmp/_stream_pm.out | head -5 || true
+grep -q "row_evicted" /tmp/_stream_pm.out
+grep -q "stream:" /tmp/_stream_pm.out
+
 echo "== tier 1f: wire-path perf smoke (micro + EDL_WIRE_DTYPE opt-in) =="
 # Microbenchmark of the ISSUE-5 wire fast paths vs the legacy paths
 # they replaced: packed ids_blob vs repeated-varint serialization,
@@ -672,6 +821,19 @@ printf '{"ts": "%s", "device_tier": %s}\n' \
   "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_device_tier.json)" \
   >> /tmp/ci_wire_micro.jsonl
 echo "device-tier A-B journaled to /tmp/ci_wire_micro.jsonl"
+
+# Streaming lifecycle bench (ISSUE 12): day-compressed Zipfian
+# clickstream with vocab churn through the real PS servicer, lifecycle
+# on vs the unbounded baseline. Absolute loss numbers are REPORT-ONLY
+# (journaled below); the script hard-fails on the acceptance gates —
+# resident rows over the bound, the baseline failing to demonstrate
+# unbounded growth, holdout-tail logloss beyond tolerance, or a
+# numpy<->native admitted-row parity break.
+python scripts/bench_streaming.py | tee /tmp/_streaming.json
+printf '{"ts": "%s", "streaming": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_streaming.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "streaming bench journaled to /tmp/ci_wire_micro.jsonl"
 
 # The reduced-precision wire opt-in must actually train: a sparse
 # local-executor run with EDL_WIRE_DTYPE=bfloat16 (LocalPSClient

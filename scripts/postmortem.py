@@ -154,6 +154,16 @@ def summarize(timeline, dump_headers):
         "presumed_dead": 0, "dump": None,
     })
     rounds = {"opened": 0, "closed": 0, "stale_rejected": 0}
+    # embedding lifecycle + streaming (ISSUE 12): tombstone tallies,
+    # the per-id eviction index behind "why is this row cold", and the
+    # last observed watermark
+    lifecycle = {
+        "rows_admitted": 0, "rows_evicted_ttl": 0,
+        "rows_evicted_lfu": 0,
+    }
+    evicted_ids = {}  # "table/id" -> last eviction reason
+    stream = {"watermark": 0, "checkpoints": 0, "exports": 0,
+              "closed": False}
     job_failed = None
     for event in timeline:
         kind = event.get("event")
@@ -177,6 +187,28 @@ def summarize(timeline, dump_headers):
             rounds["closed"] += 1
         elif kind == "stale_push_rejected":
             rounds["stale_rejected"] += 1
+        elif kind == "row_admitted":
+            lifecycle["rows_admitted"] += int(event.get("count", 0))
+        elif kind == "row_evicted":
+            reason = event.get("reason", "ttl")
+            key = "rows_evicted_%s" % reason
+            lifecycle[key] = lifecycle.get(key, 0) + int(
+                event.get("count", 0)
+            )
+            table = event.get("table", "?")
+            for row_id in event.get("ids", ()):
+                evicted_ids["%s/%s" % (table, row_id)] = reason
+        elif kind == "stream_watermark":
+            stream["watermark"] = max(
+                stream["watermark"], int(event.get("watermark", 0))
+            )
+            marker = event.get("kind")
+            if marker == "checkpoint":
+                stream["checkpoints"] += 1
+            elif marker == "export":
+                stream["exports"] += 1
+            elif marker == "closed":
+                stream["closed"] = True
         elif kind == "job_failed":
             job_failed = event
     for header in dump_headers:
@@ -190,6 +222,9 @@ def summarize(timeline, dump_headers):
             workers.items(), key=lambda kv: str(kv[0])
         )},
         "rounds": rounds,
+        "lifecycle": lifecycle,
+        "evicted_ids": evicted_ids,
+        "stream": stream,
         "job_failed": job_failed,
     }
 
@@ -245,6 +280,17 @@ def render_text(timeline, summary, dump_headers, alert_counters):
         )
     if summary["rounds"]["opened"] or summary["rounds"]["stale_rejected"]:
         lines.append("  sync rounds: %r" % (summary["rounds"],))
+    lifecycle = summary.get("lifecycle", {})
+    if any(lifecycle.values()):
+        lines.append("  embedding lifecycle: %r" % (lifecycle,))
+    stream = summary.get("stream", {})
+    if stream.get("watermark"):
+        lines.append(
+            "  stream: watermark=%d checkpoints=%d exports=%d "
+            "closed=%s"
+            % (stream["watermark"], stream["checkpoints"],
+               stream["exports"], stream["closed"])
+        )
     if summary["job_failed"]:
         lines.append("  JOB FAILED: %r" % (summary["job_failed"],))
     return "\n".join(lines)

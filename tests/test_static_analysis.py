@@ -805,6 +805,74 @@ def test_obs_span_suppression_comment_works():
 
 
 # ---------------------------------------------------------------------------
+# ft-unbounded-vocab (ISSUE 12: id-keyed growth with no eviction bound)
+
+UNBOUNDED_VOCAB = """
+    class Store:
+        def ingest(self, ids, rows):
+            for i in ids:
+                self._rows[int(i)] = rows[i]
+"""
+
+
+def test_unbounded_vocab_flags_id_keyed_growth_without_eviction():
+    findings = findings_for(
+        UNBOUNDED_VOCAB, path="elasticdl_tpu/ps/store.py",
+        rules=["ft-unbounded-vocab"],
+    )
+    assert len(findings) == 1
+    assert findings[0].rule == "ft-unbounded-vocab"
+    assert "drop_rows" in findings[0].message
+
+
+def test_unbounded_vocab_quiet_with_eviction_entry_point():
+    assert not findings_for("""
+        class Store:
+            def ingest(self, ids, rows):
+                for i in ids:
+                    self._rows[int(i)] = rows[i]
+
+            def drop_rows(self, name, ids):
+                for i in ids:
+                    self._rows.pop(int(i), None)
+    """, path="elasticdl_tpu/ps/store.py",
+        rules=["ft-unbounded-vocab"])
+
+
+def test_unbounded_vocab_flags_setdefault_and_set_add():
+    findings = findings_for("""
+        def track(unique_ids):
+            seen = set()
+            counts = {}
+            for i in unique_ids:
+                seen.add(i)
+                counts.setdefault(i, 0)
+    """, path="elasticdl_tpu/stream/tracker.py",
+        rules=["ft-unbounded-vocab"])
+    assert len(findings) == 2
+    assert {f.code for f in findings} == {"seen.add()",
+                                          "counts.setdefault()"}
+
+
+def test_unbounded_vocab_quiet_outside_store_layers():
+    # the same growth in a model/bench module is not a PS memory leak
+    assert not findings_for(
+        UNBOUNDED_VOCAB, path="elasticdl_tpu/models/store.py",
+        rules=["ft-unbounded-vocab"],
+    )
+
+
+def test_unbounded_vocab_quiet_for_non_id_iterables():
+    assert not findings_for("""
+        class Cache:
+            def fill(self, batches):
+                for b in batches:
+                    self._slots[b] = 1
+    """, path="elasticdl_tpu/ps/cache.py",
+        rules=["ft-unbounded-vocab"])
+
+
+# ---------------------------------------------------------------------------
 # the gate
 
 @pytest.mark.lint
