@@ -27,6 +27,11 @@ for (or refuses to pay for):
   rows (``for i in ids: table[i]``) inside hot functions; use a
   vectorized gather (``table[ids]``/``np.take``) or the fused
   device-tier kernels (``ops/embedding_tier.py``).
+- ``perf-io-under-lock``  — no file IO (``open``/``np.savez``/
+  checkpoint-saver calls) inside a lock-guarded block in ps/ modules:
+  a serialize-and-write under a push-path lock stalls every worker's
+  push for the save's duration — snapshot under the lock, write
+  outside it (the ISSUE-13 off-RPC checkpoint contract).
 - ``serve-unbounded-queue`` — no unbounded ``queue.Queue()`` /
   ``deque()`` constructors in the serving package: the serving tier's
   contract is admission control, so every queue carries a bound

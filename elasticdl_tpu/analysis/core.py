@@ -224,6 +224,7 @@ def _rules_by_name(names=None):
         obs_span,
         perf_gather,
         perf_gil,
+        perf_io,
         perf_wire,
         serve_queue,
         unbounded_vocab,
@@ -237,6 +238,7 @@ def _rules_by_name(names=None):
         "perf-varint-ids": perf_wire.run,
         "perf-host-gather": perf_gather.run,
         "perf-gil-held-apply": perf_gil.run,
+        "perf-io-under-lock": perf_io.run,
         "serve-unbounded-queue": serve_queue.run,
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
@@ -261,6 +263,7 @@ RULE_NAMES = (
     "perf-varint-ids",
     "perf-host-gather",
     "perf-gil-held-apply",
+    "perf-io-under-lock",
     "serve-unbounded-queue",
     "ft-swallowed-except",
     "ft-grpc-timeout",

@@ -1,0 +1,134 @@
+"""perf-io-under-lock: file IO inside a lock-guarded block in ps/.
+
+The idiom this rule keeps out of the PS (the pre-ISSUE-13 shape the
+incremental-checkpoint work removed):
+
+    with self._push_lock:
+        ...
+        self._checkpoint_saver.save(version, self._store)  # np.savez!
+
+A checkpoint save is O(rows) serialization plus file IO; under a lock
+the push path contends on, it stalls every worker's push for the
+duration of the save — exactly the inline-save stall ISSUE 13's
+off-RPC checkpoint thread exists to remove. The same goes for any
+``np.savez``/``np.load``/``open``/rename under a store or push lock:
+snapshot under the lock (the store's ``export_table_dirty`` is built
+for this — one brief gather), serialize and write outside it.
+
+Scope: PS modules only (path contains ``ps/`` or a ``servicer``/
+``checkpoint`` basename). Elsewhere a file write under a lock can be a
+deliberate write-through-journal choice (events.py holds its lock
+across NDJSON appends on purpose); on the PS data path it never is.
+
+What fires: a ``with`` statement whose context expression mentions a
+lock (name/attribute containing ``lock``) and whose body contains a
+file-IO call at any nesting depth inside that block:
+
+- ``open(...)`` (builtin),
+- ``np.savez`` / ``np.savez_compressed`` / ``np.save`` / ``np.load``
+  (any receiver: ``savez`` has no other meaning),
+- ``os.replace`` / ``os.rename`` / ``os.makedirs`` /
+  ``shutil.rmtree``,
+- ``.save(...)`` / ``.restore(...)`` on a receiver whose dotted chain
+  mentions ``saver`` or ``checkpoint`` (the checkpoint-saver calls —
+  each one is a full serialize-and-write).
+"""
+
+import ast
+import os
+
+from elasticdl_tpu.analysis.core import Finding, attr_chain, walk_with_scope
+
+RULE = "perf-io-under-lock"
+
+# method names that are IO wherever they appear
+_IO_METHOD_NAMES = {"savez", "savez_compressed"}
+
+# full dotted chains that are IO
+_IO_CHAINS = {
+    "np.save", "np.load", "numpy.save", "numpy.load",
+    "os.replace", "os.rename", "os.makedirs", "shutil.rmtree",
+}
+
+# method names that are IO when the receiver chain names the
+# checkpoint saver
+_SAVER_METHOD_NAMES = {"save", "restore"}
+
+
+def _ps_module(path):
+    normalized = path.replace(os.sep, "/")
+    base = os.path.basename(normalized)
+    return (
+        "/ps/" in normalized
+        or "servicer" in base
+        or "checkpoint" in base
+    )
+
+
+def _mentions_lock(expr):
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and "lock" in node.attr.lower():
+            return True
+        if isinstance(node, ast.Name) and "lock" in node.id.lower():
+            return True
+    return False
+
+
+def _io_call_name(node):
+    """The display name when ``node`` is a file-IO call, else None."""
+    func = node.func
+    if isinstance(func, ast.Name) and func.id == "open":
+        return "open"
+    chain = attr_chain(func)
+    if chain in _IO_CHAINS:
+        return chain
+    if isinstance(func, ast.Attribute):
+        if func.attr in _IO_METHOD_NAMES:
+            return func.attr
+        if func.attr in _SAVER_METHOD_NAMES:
+            receiver = attr_chain(func.value) or ""
+            lowered = receiver.lower()
+            if "saver" in lowered or "checkpoint" in lowered:
+                return "%s.%s" % (receiver, func.attr)
+    return None
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        if not _ps_module(unit.path):
+            continue
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(
+                _mentions_lock(item.context_expr) for item in node.items
+            ):
+                continue
+            io_calls = []
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        name = _io_call_name(sub)
+                        if name:
+                            io_calls.append(name)
+            if io_calls:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.path,
+                        line=node.lineno,
+                        symbol=scope,
+                        code="with lock: %s" % sorted(io_calls)[0],
+                        message=(
+                            "file IO (%s) inside a lock-guarded block: "
+                            "a serialize-and-write under a lock the "
+                            "push path contends on stalls every "
+                            "worker's push for the save's duration — "
+                            "snapshot under the lock (export_table_"
+                            "dirty), write outside it"
+                            % ", ".join(sorted(set(io_calls)))
+                        ),
+                    )
+                )
+    return findings

@@ -194,6 +194,11 @@ class FleetMonitor:
                 "ps_rows_evicted_lfu": int(blob.ps_rows_evicted_lfu),
                 "ps_tracked_ids": int(blob.ps_tracked_ids),
                 "ps_resident_rows": int(blob.ps_resident_rows),
+                # incremental checkpoints (ISSUE 13): what the shard's
+                # last save carried and how long its delta chain is —
+                # the restore replay cost a relaunch would pay
+                "ps_ckpt_dirty_rows": int(blob.ps_ckpt_dirty_rows),
+                "ps_ckpt_chain_len": int(blob.ps_ckpt_chain_len),
             }
             # stuck-round bookkeeping: the clock restarts whenever the
             # fill grows or the store version advances

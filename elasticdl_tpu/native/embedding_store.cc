@@ -41,6 +41,7 @@
 #include <shared_mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -270,6 +271,15 @@ struct Table {
   std::unordered_map<int64_t, std::unique_ptr<float[]>> rows;
   // Adam per-row step counts for bias correction.
   std::unordered_map<int64_t, int64_t> row_steps;
+  // Incremental-checkpoint bookkeeping (ISSUE 13), guarded by mu like
+  // the rows themselves: dirty_ids = resident rows mutated (or first
+  // materialized) since the last dirty export; dead_ids = ids dropped
+  // since then, replayed as deletes by the delta restore so an
+  // evicted row cannot resurrect. Invariants: dirty_ids is a subset
+  // of the resident ids, dead_ids is disjoint from them — a drop
+  // moves an id dirty->dead, a re-materialization moves it back.
+  std::unordered_set<int64_t> dirty_ids;
+  std::unordered_set<int64_t> dead_ids;
   // Per-table RNG: only touched under this table's unique lock, so
   // concurrent lookups on different tables never race on RNG state.
   std::mt19937 rng;
@@ -279,6 +289,11 @@ struct Table {
     std::mt19937* rng = &this->rng;
     auto it = rows.find(id);
     if (it != rows.end()) return it->second.get();
+    // a lazy init is a state change: a full save would carry the drawn
+    // row, so the delta chain must too (the restored twin's RNG stream
+    // is at a different position — absence would not reproduce it)
+    dirty_ids.insert(id);
+    dead_ids.erase(id);
     auto row = std::make_unique<float[]>(dim * (1 + slots));
     switch (init_kind) {
       case InitKind::kUniform: {
@@ -414,8 +429,9 @@ extern "C" {
 // a drifted ABI. History: 1 = float hyperparameters, no blob entry
 // points; 2 = double hyperparameters + apply_blob/lookup_cast/
 // import_blob; 3 = drop_rows/drop_table (embedding lifecycle
-// eviction, ISSUE 12).
-int64_t edl_store_abi_version(void) { return 3; }
+// eviction, ISSUE 12); 4 = dirty-row tracking + export_dirty/
+// dirty_count/clear_dirty (incremental checkpoints, ISSUE 13).
+int64_t edl_store_abi_version(void) { return 4; }
 
 void* edl_store_create(uint64_t seed) {
   auto* store = new Store();
@@ -518,6 +534,7 @@ int edl_store_push_gradients(void* handle, const char* name,
     float* row = table->get_or_init(ids[i]);
     int64_t step = ++table->row_steps[ids[i]];
     apply_row(store->opt, row, grads + i * table->dim, table->dim, lr, step);
+    table->dirty_ids.insert(ids[i]);
   }
   return 0;
 }
@@ -559,6 +576,7 @@ int edl_store_apply_blob(void* handle, const char* name,
       float* row = table->get_or_init(ids[i]);
       int64_t step = ++table->row_steps[ids[i]];
       apply_row(store->opt, row, scratch.data(), dim, lr, step);
+      table->dirty_ids.insert(ids[i]);
     }
     return 0;
   }
@@ -596,6 +614,7 @@ int edl_store_apply_blob(void* handle, const char* name,
     float* row = table->get_or_init(id);
     int64_t step = ++table->row_steps[id];
     apply_row(store->opt, row, grad_row, dim, lr, step);
+    table->dirty_ids.insert(id);
     s = e;
   }
   return 0;
@@ -660,6 +679,7 @@ int edl_store_import_blob(void* handle, const char* name,
       continue;
     float* row = table->get_or_init(ids[i]);
     decode_row(bytes + i * dim * itemsize, dtype, dim, row);
+    table->dirty_ids.insert(ids[i]);
   }
   return 0;
 }
@@ -681,7 +701,14 @@ int64_t edl_store_drop_rows(void* handle, const char* name,
   std::unique_lock<std::shared_mutex> lock(table->mu);
   int64_t dropped = 0;
   for (int64_t i = 0; i < n; ++i) {
-    dropped += static_cast<int64_t>(table->rows.erase(ids[i]));
+    if (table->rows.erase(ids[i])) {
+      ++dropped;
+      // the id leaves the dirty set and enters the dead set: the next
+      // delta checkpoint must replay this drop as a delete, or a
+      // restored PS resurrects the evicted row from an older shard
+      table->dirty_ids.erase(ids[i]);
+      table->dead_ids.insert(ids[i]);
+    }
     table->row_steps.erase(ids[i]);
   }
   return dropped;
@@ -765,6 +792,7 @@ int edl_store_import(void* handle, const char* name, const int64_t* ids,
       continue;
     float* row = table->get_or_init(ids[i]);
     std::memcpy(row, values + i * table->dim, sizeof(float) * table->dim);
+    table->dirty_ids.insert(ids[i]);
   }
   return 0;
 }
@@ -824,7 +852,103 @@ int edl_store_import_full(void* handle, const char* name,
     std::memcpy(row, values + i * row_floats,
                 sizeof(float) * (exact ? full : table->dim));
     if (exact && steps != nullptr) table->row_steps[ids[i]] = steps[i];
+    table->dirty_ids.insert(ids[i]);
   }
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// Incremental checkpoints (ISSUE 13): dirty-row delta export.
+
+// Number of rows a dirty export would currently carry (the
+// edl_ps_ckpt_dirty_rows gauge / buffer sizing). -1 unknown table.
+int64_t edl_store_dirty_count(void* handle, const char* name) {
+  Table* table = static_cast<Store*>(handle)->find(name);
+  if (table == nullptr) return -1;
+  std::shared_lock<std::shared_mutex> lock(table->mu);
+  return (int64_t)table->dirty_ids.size();
+}
+
+int64_t edl_store_dead_count(void* handle, const char* name) {
+  Table* table = static_cast<Store*>(handle)->find(name);
+  if (table == nullptr) return -1;
+  std::shared_lock<std::shared_mutex> lock(table->mu);
+  return (int64_t)table->dead_ids.size();
+}
+
+// Snapshot-and-clear dirty export, the delta-checkpoint primitive:
+// under ONE hold of the per-table unique lock, export every dirty
+// row's full train state (ids ascending: checkpoint files must be
+// deterministic — hash-set order is not) plus the dead-id tombstones,
+// then clear both sets. Atomicity is the point: a row mutated after
+// this call re-enters the dirty set and rides the NEXT delta; nothing
+// can fall between an export and a separate clear.
+//
+// Sizing protocol: out_ids == nullptr is a count-only probe — returns
+// the dirty count and writes the dead count through out_dead_count,
+// clearing nothing. A fill call whose capacities are too small
+// returns -3 having written and cleared nothing (the caller re-probes
+// and retries). Returns the dirty-row count written, or -1 for an
+// unknown table. ``clear`` == 0 keeps both sets (inspection).
+int64_t edl_store_export_dirty(void* handle, const char* name,
+                               int64_t* out_ids, float* out_values,
+                               int64_t* out_steps, int64_t* out_dead,
+                               int64_t capacity, int64_t dead_capacity,
+                               int64_t* out_dead_count, int clear) {
+  auto* store = static_cast<Store*>(handle);
+  Table* table = store->find(name);
+  if (table == nullptr) return -1;
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  const int64_t nd = (int64_t)table->dirty_ids.size();
+  const int64_t ndead = (int64_t)table->dead_ids.size();
+  if (out_ids == nullptr) {
+    if (out_dead_count != nullptr) *out_dead_count = ndead;
+    return nd;
+  }
+  if (nd > capacity || ndead > dead_capacity) return -3;
+  std::vector<int64_t> ids(table->dirty_ids.begin(),
+                           table->dirty_ids.end());
+  std::sort(ids.begin(), ids.end());
+  const int64_t row_floats = table->dim * (1 + table->slots);
+  for (int64_t i = 0; i < nd; ++i) {
+    out_ids[i] = ids[i];
+    // invariant: every dirty id is resident (drops move ids to dead);
+    // belt-and-braces zero fill rather than UB if it ever breaks
+    auto it = table->rows.find(ids[i]);
+    if (it == table->rows.end()) {
+      std::memset(out_values + i * row_floats, 0,
+                  sizeof(float) * row_floats);
+      out_steps[i] = 0;
+      continue;
+    }
+    std::memcpy(out_values + i * row_floats, it->second.get(),
+                sizeof(float) * row_floats);
+    auto step_it = table->row_steps.find(ids[i]);
+    out_steps[i] =
+        step_it == table->row_steps.end() ? 0 : step_it->second;
+  }
+  std::vector<int64_t> dead(table->dead_ids.begin(),
+                            table->dead_ids.end());
+  std::sort(dead.begin(), dead.end());
+  for (int64_t i = 0; i < ndead; ++i) out_dead[i] = dead[i];
+  if (out_dead_count != nullptr) *out_dead_count = ndead;
+  if (clear) {
+    table->dirty_ids.clear();
+    table->dead_ids.clear();
+  }
+  return nd;
+}
+
+// Drop all dirty/dead bookkeeping for a table (taken before a FULL
+// base export: the base carries complete state, so pre-base dirt is
+// redundant — rows mutated between this clear and the export are
+// re-marked and simply ride the next delta too). 0 ok, -1 unknown.
+int edl_store_clear_dirty(void* handle, const char* name) {
+  Table* table = static_cast<Store*>(handle)->find(name);
+  if (table == nullptr) return -1;
+  std::unique_lock<std::shared_mutex> lock(table->mu);
+  table->dirty_ids.clear();
+  table->dead_ids.clear();
   return 0;
 }
 

@@ -43,6 +43,14 @@ int edl_store_import_blob(void* handle, const char* name,
                           const int64_t* ids, int64_t n, const void* values,
                           int dtype, int shard_id, int shard_num);
 int64_t edl_store_abi_version(void);
+int64_t edl_store_drop_rows(void* handle, const char* name,
+                            const int64_t* ids, int64_t n);
+int64_t edl_store_export_dirty(void* handle, const char* name,
+                               int64_t* out_ids, float* out_values,
+                               int64_t* out_steps, int64_t* out_dead,
+                               int64_t capacity, int64_t dead_capacity,
+                               int64_t* out_dead_count, int clear);
+int edl_store_clear_dirty(void* handle, const char* name);
 }
 
 namespace {
@@ -146,6 +154,54 @@ void blob_worker(void* store, int tid) {
     }
   }
 }
+// ISSUE 13 interleave: the checkpoint thread's dirty snapshot-and-
+// clear (plus lifecycle drops feeding the dead set) racing the push/
+// import traffic above — exactly the off-RPC delta-save shape. The
+// sizing probe + fill retry mirrors the Python binding's loop.
+void dirty_worker(void* store, int tid) {
+  int64_t ids[kIdsPerOp];
+  uint64_t rng = 0xbf58476d1ce4e5b9ull * (tid + 11);
+  for (int iter = 0; iter < kIters; ++iter) {
+    rng = rng * 6364136223846793005ull + 1442695040888963407ull;
+    const char* table = kTables[(rng >> 33) & 1];
+    switch ((rng >> 20) % 3) {
+      case 0: {
+        for (int i = 0; i < kIdsPerOp; ++i) {
+          ids[i] = (int64_t)((rng >> (i % 24)) % 512);
+        }
+        if (edl_store_drop_rows(store, table, ids, kIdsPerOp) < 0)
+          std::abort();
+        break;
+      }
+      case 1: {
+        const int slots = edl_store_table_slots(store, table);
+        if (slots < 0) std::abort();
+        for (int attempt = 0; attempt < 8; ++attempt) {
+          int64_t dead = 0;
+          int64_t nd = edl_store_export_dirty(
+              store, table, nullptr, nullptr, nullptr, nullptr, 0, 0,
+              &dead, 0);
+          if (nd < 0) std::abort();
+          std::vector<int64_t> out_ids(nd + 64);
+          std::vector<float> out_values((nd + 64) * kDim * (1 + slots));
+          std::vector<int64_t> out_steps(nd + 64);
+          std::vector<int64_t> out_dead(dead + 64);
+          int64_t got = edl_store_export_dirty(
+              store, table, out_ids.data(), out_values.data(),
+              out_steps.data(), out_dead.data(), nd + 64, dead + 64,
+              &dead, /*clear=*/1);
+          if (got == -3) continue;  // grew past the slack; re-probe
+          if (got < 0) std::abort();
+          break;
+        }
+        break;
+      }
+      case 2:
+        if (edl_store_clear_dirty(store, table) != 0) std::abort();
+        break;
+    }
+  }
+}
 }  // namespace
 
 int main() {
@@ -162,6 +218,7 @@ int main() {
   for (int t = 0; t < kThreads; ++t) {
     threads.emplace_back(worker, store, t);
     threads.emplace_back(blob_worker, store, t);
+    if (t < 2) threads.emplace_back(dirty_worker, store, t);
   }
   for (auto& t : threads) t.join();
   if (edl_store_version(store) <= 0) return 3;

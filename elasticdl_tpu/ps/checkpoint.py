@@ -1,71 +1,281 @@
-"""Sparse embedding checkpoints: versioned, id-shardable files.
+"""Sparse embedding checkpoints: versioned, id-shardable, incremental.
 
 Reference parity: go/pkg/ps/checkpoint.go + common/save_utils.py —
 ``<dir>/version-<v>/embeddings-<i>-of-<N>.npz`` with rows routed to
 shards by id mod N, keep-max GC, and restore that re-shards any
 checkpoint onto the current PS count (save_utils.py:229-282).
+
+Incremental format (ISSUE 13): a ``version-<v>`` directory is a CHAIN
+anchored at a full base save —
+
+    version-<v>/
+      embeddings-<i>-of-<N>.npz            # full base, store version v
+      delta-1-embeddings-<i>-of-<N>.npz    # dirty rows + tombstones
+      delta-2-embeddings-<i>-of-<N>.npz    # ...
+
+Each delta carries ONLY the rows mutated since the previous save (the
+store's snapshot-and-clear ``export_table_dirty``) plus the ids
+``drop_rows`` evicted since then, which restore replays as deletes —
+an evicted row must stay dead, or a restored PS resurrects it. Every
+``EDL_CKPT_COMPACT_EVERY`` deltas the saver compacts: the next save is
+a fresh full base in a new ``version-<v'>`` dir, bounding chain length
+and letting the keep-max GC retire old chains whole. Restore walks the
+newest chain all-or-nothing: the base plus the longest contiguous
+prefix of complete, verified deltas (a SIGKILL mid-delta-write or
+mid-compaction simply shortens the replay to the newest complete
+state). Old full-format checkpoints are chains of length zero and
+restore unchanged.
+
+Every shard file is written to a ``.tmp`` sibling and atomically
+renamed into place: a crash mid-``np.savez`` leaves a stale temp file
+(ignored by every reader, removed with its chain by GC) instead of a
+truncated shard that burns a whole version slot at restore time.
 """
 
 import os
 import re
 import shutil
+import threading
+import time
+from dataclasses import dataclass
 
 import numpy as np
 
+from elasticdl_tpu.common.env_utils import env_int
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import events
 
 logger = _logger_factory("elasticdl_tpu.ps.checkpoint")
 
-_FILE_RE = re.compile(r"embeddings-(\d+)-of-(\d+)\.npz$")
+# anchored: a delta file name CONTAINS the base pattern as a suffix,
+# so an unanchored search would count deltas as base shards
+_FILE_RE = re.compile(r"^embeddings-(\d+)-of-(\d+)\.npz$")
+_DELTA_RE = re.compile(r"^delta-(\d+)-embeddings-(\d+)-of-(\d+)\.npz$")
+
+# deltas per chain before the saver compacts into a fresh full base;
+# 0 disables deltas outright (every save is a full base — the
+# pre-ISSUE-13 behavior)
+COMPACT_EVERY_ENV = "EDL_CKPT_COMPACT_EVERY"
+DEFAULT_COMPACT_EVERY = 8
+
+# the key a delta shard file records its store version under (base
+# files have none: their version is the directory name)
+_DELTA_VERSION_KEY = "__delta_version__"
+
+# chain-generation token: every full base mints one and every delta of
+# that chain repeats it. Restore replays a delta ONLY when its token
+# matches its shard's base token — so a delta from an older generation
+# that shares a directory with a newer base (a stop-timeout race
+# landing a stale delta beside SIGTERM's final full save, a relaunch
+# re-saving a colliding version) can never replay stale rows over the
+# newer base. Old-format files carry no token: a token-less base
+# accepts only token-less deltas (i.e. none of ours).
+_CHAIN_TOKEN_KEY = "__chain_token__"
+
+
+@dataclass
+class SaveResult:
+    """What one ``save()`` actually wrote (metrics/telemetry food)."""
+
+    path: str
+    kind: str        # "full" | "delta"
+    version: int     # store version recorded with the save
+    rows: int        # rows written (all resident for full, dirty for delta)
+    tombstones: int  # dead ids written (always 0 for full)
+    chain_len: int   # deltas in the chain after this save (full -> 0)
+
+
+def _savez_atomic(path, arrays):
+    """np.savez through a temp file + atomic rename: readers only ever
+    see complete shard files. The temp name must not match the shard
+    patterns (it ends ``.tmp``) and is opened as a FILE OBJECT so
+    np.savez cannot append its own ``.npz`` suffix to it."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        # a failed write must not leave a temp file that shadows the
+        # next attempt's open(.., "wb") — best effort, the GC sweep of
+        # the chain dir owns anything that survives a hard kill
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class SparseCheckpointSaver:
-    def __init__(self, checkpoint_dir, shard_id=0, shard_num=1, keep_max=3):
+    def __init__(self, checkpoint_dir, shard_id=0, shard_num=1, keep_max=3,
+                 compact_every=None):
         self._dir = checkpoint_dir
         self._shard_id = shard_id
         self._shard_num = shard_num
         self._keep_max = keep_max
+        self._compact_every = (
+            env_int(COMPACT_EVERY_ENV, DEFAULT_COMPACT_EVERY)
+            if compact_every is None else int(compact_every)
+        )
+        # open chain state: deltas only ever append to a chain whose
+        # base THIS saver wrote — a relaunch always opens with a fresh
+        # full base, so torn files in a predecessor's chain can never
+        # be extended past
+        self._chain_dir = None
+        self._chain_token = None
+        self._delta_index = 1
+        # one save at a time: the chain state above is shared, and in
+        # inline mode (EDL_CKPT_ASYNC=0) concurrent push handlers can
+        # both trip the cadence — unserialized they would write the
+        # same delta-<k> file through the same .tmp path
+        self._save_lock = threading.Lock()
 
     def _version_dir(self, version):
         return os.path.join(self._dir, "version-%d" % version)
 
-    def save(self, version, store):
+    # ------------------------------------------------------------------
+    # save
+    def save(self, version, store, force_full=False):
+        """Save a checkpoint at ``version``: a delta of the store's
+        dirty rows when a chain is open (and the store tracks dirt),
+        a full base otherwise — or when ``force_full`` (the SIGTERM
+        final save), or when the chain hit EDL_CKPT_COMPACT_EVERY
+        deltas (compaction). Returns a :class:`SaveResult`."""
+        supports_delta = (
+            self._compact_every > 0
+            and callable(getattr(store, "export_table_dirty", None))
+        )
+        with self._save_lock:
+            if (
+                not force_full
+                and supports_delta
+                and self._chain_dir is not None
+                and self._delta_index <= self._compact_every
+                and os.path.isdir(self._chain_dir)
+            ):
+                return self._save_delta(version, store)
+            return self._save_full(version, store, supports_delta)
+
+    def _save_full(self, version, store, supports_delta):
+        import binascii
+
         vdir = self._version_dir(version)
         os.makedirs(vdir, exist_ok=True)
-        arrays = {}
+        token = "%d-%s" % (version, binascii.hexlify(
+            os.urandom(8)).decode())
+        arrays = {_CHAIN_TOKEN_KEY: np.str_(token)}
+        rows = 0
         for name in store.table_names():
+            if supports_delta:
+                # the base carries complete state, so dirt accumulated
+                # before it is redundant. Clearing BEFORE the export is
+                # the race-free order: a row mutated in between lands
+                # in the base AND re-enters the dirty set (carried
+                # again by the next delta — wasteful, never lossy).
+                store.clear_dirty(name)
             # full train state: weights + optimizer slot rows + per-row
             # step counts. The reference dropped slot tables from
             # checkpoints (ps/parameters.py:194-199), so a resumed Adam
             # restarted its bias correction; saving them closes that gap
             # (SURVEY.md s7). Old weights-only checkpoints still restore.
-            ids, rows, steps = store.export_table_full(name)
+            ids, full_rows, steps = store.export_table_full(name)
             arrays["ids/" + name] = ids
-            arrays["fullrows/" + name] = rows
+            arrays["fullrows/" + name] = full_rows
             arrays["steps/" + name] = steps
             arrays["dim/" + name] = np.int64(store.table_dim(name))
             # slot state is only meaningful under the optimizer that
             # produced it — a same-width swap (momentum<->adagrad) would
             # otherwise import foreign slots undetected
             arrays["opt/" + name] = np.str_(store.opt_type)
+            rows += int(ids.size)
         path = os.path.join(
             vdir,
             "embeddings-%d-of-%d.npz" % (self._shard_id, self._shard_num),
         )
-        np.savez(path, **arrays)
-        logger.info("Saved sparse checkpoint %s", path)
+        _savez_atomic(path, arrays)
+        self._chain_dir = vdir if supports_delta else None
+        self._chain_token = token
+        self._delta_index = 1
+        logger.info("Saved sparse checkpoint %s (full, %d rows)",
+                    path, rows)
         self._gc()
-        return path
+        return SaveResult(path=path, kind="full", version=int(version),
+                          rows=rows, tombstones=0, chain_len=0)
 
+    def _save_delta(self, version, store):
+        k = self._delta_index
+        arrays = {
+            _DELTA_VERSION_KEY: np.int64(version),
+            _CHAIN_TOKEN_KEY: np.str_(self._chain_token),
+        }
+        rows = tombstones = 0
+        for name in store.table_names():
+            ids, full_rows, steps, dead = store.export_table_dirty(name)
+            arrays["ids/" + name] = ids
+            arrays["fullrows/" + name] = full_rows
+            arrays["steps/" + name] = steps
+            arrays["dead/" + name] = dead
+            arrays["dim/" + name] = np.int64(store.table_dim(name))
+            arrays["opt/" + name] = np.str_(store.opt_type)
+            rows += int(ids.size)
+            tombstones += int(dead.size)
+        path = os.path.join(
+            self._chain_dir,
+            "delta-%d-embeddings-%d-of-%d.npz"
+            % (k, self._shard_id, self._shard_num),
+        )
+        _savez_atomic(path, arrays)
+        self._delta_index = k + 1
+        logger.info(
+            "Saved sparse checkpoint %s (delta %d, %d dirty rows, "
+            "%d tombstones)", path, k, rows, tombstones,
+        )
+        return SaveResult(path=path, kind="delta", version=int(version),
+                          rows=rows, tombstones=tombstones, chain_len=k)
+
+    # ------------------------------------------------------------------
+    # directory structure
     def _complete(self, vdir):
-        """A version dir is valid when all N shard files exist
-        (reference validity check: save_utils.py:211-227)."""
-        files = [f for f in sorted(os.listdir(vdir)) if _FILE_RE.search(f)]
+        """A chain is valid when its BASE is: all N base shard files
+        exist (reference validity check: save_utils.py:211-227).
+        Writes are atomic, so presence implies fully written."""
+        try:
+            names = sorted(os.listdir(vdir))
+        except OSError:
+            return False
+        files = [f for f in names if _FILE_RE.match(f)]
         if not files:
             return False
-        total = int(_FILE_RE.search(files[0]).group(2))
+        total = int(_FILE_RE.match(files[0]).group(2))
         return len(files) >= total
+
+    def _delta_chain(self, vdir):
+        """Contiguous complete delta prefix of a chain dir: ordered
+        ``[(k, [shard paths])]`` for k = 1.. until the first missing or
+        incomplete delta index (everything past a gap is unreachable —
+        its predecessor state cannot be reconstructed)."""
+        by_k = {}
+        try:
+            names = sorted(os.listdir(vdir))
+        except OSError:
+            return []
+        for fname in names:
+            match = _DELTA_RE.match(fname)
+            if match:
+                k = int(match.group(1))
+                by_k.setdefault(k, []).append(
+                    os.path.join(vdir, fname)
+                )
+        chain = []
+        k = 1
+        while k in by_k:
+            files = sorted(by_k[k])
+            total = int(_DELTA_RE.match(os.path.basename(files[0])).group(3))
+            if len(files) < total:
+                break
+            chain.append((k, files))
+            k += 1
+        return chain
 
     def _gc(self):
         if self._keep_max <= 0 or not os.path.isdir(self._dir):
@@ -84,8 +294,15 @@ class SparseCheckpointSaver:
     # ------------------------------------------------------------------
     @staticmethod
     def latest_version(checkpoint_dir):
-        """Newest *complete* version (all N shard files present): a crash
-        between shard saves must not lead to a silent partial restore."""
+        """Newest complete checkpoint's EFFECTIVE version: the newest
+        complete chain's base version, advanced by its readable
+        contiguous delta prefix — the SAME forward walk restore
+        replays, so the two agree even when a middle delta is torn. A
+        crash between shard saves (or mid-delta) must not lead to a
+        silent partial restore: incomplete bases are skipped, a bad
+        delta truncates the answer there, never forward. (This poll
+        path opens files without forcing array CRCs — interior
+        bit-rot past an intact zip directory is restore's to catch.)"""
         if not os.path.isdir(checkpoint_dir):
             return None
         versions = sorted(
@@ -95,13 +312,50 @@ class SparseCheckpointSaver:
         )
         saver = SparseCheckpointSaver(checkpoint_dir)
         for v in reversed(versions):
-            if saver._complete(saver._version_dir(v)):
-                return v
+            vdir = saver._version_dir(v)
+            if not saver._complete(vdir):
+                continue
+            try:
+                # open (not read) each base shard: the zip central
+                # directory lives at the END of the file, so a torn
+                # base — e.g. a foreign/pre-atomic writer's crash —
+                # fails here instead of being reported restorable
+                base_tokens = {
+                    saver._shard_index(path): saver._file_token(path)
+                    for path in saver._shard_files(v)
+                }
+            except Exception as e:
+                logger.warning(
+                    "latest_version: unreadable base in version-%d "
+                    "(%s); skipping the chain", v, e,
+                )
+                continue
+            effective = v
+            for k, files in saver._delta_chain(vdir):
+                try:
+                    stamp = 0
+                    for path in files:
+                        token = saver._file_token(path)
+                        if token != base_tokens.get(
+                            saver._shard_index(path)
+                        ):
+                            raise ValueError("chain token mismatch")
+                        with np.load(path) as data:
+                            stamp = max(stamp, int(data[_DELTA_VERSION_KEY]))
+                    effective = max(effective, stamp)
+                except Exception as e:
+                    # bad delta: truncate here, like restore's replay
+                    logger.warning(
+                        "latest_version: unreadable delta %d in "
+                        "version-%d (%s); truncating", k, v, e,
+                    )
+                    break
+            return effective
         return None
 
     def _candidate_versions(self, version):
-        """Versions to try, preferred first: the requested one (if any),
-        then every on-disk version newest-first."""
+        """Base versions to try, preferred first: the requested one (if
+        any), then every on-disk version newest-first."""
         if not os.path.isdir(self._dir):
             return []
         versions = sorted(
@@ -121,36 +375,103 @@ class SparseCheckpointSaver:
         return [
             os.path.join(vdir, fname)
             for fname in sorted(os.listdir(vdir))
-            if _FILE_RE.search(fname)
+            if _FILE_RE.match(fname)
         ]
 
-    def _verify_version_files(self, version):
-        """Raise on ANY missing/truncated/corrupt content of a version
-        BEFORE the import touches the live store — restore is
-        all-or-nothing, never half-imported. Reads one file at a time
-        and discards (forcing the zipfile CRC/length checks), so peak
-        memory is one shard file, not the whole checkpoint."""
-        if not self._complete(self._version_dir(version)):
+    @staticmethod
+    def _verify_chain_file(path):
+        """One pass over a shard file: force the zipfile CRC/length
+        checks on every array (peak memory = one shard file) and
+        return ``(chain_token, delta_version)`` — None for keys the
+        file doesn't carry (old-format/base files)."""
+        token = stamp = None
+        with np.load(path) as data:
+            for key in data.files:
+                arr = data[key]
+                if key == _CHAIN_TOKEN_KEY:
+                    token = str(arr)
+                elif key == _DELTA_VERSION_KEY:
+                    stamp = int(arr)
+        return token, stamp
+
+    @staticmethod
+    def _file_token(path):
+        """The chain-generation token a shard file carries (None for
+        old-format files)."""
+        with np.load(path) as data:
+            if _CHAIN_TOKEN_KEY in data.files:
+                return str(data[_CHAIN_TOKEN_KEY])
+        return None
+
+    @staticmethod
+    def _shard_index(path):
+        match = _DELTA_RE.match(os.path.basename(path))
+        if match:
+            return int(match.group(2))
+        return int(_FILE_RE.match(os.path.basename(path)).group(1))
+
+    def _chain_plan(self, version):
+        """Verified replay plan for one chain: ``(base_files,
+        [(k, delta_files, delta_version)])``. Raises on ANY base
+        problem (the candidate is unusable); a bad delta — torn,
+        incomplete, or carrying another generation's chain token —
+        truncates the plan there: the chain restores to its newest
+        complete prefix, which is exactly the crash-mid-delta
+        contract."""
+        vdir = self._version_dir(version)
+        if not self._complete(vdir):
             raise ValueError("incomplete version dir (missing shards)")
-        for path in self._shard_files(version):
-            with np.load(path) as data:
-                for key in data.files:
-                    data[key]
+        base_files = self._shard_files(version)
+        base_tokens = {}
+        for path in base_files:
+            token, _ = self._verify_chain_file(path)
+            base_tokens[self._shard_index(path)] = token
+        deltas = []
+        for k, files in self._delta_chain(vdir):
+            try:
+                stamp = version
+                for path in files:
+                    token, file_stamp = self._verify_chain_file(path)
+                    if token != base_tokens.get(self._shard_index(path)):
+                        raise ValueError(
+                            "chain token mismatch (delta from another "
+                            "chain generation)"
+                        )
+                    if file_stamp is not None:
+                        stamp = max(stamp, file_stamp)
+            except Exception as e:
+                logger.warning(
+                    "truncating chain version-%d at delta %d: %s",
+                    version, k, e,
+                )
+                events.emit(
+                    "checkpoint_delta_skipped", version=version,
+                    delta=k, why=str(e)[:200],
+                )
+                break
+            deltas.append((k, files, stamp))
+        return base_files, deltas
 
     def restore(self, store, version=None):
-        """Load all shard files of a version, keeping only rows belonging
+        """Load the newest restorable chain: full base + the longest
+        contiguous verified delta prefix, keeping only rows belonging
         to this shard — re-sharding is implicit (any old N -> new N).
+        Delta tombstones replay as deletes AFTER their delta's rows,
+        so an id evicted then re-admitted lands in whichever state the
+        chain recorded last.
 
         Hardened against the crash windows this module itself creates:
-        an incomplete ``version-<v>`` dir (PS died between shard saves)
-        or a truncated/corrupt ``.npz`` (died mid-write, disk trouble)
-        is SKIPPED — logged and journaled — and the newest older
-        complete version restores instead of the whole PS failing to
-        boot. Returns the restored version, or None when nothing on
-        disk was restorable."""
+        an incomplete ``version-<v>`` dir (PS died between base shard
+        saves, e.g. mid-compaction) or a truncated/corrupt ``.npz`` is
+        SKIPPED — logged and journaled — and the newest older complete
+        state restores instead of the whole PS failing to boot. All
+        files are verified BEFORE the import touches the live store:
+        restore is all-or-nothing, never half-imported. Returns the
+        restored EFFECTIVE version (the newest replayed delta's store
+        version), or None when nothing on disk was restorable."""
         for candidate in self._candidate_versions(version):
             try:
-                self._verify_version_files(candidate)
+                base_files, deltas = self._chain_plan(candidate)
             except Exception as e:
                 logger.warning(
                     "skipping sparse checkpoint version %d: %s",
@@ -164,23 +485,59 @@ class SparseCheckpointSaver:
             # second pass imports one (verified) file at a time; only
             # this shard's rows are kept, so peak memory stays at one
             # shard file rather than the whole checkpoint
-            for path in self._shard_files(candidate):
+            seen_tables = set()
+            for path in base_files:
                 with np.load(path) as data:
-                    self._import_shard_arrays(
+                    seen_tables |= self._import_shard_arrays(
                         store, {key: data[key] for key in data.files}
                     )
+            effective = candidate
+            last_tables = None
+            for k, files, stamp in deltas:
+                delta_tables = set()
+                for path in files:
+                    with np.load(path) as data:
+                        delta_tables |= self._import_shard_arrays(
+                            store,
+                            {key: data[key] for key in data.files},
+                        )
+                seen_tables |= delta_tables
+                last_tables = delta_tables
+                effective = max(effective, stamp)
+            if last_tables is not None:
+                # every delta records the live table set (an entry per
+                # table, dirty or not), so a table present earlier in
+                # the chain but absent from the NEWEST delta was
+                # drop_table'd before that save — replay the drop, or
+                # the restore resurrects the whole table (the
+                # table-level twin of the row tombstones)
+                for name in sorted(seen_tables - last_tables):
+                    if callable(getattr(store, "drop_table", None)):
+                        logger.info(
+                            "dropping table %r absent from the chain's "
+                            "newest delta", name,
+                        )
+                        store.drop_table(name)
+            if callable(getattr(store, "clear_dirty", None)):
+                # the imports marked every restored row dirty; the
+                # on-disk chain already holds that state, and leaving
+                # it would report a phantom full-store dirty gauge
+                for name in store.table_names():
+                    store.clear_dirty(name)
             logger.info(
-                "Restored sparse checkpoint version %d into shard %d/%d",
-                candidate,
-                self._shard_id,
-                self._shard_num,
+                "Restored sparse checkpoint version %d (+%d deltas -> "
+                "version %d) into shard %d/%d",
+                candidate, len(deltas), effective,
+                self._shard_id, self._shard_num,
             )
-            return candidate
+            return effective
         return None
 
     def _import_shard_arrays(self, store, data):
-        """Import one (fully pre-read) shard file's arrays, keeping only
-        the rows belonging to this shard."""
+        """Import one (fully pre-read) shard file's arrays — base or
+        delta — keeping only the rows belonging to this shard, then
+        replaying the delta's tombstones as deletes. Returns the table
+        names the file records (the live table set at its save)."""
         tables = {
             key.split("/", 1)[1]
             for key in data
@@ -225,3 +582,102 @@ class SparseCheckpointSaver:
                     shard_id=self._shard_id,
                     shard_num=self._shard_num,
                 )
+            dead = data.get("dead/" + name)
+            if dead is not None and dead.size:
+                # lifecycle tombstones: these ids were evicted after
+                # the rows above were saved — replay as deletes (other
+                # shards' ids are simply absent here: no-op)
+                store.drop_rows(name, dead)
+        return tables
+
+
+class AsyncCheckpointer:
+    """Off-RPC checkpoint executor (ISSUE 13): push handlers only
+    ENQUEUE a save request; one dedicated thread takes the brief
+    dirty-export under the store lock and does all serialization and
+    file IO off the push path. Requests arriving while a save is in
+    flight COALESCE into a single trailing save carrying the newest
+    requested version — a burst of checkpoint triggers costs at most
+    one in-flight save plus one follow-up, never a queue.
+
+    The thread is a daemon and starts lazily on the first request, so
+    constructing a servicer never spawns threads. ``stop()`` ends it;
+    the SIGTERM path stops WITHOUT draining — its synchronous final
+    full save supersedes anything pending."""
+
+    def __init__(self, save_fn, name="ps-ckpt"):
+        self._save_fn = save_fn
+        self._name = name
+        self._cond = threading.Condition()
+        self._pending = None  # (version, kind)
+        self._in_flight = False
+        self._stopped = False
+        self._thread = None
+        self.requested = 0
+        self.completed = 0
+        self.coalesced = 0
+
+    def request(self, version, kind="sparse"):
+        """Enqueue a save; returns False after stop(). Never blocks on
+        IO — the caller is a push RPC handler."""
+        with self._cond:
+            if self._stopped:
+                return False
+            self.requested += 1
+            if self._pending is not None:
+                # the superseded request is folded into this one: the
+                # dirty export covers everything up to snapshot time
+                self.coalesced += 1
+            self._pending = (int(version), kind)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True
+                )
+                self._thread.start()
+            self._cond.notify()
+        return True
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stopped:
+                    self._cond.wait()
+                if self._pending is None:
+                    return
+                version, kind = self._pending
+                self._pending = None
+                self._in_flight = True
+            try:
+                self._save_fn(version, kind)
+            except Exception:
+                logger.exception("async sparse checkpoint failed")
+            with self._cond:
+                self._in_flight = False
+                self.completed += 1
+                self._cond.notify_all()
+
+    def drain(self, timeout=30.0):
+        """Block until idle (no pending request, no save in flight).
+        Returns True when drained inside the timeout."""
+        deadline = time.time() + timeout
+        with self._cond:
+            while self._pending is not None or self._in_flight:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    def stop(self, drain=False, timeout=30.0):
+        """End the thread. ``drain=True`` completes pending work first
+        (orderly exits); False abandons it (SIGTERM: the final full
+        save supersedes)."""
+        if drain:
+            self.drain(timeout)
+        with self._cond:
+            self._stopped = True
+            self._pending = None
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=timeout)

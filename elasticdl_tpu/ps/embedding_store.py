@@ -28,7 +28,9 @@ _SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libedl_embedding.so"))
 # from another tree: the loader rebuilds it once, and on any failure
 # falls back to the numpy store instead of raising mid-job.
 # ABI 3: drop_rows/drop_table (embedding lifecycle eviction, ISSUE 12).
-_EXPECTED_ABI = 3
+# ABI 4: dirty-row tracking + export_dirty/dirty_count/clear_dirty
+# (incremental checkpoints, ISSUE 13).
+_EXPECTED_ABI = 4
 
 # TensorBlob wire dtype name -> WireDtype enum in embedding_store.cc;
 # the only payload dtypes the blob fast paths accept — anything else
@@ -339,6 +341,25 @@ def _bind_native(lib):
         ctypes.c_int,
         ctypes.c_int,
     ]
+    lib.edl_store_dirty_count.restype = ctypes.c_int64
+    lib.edl_store_dirty_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.edl_store_dead_count.restype = ctypes.c_int64
+    lib.edl_store_dead_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.edl_store_export_dirty.restype = ctypes.c_int64
+    lib.edl_store_export_dirty.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+    ]
+    lib.edl_store_clear_dirty.restype = ctypes.c_int
+    lib.edl_store_clear_dirty.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     return lib
 
 
@@ -664,6 +685,67 @@ class NativeEmbeddingStore:
         )
         return ids[:got], rows[:got], steps[:got]
 
+    def dirty_count(self, name):
+        """Rows a delta export would currently carry (gauge/sizing)."""
+        n = self._lib.edl_store_dirty_count(self._handle, name.encode())
+        if n < 0:
+            raise KeyError(name)
+        return int(n)
+
+    def export_table_dirty(self, name, clear=True):
+        """Snapshot-and-clear dirty export — the delta-checkpoint
+        primitive (ISSUE 13). One GIL-released C call under the
+        per-table lock exports every row mutated (or first
+        materialized) since the last export — ids ascending, full
+        train state like :meth:`export_table_full` — plus the dead-id
+        tombstones from ``drop_rows``, then clears both sets. Returns
+        ``(ids, rows, steps, dead_ids)``. Traffic between the sizing
+        probe and the fill retries via the -3 protocol, so nothing is
+        ever lost or double-cleared."""
+        dim = self._dims[name]
+        row_floats = dim * (1 + self.table_slots(name))
+        dead_out = ctypes.c_int64(0)
+        while True:
+            count = self._lib.edl_store_export_dirty(
+                self._handle, name.encode(),
+                None, None, None, None, 0, 0,
+                ctypes.byref(dead_out), 0,
+            )
+            if count < 0:
+                raise KeyError(name)
+            # slack absorbs rows dirtied between probe and fill; a
+            # burst bigger than the slack returns -3 and re-probes
+            cap = int(count) + 1024
+            dead_cap = int(dead_out.value) + 1024
+            ids = np.empty((cap,), dtype=np.int64)
+            rows = np.empty((cap, row_floats), dtype=np.float32)
+            steps = np.empty((cap,), dtype=np.int64)
+            dead = np.empty((dead_cap,), dtype=np.int64)
+            got = self._lib.edl_store_export_dirty(
+                self._handle, name.encode(),
+                _i64_ptr(ids),
+                _f32_ptr(rows),
+                _i64_ptr(steps),
+                _i64_ptr(dead),
+                cap, dead_cap,
+                ctypes.byref(dead_out), 1 if clear else 0,
+            )
+            if got == -3:
+                continue
+            if got < 0:
+                raise KeyError(name)
+            return (
+                ids[:got], rows[:got], steps[:got],
+                dead[: int(dead_out.value)],
+            )
+
+    def clear_dirty(self, name):
+        """Drop all dirty/dead bookkeeping (taken before a full base
+        export: the base carries complete state)."""
+        rc = self._lib.edl_store_clear_dirty(self._handle, name.encode())
+        if rc != 0:
+            raise KeyError(name)
+
     def import_table_full(self, name, ids, rows, steps,
                           shard_id=0, shard_num=0):
         """Inverse of export_table_full; a slot-layout mismatch (the
@@ -705,6 +787,14 @@ class NumpyEmbeddingStore:
         self._tables = {}  # name -> {id: weight row}
         self._slots = {}  # name -> {id: slot array [slots, dim]}
         self._steps = {}  # name -> {id: step count}
+        # incremental-checkpoint bookkeeping, the native store's twin
+        # (ISSUE 13): _dirty = resident ids mutated/materialized since
+        # the last dirty export, _dead = ids dropped since then
+        # (tombstones). _dirty is a subset of the resident ids and
+        # disjoint from _dead — drops move ids dirty->dead, a
+        # re-materialization moves them back.
+        self._dirty = {}  # name -> set(id)
+        self._dead = {}  # name -> set(id)
         self._meta = {}  # name -> (dim, init_scale)
         self._opt = ("sgd", dict(OPTIMIZER_DEFAULTS))
         self._lock = threading.Lock()
@@ -744,6 +834,8 @@ class NumpyEmbeddingStore:
             self._tables[name] = {}
             self._slots[name] = {}
             self._steps[name] = {}
+            self._dirty[name] = set()
+            self._dead[name] = set()
 
     def _table_rng(self, name):
         # only reached from _init_row under _row_locked's callers, all
@@ -788,6 +880,10 @@ class NumpyEmbeddingStore:
                 (n_slots, dim), dtype=np.float32
             )
             self._steps[name][id_] = 0
+            # a lazy init is a state change the delta chain must carry
+            # (same rule as the native get_or_init)
+            self._dirty[name].add(id_)
+            self._dead[name].discard(id_)
         return table[id_]
 
     def lookup(self, name, ids):
@@ -815,8 +911,10 @@ class NumpyEmbeddingStore:
                 self._apply_unique_locked(name, ids, grads, opt_type,
                                           args, lr)
                 return
+            dirty = self._dirty[name]
             for i, grad in zip(ids, grads):
                 i = int(i)
+                dirty.add(i)
                 w = self._row_locked(name, i)
                 slots = self._slots[name][i]
                 self._steps[name][i] += 1
@@ -853,6 +951,7 @@ class NumpyEmbeddingStore:
         ids are unique (duplicate streams take the sequential path —
         slot-state optimizers are order-sensitive across repeats)."""
         id_list = [int(i) for i in ids]
+        self._dirty[name].update(id_list)
         # gather in input order: lazy row init draws from the per-table
         # RNG stream, so creation order must match the sequential path
         rows = [self._row_locked(name, i) for i in id_list]
@@ -918,10 +1017,16 @@ class NumpyEmbeddingStore:
             table = self._tables[name]
             slots = self._slots[name]
             steps = self._steps[name]
+            dirty = self._dirty[name]
+            dead = self._dead[name]
             for i in ids:
                 i = int(i)
                 if table.pop(i, None) is not None:
                     dropped += 1
+                    # dirty -> dead: the next delta replays this drop
+                    # as a delete so a restore cannot resurrect it
+                    dirty.discard(i)
+                    dead.add(i)
                 slots.pop(i, None)
                 steps.pop(i, None)
         return dropped
@@ -934,6 +1039,8 @@ class NumpyEmbeddingStore:
             self._tables.pop(name, None)
             self._slots.pop(name, None)
             self._steps.pop(name, None)
+            self._dirty.pop(name, None)
+            self._dead.pop(name, None)
             self._rngs.pop(name, None)
 
     def table_size(self, name):
@@ -969,11 +1076,13 @@ class NumpyEmbeddingStore:
 
     def import_table(self, name, ids, values, shard_id=0, shard_num=0):
         with self._lock:
+            dirty = self._dirty[name]
             for i, row in zip(ids, values):
                 i = int(i)
                 if shard_num > 0 and i % shard_num != shard_id:
                     continue
                 self._row_locked(name, i)[:] = row
+                dirty.add(i)
 
     @property
     def opt_type(self):
@@ -1015,16 +1124,69 @@ class NumpyEmbeddingStore:
         rows = np.asarray(rows, np.float32)
         exact = rows.ndim == 2 and rows.shape[1] == dim * (1 + slots)
         with self._lock:
+            dirty = self._dirty[name]
             for idx, i in enumerate(ids):
                 i = int(i)
                 if shard_num > 0 and i % shard_num != shard_id:
                     continue
                 self._row_locked(name, i)[:] = rows[idx][:dim]
+                dirty.add(i)
                 if exact:
                     self._slots[name][i][:] = rows[idx][dim:].reshape(
                         slots, dim
                     )
                     self._steps[name][i] = int(steps[idx])
+
+    def dirty_count(self, name):
+        """Rows a delta export would currently carry (gauge/sizing)."""
+        if name not in self._meta:
+            raise KeyError(name)
+        with self._lock:
+            return len(self._dirty[name])
+
+    def export_table_dirty(self, name, clear=True):
+        """Native-store twin of the delta-checkpoint primitive: under
+        the store lock, export every dirty row's full train state (ids
+        ascending — deterministic files, never set order) plus the
+        dead-id tombstones, then clear both sets. Returns ``(ids,
+        rows, steps, dead_ids)``; bit-exact with the native export."""
+        if name not in self._meta:
+            raise KeyError(name)
+        with self._lock:
+            dim = self._meta[name][0]
+            slots = self.table_slots(name)
+            row_floats = dim * (1 + slots)
+            dirty = sorted(self._dirty[name])
+            dead = np.asarray(sorted(self._dead[name]), np.int64)
+            if dirty:
+                ids = np.asarray(dirty, np.int64)
+                table = self._tables[name]
+                rows = np.stack([
+                    np.concatenate(
+                        [table[i]] + list(self._slots[name][i])
+                    )
+                    for i in dirty
+                ]).astype(np.float32, copy=False)
+                steps = np.asarray(
+                    [self._steps[name][i] for i in dirty], np.int64
+                )
+            else:
+                ids = np.empty((0,), np.int64)
+                rows = np.empty((0, row_floats), np.float32)
+                steps = np.empty((0,), np.int64)
+            if clear:
+                self._dirty[name] = set()
+                self._dead[name] = set()
+            return ids, rows, steps, dead
+
+    def clear_dirty(self, name):
+        """Drop all dirty/dead bookkeeping (taken before a full base
+        export: the base carries complete state)."""
+        if name not in self._meta:
+            raise KeyError(name)
+        with self._lock:
+            self._dirty[name] = set()
+            self._dead[name] = set()
 
 
 def create_store(seed=0, prefer_native=True):

@@ -328,6 +328,7 @@ class ParameterServer:
         if self._master_client is None:
             if self.lifecycle is None:
                 self.server.wait_for_termination()
+                self.servicer.finish_checkpoints()
                 return 0
             # masterless (embedded/test) but lifecycle on: the sweep
             # still needs a clock — and server termination must still
@@ -337,6 +338,7 @@ class ParameterServer:
             # TIMEOUT (still serving) and False once terminated.
             while self.server.wait_for_termination(timeout=sweep_secs):
                 self.servicer.lifecycle_tick()
+            self.servicer.finish_checkpoints()
             return 0
         # polls missed before concluding the master is gone for good:
         # must comfortably cover a master pod relaunch + state-journal
@@ -358,6 +360,10 @@ class ParameterServer:
                     logger.info("Master gone; PS exiting")
                     self.server.stop(grace=1.0)
                     self._cleanup_uds()
+                    # orderly exit: an enqueued off-RPC save must land
+                    # before the process dies, or the relaunch restores
+                    # without the job's last pushes
+                    self.servicer.finish_checkpoints()
                     events.emit("role_stop", reason="master_gone")
                     events.flush()
                     return 0

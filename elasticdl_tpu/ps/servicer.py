@@ -55,6 +55,12 @@ APPLY_THREADS_ENV = "EDL_PS_APPLY_THREADS"
 # as host int64, so they are only taken on LE hosts
 _LITTLE_ENDIAN = sys.byteorder == "little"
 
+# Off-RPC checkpointing (ISSUE 13): 1 (default) = push handlers only
+# enqueue a save request and a dedicated thread does the dirty export
+# + serialization + file IO; 0 = saves run inline in the handler (the
+# pre-ISSUE-13 behavior, kept for deterministic tests and debugging).
+CKPT_ASYNC_ENV = "EDL_CKPT_ASYNC"
+
 
 def _deserialize_gradients(slices):
     """One table's pushed gradients off the wire, upcast to the fp32
@@ -156,6 +162,21 @@ class PserverServicer:
         self._staleness_modulation = staleness_modulation
         self._checkpoint_saver = checkpoint_saver
         self._checkpoint_steps = checkpoint_steps
+        # Off-RPC saves (ISSUE 13): checkpoint triggers only ENQUEUE;
+        # the AsyncCheckpointer thread does the brief dirty export
+        # under the store lock plus all serialization and file IO off
+        # the push path, coalescing bursts. EDL_CKPT_ASYNC=0 keeps the
+        # old inline behavior.
+        self._ckpt_async = None
+        if checkpoint_saver is not None:
+            from elasticdl_tpu.common.env_utils import env_int
+            from elasticdl_tpu.ps.checkpoint import AsyncCheckpointer
+
+            if env_int(CKPT_ASYNC_ENV, 1):
+                self._ckpt_async = AsyncCheckpointer(
+                    self._save_checkpoint_now,
+                    name="ps-%d-ckpt" % ps_id,
+                )
         self._master_client = master_client
         self._lock = threading.Lock()
         self._dense = {}
@@ -260,6 +281,26 @@ class PserverServicer:
             "1 when this PS runs the native (C++) embedding store, "
             "0 on the numpy fallback",
         ).set(1 if self._native_store else 0)
+        # Incremental checkpoints (ISSUE 13): save wall time by kind
+        # (a delta should be orders of magnitude under a full base on
+        # a Zipfian stream), the dirty-row count each delta carried,
+        # and the live chain length (deltas since the last base — the
+        # restore replay cost, bounded by EDL_CKPT_COMPACT_EVERY).
+        self._m_ckpt_seconds = obs_metrics.histogram(
+            "edl_ps_checkpoint_seconds",
+            "Wall seconds per sparse checkpoint save, by kind",
+            ("kind",),
+        )
+        self._m_ckpt_dirty_rows = obs_metrics.gauge(
+            "edl_ps_ckpt_dirty_rows",
+            "Rows carried by the most recent checkpoint save "
+            "(all resident rows for a full base, dirty rows for a "
+            "delta)",
+        )
+        self._m_ckpt_chain_len = obs_metrics.gauge(
+            "edl_ps_ckpt_chain_len",
+            "Deltas in the live checkpoint chain since its full base",
+        )
         # Fleet-telemetry source (ISSUE 3): plain-int tallies kept
         # INDEPENDENTLY of the metrics registry (telemetry must work
         # with /metrics off), read by telemetry_blob() on the PS's 5 s
@@ -271,6 +312,8 @@ class PserverServicer:
         self._t_push_bytes = 0
         self._t_pull_bytes = 0
         self._t_last_push_version = 0
+        self._t_ckpt_dirty_rows = 0
+        self._t_ckpt_chain_len = 0
         self._t_prev = None  # (timestamp, push_count, pull_count)
 
     def telemetry_blob(self):
@@ -299,6 +342,8 @@ class PserverServicer:
             push_bytes=self._t_push_bytes,
             pull_bytes=self._t_pull_bytes,
             ps_native_store=self._native_store,
+            ps_ckpt_dirty_rows=self._t_ckpt_dirty_rows,
+            ps_ckpt_chain_len=self._t_ckpt_chain_len,
         )
         # embedding lifecycle health (ISSUE 12): admission/eviction
         # tallies + the resident-row gauge the bounded-memory contract
@@ -961,11 +1006,15 @@ class PserverServicer:
             version = self._store.version
         for event, fields in journal:
             events.emit(event, **fields)
+        if self._ckpt_async is not None:
+            # abandon anything pending: the synchronous final FULL
+            # save below supersedes every enqueued delta
+            self._ckpt_async.stop(drain=False)
         if self._checkpoint_saver is not None:
             try:
-                self._checkpoint_saver.save(version, self._store)
-                events.emit("checkpoint_saved", version=version,
-                            kind="sparse_final")
+                self._save_checkpoint_now(
+                    version, "sparse_final", force_full=True
+                )
                 logger.info(
                     "final sparse checkpoint saved at version %d",
                     version,
@@ -1009,20 +1058,58 @@ class PserverServicer:
             return False
         self._stream_ckpt_boundary = boundary
         version = self._store.version
+        events.emit("stream_watermark", watermark=int(watermark),
+                    kind="checkpoint")
+        logger.info(
+            "stream checkpoint at watermark %d (version %d)",
+            watermark, version,
+        )
+        return self._request_checkpoint(version, "sparse_stream")
+
+    def _save_checkpoint_now(self, version, kind, force_full=False):
+        """One synchronous checkpoint save + its metrics/journal —
+        shared by the inline path, the AsyncCheckpointer thread, and
+        the SIGTERM final full save. Raises on failure (callers own
+        the degrade-don't-crash decision)."""
+        start = time.time()
+        result = self._checkpoint_saver.save(
+            version, self._store, force_full=force_full
+        )
+        elapsed = time.time() - start
+        self._m_ckpt_seconds.labels(kind=result.kind).observe(elapsed)
+        self._m_ckpt_dirty_rows.set(result.rows)
+        self._m_ckpt_chain_len.set(result.chain_len)
+        self._t_ckpt_dirty_rows = result.rows
+        self._t_ckpt_chain_len = result.chain_len
+        events.emit(
+            "checkpoint_saved", version=version, kind=kind,
+            mode=result.kind, rows=result.rows,
+            tombstones=result.tombstones, chain_len=result.chain_len,
+        )
+
+    def _request_checkpoint(self, version, kind):
+        """Trigger a save at ``version``: enqueue on the checkpoint
+        thread (the off-RPC default — returns once the request is
+        REGISTERED, with bursts coalesced into the newest version), or
+        run inline under EDL_CKPT_ASYNC=0. Returns True when the save
+        was enqueued/completed; a failed INLINE save logs and returns
+        False (a checkpoint failure must never fail the push RPC that
+        tripped the cadence)."""
+        if self._ckpt_async is not None:
+            return self._ckpt_async.request(version, kind)
         try:
-            self._checkpoint_saver.save(version, self._store)
-            events.emit("checkpoint_saved", version=version,
-                        kind="sparse_stream")
-            events.emit("stream_watermark", watermark=int(watermark),
-                        kind="checkpoint")
-            logger.info(
-                "stream checkpoint at watermark %d (version %d)",
-                watermark, version,
-            )
+            self._save_checkpoint_now(version, kind)
             return True
         except Exception:
-            logger.exception("stream sparse checkpoint failed")
+            logger.exception("sparse checkpoint failed")
             return False
+
+    def finish_checkpoints(self, timeout=30.0):
+        """Drain the checkpoint thread (orderly shutdown paths: the
+        master-gone exit must not abandon an enqueued save that the
+        relaunch would then have to live without)."""
+        if self._ckpt_async is not None:
+            self._ckpt_async.stop(drain=True, timeout=timeout)
 
     def _maybe_checkpoint(self, version):
         if (
@@ -1030,12 +1117,7 @@ class PserverServicer:
             and self._checkpoint_steps > 0
             and version % self._checkpoint_steps == 0
         ):
-            try:
-                self._checkpoint_saver.save(version, self._store)
-                events.emit("checkpoint_saved", version=version,
-                            kind="sparse")
-            except Exception:
-                logger.exception("sparse checkpoint failed")
+            self._request_checkpoint(version, "sparse")
 
     def _maybe_report_version(self, version):
         if self._master_client is not None:

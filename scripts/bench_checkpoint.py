@@ -1,0 +1,381 @@
+#!/usr/bin/env python
+"""Incremental-checkpoint proof scenario (ISSUE 13, tier 1f).
+
+A Zipfian push stream over a bounded resident-row set (the shape the
+streaming lifecycle guarantees) against a PS whose durability is the
+new delta-chain + off-RPC checkpoint machinery, measured three ways:
+
+1. **delta vs full save cost**: wall time of a delta save (dirty rows
+   from one Zipfian window) vs a full save of the same store — the
+   O(dirty) vs O(resident) claim. Hard gate: delta >= ``MIN_SPEEDUP``x
+   faster on the numpy backend (native reported too).
+2. **push p99 during checkpoints**: worker-observed push latency
+   through the real servicer while checkpoints run off-RPC
+   (EDL_CKPT_ASYNC=1) vs a no-checkpoint baseline — hard gate: p99
+   within ``P99_FACTOR``x of baseline. The pre-ISSUE-13 inline mode is
+   measured in the same run (report-only) to show the stall the
+   checkpoint thread removes.
+3. **restore equivalence**: base + deltas (with ``drop_rows``
+   tombstones) restores bit-identically to a full save of the same
+   live store on BOTH backends, and tombstoned ids stay dead. Hard
+   gate.
+
+Output: one JSON object on stdout (journaled by ci.sh tier 1f).
+Exit 1 when a gate fails.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # run from the repo root, like ci.sh does
+
+from elasticdl_tpu.common.tensor_utils import serialize_indexed_slices
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
+from elasticdl_tpu.ps.embedding_store import (
+    NumpyEmbeddingStore,
+    native_lib,
+)
+
+DIM = 16
+RESIDENT_ROWS = 60000          # bounded resident set (lifecycle bound)
+WINDOW_IDS = 2000              # Zipfian draws per push window
+ZIPF_A = 1.3
+SAVE_REPEATS = 3               # best-of per timing
+MIN_SPEEDUP = 5.0              # delta save must beat full by this
+P99_FACTOR = 1.5               # async push p99 vs no-ckpt baseline
+P99_PUSHES = 400
+P99_CKPT_STEPS = 25            # checkpoint cadence during the p99 run
+RESTORE_WINDOWS = 6            # delta windows in the parity scenario
+
+
+def make_store(backend, seed=0):
+    if backend == "native":
+        from elasticdl_tpu.ps.embedding_store import NativeEmbeddingStore
+
+        store = NativeEmbeddingStore(seed=seed)
+    else:
+        store = NumpyEmbeddingStore(seed=seed)
+    store.set_optimizer("adam", lr=0.05)
+    store.create_table("emb", DIM, init_scale=0.0, initializer="zeros")
+    return store
+
+
+def populate(store, rows=RESIDENT_ROWS, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = np.arange(rows, dtype=np.int64)
+    for start in range(0, rows, 10000):
+        chunk = ids[start:start + 10000]
+        store.import_table(
+            "emb", chunk,
+            rng.rand(chunk.size, DIM).astype(np.float32),
+        )
+
+
+def zipf_window(rng, size=WINDOW_IDS, vocab=RESIDENT_ROWS):
+    draws = rng.zipf(ZIPF_A, size=size)
+    return np.unique((draws - 1) % vocab).astype(np.int64)
+
+
+def timed(fn, repeats=SAVE_REPEATS):
+    best = float("inf")
+    for _ in range(repeats):
+        start = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - start)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# 1. delta vs full save cost
+
+
+def bench_save_cost(backend, tmp):
+    store = make_store(backend)
+    populate(store)
+    rng = np.random.RandomState(1)
+    chain_dir = os.path.join(tmp, "cost-%s" % backend)
+    saver = SparseCheckpointSaver(chain_dir, compact_every=10 ** 6)
+    version = [0]
+
+    def full_save():
+        version[0] += 1
+        saver.save(version[0], store, force_full=True)
+
+    full_secs = timed(full_save)
+    dirty_rows = []
+
+    def delta_save():
+        ids = zipf_window(rng)
+        store.push_gradients(
+            "emb", ids, rng.randn(ids.size, DIM).astype(np.float32)
+        )
+        dirty_rows.append(store.dirty_count("emb"))
+        version[0] += 1
+        result = saver.save(version[0], store)
+        assert result.kind == "delta", result
+
+    delta_secs = timed(delta_save)
+    return {
+        "full_save_secs": round(full_secs, 4),
+        "delta_save_secs": round(delta_secs, 4),
+        "delta_dirty_rows": int(np.mean(dirty_rows)),
+        "speedup": round(full_secs / max(delta_secs, 1e-9), 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# 2. worker-observed push p99 during checkpoints (real PS subprocess:
+#    latency includes the wire, the way a worker actually sees it —
+#    an in-process loop would divide the checkpoint thread's GIL
+#    slices by a strawman sub-millisecond baseline)
+
+
+def _free_port():
+    import socket
+
+    probe = socket.socket()
+    probe.bind(("", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
+def _spawn_ps(tmp, mode, ckpt_steps):
+    import subprocess
+
+    port = _free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        EDL_CKPT_ASYNC="0" if mode == "inline" else "1",
+        EDL_CKPT_COMPACT_EVERY="1000000",
+    )
+    env.pop("EDL_FAULT_SPEC", None)
+    cmd = [
+        sys.executable, "-m", "elasticdl_tpu.ps.server",
+        "--ps_id", "0", "--num_ps_pods", "1", "--port", str(port),
+        "--opt_type", "adam", "--opt_args", "lr=0.05",
+        "--use_async", "1", "--seed", "0",
+    ]
+    if ckpt_steps:
+        ckpt_dir = os.path.join(tmp, "p99-ckpt-%s" % mode)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        cmd += ["--checkpoint_dir", ckpt_dir,
+                "--checkpoint_steps", str(ckpt_steps)]
+    log = open(os.path.join(tmp, "ps-%s.log" % mode), "wb")
+    proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+    import socket
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            probe = socket.socket()
+            probe.connect(("127.0.0.1", port))
+            probe.close()
+            return proc, port
+        except OSError:
+            time.sleep(0.2)
+    proc.kill()
+    raise TimeoutError("PS (%s) never came up" % mode)
+
+
+def percentile(values, q):
+    return float(np.percentile(values, q))
+
+
+def bench_push_p99(tmp):
+    """Three real-PS runs on identical Zipfian traffic: no checkpoints,
+    off-RPC checkpoints (the new default), inline checkpoints (the
+    pre-ISSUE-13 stall, report-only). The PS is a subprocess and each
+    push is a real gRPC round trip — the latency a worker observes."""
+    from elasticdl_tpu.worker.ps_client import PSClient
+
+    results = {}
+    for mode in ("baseline", "async", "inline"):
+        ckpt_steps = 0 if mode == "baseline" else P99_CKPT_STEPS
+        proc, port = _spawn_ps(tmp, mode, ckpt_steps)
+        try:
+            client = PSClient(["localhost:%d" % port], worker_id=0)
+            client.push_embedding_table_infos([("emb", DIM, "zeros")])
+            # materialize the resident set through real pushes
+            rng = np.random.RandomState(0)
+            all_ids = np.arange(RESIDENT_ROWS, dtype=np.int64)
+            for start in range(0, RESIDENT_ROWS, 10000):
+                chunk = all_ids[start:start + 10000]
+                grads = {"emb": (
+                    rng.rand(chunk.size, DIM).astype(np.float32), chunk
+                )}
+                assert client.push_gradients(
+                    grads, model_version=0
+                ).accepted
+            rng = np.random.RandomState(42)
+            # warmup (also fills the dirty set and, in the checkpointed
+            # modes, opens the chain with its first saves)
+            for _ in range(30):
+                ids = zipf_window(rng)
+                client.push_gradients(
+                    {"emb": (rng.randn(ids.size, DIM).astype(
+                        np.float32), ids)},
+                    model_version=0,
+                )
+            latencies = []
+            for _ in range(P99_PUSHES):
+                ids = zipf_window(rng)
+                grads = {"emb": (
+                    rng.randn(ids.size, DIM).astype(np.float32), ids
+                )}
+                start = time.perf_counter()
+                response = client.push_gradients(grads, model_version=0)
+                latencies.append(time.perf_counter() - start)
+                assert response.accepted
+            lat = np.asarray(latencies)
+            results[mode] = {
+                "p50_ms": round(1e3 * percentile(lat, 50), 3),
+                "p99_ms": round(1e3 * percentile(lat, 99), 3),
+                "max_ms": round(1e3 * float(lat.max()), 3),
+            }
+        finally:
+            proc.kill()
+            proc.wait(timeout=15)
+    results["async_vs_baseline_p99"] = round(
+        results["async"]["p99_ms"]
+        / max(results["baseline"]["p99_ms"], 1e-9), 2,
+    )
+    results["inline_vs_baseline_p99"] = round(
+        results["inline"]["p99_ms"]
+        / max(results["baseline"]["p99_ms"], 1e-9), 2,
+    )
+    return results
+
+
+# ---------------------------------------------------------------------------
+# 3. restore equivalence
+
+
+def bench_restore_parity(backend, tmp):
+    live = make_store(backend)
+    populate(live, rows=5000)
+    rng = np.random.RandomState(3)
+    chain_dir = os.path.join(tmp, "parity-chain-%s" % backend)
+    full_dir = os.path.join(tmp, "parity-full-%s" % backend)
+    saver = SparseCheckpointSaver(chain_dir, compact_every=100)
+    saver.save(1, live, force_full=True)
+    dropped = []
+    for w in range(RESTORE_WINDOWS):
+        ids = zipf_window(rng, size=600, vocab=5000)
+        live.push_gradients(
+            "emb", ids, rng.randn(ids.size, DIM).astype(np.float32)
+        )
+        victims = rng.choice(5000, size=20, replace=False).astype(
+            np.int64
+        )
+        live.drop_rows("emb", victims)
+        dropped.extend(victims.tolist())
+        saver.save(2 + w, live)
+    SparseCheckpointSaver(full_dir).save(
+        1 + RESTORE_WINDOWS, live, force_full=True
+    )
+
+    from_chain = make_store(backend, seed=1)
+    from_full = make_store(backend, seed=2)
+    SparseCheckpointSaver(chain_dir).restore(from_chain)
+    SparseCheckpointSaver(full_dir).restore(from_full)
+
+    def state(store):
+        ids, rows, steps = store.export_table_full("emb")
+        order = np.argsort(ids)
+        return ids[order], rows[order], steps[order]
+
+    a, b = state(from_chain), state(from_full)
+    bit_identical = (
+        a[0].shape == b[0].shape
+        and (a[0] == b[0]).all()
+        and (a[1] == b[1]).all()
+        and (a[2] == b[2]).all()
+    )
+    resident = set(a[0].tolist())
+    live_resident = set(live.export_table_full("emb")[0].tolist())
+    # an id dropped then re-pushed is legitimately resident again —
+    # dead means "absent from the live store", and the chain restore
+    # must agree exactly
+    tombstones_dead = all(
+        (d in live_resident) == (d in resident) for d in dropped
+    )
+    return {
+        "rows": int(a[0].size),
+        "deltas": RESTORE_WINDOWS,
+        "tombstones": len(set(dropped) - live_resident),
+        "bit_identical": bool(bit_identical),
+        "tombstones_dead": bool(tombstones_dead),
+    }
+
+
+def main():
+    import tempfile
+
+    backends = ["numpy"] + (
+        ["native"] if native_lib() is not None else []
+    )
+    report = {"backends": backends}
+    failures = []
+    with tempfile.TemporaryDirectory() as tmp:
+        for backend in backends:
+            report["save_cost_" + backend] = bench_save_cost(
+                backend, tmp
+            )
+        speedup = report["save_cost_numpy"]["speedup"]
+        if speedup < MIN_SPEEDUP:
+            failures.append(
+                "delta save only %.1fx faster than full (gate %.0fx)"
+                % (speedup, MIN_SPEEDUP)
+            )
+
+        report["push_p99"] = bench_push_p99(tmp)
+        ratio = report["push_p99"]["async_vs_baseline_p99"]
+        if ratio > P99_FACTOR:
+            failures.append(
+                "push p99 under off-RPC checkpoints %.2fx baseline "
+                "(gate %.1fx): the save leaked back onto the push path"
+                % (ratio, P99_FACTOR)
+            )
+
+        for backend in backends:
+            parity = bench_restore_parity(backend, tmp)
+            report["restore_parity_" + backend] = parity
+            if not parity["bit_identical"]:
+                failures.append(
+                    "%s: chain restore differs from full-save restore"
+                    % backend
+                )
+            if not parity["tombstones_dead"]:
+                failures.append(
+                    "%s: a tombstoned id resurrected through the chain"
+                    % backend
+                )
+
+    report["failures"] = failures
+    print(json.dumps(report))
+    if failures:
+        for failure in failures:
+            print("bench_checkpoint GATE FAILED: %s" % failure,
+                  file=sys.stderr)
+        return 1
+    print(
+        "bench_checkpoint OK: delta %.1fx faster than full; push p99 "
+        "%.2fx baseline under off-RPC checkpoints (inline was %.2fx); "
+        "chain restore bit-identical on %s"
+        % (speedup, ratio,
+           report["push_p99"]["inline_vs_baseline_p99"],
+           "+".join(backends)),
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,8 +130,13 @@ class _Run:
         )
         self.servicer.push_gradients(request)
 
-    def eval_logloss(self, windows):
+    def eval_tail(self, windows):
+        """Holdout-tail quality: (logloss, AUC). AUC beside logloss
+        (ROADMAP item 4 headroom): logloss rewards calibration, AUC
+        rewards RANKING — an eviction policy that keeps calibrated
+        head rows but scrambles tail ordering shows up only here."""
         total, n = 0.0, 0
+        scores, targets = [], []
         for ids, labels in windows:
             flat = ids.reshape(-1)
             rows = self.pull(flat).reshape(ids.shape[0], FIELDS, DIM)
@@ -143,7 +148,36 @@ class _Run:
                 labels * np.log(p) + (1 - labels) * np.log(1 - p)
             ).sum())
             n += labels.size
-        return total / max(1, n)
+            scores.append(logits)
+            targets.append(labels)
+        return total / max(1, n), _auc(
+            np.concatenate(scores), np.concatenate(targets)
+        )
+
+
+def _auc(scores, labels):
+    """ROC AUC via the rank-sum identity (average ties), no sklearn."""
+    labels = np.asarray(labels) > 0.5
+    pos = int(labels.sum())
+    neg = labels.size - pos
+    if pos == 0 or neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(scores.size, dtype=np.float64)
+    ranks[order] = np.arange(1, scores.size + 1)
+    # average ranks across ties so equal scores split the credit
+    sorted_scores = scores[order]
+    i = 0
+    while i < scores.size:
+        j = i
+        while (j + 1 < scores.size
+               and sorted_scores[j + 1] == sorted_scores[i]):
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    rank_sum = float(ranks[labels].sum())
+    return (rank_sum - pos * (pos + 1) / 2.0) / (pos * neg)
 
 
 def run_stream(backend, lifecycle_on, source):
@@ -187,8 +221,8 @@ def main():
     # sightings too (the real serving path), and the parity replay
     # below trains only — it must compare against end-of-training
     lifecycle_export = lifecycle.store.export_table_full("emb")
-    baseline_loss = baseline.eval_logloss(holdout)
-    lifecycle_loss = lifecycle.eval_logloss(holdout)
+    baseline_loss, baseline_auc = baseline.eval_tail(holdout)
+    lifecycle_loss, lifecycle_auc = lifecycle.eval_tail(holdout)
     stats = lifecycle.lifecycle.stats()
 
     failures = []
@@ -252,6 +286,10 @@ def main():
         "holdout_tail_logloss_baseline": round(baseline_loss, 5),
         "holdout_tail_logloss_lifecycle": round(lifecycle_loss, 5),
         "base_rate_logloss": round(base_rate_logloss, 5),
+        # ranking quality beside calibration (report-only: the gate
+        # stays on logloss; AUC is the ROADMAP item-4 headroom metric)
+        "holdout_tail_auc_baseline": round(baseline_auc, 5),
+        "holdout_tail_auc_lifecycle": round(lifecycle_auc, 5),
         "parity": parity,
         "failures": failures,
     }

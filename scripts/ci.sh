@@ -835,6 +835,21 @@ printf '{"ts": "%s", "streaming": %s}\n' \
   >> /tmp/ci_wire_micro.jsonl
 echo "streaming bench journaled to /tmp/ci_wire_micro.jsonl"
 
+# Incremental-checkpoint bench (ISSUE 13): delta-chain durability on a
+# Zipfian stream with bounded resident rows. Absolute timings are
+# report-only (journaled below); the script hard-fails the acceptance
+# gates — delta save under 5x faster than a full save of the same
+# store, worker-observed push p99 during off-RPC checkpoints beyond
+# 1.5x the no-checkpoint baseline (the real-PS-subprocess measurement;
+# the pre-ISSUE-13 inline stall is reported in the same run), or a
+# base+delta restore that is not bit-identical to a full-save restore
+# on either backend (tombstoned ids staying dead included).
+JAX_PLATFORMS=cpu python scripts/bench_checkpoint.py | tee /tmp/_checkpoint.json
+printf '{"ts": "%s", "checkpoint": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_checkpoint.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "checkpoint bench journaled to /tmp/ci_wire_micro.jsonl"
+
 # The reduced-precision wire opt-in must actually train: a sparse
 # local-executor run with EDL_WIRE_DTYPE=bfloat16 (LocalPSClient
 # round-trips payloads through the wire dtype, emulating exactly the

@@ -1,0 +1,623 @@
+"""Incremental sparse checkpointing (ISSUE 13): dirty-row tracking in
+both store backends, the delta chain format (base + dirty-row deltas +
+lifecycle tombstones, EDL_CKPT_COMPACT_EVERY compaction), atomic shard
+writes, chain-aware restore/latest_version under torn files, the
+off-RPC AsyncCheckpointer (coalescing contract), and the
+maybe_stream_checkpoint boundary anchoring that was untested edge
+logic before this PR."""
+
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.ps.checkpoint import (
+    AsyncCheckpointer,
+    SparseCheckpointSaver,
+)
+from elasticdl_tpu.ps.embedding_store import (
+    NumpyEmbeddingStore,
+    native_lib,
+)
+
+BACKENDS = ["numpy"] + (["native"] if native_lib() is not None else [])
+
+
+def make_store(backend, opt_type="adam", seed=0, **opt_args):
+    if backend == "native":
+        from elasticdl_tpu.ps.embedding_store import NativeEmbeddingStore
+
+        store = NativeEmbeddingStore(seed=seed)
+    else:
+        store = NumpyEmbeddingStore(seed=seed)
+    store.set_optimizer(opt_type, **opt_args)
+    store.create_table("t", 4, init_scale=0.0, initializer="zeros")
+    return store
+
+
+def full_state(store, name="t"):
+    """(ids, rows, steps) sorted by id — order-free comparison key."""
+    ids, rows, steps = store.export_table_full(name)
+    order = np.argsort(ids)
+    return ids[order], rows[order], steps[order]
+
+
+def assert_state_equal(a, b):
+    sa, sb = full_state(a), full_state(b)
+    assert sa[0].shape == sb[0].shape
+    np.testing.assert_array_equal(sa[0], sb[0])
+    np.testing.assert_array_equal(sa[1], sb[1])
+    np.testing.assert_array_equal(sa[2], sb[2])
+
+
+# ---------------------------------------------------------------------------
+# dirty-row tracking
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_dirty_tracking_snapshot_and_clear(backend):
+    store = make_store(backend)
+    ids = np.arange(8, dtype=np.int64)
+    store.push_gradients("t", ids, np.ones((8, 4), np.float32))
+    # a lookup that MATERIALIZES a row is a state change; re-reading a
+    # resident row is not
+    store.lookup("t", np.array([99, 3], np.int64))
+    assert store.dirty_count("t") == 9
+    d_ids, d_rows, d_steps, dead = store.export_table_dirty("t")
+    # ids ascending (deterministic files), full train-state width
+    np.testing.assert_array_equal(
+        d_ids, np.array([0, 1, 2, 3, 4, 5, 6, 7, 99])
+    )
+    assert d_rows.shape == (9, 4 * (1 + store.table_slots("t")))
+    assert dead.size == 0
+    # snapshot CLEARED: nothing dirty until the next mutation
+    assert store.dirty_count("t") == 0
+    assert store.export_table_dirty("t")[0].size == 0
+    store.push_gradients(
+        "t", np.array([3], np.int64), np.ones((1, 4), np.float32)
+    )
+    assert store.dirty_count("t") == 1
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_drop_rows_moves_dirty_to_dead(backend):
+    store = make_store(backend)
+    ids = np.arange(4, dtype=np.int64)
+    store.push_gradients("t", ids, np.ones((4, 4), np.float32))
+    assert store.drop_rows("t", np.array([1, 2, 77], np.int64)) == 2
+    d_ids, _, _, dead = store.export_table_dirty("t")
+    np.testing.assert_array_equal(d_ids, np.array([0, 3]))
+    # only rows that EXISTED are tombstoned (77 never materialized)
+    np.testing.assert_array_equal(dead, np.array([1, 2]))
+    # a re-materialized id leaves the dead set again
+    store.push_gradients(
+        "t", np.array([1], np.int64), np.ones((1, 4), np.float32)
+    )
+    d_ids, _, _, dead = store.export_table_dirty("t")
+    np.testing.assert_array_equal(d_ids, np.array([1]))
+    assert dead.size == 0
+
+
+def test_dirty_export_parity_numpy_native():
+    if native_lib() is None:
+        pytest.skip("no native lib")
+    stores = [make_store(b) for b in ("numpy", "native")]
+    rng = np.random.RandomState(7)
+    for step in range(5):
+        ids = rng.randint(0, 40, size=12).astype(np.int64)
+        ids = np.unique(ids)
+        grads = rng.randn(ids.size, 4).astype(np.float32)
+        for store in stores:
+            store.push_gradients("t", ids, grads)
+        if step == 2:
+            for store in stores:
+                store.drop_rows("t", np.array([5, 6], np.int64))
+    exports = [s.export_table_dirty("t") for s in stores]
+    for a, b in zip(*exports):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# delta chain format
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_chain_restore_bit_identical_to_full(backend, tmp_path):
+    """The acceptance shape: base + deltas (with tombstones) restores
+    bit-identically to a full save of the same live store, and
+    tombstoned ids stay dead."""
+    live = make_store(backend)
+    rng = np.random.RandomState(0)
+    saver = SparseCheckpointSaver(str(tmp_path / "chain"),
+                                  compact_every=10)
+    ids = np.arange(50, dtype=np.int64)
+    live.push_gradients("t", ids, rng.randn(50, 4).astype(np.float32))
+    assert saver.save(1, live).kind == "full"
+    for v in range(2, 6):
+        sub = np.unique(rng.randint(0, 60, size=8)).astype(np.int64)
+        live.push_gradients(
+            "t", sub, rng.randn(sub.size, 4).astype(np.float32)
+        )
+        live.drop_rows("t", np.array([40 + v], np.int64))
+        result = saver.save(v, live)
+        assert result.kind == "delta"
+        assert result.chain_len == v - 1
+    # reference: an independent FULL save of the same live state
+    SparseCheckpointSaver(str(tmp_path / "full")).save(5, live)
+
+    from_chain = make_store(backend, seed=1)
+    from_full = make_store(backend, seed=2)
+    assert SparseCheckpointSaver(
+        str(tmp_path / "chain")
+    ).restore(from_chain) == 5
+    assert SparseCheckpointSaver(
+        str(tmp_path / "full")
+    ).restore(from_full) == 5
+    assert_state_equal(from_chain, from_full)
+    assert_state_equal(from_chain, live)
+    resident = set(from_chain.export_table_full("t")[0].tolist())
+    for v in range(2, 6):
+        assert 40 + v not in resident, "tombstoned id resurrected"
+
+
+def test_chain_interop_numpy_native_bit_exact(tmp_path):
+    """A chain written from the numpy store restores into the native
+    store bit-exactly, and vice versa (the checkpoint is the interop
+    boundary between backends)."""
+    if native_lib() is None:
+        pytest.skip("no native lib")
+    for writer_backend, reader_backend in (
+        ("numpy", "native"), ("native", "numpy"),
+    ):
+        ckpt = tmp_path / ("chain-" + writer_backend)
+        writer = make_store(writer_backend)
+        rng = np.random.RandomState(3)
+        saver = SparseCheckpointSaver(str(ckpt), compact_every=8)
+        ids = np.arange(20, dtype=np.int64)
+        writer.push_gradients(
+            "t", ids, rng.randn(20, 4).astype(np.float32)
+        )
+        saver.save(1, writer)
+        writer.drop_rows("t", np.array([4], np.int64))
+        writer.push_gradients(
+            "t", ids[:6], rng.randn(6, 4).astype(np.float32)
+        )
+        saver.save(2, writer)
+        reader = make_store(reader_backend, seed=9)
+        assert SparseCheckpointSaver(str(ckpt)).restore(reader) == 2
+        assert_state_equal(reader, writer)
+
+
+def test_old_full_format_still_restores(tmp_path):
+    """A pre-ISSUE-13 checkpoint dir (full base only, written by the
+    old non-atomic saver) is a chain of length zero."""
+    store = make_store("numpy")
+    ids = np.arange(6, dtype=np.int64)
+    store.push_gradients("t", ids, np.ones((6, 4), np.float32))
+    arrays = {}
+    full_ids, rows, steps = store.export_table_full("t")
+    arrays["ids/t"] = full_ids
+    arrays["fullrows/t"] = rows
+    arrays["steps/t"] = steps
+    arrays["dim/t"] = np.int64(4)
+    arrays["opt/t"] = np.str_(store.opt_type)
+    vdir = tmp_path / "version-7"
+    vdir.mkdir(parents=True)
+    np.savez(str(vdir / "embeddings-0-of-1.npz"), **arrays)
+    restored = make_store("numpy", seed=1)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored) == 7
+    assert_state_equal(restored, store)
+
+
+def test_compaction_bounds_chain_and_gc_retires_old_chains(tmp_path):
+    store = make_store("numpy")
+    saver = SparseCheckpointSaver(str(tmp_path), keep_max=2,
+                                  compact_every=2)
+    ids = np.arange(4, dtype=np.int64)
+    version = 0
+    for round_ in range(4):
+        for _ in range(3):
+            version += 1
+            store.push_gradients(
+                "t", ids, np.ones((4, 4), np.float32)
+            )
+            saver.save(version, store)
+    # every 3rd save compacts (base + 2 deltas per chain); keep_max=2
+    chains = sorted(os.listdir(str(tmp_path)))
+    assert len(chains) == 2, chains
+    for chain in chains:
+        names = sorted(os.listdir(str(tmp_path / chain)))
+        assert names == [
+            "delta-1-embeddings-0-of-1.npz",
+            "delta-2-embeddings-0-of-1.npz",
+            "embeddings-0-of-1.npz",
+        ], names
+    restored = make_store("numpy", seed=1)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored) == 12
+    assert_state_equal(restored, store)
+
+
+# ---------------------------------------------------------------------------
+# torn files / crash windows
+
+
+def _build_chain(tmp_path, deltas=3):
+    store = make_store("numpy")
+    saver = SparseCheckpointSaver(str(tmp_path), compact_every=10)
+    rng = np.random.RandomState(1)
+    states = []
+    ids = np.arange(10, dtype=np.int64)
+    store.push_gradients("t", ids, rng.randn(10, 4).astype(np.float32))
+    saver.save(1, store)
+    states.append(full_state(store))
+    for v in range(2, 2 + deltas):
+        store.push_gradients(
+            "t", ids[:3], rng.randn(3, 4).astype(np.float32)
+        )
+        saver.save(v, store)
+        states.append(full_state(store))
+    return store, states
+
+
+def test_torn_delta_truncates_chain_to_newest_complete_prefix(tmp_path):
+    _, states = _build_chain(tmp_path, deltas=3)
+    vdir = tmp_path / "version-1"
+    # SIGKILL mid-delta-write: the newest delta is a truncated npz
+    path = vdir / "delta-3-embeddings-0-of-1.npz"
+    raw = path.read_bytes()
+    path.write_bytes(raw[: len(raw) // 2])
+    assert SparseCheckpointSaver.latest_version(str(tmp_path)) == 3
+    restored = make_store("numpy", seed=1)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored) == 3
+    ids, rows, steps = full_state(restored)
+    np.testing.assert_array_equal(rows, states[2][1])
+    # a gap poisons everything past it: drop delta-2 entirely, the
+    # intact delta-3 copy must NOT be replayed over delta-1 state
+    path.write_bytes(raw)
+    os.unlink(str(vdir / "delta-2-embeddings-0-of-1.npz"))
+    restored2 = make_store("numpy", seed=2)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored2) == 2
+    np.testing.assert_array_equal(full_state(restored2)[1], states[1][1])
+
+
+def test_tmp_files_are_invisible_to_restore_and_completeness(tmp_path):
+    _, states = _build_chain(tmp_path, deltas=1)
+    vdir = tmp_path / "version-1"
+    # crash mid-write leaves only .tmp siblings — never counted as
+    # shards, never opened by restore
+    (vdir / "delta-2-embeddings-0-of-1.npz.tmp").write_bytes(b"torn")
+    (vdir / "embeddings-9-of-9.npz.tmp").write_bytes(b"torn")
+    assert SparseCheckpointSaver.latest_version(str(tmp_path)) == 2
+    restored = make_store("numpy", seed=1)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored) == 2
+    np.testing.assert_array_equal(full_state(restored)[1], states[1][1])
+
+
+def test_drop_table_replays_as_table_tombstone(tmp_path):
+    """A table dropped after the chain's base must NOT resurrect at
+    restore: every delta records the live table set, so a table absent
+    from the newest delta replays as drop_table — the table-level twin
+    of the row tombstones."""
+    store = make_store("numpy")
+    store.create_table("t2", 4, init_scale=0.0, initializer="zeros")
+    ids = np.arange(4, dtype=np.int64)
+    store.push_gradients("t", ids, np.ones((4, 4), np.float32))
+    store.push_gradients("t2", ids, np.ones((4, 4), np.float32))
+    saver = SparseCheckpointSaver(str(tmp_path), compact_every=8)
+    saver.save(2, store)
+    store.drop_table("t2")
+    store.push_gradients("t", ids[:2], np.ones((2, 4), np.float32))
+    assert saver.save(3, store).kind == "delta"
+    restored = make_store("numpy", seed=1)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored) == 3
+    assert restored.table_names() == ["t"]
+    assert_state_equal(restored, store)
+
+
+def test_middle_delta_corruption_latest_version_matches_restore(
+    tmp_path,
+):
+    """latest_version and restore walk the chain the same way: a bad
+    MIDDLE delta truncates both at the same point, so a poller that
+    waits on latest_version never observes a restore anchored below
+    what it promised."""
+    _, states = _build_chain(tmp_path, deltas=3)
+    path = tmp_path / "version-1" / "delta-2-embeddings-0-of-1.npz"
+    raw = path.read_bytes()
+    path.write_bytes(raw[: len(raw) // 2])  # delta-3 stays intact
+    assert SparseCheckpointSaver.latest_version(str(tmp_path)) == 2
+    restored = make_store("numpy", seed=1)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored) == 2
+    np.testing.assert_array_equal(full_state(restored)[1], states[1][1])
+
+
+def test_concurrent_inline_saves_are_serialized(tmp_path):
+    """EDL_CKPT_ASYNC=0 runs saves in the push handlers, and two
+    handlers can trip the cadence concurrently — the saver must
+    serialize them (unserialized, both write the same delta-<k>
+    through the same .tmp path and corrupt the chain)."""
+    store = make_store("numpy", opt_type="sgd", lr=0.1)
+    ids = np.arange(8, dtype=np.int64)
+    store.push_gradients("t", ids, np.ones((8, 4), np.float32))
+    saver = SparseCheckpointSaver(str(tmp_path), compact_every=100)
+    saver.save(1, store)
+    errors = []
+    barrier = threading.Barrier(4)
+
+    def hammer(tid):
+        try:
+            barrier.wait(5)
+            for i in range(10):
+                store.push_gradients(
+                    "t", ids[:2], np.ones((2, 4), np.float32)
+                )
+                saver.save(2 + tid * 10 + i, store)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors
+    # quiesced final save: the chain must be intact (no torn/dup
+    # delta indices) and restore to exactly the live state
+    saver.save(999, store)
+    restored = make_store("numpy", opt_type="sgd", lr=0.1, seed=1)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored) == 999
+    assert_state_equal(restored, store)
+
+
+def test_stale_delta_from_old_generation_never_replays(tmp_path):
+    """A full base saved into a version dir that still holds another
+    generation's delta files (colliding version across process lives)
+    must NOT have those deltas replayed over it — the chain token
+    pins every delta to the base that minted it."""
+    old = make_store("numpy")
+    ids = np.arange(5, dtype=np.int64)
+    old.push_gradients("t", ids, np.ones((5, 4), np.float32))
+    saver1 = SparseCheckpointSaver(str(tmp_path), compact_every=8)
+    saver1.save(3, old)
+    old.push_gradients("t", ids, np.full((5, 4), 9.0, np.float32))
+    assert saver1.save(4, old).kind == "delta"  # gen-1 delta lingers
+
+    new = make_store("numpy", seed=1)
+    new.push_gradients("t", ids, np.full((5, 4), 0.5, np.float32))
+    saver2 = SparseCheckpointSaver(str(tmp_path), compact_every=8)
+    # same version dir, new generation: the gen-1 delta-1 file is
+    # still on disk beside the fresh base
+    saver2.save(3, new, force_full=True)
+    assert os.path.exists(
+        str(tmp_path / "version-3" / "delta-1-embeddings-0-of-1.npz")
+    )
+    assert SparseCheckpointSaver.latest_version(str(tmp_path)) == 3
+    restored = make_store("numpy", seed=2)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored) == 3
+    assert_state_equal(restored, new)
+    # a delta of the NEW generation appends and replays normally
+    new.push_gradients("t", ids[:2], np.ones((2, 4), np.float32))
+    assert saver2.save(4, new).kind == "delta"
+    restored2 = make_store("numpy", seed=3)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored2) == 4
+    assert_state_equal(restored2, new)
+
+
+def test_crash_mid_compaction_falls_back_to_previous_chain(tmp_path):
+    store, states = _build_chain(tmp_path, deltas=2)
+    # a compaction that died mid-base-write: newer version dir whose
+    # base shard is truncated
+    vdir = tmp_path / "version-9"
+    vdir.mkdir()
+    good = tmp_path / "version-1" / "embeddings-0-of-1.npz"
+    raw = good.read_bytes()
+    (vdir / "embeddings-0-of-1.npz").write_bytes(raw[: len(raw) // 3])
+    restored = make_store("numpy", seed=1)
+    assert SparseCheckpointSaver(str(tmp_path)).restore(restored) == 3
+    np.testing.assert_array_equal(full_state(restored)[1], states[2][1])
+
+
+# ---------------------------------------------------------------------------
+# AsyncCheckpointer
+
+
+def test_async_checkpointer_coalesces_bursts():
+    gate = threading.Event()
+    saved = []
+
+    def slow_save(version, kind):
+        gate.wait(5.0)
+        saved.append((version, kind))
+
+    ckpt = AsyncCheckpointer(slow_save)
+    assert ckpt.request(1, "sparse")
+    # wait for the thread to take request 1 into flight, then burst:
+    # 2..5 arrive while 1 is saving — they coalesce to ONE trailing
+    # save at the newest version
+    deadline = time.time() + 5
+    while not ckpt._in_flight and time.time() < deadline:
+        time.sleep(0.01)
+    assert ckpt._in_flight
+    for v in range(2, 6):
+        assert ckpt.request(v, "sparse")
+    gate.set()
+    assert ckpt.drain(timeout=10)
+    assert saved == [(1, "sparse"), (5, "sparse")]
+    assert ckpt.coalesced == 3
+    ckpt.stop()
+    assert not ckpt.request(6, "sparse"), "request after stop"
+
+
+def test_async_checkpointer_survives_save_failure():
+    calls = []
+
+    def flaky(version, kind):
+        calls.append(version)
+        if version == 1:
+            raise RuntimeError("disk full")
+
+    ckpt = AsyncCheckpointer(flaky)
+    ckpt.request(1)
+    assert ckpt.drain(timeout=10)
+    ckpt.request(2)
+    assert ckpt.drain(timeout=10)
+    assert calls == [1, 2]
+    ckpt.stop()
+
+
+# ---------------------------------------------------------------------------
+# servicer integration: off-RPC saves + boundary anchoring
+
+
+def make_servicer(tmp_path, monkeypatch, ckpt_async, checkpoint_steps=0,
+                  restored_version=None, compact_every=None):
+    from elasticdl_tpu.ps.servicer import PserverServicer
+
+    monkeypatch.setenv("EDL_CKPT_ASYNC", "1" if ckpt_async else "0")
+    if compact_every is not None:
+        monkeypatch.setenv("EDL_CKPT_COMPACT_EVERY", str(compact_every))
+    store = make_store("numpy", opt_type="sgd", lr=1.0)
+    saver = SparseCheckpointSaver(str(tmp_path))
+    servicer = PserverServicer(
+        store, use_async=True, checkpoint_saver=saver,
+        checkpoint_steps=checkpoint_steps,
+        restored_version=restored_version,
+    )
+    return servicer, store, saver
+
+
+def push(servicer, ids, value=1.0):
+    from elasticdl_tpu.common.tensor_utils import serialize_indexed_slices
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    ids = np.asarray(ids, np.int64)
+    request = pb.PushGradientsRequest()
+    serialize_indexed_slices(
+        np.full((ids.size, 4), value, np.float32), ids,
+        request.gradients.embedding_tables["t"],
+    )
+    return servicer.push_gradients(request)
+
+
+def test_push_path_only_enqueues_and_save_lands_async(
+    tmp_path, monkeypatch,
+):
+    servicer, store, _ = make_servicer(
+        tmp_path, monkeypatch, ckpt_async=True, checkpoint_steps=1,
+    )
+    assert push(servicer, [0, 1]).accepted
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if SparseCheckpointSaver.latest_version(str(tmp_path)) == 1:
+            break
+        time.sleep(0.05)
+    assert SparseCheckpointSaver.latest_version(str(tmp_path)) == 1
+    assert servicer._ckpt_async is not None
+    # the saved state restores what the push applied
+    restored = make_store("numpy", opt_type="sgd", lr=1.0, seed=1)
+    servicer.finish_checkpoints()
+    SparseCheckpointSaver(str(tmp_path)).restore(restored)
+    np.testing.assert_array_equal(
+        restored.lookup("t", np.array([0], np.int64)),
+        store.lookup("t", np.array([0], np.int64)),
+    )
+
+
+def test_graceful_stop_final_full_save_supersedes_pending(
+    tmp_path, monkeypatch,
+):
+    servicer, store, _ = make_servicer(
+        tmp_path, monkeypatch, ckpt_async=True, checkpoint_steps=1,
+        compact_every=8,
+    )
+    for i in range(4):
+        assert push(servicer, [i]).accepted
+    servicer.graceful_stop()
+    # the final save is synchronous, FULL, and at the final version —
+    # whatever the async thread had pending is superseded
+    restored = make_store("numpy", opt_type="sgd", lr=1.0, seed=1)
+    assert SparseCheckpointSaver(
+        str(tmp_path)
+    ).restore(restored) == store.version
+    assert_state_equal(restored, store)
+    vdir = str(tmp_path / ("version-%d" % store.version))
+    assert os.path.exists(
+        os.path.join(vdir, "embeddings-0-of-1.npz")
+    ), "final save must be a full base"
+
+
+def test_inline_mode_saves_synchronously(tmp_path, monkeypatch):
+    servicer, store, _ = make_servicer(
+        tmp_path, monkeypatch, ckpt_async=False, checkpoint_steps=2,
+    )
+    assert servicer._ckpt_async is None
+    push(servicer, [0])
+    assert SparseCheckpointSaver.latest_version(str(tmp_path)) is None
+    push(servicer, [1])
+    # inline: the save completed before the push RPC returned
+    assert SparseCheckpointSaver.latest_version(str(tmp_path)) == 2
+
+
+# maybe_stream_checkpoint boundary anchoring (ps/servicer.py — the
+# fresh-boot vs restored-boot `_stream_ckpt_boundary` paths)
+
+
+def test_stream_boundary_fresh_boot_saves_from_first_crossing(
+    tmp_path, monkeypatch,
+):
+    servicer, store, _ = make_servicer(
+        tmp_path, monkeypatch, ckpt_async=False,
+    )
+    push(servicer, [0])
+    # below the first boundary: anchors at 0, nothing saved
+    assert not servicer.maybe_stream_checkpoint(50, 100)
+    assert servicer._stream_ckpt_boundary == 0
+    # first crossing saves; repeated watermarks inside the same
+    # boundary do not
+    assert servicer.maybe_stream_checkpoint(250, 100)
+    assert servicer._stream_ckpt_boundary == 2
+    assert not servicer.maybe_stream_checkpoint(260, 100)
+    assert servicer.maybe_stream_checkpoint(300, 100)
+    assert servicer._stream_ckpt_boundary == 3
+    assert SparseCheckpointSaver.latest_version(
+        str(tmp_path)
+    ) == store.version
+
+
+def test_stream_boundary_restored_boot_anchors_at_first_watermark(
+    tmp_path, monkeypatch,
+):
+    servicer, store, _ = make_servicer(
+        tmp_path, monkeypatch, ckpt_async=False, restored_version=5,
+    )
+    push(servicer, [0])
+    # a restored PS anchors at its first observed watermark WITHOUT
+    # saving: the predecessor already covered those boundaries
+    assert not servicer.maybe_stream_checkpoint(250, 100)
+    assert servicer._stream_ckpt_boundary == 2
+    assert SparseCheckpointSaver.latest_version(str(tmp_path)) is None
+    assert not servicer.maybe_stream_checkpoint(299, 100)
+    # the next boundary after the anchor saves
+    assert servicer.maybe_stream_checkpoint(300, 100)
+    assert SparseCheckpointSaver.latest_version(
+        str(tmp_path)
+    ) == store.version
+
+
+def test_stream_boundary_guards(tmp_path, monkeypatch):
+    servicer, _, _ = make_servicer(
+        tmp_path, monkeypatch, ckpt_async=False,
+    )
+    assert not servicer.maybe_stream_checkpoint(0, 100)   # no watermark
+    assert not servicer.maybe_stream_checkpoint(100, 0)   # cadence off
+    from elasticdl_tpu.ps.servicer import PserverServicer
+
+    saverless = PserverServicer(
+        make_store("numpy", opt_type="sgd", lr=1.0), use_async=True,
+    )
+    assert not saverless.maybe_stream_checkpoint(100, 10)  # no saver

@@ -19,9 +19,12 @@ def test_save_restore_and_gc(tmp_path):
     ids = np.arange(20, dtype=np.int64)
     values = np.random.RandomState(0).rand(20, 4).astype(np.float32)
     store.import_table("t", ids, values)
-    saver = SparseCheckpointSaver(ckpt_dir, shard_id=0, shard_num=1, keep_max=2)
+    # compact_every=0: every save is a full base (the pre-ISSUE-13
+    # behavior — chain GC is covered by test_chain_gc below)
+    saver = SparseCheckpointSaver(ckpt_dir, shard_id=0, shard_num=1,
+                                  keep_max=2, compact_every=0)
     for version in (5, 10, 15):
-        saver.save(version, store)
+        assert saver.save(version, store).kind == "full"
     # GC keeps only the last two complete versions
     remaining = sorted(os.listdir(ckpt_dir))
     assert remaining == ["version-10", "version-15"]

@@ -538,6 +538,67 @@ def test_perf_gil_held_apply_scoped_to_servicer_modules():
 
 
 # ---------------------------------------------------------------------------
+# perf-io-under-lock (ISSUE 13)
+
+IO_UNDER_LOCK = """
+    import numpy as np
+
+    class Servicer:
+        def push(self, request, version):
+            with self._push_lock:
+                self._apply(request)
+                self._checkpoint_saver.save(version, self._store)  # BUG
+
+        def snapshot(self, path, arrays):
+            with self._store_lock:
+                np.savez(path, **arrays)  # BUG
+"""
+
+
+def test_perf_io_under_lock_flags_savez_and_saver_call():
+    findings = findings_for(
+        IO_UNDER_LOCK, path="elasticdl_tpu/ps/servicer.py",
+        rules=["perf-io-under-lock"],
+    )
+    assert len(findings) == 2
+    assert all(f.rule == "perf-io-under-lock" for f in findings)
+    assert any("savez" in f.message for f in findings)
+    assert any("save" in f.message for f in findings)
+
+
+def test_perf_io_under_lock_quiet_when_io_hoisted():
+    # the ISSUE-13 shape: snapshot under the lock (export_table_dirty
+    # inside save() takes it internally), serialize+write outside —
+    # and non-lock with-blocks (spans, np.load file handles) are not
+    # this rule's business
+    assert not findings_for("""
+        import numpy as np
+
+        class Saver:
+            def save(self, version, store):
+                with self._cond:
+                    version, kind = self._pending
+                    self._pending = None
+                arrays = self._export(store)
+                np.savez(self._path(version), **arrays)
+
+            def read(self, path):
+                with np.load(path) as data:
+                    return dict(data)
+    """, path="elasticdl_tpu/ps/checkpoint.py",
+        rules=["perf-io-under-lock"])
+
+
+def test_perf_io_under_lock_scoped_to_ps_modules():
+    # a write-through journal holding its lock across the append is a
+    # deliberate durability choice outside ps/ (observability/events)
+    assert not findings_for(
+        IO_UNDER_LOCK, path="elasticdl_tpu/observability/events.py",
+        rules=["perf-io-under-lock"],
+    )
+
+
+# ---------------------------------------------------------------------------
 # xhost-determinism
 
 def test_determinism_flags_set_iteration_in_checkpoint_path():

@@ -89,20 +89,28 @@ def test_ps_sigkill_midstream_lifecycle_restore(tmp_path, monkeypatch):
             "LFU sweep did not evict the cold tail: %r" % evicted_rows
         )
         # cross a checkpoint boundary AFTER the sweep so the restored
-        # state carries the tombstones
+        # state carries the tombstones (as delta tombstones now: the
+        # chain's base predates the sweep, so the eviction must replay
+        # as a delete at restore). latest_version is the chain's
+        # EFFECTIVE version — saves append deltas to one version dir,
+        # and the off-RPC checkpoint thread lands them asynchronously.
+        from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
+
         for _ in range(4):
             push(hot)
         deadline = time.time() + 30
+        effective = None
         while time.time() < deadline:
-            versions = [
-                d for d in os.listdir(str(ckpt_dir))
-                if d.startswith("version-")
-                and int(d.split("-")[1]) >= 9
-            ]
-            if versions:
+            effective = SparseCheckpointSaver.latest_version(
+                str(ckpt_dir)
+            )
+            if effective is not None and effective >= 9:
                 break
             time.sleep(0.2)
-        assert versions, "no post-sweep checkpoint landed"
+        assert effective is not None and effective >= 9, (
+            "no post-sweep checkpoint landed (effective version %r)"
+            % effective
+        )
         hot_before = client.pull_embedding_vectors("t", hot)
 
         proc.send_signal(signal.SIGKILL)
@@ -155,6 +163,135 @@ def test_ps_sigkill_midstream_lifecycle_restore(tmp_path, monkeypatch):
         assert not np.allclose(
             client2.pull_embedding_vectors("t", novel), 0.0
         ), "novel id never re-admitted after restore"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=15)
+
+
+def test_ps_sigkill_torn_chain_restores_newest_complete_then_sigterm_full_save(
+    tmp_path, monkeypatch,
+):
+    """ISSUE 13 chaos: SIGKILL a real PS running incremental (delta)
+    checkpoints, then emulate the two crash windows the format creates
+    — a torn newest delta (died mid-delta-write) and a torn newer base
+    dir (died mid-compaction) — plus a stray ``.tmp`` from the atomic
+    writer. The same-dir relaunch must restore exactly the newest
+    COMPLETE chain prefix (bit-compared against an offline numpy
+    restore of the doctored dir). Then SIGTERM the relaunch:
+    ``graceful_stop``'s synchronous final FULL save must still land as
+    a complete base at the final version."""
+    from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
+    from elasticdl_tpu.ps.embedding_store import NumpyEmbeddingStore
+
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    monkeypatch.setenv("EDL_CKPT_COMPACT_EVERY", "6")
+    for knob in ("EDL_EMB_ADMIT_K", "EDL_EMB_MAX_ROWS",
+                 "EDL_EMB_TTL_SECS"):
+        monkeypatch.delenv(knob, raising=False)
+    extra = ["--checkpoint_dir", str(ckpt_dir), "--checkpoint_steps",
+             "2", "--seed", "0"]
+    proc, port = spawn_ps_process(
+        opt_type="sgd", opt_args="lr=1.0", use_async=True,
+        log_path=str(tmp_path / "ps-first.log"), extra=extra,
+    )
+    ids = np.arange(8, dtype=np.int64)
+    try:
+        client = PSClient(["localhost:%d" % port], worker_id=0)
+        client.push_embedding_table_infos([("t", 4, "zeros")])
+
+        def push(c, value=0.25):
+            grads = {"t": (np.full((ids.size, 4), value, np.float32),
+                           ids)}
+            assert c.push_gradients(grads, model_version=0).accepted
+
+        for _ in range(8):
+            push(client)
+        # the off-RPC checkpoint thread lands base + deltas shortly
+        # after the triggering pushes return
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (SparseCheckpointSaver.latest_version(str(ckpt_dir))
+                    or 0) >= 4:
+                break
+            time.sleep(0.2)
+        assert (SparseCheckpointSaver.latest_version(str(ckpt_dir))
+                or 0) >= 4, "no delta chain landed before the kill"
+        chains = sorted(
+            d for d in os.listdir(str(ckpt_dir))
+            if d.startswith("version-")
+        )
+        deltas = sorted(
+            f for f in os.listdir(str(ckpt_dir / chains[0]))
+            if f.startswith("delta-")
+        )
+        assert deltas, "checkpoints never went incremental"
+
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+        # crash-window emulation on the dead PS's dir:
+        # (a) mid-delta-write — truncate the newest delta file
+        vdir = ckpt_dir / chains[-1]
+        newest = sorted(
+            (f for f in os.listdir(str(vdir))
+             if f.startswith("delta-")),
+            key=lambda f: int(f.split("-")[1]),
+        )[-1]
+        torn = vdir / newest
+        torn.write_bytes(torn.read_bytes()[:100])
+        # (b) mid-compaction — a newer version dir with a torn base
+        comp = ckpt_dir / "version-9999"
+        comp.mkdir()
+        (comp / "embeddings-0-of-1.npz").write_bytes(b"torn-base")
+        # (c) the atomic writer's crash residue
+        (vdir / "delta-99-embeddings-0-of-1.npz.tmp").write_bytes(b"x")
+
+        # offline expectation: what the newest complete prefix holds
+        offline = NumpyEmbeddingStore(seed=0)
+        offline.set_optimizer("sgd", lr=1.0)
+        expected_version = SparseCheckpointSaver(
+            str(ckpt_dir)
+        ).restore(offline)
+        assert expected_version is not None
+        expected_rows = offline.lookup("t", ids)
+        assert not np.allclose(expected_rows, 0.0)
+
+        proc, _ = spawn_ps_process(
+            opt_type="sgd", opt_args="lr=1.0", use_async=True,
+            log_path=str(tmp_path / "ps-relaunch.log"), extra=extra,
+            port=port,
+        )
+        client2 = PSClient(["localhost:%d" % port], worker_id=1)
+        client2.push_embedding_table_infos([("t", 4, "zeros")])
+        restored_rows = client2.pull_embedding_vectors("t", ids)
+        np.testing.assert_array_equal(restored_rows, expected_rows)
+
+        # graceful_stop keeps its synchronous final FULL save: push
+        # past the restored state, SIGTERM, and require a complete
+        # base at the final version that restores the live state
+        for _ in range(3):
+            push(client2, value=0.125)
+        final_rows = client2.pull_embedding_vectors("t", ids)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0, "SIGTERM drain failed"
+        final_version = SparseCheckpointSaver.latest_version(
+            str(ckpt_dir)
+        )
+        assert final_version is not None
+        final_dir = ckpt_dir / ("version-%d" % final_version)
+        assert (final_dir / "embeddings-0-of-1.npz").exists(), (
+            "final save was not a full base"
+        )
+        offline2 = NumpyEmbeddingStore(seed=0)
+        offline2.set_optimizer("sgd", lr=1.0)
+        assert SparseCheckpointSaver(
+            str(ckpt_dir)
+        ).restore(offline2) == final_version
+        np.testing.assert_array_equal(
+            offline2.lookup("t", ids), final_rows
+        )
     finally:
         if proc.poll() is None:
             proc.kill()
