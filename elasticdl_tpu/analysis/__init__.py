@@ -17,6 +17,11 @@ for (or refuses to pay for):
   blocks in modules that bypass ``build_channel``: the trace context
   propagates only through the channel interceptor, so a raw-channel
   stub call orphans the remote half of the trace.
+- ``obs-deterministic-tracer`` — no ``sys.settrace`` /
+  ``sys.setprofile`` / ``threading.settrace``/``setprofile`` outside
+  ``observability/profiler.py`` and tests: a deterministic tracer in a
+  role costs orders of magnitude more than the 29 Hz sampling
+  profiler, and does it silently.
 - ``ft-swallowed-except`` / ``ft-grpc-timeout`` — fault-tolerance
   hygiene: no broad except that swallows without logging/re-raising,
   no gRPC stub call without a deadline.

@@ -217,6 +217,7 @@ def _rules_by_name(names=None):
     # imported here to avoid a cycle (rule modules import core helpers)
     from elasticdl_tpu.analysis import (
         determinism,
+        deterministic_tracer,
         fault_tolerance,
         hot_path,
         lock_discipline,
@@ -235,6 +236,7 @@ def _rules_by_name(names=None):
         "jax-hot-path": hot_path.run,
         "obs-hot-path": obs_hot_path.run,
         "obs-span-no-context": obs_span.run,
+        "obs-deterministic-tracer": deterministic_tracer.run,
         "perf-varint-ids": perf_wire.run,
         "perf-host-gather": perf_gather.run,
         "perf-gil-held-apply": perf_gil.run,
@@ -260,6 +262,7 @@ RULE_NAMES = (
     "jax-hot-path",
     "obs-hot-path",
     "obs-span-no-context",
+    "obs-deterministic-tracer",
     "perf-varint-ids",
     "perf-host-gather",
     "perf-gil-held-apply",

@@ -1,0 +1,112 @@
+"""obs-deterministic-tracer: no sys.settrace/setprofile outside the
+sampling profiler.
+
+The continuous profiler (``observability/profiler.py``, ISSUE 14) is a
+SAMPLING profiler on purpose: walking ``sys._current_frames()`` at
+29 Hz costs <3% (CI-gated). A *deterministic* tracer —
+``sys.settrace``, ``sys.setprofile``, or their ``threading`` twins
+that arm every future thread — fires a Python callback on EVERY call
+(or every line), which costs orders of magnitude more and, worse, does
+it silently: the job still trains, just several times slower, and the
+regression looks like "the PS got slow" instead of "someone left a
+tracer armed". Coverage/debug tooling that reaches a role main through
+an import side effect is exactly how this ships by accident.
+
+What fires: any call whose target resolves to ``sys.settrace``,
+``sys.setprofile``, ``threading.settrace``, ``threading.setprofile``
+(plus the 3.12 ``*_all_threads`` variants), whether attribute-style
+(``sys.settrace(fn)``) or via a bare name imported from those modules
+(``from sys import settrace; settrace(fn)``).
+
+Exempt by path: ``observability/profiler.py`` (the one module licensed
+to own profiling machinery, even though the sampler needs no tracer)
+and anything under ``tests/`` — a test arming a tracer to assert
+framework behavior is not a production role paying for one.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import (
+    Finding,
+    attr_chain,
+    package_relative,
+    walk_with_scope,
+)
+
+RULE = "obs-deterministic-tracer"
+
+_TRACER_MODULES = ("sys", "threading")
+_TRACER_NAMES = frozenset({
+    "settrace",
+    "setprofile",
+    "settrace_all_threads",
+    "setprofile_all_threads",
+})
+_TRACER_CHAINS = frozenset(
+    "%s.%s" % (module, name)
+    for module in _TRACER_MODULES
+    for name in _TRACER_NAMES
+)
+
+
+def _exempt(path):
+    relative = package_relative(path)
+    if relative == "elasticdl_tpu/observability/profiler.py":
+        return True
+    posix = path.replace("\\", "/")
+    return "/tests/" in posix or posix.startswith("tests/")
+
+
+def _tracer_imports(tree):
+    """Bare names bound to a tracer installer by ``from sys import
+    settrace``-style imports (aliases included)."""
+    bound = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and node.module in _TRACER_MODULES
+        ):
+            for alias in node.names:
+                if alias.name in _TRACER_NAMES:
+                    bound.add(alias.asname or alias.name)
+    return bound
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        if _exempt(unit.path):
+            continue
+        bare_names = _tracer_imports(unit.tree)
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            code = None
+            if isinstance(func, ast.Attribute):
+                chain = attr_chain(func)
+                if chain in _TRACER_CHAINS:
+                    code = chain
+            elif isinstance(func, ast.Name) and func.id in bare_names:
+                code = func.id
+            if code is None:
+                continue
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.path,
+                    line=node.lineno,
+                    symbol=scope,
+                    code=code,
+                    message=(
+                        "deterministic tracer installed outside "
+                        "observability/profiler.py: %s fires a Python "
+                        "callback on every call/line — orders of "
+                        "magnitude costlier than the 29 Hz sampling "
+                        "profiler, and silently. Use the continuous "
+                        "profiler (EDL_PROF_HZ + /profilez) instead"
+                        % code
+                    ),
+                )
+            )
+    return findings

@@ -23,7 +23,7 @@ from elasticdl_tpu.master.state_store import MasterStateJournal
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.master.task_monitor import TaskMonitor
 from elasticdl_tpu.models.registry import get_model_spec
-from elasticdl_tpu.observability import events, http_server, trace
+from elasticdl_tpu.observability import events, http_server, profiler, trace
 from elasticdl_tpu.proto.services import add_master_servicer_to_server
 
 logger = _logger_factory("elasticdl_tpu.master.master")
@@ -297,6 +297,9 @@ class Master:
         trace.configure("master")
         events.configure("master")
         events.emit("role_start", port=self._port)
+        # continuous profiler (ISSUE 14): always-on when EDL_PROF_HZ is
+        # set, served as /profilez on the observability port below
+        profiler.maybe_start("master")
         if self._recovered is not None:
             # flight-recorder marker: the postmortem threads the crash,
             # the relaunch, and the resumed dispatch into one timeline

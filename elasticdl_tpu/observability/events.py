@@ -110,6 +110,11 @@ EVENT_TYPES = frozenset({
     # distributed tracing (ISSUE 9)
     "trace_flushed",         # a drain path flushed the trace buffer to
                              #   EDL_TRACE_DIR (+ reason)
+    # continuous profiling (ISSUE 14)
+    "profiler_started",      # the role's stack sampler came up
+                             #   (+ hz, ring_secs)
+    "profile_captured",      # an on-demand /profilez window capture
+                             #   completed (+ seconds, samples, stacks)
     # continual streaming training (ISSUE 12)
     "row_admitted",          # ids passed frequency admission and
                              #   materialized real rows (+ table,

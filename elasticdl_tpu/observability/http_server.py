@@ -9,6 +9,11 @@ A stdlib ``http.server`` daemon thread serving:
   initialized; worker → master channel ready), else 503 listing the
   failing checks — the pod manager's signal to hold traffic, not
   restart.
+- ``GET /profilez`` — the continuous profiler (ISSUE 14): the rolling
+  ring snapshot by default, ``?seconds=N`` for an on-demand window
+  capture, ``&format=collapsed`` for flamegraph-ready text instead of
+  JSON. Answers 404 when the profiler is disabled (``EDL_PROF_HZ``
+  unset) — the disabled state must be visible, not an empty profile.
 - role-registered JSON endpoints (``add_json_handler``): the master
   mounts ``/statusz`` (full fleet telemetry snapshot) and ``/alerts``
   (firing anomaly detectors) here — see master/fleet.py.
@@ -22,6 +27,7 @@ import http.server
 import json
 import os
 import threading
+import urllib.parse
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import metrics as metrics_mod
@@ -135,6 +141,8 @@ class ObservabilityServer:
                             503,
                             ("unready: %s\n" % ",".join(failing)).encode(),
                         )
+                elif path == "/profilez":
+                    self._serve_profilez()
                 elif path in server._json_handlers:
                     try:
                         body = json.dumps(
@@ -151,6 +159,57 @@ class ObservabilityServer:
                     self._reply(200, body, "application/json")
                 else:
                     self._reply(404, b"not found\n")
+
+            def _serve_profilez(self):
+                # imported lazily: the probe server must not pull the
+                # profiler module in for roles that never profile
+                from elasticdl_tpu.observability import profiler
+
+                sampler = profiler.sampler()
+                if sampler is None:
+                    self._reply(
+                        404,
+                        b"profiler disabled (set EDL_PROF_HZ)\n",
+                    )
+                    return
+                query = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
+                try:
+                    seconds = float(query.get("seconds", ["0"])[0] or 0)
+                except ValueError:
+                    self._reply(400, b"bad seconds parameter\n")
+                    return
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "collapsed"):
+                    self._reply(
+                        400, b"format must be json or collapsed\n"
+                    )
+                    return
+                try:
+                    # a window capture blocks only THIS handler thread
+                    # (ThreadingHTTPServer); probes keep answering
+                    snap = (
+                        sampler.capture(seconds)
+                        if seconds > 0
+                        else sampler.snapshot()
+                    )
+                except Exception as e:
+                    logger.warning("/profilez failed: %s", e)
+                    self._reply(
+                        500, ("error: %s\n" % e).encode("utf-8")
+                    )
+                    return
+                if fmt == "collapsed":
+                    self._reply(
+                        200, profiler.collapsed(snap).encode("utf-8")
+                    )
+                else:
+                    self._reply(
+                        200,
+                        json.dumps(snap).encode("utf-8"),
+                        "application/json",
+                    )
 
             def _reply(self, status, body, content_type="text/plain"):
                 self.send_response(status)

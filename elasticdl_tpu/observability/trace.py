@@ -283,6 +283,50 @@ def current_context():
     return getattr(_tls, "ctx", None)
 
 
+# ---------------------------------------------------------------------------
+# continuous-profiler correlation (ISSUE 14)
+#
+# The sampling profiler's thread cannot read another thread's
+# thread-local span stack, so while a sampler is attached each thread
+# publishes its innermost open sampled span that the profiler can MAP
+# to a critical-path segment, as {thread_ident: (trace_id, span_name)}.
+# "Mapped" matters: critical_path.py attributes an unmapped span's
+# time (rpc_attempt, ps_apply_round, future names) to its nearest
+# mapped ANCESTOR's segment, so an unmapped span must keep the
+# enclosing publication instead of overwriting it — otherwise the
+# profiler files the same wall time under "other" that the trace
+# analyzer files under pull/push/apply. The profiler passes its
+# mapped-name predicate at attach time (None = publish everything).
+# Guarded by one module-global bool check per span enter/exit, so the
+# tracing hot path pays nothing when no profiler runs; plain-dict
+# get/set under the GIL is safe for the single-writer-per-key access
+# pattern (each thread writes only its own ident; the sampler only
+# reads).
+
+_prof_spans = {}
+_prof_active = False
+_prof_mapped = None  # predicate(name) -> bool, or None = all names
+
+
+def _profiler_attach(mapped=None):
+    global _prof_active, _prof_mapped
+    _prof_mapped = mapped
+    _prof_active = True
+
+
+def _profiler_detach():
+    global _prof_active, _prof_mapped
+    _prof_active = False
+    _prof_mapped = None
+    _prof_spans.clear()
+
+
+def profiled_spans():
+    """The live {thread_ident: (trace_id, span_name)} map (read by the
+    sampler thread; empty whenever no profiler is attached)."""
+    return _prof_spans
+
+
 def _current_sink():
     return getattr(_tls, "sink", None)
 
@@ -496,6 +540,9 @@ def root_span(name, **args):
     prev_sink = getattr(_tls, "sink", None)
     _tls.ctx = ctx
     _tls.sink = sink
+    published = _prof_active and sampled
+    if published:
+        _prof_spans[threading.get_ident()] = (ctx.trace_id, name)
     _push_open(args)
     start = time.time()
     error = None
@@ -509,6 +556,8 @@ def root_span(name, **args):
         _pop_open()
         _tls.ctx = None
         _tls.sink = prev_sink
+        if published:
+            _prof_spans.pop(threading.get_ident(), None)
         keep_tail = (
             sink is not None and (end - start) * 1e3 >= tail_ms
         )
@@ -574,6 +623,16 @@ def span(name, **args):
     child = ctx.child() if ctx is not None else None
     if child is not None:
         _tls.ctx = child
+    published = (
+        _prof_active
+        and child is not None
+        and ctx.sampled
+        and (_prof_mapped is None or _prof_mapped(name))
+    )
+    if published:
+        ident = threading.get_ident()
+        prev_published = _prof_spans.get(ident)
+        _prof_spans[ident] = (ctx.trace_id, name)
     _push_open(args)
     start = time.time()
     error = None
@@ -586,6 +645,13 @@ def span(name, **args):
         _pop_open()
         if child is not None:
             _tls.ctx = ctx
+        if published:
+            # restore the enclosing span's publication (unless the
+            # profiler detached mid-span — then leave nothing behind)
+            if prev_published is not None and _prof_active:
+                _prof_spans[ident] = prev_published
+            else:
+                _prof_spans.pop(ident, None)
         if error is not None:
             _note_error(args, error)
         _emit(writer, name, start, time.time(), args,
@@ -708,6 +774,7 @@ def _reset_for_tests():
         _writer = None
     _sample_cache = (None, 1.0)
     _tail_cache = (None, 0.0)
+    _profiler_detach()
     for attr in ("ctx", "sink", "task_id", "open_args"):
         if hasattr(_tls, attr):
             delattr(_tls, attr)

@@ -17,7 +17,7 @@ import grpc
 from elasticdl_tpu.common.args import add_bool_argument
 from elasticdl_tpu.common.grpc_utils import build_server, uds_socket_path
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
-from elasticdl_tpu.observability import events, http_server, trace
+from elasticdl_tpu.observability import events, http_server, profiler, trace
 from elasticdl_tpu.ps.checkpoint import SparseCheckpointSaver
 from elasticdl_tpu.ps.embedding_store import create_store
 from elasticdl_tpu.ps.servicer import PserverServicer
@@ -235,6 +235,9 @@ class ParameterServer:
         trace.configure(role)
         events.configure(role)
         events.emit("role_start", port=self.args.port)
+        # continuous profiler (ISSUE 14): always-on when EDL_PROF_HZ is
+        # set, served as /profilez on the observability port below
+        profiler.maybe_start(role)
         if self._restored_version is not None:
             events.emit(
                 "ps_restored", version=self._restored_version,

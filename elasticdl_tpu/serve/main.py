@@ -152,7 +152,12 @@ class ServeRole:
     # ------------------------------------------------------------------
     def prepare(self):
         from elasticdl_tpu.common.grpc_utils import build_server
-        from elasticdl_tpu.observability import events, http_server, trace
+        from elasticdl_tpu.observability import (
+            events,
+            http_server,
+            profiler,
+            trace,
+        )
         from elasticdl_tpu.proto.services import (
             add_serve_servicer_to_server,
         )
@@ -162,6 +167,9 @@ class ServeRole:
         trace.configure(role)
         events.configure(role)
         events.emit("role_start", port=self.args.port)
+        # continuous profiler (ISSUE 14): always-on when EDL_PROF_HZ is
+        # set, served as /profilez on the observability port below
+        profiler.maybe_start(role)
         self.engine.start()
         self.server = build_server()
         add_serve_servicer_to_server(ServeServicer(self.engine), self.server)

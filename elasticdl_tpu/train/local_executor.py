@@ -84,11 +84,20 @@ class LocalExecutor:
         # distributed roles — the "try it on my laptop" path is also
         # the CI smoke that asserts /metrics serves the core series
         from elasticdl_tpu.common.timing_utils import Timing
-        from elasticdl_tpu.observability import events, http_server, trace
+        from elasticdl_tpu.observability import (
+            events,
+            http_server,
+            profiler,
+            trace,
+        )
 
         self._timing = Timing()
         trace.configure("local")
         events.configure("local")
+        # continuous profiler (ISSUE 14): the local executor plays the
+        # worker role, so EDL_PROF_HZ profiles it the same way — and
+        # /profilez rides the same opt-in metrics port
+        profiler.maybe_start("local")
         self.observability = http_server.maybe_start("local")
         if self.observability is not None:
             # a local run is ready as soon as the trainer exists

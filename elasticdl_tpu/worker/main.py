@@ -34,7 +34,12 @@ def main(argv=None):
 
     args = parse_worker_args(argv)
     configure_logging(args.log_level, args.log_file_path)
-    from elasticdl_tpu.observability import events, http_server, trace
+    from elasticdl_tpu.observability import (
+        events,
+        http_server,
+        profiler,
+        trace,
+    )
 
     if args.metrics_port:
         # publish the knob before any instrument (or instrumented
@@ -43,6 +48,9 @@ def main(argv=None):
         os.environ[http_server.PORT_ENV] = str(args.metrics_port)
     trace.configure("worker-%d" % args.worker_id)
     events.configure("worker-%d" % args.worker_id)
+    # continuous profiler (ISSUE 14): always-on when EDL_PROF_HZ is
+    # set, served as /profilez on the observability port below
+    profiler.maybe_start("worker-%d" % args.worker_id)
     from elasticdl_tpu.testing import faults
 
     # before any master/PS channel is built: fault specs match on role

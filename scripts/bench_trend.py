@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Bench-trend regression watchdog: the trajectory finally gets a watcher.
+
+The repo accumulates two performance records nothing reads:
+
+- ``BENCH_r*.json`` at the repo root — one per growth round, each
+  carrying ``parsed.value`` (the headline metric) plus a
+  ``parsed.extra`` dict of per-workload numbers;
+- the tier-1f CI journal (``/tmp/ci_wire_micro.jsonl`` by default) —
+  one line per bench invocation, ``{"ts": ..., "<kind>": {...}}``.
+
+This script folds both into a per-metric trajectory and flags any
+metric whose LATEST value regresses more than ``--threshold`` (default
+20%) against the best value ever recorded for it. Direction is
+inferred from the metric name (``*_ms`` / ``*latency*`` / ``*loss*`` /
+``*overhead*`` → lower is better; throughputs / ratios like
+``*steps_per_sec`` / ``*mfu*`` / ``*hit_rate*`` → higher is better).
+
+REPORT-ONLY by design, like every tier-1f number: absolute timings
+flake across boxes, so a flagged regression is a prompt to look, not a
+CI failure. The JSON report goes to stdout (journaled by ci.sh so the
+watchdog's own history is greppable); the human table to stderr. Exit
+code is 0 even with regressions; 1 only when no data was found at all.
+
+Usage:
+    python scripts/bench_trend.py [--repo-root DIR] [--journal FILE]
+        [--threshold 0.2] [-o report.json]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# name fragments that mean "smaller is better"; checked against
+# _-separated name tokens so e.g. "examples" does not match "amp"
+_LOWER_BETTER_TOKENS = frozenset({
+    "ms", "secs", "seconds", "latency", "loss", "logloss", "overhead",
+    "lag", "stall", "p50", "p99", "evictions", "misses",
+})
+
+# journal kinds that are themselves meta-reports, not bench numbers —
+# folding them back in would make the watchdog watch itself
+_SKIP_JOURNAL_KINDS = frozenset({
+    "bench_trend", "critical_path", "profile_report",
+})
+
+
+def lower_is_better(name):
+    tokens = set()
+    for part in name.replace(".", "_").split("_"):
+        tokens.add(part)
+    return bool(tokens & _LOWER_BETTER_TOKENS)
+
+
+def _flatten(prefix, value, out):
+    """Numeric leaves of a nested dict as dotted names (bools and
+    strings dropped; lists skipped — per-item series are not trends)."""
+    if isinstance(value, bool):
+        return
+    if isinstance(value, (int, float)):
+        out[prefix] = float(value)
+    elif isinstance(value, dict):
+        for key, sub in value.items():
+            _flatten("%s.%s" % (prefix, key) if prefix else str(key),
+                     sub, out)
+
+
+def load_bench_rounds(repo_root):
+    """[(label, {metric: value})] from BENCH_r*.json, oldest first."""
+    rounds = []
+    for path in sorted(glob.glob(
+        os.path.join(repo_root, "BENCH_r*.json")
+    )):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+            continue
+        parsed = payload.get("parsed") or {}
+        metrics = {}
+        name = parsed.get("metric")
+        if name and isinstance(parsed.get("value"), (int, float)):
+            metrics[str(name)] = float(parsed["value"])
+        extra = parsed.get("extra")
+        if isinstance(extra, dict):
+            _flatten("", extra, metrics)
+        label = os.path.splitext(os.path.basename(path))[0]
+        if metrics:
+            rounds.append((label, metrics))
+    return rounds
+
+
+def load_journal(path):
+    """[(label, {metric: value})] from tier-1f journal lines, in file
+    order. Metric names DROP the journal kind prefix (``wire_micro``,
+    ``serving``, ...): the bench scripts already namespace their keys
+    (``deepfm_ctr_steps_per_sec``, ``serving_p99_ms``), and it is the
+    leaf name that must line up with the same metric in the
+    ``BENCH_r*.json`` extras for the two sources to form ONE
+    trajectory. Torn lines are skipped (the journal is append-only
+    across interrupted runs)."""
+    entries = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return entries
+    for index, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue  # torn tail from an interrupted run
+        if not isinstance(record, dict):
+            continue
+        ts = record.get("ts", "")
+        for kind, payload in record.items():
+            if kind == "ts" or kind in _SKIP_JOURNAL_KINDS:
+                continue
+            if not isinstance(payload, dict):
+                continue
+            metrics = {}
+            _flatten("", payload, metrics)
+            if metrics:
+                entries.append(
+                    ("journal[%d] %s %s" % (index, ts, kind), metrics)
+                )
+    return entries
+
+
+def build_series(sources):
+    """{metric: [(label, value), ...]} in recording order."""
+    series = {}
+    for label, metrics in sources:
+        for name, value in metrics.items():
+            series.setdefault(name, []).append((label, value))
+    return series
+
+
+def analyze(series, threshold=0.2):
+    """Per-metric verdicts + the regression list."""
+    metrics = {}
+    regressions = []
+    for name, points in sorted(series.items()):
+        if len(points) < 2:
+            continue  # one point is a value, not a trend
+        lower = lower_is_better(name)
+        values = [v for _, v in points]
+        latest_label, latest = points[-1]
+        if lower:
+            best = min(values)
+            regressing = (
+                best > 0 and latest > best * (1.0 + threshold)
+            )
+            ratio = latest / best if best else 1.0
+        else:
+            best = max(values)
+            regressing = (
+                best > 0 and latest < best * (1.0 - threshold)
+            )
+            ratio = latest / best if best else 1.0
+        best_label = next(l for l, v in points if v == best)
+        entry = {
+            "points": len(points),
+            "direction": "lower" if lower else "higher",
+            "best": best,
+            "best_at": best_label,
+            "latest": latest,
+            "latest_at": latest_label,
+            "vs_best": round(ratio, 4),
+            "regressing": regressing,
+        }
+        metrics[name] = entry
+        if regressing:
+            regressions.append(dict(entry, metric=name))
+    return metrics, regressions
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    default_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    parser.add_argument("--repo-root", default=default_root,
+                        help="where the BENCH_r*.json series lives")
+    parser.add_argument("--journal", default="/tmp/ci_wire_micro.jsonl",
+                        help="tier-1f NDJSON bench journal")
+    parser.add_argument("--threshold", type=float, default=0.2,
+                        help="regression threshold vs best (default "
+                             "0.2 = 20%%)")
+    parser.add_argument("-o", "--output", default="",
+                        help="also write the JSON report here")
+    args = parser.parse_args(argv)
+
+    sources = load_bench_rounds(args.repo_root)
+    sources += load_journal(args.journal)
+    series = build_series(sources)
+    if not series:
+        print(
+            "bench_trend: no BENCH_r*.json under %s and no journal at "
+            "%s — nothing to watch" % (args.repo_root, args.journal),
+            file=sys.stderr,
+        )
+        return 1
+    metrics, regressions = analyze(series, threshold=args.threshold)
+    tracked = len(metrics)
+    print(
+        "bench-trend: %d metric(s) with >=2 points, %d regressing "
+        ">%.0f%% vs best"
+        % (tracked, len(regressions), args.threshold * 100),
+        file=sys.stderr,
+    )
+    for entry in regressions:
+        print(
+            "  REGRESSING %-48s latest %.4g (%s) vs best %.4g (%s), "
+            "%.2fx [%s better]"
+            % (entry["metric"], entry["latest"], entry["latest_at"],
+               entry["best"], entry["best_at"], entry["vs_best"],
+               entry["direction"]),
+            file=sys.stderr,
+        )
+    report = {
+        "tracked_metrics": tracked,
+        "threshold": args.threshold,
+        "regressions": regressions,
+        "metrics": metrics,
+    }
+    text = json.dumps(report)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text)
+    print(text)
+    # report-only: regressions are flagged, never fatal (tier-1f rule —
+    # absolute numbers flake across boxes; the journal keeps the record)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

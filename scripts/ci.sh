@@ -120,6 +120,121 @@ print("tracing smoke OK: %d step traces, roles %s, segments %s"
       % (step["count"], step["roles"], sorted(step["segments"])))
 PYEOF
 
+echo "== tier 1d (profiling): continuous profiler smoke (/profilez + span-correlated frames) =="
+# ISSUE 14: a traced+profiled deepfm local run (the local executor
+# plays the worker role) must answer a mid-run /profilez window
+# capture whose collapsed stacks name a known hot frame, and the
+# end-of-run ring snapshot + merged trace must let critical_path.py
+# --frames attribute real frame stacks to BOTH the compute and apply
+# segments (the span-correlation acceptance gate). profile_report.py
+# merges the capture into a flamegraph-ready collapsed file. The
+# numbers are REPORT-ONLY (journaled below, like tier 1f); the gates
+# are structural.
+PROF_DIR="$(mktemp -d)"
+PROF_TRACE_DIR="$(mktemp -d)"
+PROF_EVENTS_DIR="$(mktemp -d)"
+export PROF_DIR PROF_TRACE_DIR
+# 211 Hz here, NOT the 29 Hz default: this lane gates a STRUCTURAL
+# property (>=1 frame stack lands in each of compute and apply), and
+# the apply leg is a small slice of a CPU deepfm step — at 29 Hz its
+# expected sample count is low single digits, i.e. a coin-flip gate.
+# The 29 Hz overhead contract has its own tier-1f A/B gate.
+JAX_PLATFORMS=cpu EDL_TRACE_DIR="$PROF_TRACE_DIR" EDL_TRACE_SAMPLE=1 \
+EDL_PROF_HZ=211 EDL_EVENTS_DIR="$PROF_EVENTS_DIR" \
+python - <<'PYEOF'
+import json, os, re, sys, tempfile, threading, time, urllib.request
+sys.path.insert(0, "tests")
+from test_utils import create_ctr_recordio
+from elasticdl_tpu.common.grpc_utils import find_free_port
+
+port = find_free_port()
+os.environ["EDL_METRICS_PORT"] = str(port)
+from elasticdl_tpu.train.local_executor import LocalExecutor
+from elasticdl_tpu.observability import trace
+
+prof_dir = os.environ["PROF_DIR"]
+with tempfile.TemporaryDirectory() as tmp:
+    create_ctr_recordio(tmp + "/f0.rec", num_records=4096, seed=0)
+    executor = LocalExecutor(
+        "elasticdl_tpu.models.deepfm", training_data=tmp,
+        minibatch_size=128, num_epochs=12,
+    )
+    base = "http://localhost:%d" % executor.observability.port
+    thread = threading.Thread(target=executor.train, daemon=True)
+    thread.start()
+    # wait past jit compile: capture only once real steps are landing
+    # (the batch_process phase counter ticks once per train step)
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        body = urllib.request.urlopen(
+            base + "/metrics", timeout=5
+        ).read().decode()
+        m = re.search(
+            r'edl_phase_seconds_count\{phase="batch_process"\} (\d+)',
+            body,
+        )
+        if m and int(m.group(1)) >= 2:
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("training never started stepping")
+    collapsed = urllib.request.urlopen(
+        base + "/profilez?seconds=2&format=collapsed", timeout=30
+    ).read().decode()
+    assert "train_step" in collapsed or "apply" in collapsed, (
+        "mid-run capture names no known hot frame:\n%s"
+        % collapsed[:2000]
+    )
+    thread.join(timeout=300)
+    assert not thread.is_alive(), "deepfm run did not finish"
+    # the rolling ring saw the whole run: save it as the per-role
+    # capture the report tooling consumes
+    snap = json.loads(urllib.request.urlopen(
+        base + "/profilez", timeout=5
+    ).read())
+    assert snap["samples"] > 0, snap
+    with open(os.path.join(
+        prof_dir, "%s.profile.json" % snap["role"]
+    ), "w") as f:
+        json.dump(snap, f)
+    # the profiler's own series are live on /metrics
+    body = urllib.request.urlopen(
+        base + "/metrics", timeout=5
+    ).read().decode()
+    assert "edl_prof_samples_total" in body, body[:1000]
+    assert "edl_prof_overhead_ratio" in body
+    trace.flush()
+print("profiled deepfm run OK (mid-run /profilez capture verified)")
+PYEOF
+python scripts/merge_trace.py "$PROF_TRACE_DIR"
+python scripts/profile_report.py "$PROF_DIR" \
+  -o "$PROF_DIR/merged.collapsed.txt" > /tmp/_profile_report.json
+python scripts/critical_path.py "$PROF_TRACE_DIR/merged.trace.json" \
+  --frames "$PROF_DIR" 2>/dev/null > /tmp/_critical_frames.json
+printf '{"ts": "%s", "profile_report": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_profile_report.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+python - <<'PYEOF'
+import json
+report = json.load(open("/tmp/_critical_frames.json"))
+frames = report.get("frames") or {}
+# the ISSUE 14 acceptance gate: the live run's compute AND apply
+# segments each attribute at least one real frame stack
+for segment in ("compute", "apply"):
+    stacks = frames.get(segment)
+    assert stacks, "segment %r got no frame stacks: %s" % (
+        segment, sorted(frames))
+    assert all(s["count"] > 0 and s["stack"] for s in stacks)
+print("span-correlated frames OK: %s" % {
+    seg: len(stacks) for seg, stacks in sorted(frames.items())})
+PYEOF
+# the flight recorder saw the profiler lifecycle (the journal carries
+# profiler_started + the mid-run profile_captured on the timeline)
+python scripts/postmortem.py "$PROF_EVENTS_DIR" 2>/dev/null \
+  > /tmp/_prof_postmortem.out
+grep -q "profiler_started" /tmp/_prof_postmortem.out
+grep -q "profile_captured" /tmp/_prof_postmortem.out
+
 echo "== tier 1d+: flight recorder smoke (/statusz /alerts + postmortem) =="
 # a real master + in-process worker with EDL_EVENTS_DIR set: the master
 # must serve the fleet snapshot and alert list, the roles must journal
@@ -849,6 +964,32 @@ printf '{"ts": "%s", "checkpoint": %s}\n' \
   "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_checkpoint.json)" \
   >> /tmp/ci_wire_micro.jsonl
 echo "checkpoint bench journaled to /tmp/ci_wire_micro.jsonl"
+
+# Profiler overhead A/B (ISSUE 14): deepfm steps/s with the 29 Hz
+# sampler started vs stopped, interleaved inside ONE process so box
+# drift cancels. Absolute steps/s are report-only (journaled below);
+# the script hard-fails the acceptance gate — measured overhead above
+# 3% (after one re-measure; a real sampler regression fails both
+# passes) or a sampler that collected no samples at all.
+JAX_PLATFORMS=cpu python scripts/bench_profiler_overhead.py | tee /tmp/_prof_overhead.json
+printf '{"ts": "%s", "prof_overhead": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_prof_overhead.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "profiler-overhead A/B journaled to /tmp/ci_wire_micro.jsonl"
+
+# Bench-trend watchdog (ISSUE 14): folds the repo's BENCH_r*.json
+# series plus everything this run just journaled above into per-metric
+# trajectories and flags any metric >20% worse than its best recorded
+# value. REPORT-ONLY (absolute numbers flake across boxes — a flag is
+# a prompt to look, not a failure); runs after every journaling bench
+# so it sees this run's own numbers, and its report is journaled so
+# the watchdog has a history too.
+python scripts/bench_trend.py --journal /tmp/ci_wire_micro.jsonl \
+  | tee /tmp/_bench_trend.json
+printf '{"ts": "%s", "bench_trend": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_bench_trend.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "bench-trend report journaled to /tmp/ci_wire_micro.jsonl"
 
 # The reduced-precision wire opt-in must actually train: a sparse
 # local-executor run with EDL_WIRE_DTYPE=bfloat16 (LocalPSClient

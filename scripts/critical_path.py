@@ -39,8 +39,18 @@ gates on (a step trace must span worker AND ps).
 Report-only by design: CI journals the JSON (tier 1d, like the tier 1f
 benches) and asserts only the structural invariants.
 
+**Frame attribution (ISSUE 14).** With ``--frames`` pointing at
+``/profilez`` captures (files or a dir of ``*.profile.json``) from the
+same run, the report adds a ``frames`` section: the continuous
+profiler tags each sample landing inside an open sampled span with
+that span's critical-path segment, so every segment above breaks down
+into the top-K Python frame stacks that actually burned it —
+"``apply`` is 40% of the step" becomes "``apply`` is 40%, and it's
+``embedding_store.push_gradients`` → ``np.add.reduceat``".
+
 Usage:
     python scripts/critical_path.py TRACE_DIR [--slowest N] [-o out.json]
+        [--frames PROFILES] [--frames-top K]
 
 stdout is the JSON report; the human-readable table goes to stderr.
 """
@@ -265,6 +275,58 @@ def build_report(events, slowest=10):
     return report
 
 
+def load_profiles(path_spec):
+    """/profilez capture dicts from a comma-separated list of files
+    and/or directories (discovery + tolerant load shared with
+    scripts/profile_report.py)."""
+    import profile_report
+
+    paths = [p.strip() for p in path_spec.split(",") if p.strip()]
+    return [
+        capture
+        for _path, capture in profile_report.load_captures(
+            profile_report.discover(paths)
+        )
+    ]
+
+
+def frames_by_segment(profiles, top=3):
+    """{segment: [{stack, count, roles}]}: the top-K span-tagged frame
+    stacks per critical-path segment, merged across roles. Untagged
+    samples (no open span at sample time) are excluded — they have no
+    segment to attribute to."""
+    tally = {}  # segment -> stack tuple -> [count, roles set]
+    for profile in profiles:
+        role = profile.get("role", "?")
+        for entry in profile.get("stacks", ()):
+            segment = entry.get("segment")
+            if not segment:
+                continue
+            stack = tuple(entry.get("stack", ()))
+            if not stack:
+                continue
+            bucket = tally.setdefault(segment, {})
+            slot = bucket.get(stack)
+            if slot is None:
+                bucket[stack] = [int(entry.get("count", 0)), {role}]
+            else:
+                slot[0] += int(entry.get("count", 0))
+                slot[1].add(role)
+    return {
+        segment: [
+            {
+                "stack": list(stack),
+                "count": count,
+                "roles": sorted(roles),
+            }
+            for stack, (count, roles) in sorted(
+                bucket.items(), key=lambda kv: (-kv[1][0], kv[0])
+            )[:top]
+        ]
+        for segment, bucket in sorted(tally.items())
+    }
+
+
 def render_text(report, out=sys.stderr):
     print("critical-path attribution: %d trace(s)" % report["traces"],
           file=out)
@@ -295,6 +357,20 @@ def render_text(report, out=sys.stderr):
                record["duration_ms"], record["roles"]),
             file=out,
         )
+    frames = report.get("frames")
+    if frames:
+        print("segment frame stacks (continuous profiler):", file=out)
+        for segment, stacks in frames.items():
+            print("  %s:" % segment, file=out)
+            for entry in stacks:
+                # leaf-most frames carry the signal; elide long roots
+                stack = entry["stack"]
+                shown = ";".join(stack[-4:])
+                if len(stack) > 4:
+                    shown = "...;" + shown
+                print(
+                    "    %6d  %s" % (entry["count"], shown), file=out
+                )
 
 
 def main(argv=None):
@@ -307,9 +383,21 @@ def main(argv=None):
                         help="slowest-N traces to include (default 10)")
     parser.add_argument("-o", "--output", default="",
                         help="also write the JSON report here")
+    parser.add_argument(
+        "--frames", default="",
+        help="comma-separated /profilez capture files or dirs of "
+             "*.profile.json from the same run: break each segment "
+             "down into its top span-tagged frame stacks (ISSUE 14)",
+    )
+    parser.add_argument("--frames-top", type=int, default=3,
+                        help="frame stacks per segment (default 3)")
     args = parser.parse_args(argv)
     events = load_events(args.trace_path)
     report = build_report(events, slowest=args.slowest)
+    if args.frames:
+        report["frames"] = frames_by_segment(
+            load_profiles(args.frames), top=args.frames_top
+        )
     render_text(report)
     text = json.dumps(report)
     if args.output:

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Merge per-role /profilez captures into one flamegraph-ready file.
+
+Input: one or more ``/profilez`` JSON captures (files, or directories
+scanned for ``*.profile.json``) — each the output of
+``curl role:port/profilez[?seconds=N]`` saved per role. Output:
+
+- a merged collapsed-stack file (``-o``, default
+  ``<first input dir>/merged.collapsed.txt``): one
+  ``role;[segment];frame;... count`` line per aggregated stack, role
+  (and critical-path segment, when the sample was span-tagged) folded
+  in as leading frames so a flamegraph groups by role at the root —
+  load it in speedscope / flamegraph.pl / any collapsed-stack viewer;
+- a per-role top-N self-time table on stderr (self = samples with the
+  frame on top, total = samples with the frame anywhere), the "where
+  did this role's host time go" answer without leaving the terminal;
+- the same summary as JSON on stdout (journaled by CI tier 1d).
+
+Usage:
+    python scripts/profile_report.py CAPTURES... [-o collapsed.txt]
+        [--top N]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def discover(paths):
+    """Capture file list: files as given, directories scanned for
+    *.profile.json (sorted — deterministic merge order)."""
+    found = []
+    for path in paths:
+        if os.path.isdir(path):
+            found.extend(sorted(glob.glob(
+                os.path.join(path, "*.profile.json")
+            )))
+        elif path:
+            found.append(path)
+    return found
+
+
+def load_captures(paths):
+    """[(path, capture dict)] for every parseable capture; a corrupt
+    file is skipped loudly, not fatal — partial reports beat none."""
+    captures = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                capture = json.load(f)
+        except (OSError, ValueError) as e:
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+            continue
+        if not isinstance(capture, dict) or "stacks" not in capture:
+            print("skipping %s: not a /profilez capture" % path,
+                  file=sys.stderr)
+            continue
+        captures.append((path, capture))
+    return captures
+
+
+def merge_collapsed(captures):
+    """{collapsed line prefix -> count} with role (and segment) folded
+    in as leading frames."""
+    merged = {}
+    for path, capture in captures:
+        role = capture.get("role") or os.path.basename(path)
+        for entry in capture.get("stacks", ()):
+            frames = [role]
+            if entry.get("segment"):
+                frames.append("[%s]" % entry["segment"])
+            frames.extend(entry.get("stack", ()))
+            key = ";".join(frames)
+            merged[key] = merged.get(key, 0) + int(entry.get("count", 0))
+    return merged
+
+
+def per_role_top(captures, top=10):
+    """{role: {samples, top: [{frame, self, total}]}} — self time is
+    leaf-frame sample count, total counts the frame anywhere in the
+    stack (deduped per stack, so recursion doesn't double-bill)."""
+    roles = {}
+    for path, capture in captures:
+        role = capture.get("role") or os.path.basename(path)
+        book = roles.setdefault(
+            role, {"samples": 0, "self": {}, "total": {}}
+        )
+        book["samples"] += int(capture.get("samples", 0))
+        for entry in capture.get("stacks", ()):
+            stack = entry.get("stack", ())
+            count = int(entry.get("count", 0))
+            if not stack:
+                continue
+            leaf = stack[-1]
+            book["self"][leaf] = book["self"].get(leaf, 0) + count
+            for frame in set(stack):
+                book["total"][frame] = (
+                    book["total"].get(frame, 0) + count
+                )
+    report = {}
+    for role, book in sorted(roles.items()):
+        ranked = sorted(
+            book["self"].items(), key=lambda kv: (-kv[1], kv[0])
+        )[:top]
+        report[role] = {
+            "samples": book["samples"],
+            "top": [
+                {
+                    "frame": frame,
+                    "self": self_count,
+                    "total": book["total"].get(frame, self_count),
+                }
+                for frame, self_count in ranked
+            ],
+        }
+    return report
+
+
+def render_table(report, out=sys.stderr):
+    for role, entry in report.items():
+        print(
+            "%s: %d samples" % (role, entry["samples"]), file=out
+        )
+        samples = max(1, entry["samples"])
+        for row in entry["top"]:
+            print(
+                "  %5.1f%% self  %5.1f%% total  %s"
+                % (100.0 * row["self"] / samples,
+                   100.0 * row["total"] / samples, row["frame"]),
+                file=out,
+            )
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "captures", nargs="+",
+        help="/profilez JSON capture files, or dirs of *.profile.json",
+    )
+    parser.add_argument("-o", "--output", default="",
+                        help="collapsed-stack output path (default: "
+                             "<first input dir>/merged.collapsed.txt)")
+    parser.add_argument("--top", type=int, default=10,
+                        help="rows per role in the self-time table")
+    args = parser.parse_args(argv)
+    paths = discover(args.captures)
+    captures = load_captures(paths)
+    if not captures:
+        print("no /profilez captures found in %s" % args.captures,
+              file=sys.stderr)
+        return 1
+    out_path = args.output
+    if not out_path:
+        first = args.captures[0]
+        base = first if os.path.isdir(first) else os.path.dirname(first)
+        out_path = os.path.join(base or ".", "merged.collapsed.txt")
+    merged = merge_collapsed(captures)
+    with open(out_path, "w", encoding="utf-8") as f:
+        for key, count in sorted(
+            merged.items(), key=lambda kv: (-kv[1], kv[0])
+        ):
+            f.write("%s %d\n" % (key, count))
+    report = per_role_top(captures, top=args.top)
+    render_table(report)
+    print(
+        "merged %d capture(s), %d distinct stacks -> %s"
+        % (len(captures), len(merged), out_path),
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "captures": len(captures),
+        "stacks": len(merged),
+        "collapsed_path": out_path,
+        "roles": report,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -181,6 +181,67 @@ def test_raising_readiness_check_is_unready():
 
 
 # ---------------------------------------------------------------------------
+# http daemon error paths (ISSUE 14): previously only exercised
+# incidentally through role smokes
+
+
+def test_unknown_routes_answer_404_and_server_survives():
+    reg = Registry(enabled=True)
+    server = ObservabilityServer("w", 0, registry=reg).start()
+    try:
+        base = "http://localhost:%d" % server.port
+        for path in ("/nope", "/metricsz", "/profilez/extra", "/"):
+            assert _get(base + path)[0] == 404, path
+        # 404s never take the daemon down
+        assert _get(base + "/healthz")[0] == 200
+    finally:
+        server.stop()
+
+
+def test_busy_port_degrades_to_no_server(caplog):
+    """maybe_start on an occupied port returns None instead of raising:
+    telemetry is best-effort, a port collision must not kill the job."""
+    import socket
+
+    from elasticdl_tpu.observability import http_server
+
+    holder = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    holder.bind(("0.0.0.0", 0))
+    holder.listen(1)
+    busy_port = holder.getsockname()[1]
+    try:
+        assert http_server.maybe_start("w", cli_port=busy_port) is None
+    finally:
+        holder.close()
+
+
+def test_raising_json_handler_answers_500_and_daemon_survives():
+    reg = Registry(enabled=True)
+    server = ObservabilityServer("master", 0, registry=reg).start()
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("snapshot source broke")
+        return {"ok": calls["n"]}
+
+    server.add_json_handler("/statusz", flaky)
+    try:
+        base = "http://localhost:%d" % server.port
+        status, body = _get(base + "/statusz")
+        assert status == 500 and "snapshot source broke" in body
+        # the handler thread died with the request, not the daemon:
+        # probes still answer and the next handler call succeeds
+        assert _get(base + "/healthz")[0] == 200
+        status, body = _get(base + "/statusz")
+        assert status == 200 and json.loads(body) == {"ok": 2}
+        assert _get(base + "/metrics")[0] == 200
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
 # RPC interceptors on a live in-process master<->worker channel
 
 

@@ -768,6 +768,12 @@ _CLI_POSITIVE_FIXTURES = {
         def serialize(slices, ids):
             slices.ids.extend(int(i) for i in ids)
     """),
+    "obs-deterministic-tracer": ("bad_tracer.py", """
+        import sys
+
+        def arm(callback):
+            sys.settrace(callback)
+    """),
 }
 
 
@@ -863,6 +869,79 @@ def test_obs_span_suppression_comment_works():
                 # edlint: disable=obs-span-no-context
                 return stub.check(request, timeout=5)
     """, rules=["obs-span-no-context"])
+
+
+# ---------------------------------------------------------------------------
+# obs-deterministic-tracer (ISSUE 14)
+
+def test_deterministic_tracer_flags_sys_and_threading_installers():
+    findings = findings_for("""
+        import sys
+        import threading
+
+        def arm(callback):
+            sys.settrace(callback)          # BUG
+            threading.setprofile(callback)  # BUG
+    """, rules=["obs-deterministic-tracer"])
+    assert len(findings) == 2, findings
+    assert {f.code for f in findings} == {
+        "sys.settrace", "threading.setprofile"
+    }
+    assert all(f.symbol == "arm" for f in findings)
+
+
+def test_deterministic_tracer_flags_bare_imported_name():
+    findings = findings_for("""
+        from sys import settrace as st
+
+        def arm(callback):
+            st(callback)  # BUG: aliased import of the installer
+    """, rules=["obs-deterministic-tracer"])
+    assert len(findings) == 1
+    assert findings[0].code == "st"
+
+
+def test_deterministic_tracer_exempts_profiler_and_tests():
+    armed = """
+        import sys
+
+        def arm(callback):
+            sys.settrace(callback)
+    """
+    assert not findings_for(
+        armed,
+        path="elasticdl_tpu/observability/profiler.py",
+        rules=["obs-deterministic-tracer"],
+    )
+    assert not findings_for(
+        armed,
+        path="tests/test_debugging.py",
+        rules=["obs-deterministic-tracer"],
+    )
+
+
+def test_deterministic_tracer_quiet_on_lookalikes():
+    # reading gettrace, a same-named method on another object, and the
+    # sampling profiler's own frame walk are all fine
+    assert not findings_for("""
+        import sys
+
+        def sample(tracer):
+            frames = sys._current_frames()
+            old = sys.gettrace()
+            tracer.settrace("not the sys one")
+            return frames, old
+    """, rules=["obs-deterministic-tracer"])
+
+
+def test_deterministic_tracer_suppression_comment_works():
+    assert not findings_for("""
+        import sys
+
+        def arm(callback):
+            # edlint: disable=obs-deterministic-tracer
+            sys.settrace(callback)
+    """, rules=["obs-deterministic-tracer"])
 
 
 # ---------------------------------------------------------------------------
