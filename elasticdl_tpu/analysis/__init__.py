@@ -17,6 +17,11 @@ for (or refuses to pay for):
   blocks in modules that bypass ``build_channel``: the trace context
   propagates only through the channel interceptor, so a raw-channel
   stub call orphans the remote half of the trace.
+- ``num-silent-nonfinite`` — no ``np.nan*`` aggregations or
+  ``nan_to_num`` in train/ps/worker scopes: silently masking
+  nonfinite values is exactly what the ISSUE-15 health sentinels
+  exist to prevent — let the NaN surface and be detected, skipped,
+  or halted on.
 - ``obs-deterministic-tracer`` — no ``sys.settrace`` /
   ``sys.setprofile`` / ``threading.settrace``/``setprofile`` outside
   ``observability/profiler.py`` and tests: a deterministic tracer in a

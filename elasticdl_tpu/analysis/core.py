@@ -221,6 +221,7 @@ def _rules_by_name(names=None):
         fault_tolerance,
         hot_path,
         lock_discipline,
+        numerics,
         obs_hot_path,
         obs_span,
         perf_gather,
@@ -237,6 +238,7 @@ def _rules_by_name(names=None):
         "obs-hot-path": obs_hot_path.run,
         "obs-span-no-context": obs_span.run,
         "obs-deterministic-tracer": deterministic_tracer.run,
+        "num-silent-nonfinite": numerics.run,
         "perf-varint-ids": perf_wire.run,
         "perf-host-gather": perf_gather.run,
         "perf-gil-held-apply": perf_gil.run,
@@ -263,6 +265,7 @@ RULE_NAMES = (
     "obs-hot-path",
     "obs-span-no-context",
     "obs-deterministic-tracer",
+    "num-silent-nonfinite",
     "perf-varint-ids",
     "perf-host-gather",
     "perf-gil-held-apply",

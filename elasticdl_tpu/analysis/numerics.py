@@ -1,0 +1,108 @@
+"""num-silent-nonfinite: no NaN-swallowing aggregations in hot scopes.
+
+The training-health sentinels (ISSUE 15, ``train/health.py``) exist
+because a NaN batch must be LOUD: detected in-graph, journaled, and
+either alerted, skipped, or halted — never silently absorbed. numpy's
+``nan*`` family (``nanmean``/``nansum``/``nanmax``/...) and
+``nan_to_num`` do exactly the opposite: they make nonfinite values
+disappear inside an aggregation, so a corrupted gradient or loss
+averages into a plausible number and trains on. A ``nan_to_num`` on a
+pushed gradient is the canonical anti-pattern — it converts "the
+sentinel would have fired" into "row 12345 silently got a zero
+update".
+
+What fires, in files under a ``train/``, ``ps/``, or ``worker/``
+package directory only: any call whose target is a ``nan*``
+aggregation or ``nan_to_num`` — attribute-style through any module
+alias (``np.nanmean``, ``numpy.nansum``, ``jnp.nan_to_num``) or a bare
+name bound by ``from numpy import nanmean``.
+
+Legitimate uses (e.g. summarizing a metrics array that encodes
+"absent" as NaN by design) are one
+``# edlint: disable=num-silent-nonfinite`` away, with the
+justification the suppression comment forces. Scripts, tests, and the
+analysis package itself are out of scope — the rule pins the training
+data path, not reporting tools.
+"""
+
+import ast
+import os
+
+from elasticdl_tpu.analysis.core import (
+    Finding,
+    attr_chain,
+    walk_with_scope,
+)
+
+RULE = "num-silent-nonfinite"
+
+_SCOPED_DIRS = {"train", "ps", "worker"}
+
+_NAN_FUNCS = frozenset({
+    "nanmean", "nansum", "nanmax", "nanmin", "nanstd", "nanvar",
+    "nanprod", "nanmedian", "nanpercentile", "nanquantile",
+    "nanargmax", "nanargmin", "nancumsum", "nancumprod",
+    "nan_to_num",
+})
+
+# modules whose nan* members count: numpy/jax.numpy under any alias is
+# caught by the member NAME (the chains below are only used to catch
+# `from numpy import nanmean` rebinding)
+_NAN_MODULES = ("numpy", "jax.numpy")
+
+
+def _in_scope(path):
+    parts = path.replace(os.sep, "/").split("/")
+    return bool(_SCOPED_DIRS & set(parts))
+
+
+def _nan_imports(tree):
+    """Bare names bound to a nan* aggregation by ``from numpy import
+    nanmean``-style imports (aliases included)."""
+    bound = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and node.module in _NAN_MODULES
+        ):
+            for alias in node.names:
+                if alias.name in _NAN_FUNCS:
+                    bound.add(alias.asname or alias.name)
+    return bound
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        if not _in_scope(unit.path):
+            continue
+        bare_names = _nan_imports(unit.tree)
+        for node, scope in walk_with_scope(unit.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            code = None
+            if isinstance(func, ast.Attribute) and func.attr in _NAN_FUNCS:
+                chain = attr_chain(func)
+                code = chain or func.attr
+            elif isinstance(func, ast.Name) and func.id in bare_names:
+                code = func.id
+            if code is None:
+                continue
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=unit.path,
+                    line=node.lineno,
+                    symbol=scope,
+                    code=code,
+                    message=(
+                        "%s silently masks nonfinite values — exactly "
+                        "what the health sentinels exist to catch. "
+                        "Let the NaN surface (EDL_HEALTH detects it "
+                        "in-graph) or mask explicitly with a boolean "
+                        "mask whose coverage is asserted" % code
+                    ),
+                )
+            )
+    return findings

@@ -28,6 +28,25 @@ constructor args override for tests):
 - **version-lag**   — a PS reports version lag beyond
   ``EDL_VERSION_LAG_MAX`` (default 100).
 
+Training-health detectors (ISSUE 15) — the model-side view, fed by
+the workers' health-sentinel telemetry (TelemetryBlob fields 28-35)
+and the stream feeder's per-window drift stats:
+
+- **nonfinite_loss**  — a worker reports a live nonfinite streak, or
+  its cumulative nonfinite count moved within the last
+  ``EDL_HEALTH_ALERT_SECS`` (default 30 s; the recency window is what
+  makes raise→clear observable for a one-off NaN under ``skip``).
+- **loss_spike**      — a worker's cumulative robust-z spike count
+  moved within the window.
+- **grad_explosion**  — a worker's cumulative grad-norm explosion
+  count moved within the window.
+- **label_shift**     — a stream window's label rate deviated more
+  than ``EDL_LABEL_SHIFT_DELTA`` (default 0.15) from the stream's own
+  label-rate EWMA, or its id-novelty rate exceeded
+  ``EDL_ID_NOVELTY_MAX`` (default 0.9); the alert detail carries the
+  watermark the offending window was tagged with, so drift is
+  attributable to a window.
+
 Everything is plain dict/float work under one lock, sized for a scan
 thread ticking at 1 Hz over hundreds of roles — no numpy, no RPC.
 """
@@ -47,10 +66,35 @@ STRAGGLER_FACTOR_ENV = "EDL_STRAGGLER_FACTOR"
 DEAD_AIR_SECS_ENV = "EDL_DEAD_AIR_SECS"
 STUCK_ROUND_SECS_ENV = "EDL_STUCK_ROUND_SECS"
 VERSION_LAG_MAX_ENV = "EDL_VERSION_LAG_MAX"
+HEALTH_ALERT_SECS_ENV = "EDL_HEALTH_ALERT_SECS"
+LABEL_SHIFT_DELTA_ENV = "EDL_LABEL_SHIFT_DELTA"
+ID_NOVELTY_MAX_ENV = "EDL_ID_NOVELTY_MAX"
 
-ALERT_KINDS = ("straggler", "dead_air", "stuck_round", "version_lag")
+ALERT_KINDS = (
+    "straggler", "dead_air", "stuck_round", "version_lag",
+    # training health (ISSUE 15)
+    "nonfinite_loss", "loss_spike", "grad_explosion", "label_shift",
+)
+
+# worker-health cumulative counters watched for recent movement:
+# blob key -> the alert kind a recent delta raises
+_HEALTH_COUNTER_ALERTS = (
+    ("health_nonfinite_batches", "nonfinite_loss"),
+    ("health_loss_spikes", "loss_spike"),
+    ("health_grad_explosions", "grad_explosion"),
+)
 
 
+
+
+def _json_num(value, digits=6):
+    """Round for the JSON views, keeping nonfinite values explicit:
+    a NaN loss must read "nan" on /statusz (json.dumps would emit a
+    bare NaN token no strict parser accepts)."""
+    value = float(value)
+    if value != value or value in (float("inf"), float("-inf")):
+        return repr(value)
+    return round(value, digits)
 
 
 class _RoleState:
@@ -59,6 +103,7 @@ class _RoleState:
     __slots__ = (
         "role", "worker_id", "last_seen", "blob",
         "stuck_since", "stuck_fill", "stuck_version",
+        "health_marks",
     )
 
     def __init__(self, role, worker_id, now):
@@ -70,6 +115,12 @@ class _RoleState:
         self.stuck_since = None
         self.stuck_fill = 0
         self.stuck_version = 0
+        # health-counter recency (ISSUE 15): cumulative-counter blob
+        # key -> (last seen value, ts of last observed increase) — the
+        # nonfinite/spike/explosion detectors fire on movement within
+        # the recency window, which is what makes raise→clear
+        # observable for one-off events
+        self.health_marks = {}
 
 
 class FleetMonitor:
@@ -79,6 +130,9 @@ class FleetMonitor:
         dead_air_secs=None,
         stuck_round_secs=None,
         version_lag_max=None,
+        health_alert_secs=None,
+        label_shift_delta=None,
+        id_novelty_max=None,
     ):
         self._straggler_factor = (
             straggler_factor
@@ -100,6 +154,36 @@ class FleetMonitor:
             if version_lag_max is not None
             else _env_float(VERSION_LAG_MAX_ENV, 100.0)
         )
+        # training-health knobs (ISSUE 15)
+        self._health_alert_secs = (
+            health_alert_secs
+            if health_alert_secs is not None
+            else _env_float(HEALTH_ALERT_SECS_ENV, 30.0)
+        )
+        self._label_shift_delta = (
+            label_shift_delta
+            if label_shift_delta is not None
+            else _env_float(LABEL_SHIFT_DELTA_ENV, 0.15)
+        )
+        self._id_novelty_max = (
+            id_novelty_max
+            if id_novelty_max is not None
+            else _env_float(ID_NOVELTY_MAX_ENV, 0.9)
+        )
+        # stream drift books (fed by the feeder, in-process — the
+        # stream has no RPC of its own): label-rate EWMA over windows
+        # plus the most recent out-of-band window, timestamped so the
+        # label_shift alert clears once the stream is back in band
+        self._stream_health = {
+            "windows": 0,
+            "label_rate_ewma": 0.0,
+            "novelty_rate_ewma": 0.0,
+            "last_label_rate": 0.0,
+            "last_novelty_rate": 0.0,
+            "watermark": 0,
+            "shift_ts": 0.0,     # when the last out-of-band window landed
+            "shift_detail": None,
+        }
         self._lock = threading.Lock()
         self._roles = {}  # key (worker_id or role string) -> _RoleState
         # alert key (kind, target) -> {"since": ts, ...detail}
@@ -199,7 +283,52 @@ class FleetMonitor:
                 # the restore replay cost a relaunch would pay
                 "ps_ckpt_dirty_rows": int(blob.ps_ckpt_dirty_rows),
                 "ps_ckpt_chain_len": int(blob.ps_ckpt_chain_len),
+                # training health (ISSUE 15): the worker's numerics
+                # sentinels — what the nonfinite_loss / loss_spike /
+                # grad_explosion detectors read
+                "health_loss_ewma": _json_num(blob.health_loss_ewma),
+                "health_loss_last": _json_num(blob.health_loss_last),
+                "health_grad_norm": _json_num(blob.health_grad_norm),
+                "health_nonfinite_batches": int(
+                    blob.health_nonfinite_batches
+                ),
+                "health_nonfinite_streak": int(
+                    blob.health_nonfinite_streak
+                ),
+                "health_loss_spikes": int(blob.health_loss_spikes),
+                "health_grad_explosions": int(
+                    blob.health_grad_explosions
+                ),
+                "health_skipped_batches": int(
+                    blob.health_skipped_batches
+                ),
+                # PS table-health scan (ISSUE 15)
+                "ps_row_norm_p50": round(
+                    float(blob.ps_row_norm_p50), 6
+                ),
+                "ps_row_norm_p99": round(
+                    float(blob.ps_row_norm_p99), 6
+                ),
+                "ps_dead_row_fraction": round(
+                    float(blob.ps_dead_row_fraction), 4
+                ),
+                "ps_exploding_rows": int(blob.ps_exploding_rows),
             }
+            # recency bookkeeping for the health-counter detectors: a
+            # cumulative counter that moved since the last sighting
+            # stamps "now" (a restarted worker resetting its counters
+            # reads as no movement — harmless)
+            for blob_key, _kind in _HEALTH_COUNTER_ALERTS:
+                value = state.blob[blob_key]
+                prev = state.health_marks.get(blob_key)
+                if prev is None:
+                    state.health_marks[blob_key] = (
+                        value, now if value > 0 else 0.0
+                    )
+                elif value > prev[0]:
+                    state.health_marks[blob_key] = (value, now)
+                elif value < prev[0]:
+                    state.health_marks[blob_key] = (value, prev[1])
             # stuck-round bookkeeping: the clock restarts whenever the
             # fill grows or the store version advances
             fill = int(blob.round_buffer_fill)
@@ -214,6 +343,50 @@ class FleetMonitor:
                 state.stuck_since = now
             state.stuck_fill = fill
             state.stuck_version = version
+
+    def observe_stream_window(self, watermark, label_rate, novelty_rate):
+        """Fold one stream window's drift stats in (ISSUE 15): called
+        by the stream feeder (in-process, no RPC) as it mints each
+        window, tagged with the watermark the window lands at. Label
+        rate deviating from the stream's own EWMA — or a novelty rate
+        above the ceiling — marks the window out-of-band; the
+        label_shift detector fires while the most recent out-of-band
+        window is inside the recency window and clears after."""
+        now = time.time()
+        with self._lock:
+            books = self._stream_health
+            label_rate = float(label_rate)
+            novelty_rate = float(novelty_rate)
+            ewma = books["label_rate_ewma"]
+            deviation = abs(label_rate - ewma)
+            # needs a baseline: the first windows only seed the EWMA
+            warmed = books["windows"] >= 5
+            shifted = warmed and deviation > self._label_shift_delta
+            novel = warmed and novelty_rate > self._id_novelty_max
+            if books["windows"] == 0:
+                books["label_rate_ewma"] = label_rate
+                books["novelty_rate_ewma"] = novelty_rate
+            else:
+                books["label_rate_ewma"] = (
+                    0.9 * books["label_rate_ewma"] + 0.1 * label_rate
+                )
+                books["novelty_rate_ewma"] = (
+                    0.9 * books["novelty_rate_ewma"]
+                    + 0.1 * novelty_rate
+                )
+            books["windows"] += 1
+            books["last_label_rate"] = label_rate
+            books["last_novelty_rate"] = novelty_rate
+            books["watermark"] = int(watermark)
+            if shifted or novel:
+                books["shift_ts"] = now
+                books["shift_detail"] = {
+                    "watermark": int(watermark),
+                    "label_rate": round(label_rate, 4),
+                    "label_rate_ewma": round(ewma, 4),
+                    "novelty_rate": round(novelty_rate, 4),
+                    "reason": "label_rate" if shifted else "id_novelty",
+                }
 
     def forget(self, worker_id):
         """Drop a role and every alert about it (tests / explicit
@@ -391,6 +564,61 @@ class FleetMonitor:
                     "version_lag": state.blob["version_lag"],
                     "max": self._version_lag_max,
                 }
+            # training-health detectors (ISSUE 15): a live nonfinite
+            # streak always fires; otherwise each counter fires while
+            # its last observed movement is inside the recency window
+            # (and clears after — a one-off NaN under skip raises then
+            # clears, both edges journaled)
+            if state.blob is not None:
+                streak = state.blob.get("health_nonfinite_streak", 0)
+                for blob_key, kind in _HEALTH_COUNTER_ALERTS:
+                    mark = state.health_marks.get(blob_key)
+                    if mark is None:
+                        continue
+                    count, moved_at = mark
+                    recent = (
+                        moved_at > 0
+                        and now - moved_at <= self._health_alert_secs
+                    )
+                    live = kind == "nonfinite_loss" and streak > 0
+                    if not (recent or live):
+                        continue
+                    detail = {
+                        "since": now,
+                        "count": count,
+                        "window_secs": self._health_alert_secs,
+                    }
+                    if kind == "nonfinite_loss":
+                        detail["streak"] = streak
+                        detail["skipped"] = state.blob.get(
+                            "health_skipped_batches", 0
+                        )
+                        detail["loss"] = state.blob.get(
+                            "health_loss_last", 0.0
+                        )
+                    elif kind == "loss_spike":
+                        detail["loss"] = state.blob.get(
+                            "health_loss_last", 0.0
+                        )
+                        detail["loss_ewma"] = state.blob.get(
+                            "health_loss_ewma", 0.0
+                        )
+                    else:  # grad_explosion
+                        detail["grad_norm"] = state.blob.get(
+                            "health_grad_norm", 0.0
+                        )
+                    desired[(kind, wid)] = detail
+        # label_shift (ISSUE 15): the most recent out-of-band stream
+        # window is inside the recency window
+        shift_ts = self._stream_health["shift_ts"]
+        if (
+            shift_ts > 0
+            and now - shift_ts <= self._health_alert_secs
+            and self._stream_health["shift_detail"] is not None
+        ):
+            detail = {"since": now}
+            detail.update(self._stream_health["shift_detail"])
+            desired[("label_shift", "stream")] = detail
         # eviction tombstones persist while their worker stays gone;
         # a re-registration re-adds the role and the normal logic
         # above then clears (or re-raises) the alert
@@ -479,6 +707,49 @@ class FleetMonitor:
                 }
                 for wid, detail in self._drained.items()
             }
+            # training-health section (ISSUE 15): the model-side view
+            # in one place — worker sentinels, PS table health, stream
+            # drift — so "is the model OK" is one /statusz read
+            health_workers = {}
+            health_ps = {}
+            for wid, state in self._roles.items():
+                if state.blob is None:
+                    continue
+                if wid >= 0:
+                    health_workers[state.role] = {
+                        key: state.blob[key]
+                        for key in (
+                            "health_loss_ewma", "health_loss_last",
+                            "health_grad_norm",
+                            "health_nonfinite_batches",
+                            "health_nonfinite_streak",
+                            "health_loss_spikes",
+                            "health_grad_explosions",
+                            "health_skipped_batches",
+                        )
+                    }
+                else:
+                    health_ps[state.role] = {
+                        key: state.blob[key]
+                        for key in (
+                            "ps_row_norm_p50", "ps_row_norm_p99",
+                            "ps_dead_row_fraction",
+                            "ps_exploding_rows",
+                        )
+                    }
+            stream_health = {
+                key: value
+                for key, value in self._stream_health.items()
+                if key != "shift_detail"
+            }
+            stream_health["last_shift"] = self._stream_health[
+                "shift_detail"
+            ]
+            health = {
+                "workers": health_workers,
+                "ps": health_ps,
+                "stream": stream_health,
+            }
         body = {
             "ts": now,
             "job": os.environ.get(events.JOB_NAME_ENV, ""),
@@ -486,11 +757,15 @@ class FleetMonitor:
             "fleet": roles,
             "drained": drained,
             "alerts": firing,
+            "health": health,
             "thresholds": {
                 "straggler_factor": self._straggler_factor,
                 "dead_air_secs": self._dead_air_secs,
                 "stuck_round_secs": self._stuck_round_secs,
                 "version_lag_max": self._version_lag_max,
+                "health_alert_secs": self._health_alert_secs,
+                "label_shift_delta": self._label_shift_delta,
+                "id_novelty_max": self._id_novelty_max,
             },
         }
         if extra:

@@ -112,12 +112,19 @@ class Master:
             recovered=self._recovered,
             stream=stream_source is not None,
         )
+        # cluster-level fleet view + anomaly detectors (/statusz,
+        # /alerts): fed by telemetry piggybacked on worker/PS RPCs,
+        # evaluated on the task monitor's scan tick. Built before the
+        # feeder so stream windows' drift stats (ISSUE 15) can fold
+        # straight into the label_shift detector.
+        self.fleet_monitor = FleetMonitor()
         self.stream_feeder = None
         if stream_source is not None:
             self.stream_feeder = StreamFeeder(
                 self.task_dispatcher,
                 stream_source,
                 saved_model_path=saved_model_path or "",
+                fleet=self.fleet_monitor,
             )
         if saved_model_path and self.job_type != JobType.PREDICTION_ONLY:
             self.task_dispatcher.add_deferred_callback_create_train_end_task(
@@ -143,10 +150,6 @@ class Master:
                 summary_writer=self.tensorboard_service,
             )
         self.rendezvous = MeshRendezvous()
-        # cluster-level fleet view + anomaly detectors (/statusz,
-        # /alerts): fed by telemetry piggybacked on worker/PS RPCs,
-        # evaluated on the task monitor's scan tick
-        self.fleet_monitor = FleetMonitor()
         self.servicer = MasterServicer(
             self.task_dispatcher,
             self.evaluation_service,

@@ -129,6 +129,21 @@ EVENT_TYPES = frozenset({
                              #   |closed) — the streaming durability
                              #   clock the checkpoint/export cadence
                              #   rides
+    # training-health sentinels (ISSUE 15)
+    "health_nonfinite",      # nonfinite loss/grads streak OPENED
+                             #   (+ loss, grad_norm, action; edge-
+                             #   journaled so a NaN-wedged job can't
+                             #   flood the journal)
+    "health_loss_spike",     # robust-z loss spike (+ loss, ewma)
+    "health_grad_explosion",  # grad-norm explosion (+ grad_norm, ewma)
+    "health_halt",           # EDL_HEALTH_ON_NONFINITE=halt tripped:
+                             #   the task fails loudly and the process
+                             #   exits nonzero (+ loss, grad_norm,
+                             #   streak)
+    "health_table_exploding",  # PS table-health scan found sampled
+                             #   rows beyond EDL_HEALTH_ROW_NORM_MAX
+                             #   (+ ps, rows, tables, norm_max; edge-
+                             #   journaled per scan transition)
 })
 
 

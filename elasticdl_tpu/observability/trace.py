@@ -167,12 +167,49 @@ def extract_context(metadata):
     return None
 
 
+class _EntropyPool:
+    """Buffered span/trace-id entropy (ISSUE 15 satellite): PR 14's
+    profiler measured the per-span ``os.urandom`` syscall at ~5-7% of
+    traced-run host samples. One 4 KiB refill amortizes the syscall
+    over ~512 span ids; ``take`` under the lock is a slice + index
+    bump. Fork safety: ``os.register_at_fork`` empties the child's
+    buffer, so a forked process can never re-deal its parent's bytes
+    (duplicate ids across processes would corrupt trace threading)."""
+
+    __slots__ = ("_lock", "_buf", "_pos", "_size")
+
+    def __init__(self, size=4096):
+        self._lock = threading.Lock()
+        self._buf = b""
+        self._pos = 0
+        self._size = int(size)
+
+    def take(self, n):
+        with self._lock:
+            if self._pos + n > len(self._buf):
+                self._buf = os.urandom(self._size)
+                self._pos = 0
+            out = self._buf[self._pos:self._pos + n]
+            self._pos += n
+            return out
+
+    def reset(self):
+        with self._lock:
+            self._buf = b""
+            self._pos = 0
+
+
+_entropy = _EntropyPool()
+if hasattr(os, "register_at_fork"):  # pragma: no branch
+    os.register_at_fork(after_in_child=_entropy.reset)
+
+
 def _new_trace_id():
-    return os.urandom(16).hex()
+    return _entropy.take(16).hex()
 
 
 def _new_span_id():
-    return os.urandom(8).hex()
+    return _entropy.take(8).hex()
 
 
 class TraceWriter:

@@ -341,6 +341,7 @@ class ParameterServer:
             # TIMEOUT (still serving) and False once terminated.
             while self.server.wait_for_termination(timeout=sweep_secs):
                 self.servicer.lifecycle_tick()
+                self.servicer.table_health_scan()
             self.servicer.finish_checkpoints()
             return 0
         # polls missed before concluding the master is gone for good:
@@ -383,6 +384,10 @@ class ParameterServer:
             ):
                 last_sweep = time.time()
                 self.servicer.lifecycle_tick()
+            # table-health scan (ISSUE 15): rides the same poll,
+            # rate-limited internally (EDL_HEALTH_SCAN_SECS); its
+            # aggregates go out with the next telemetry blob
+            self.servicer.table_health_scan()
 
 
 def main(argv=None):

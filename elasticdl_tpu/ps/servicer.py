@@ -61,6 +61,15 @@ _LITTLE_ENDIAN = sys.byteorder == "little"
 # pre-ISSUE-13 behavior, kept for deterministic tests and debugging).
 CKPT_ASYNC_ENV = "EDL_CKPT_ASYNC"
 
+# Table-health scan (ISSUE 15): row-norm ceiling past which a sampled
+# row counts as exploding, per-table sample size, and the minimum
+# seconds between scans (the scan rides the 5 s poll loop but a full
+# table export per tick would be wasteful).
+ROW_NORM_MAX_ENV = "EDL_HEALTH_ROW_NORM_MAX"
+HEALTH_SCAN_SAMPLE_ENV = "EDL_HEALTH_SCAN_SAMPLE"
+HEALTH_SCAN_SECS_ENV = "EDL_HEALTH_SCAN_SECS"
+HEALTH_SCAN_MAX_ROWS_ENV = "EDL_HEALTH_SCAN_MAX_ROWS"
+
 
 def _deserialize_gradients(slices):
     """One table's pushed gradients off the wire, upcast to the fp32
@@ -315,6 +324,47 @@ class PserverServicer:
         self._t_ckpt_dirty_rows = 0
         self._t_ckpt_chain_len = 0
         self._t_prev = None  # (timestamp, push_count, pull_count)
+        # Table-health scan (ISSUE 15): shard-level aggregates the
+        # telemetry blob carries between scans, the per-table gauges,
+        # and the scan's rate limit. The scan runs on the poll loop
+        # (ps/server.py), never on an RPC handler.
+        from elasticdl_tpu.common.env_utils import env_float, env_int
+        from elasticdl_tpu.train.health import health_enabled
+
+        self._health_scan_on = health_enabled()
+        self._row_norm_max = env_float(ROW_NORM_MAX_ENV, 1e3)
+        self._health_sample = max(
+            8, env_int(HEALTH_SCAN_SAMPLE_ENV, 256)
+        )
+        # the sampling rides export_table (one full copy under the
+        # per-table lock): past this resident-row count the copy —
+        # and the lock hold the data plane pays for it — outweighs
+        # the signal, so bigger tables are skipped with a log
+        self._health_scan_max_rows = env_int(
+            HEALTH_SCAN_MAX_ROWS_ENV, 262_144
+        )
+        self._health_scan_skipped = set()
+        self._health_scan_secs = env_float(HEALTH_SCAN_SECS_ENV, 30.0)
+        self._health_scan_at = 0.0
+        self._t_row_norm_p50 = 0.0
+        self._t_row_norm_p99 = 0.0
+        self._t_dead_row_fraction = 0.0
+        self._t_exploding_rows = 0
+        self._m_row_norm = obs_metrics.gauge(
+            "edl_ps_row_norm",
+            "Sampled row-norm percentile per table",
+            ("table", "quantile"),
+        )
+        self._m_exploding = obs_metrics.gauge(
+            "edl_ps_exploding_rows",
+            "Sampled rows with norm beyond EDL_HEALTH_ROW_NORM_MAX",
+            ("table",),
+        )
+        self._m_dead_fraction = obs_metrics.gauge(
+            "edl_ps_dead_row_fraction",
+            "Evicted rows / (evicted + resident), from the lifecycle "
+            "books (0 without a lifecycle)",
+        )
 
     def telemetry_blob(self):
         """Piggyback payload for the PS's get_comm_info liveness poll:
@@ -344,6 +394,13 @@ class PserverServicer:
             ps_native_store=self._native_store,
             ps_ckpt_dirty_rows=self._t_ckpt_dirty_rows,
             ps_ckpt_chain_len=self._t_ckpt_chain_len,
+            # table-health scan (ISSUE 15): last scan's shard-level
+            # aggregates — sampled row-norm percentiles, dead-row
+            # fraction from the lifecycle books, exploding-row count
+            ps_row_norm_p50=self._t_row_norm_p50,
+            ps_row_norm_p99=self._t_row_norm_p99,
+            ps_dead_row_fraction=self._t_dead_row_fraction,
+            ps_exploding_rows=self._t_exploding_rows,
         )
         # embedding lifecycle health (ISSUE 12): admission/eviction
         # tallies + the resident-row gauge the bounded-memory contract
@@ -1030,6 +1087,104 @@ class PserverServicer:
         if self._lifecycle is None:
             return None
         return self._lifecycle.sweep()
+
+    def table_health_scan(self, force=False):
+        """Table-health scan (ISSUE 15), on the poll loop — NEVER on
+        an RPC handler: sampled per-table row-norm percentiles, the
+        shard's dead-row fraction from the lifecycle books, and a
+        count of sampled rows whose norm exceeds
+        EDL_HEALTH_ROW_NORM_MAX. A dead table (norms collapsing to the
+        initializer scale) or an exploding one is invisible to
+        loss-side sentinels until serving quality craters — the PS
+        watches its own rows. Rate-limited by EDL_HEALTH_SCAN_SECS;
+        exports each table once per scan (the per-table lock is held
+        for the export only), then samples at most
+        EDL_HEALTH_SCAN_SAMPLE rows host-side. Returns the scan dict,
+        or None when skipped (rate limit / EDL_HEALTH=0)."""
+        if not self._health_scan_on:
+            return None
+        now = time.time()
+        if not force and now - self._health_scan_at < self._health_scan_secs:
+            return None
+        self._health_scan_at = now
+        pooled = []
+        exploding_total = 0
+        per_table = {}
+        for name in self._store.table_names():
+            try:
+                size = self._store.table_size(name)
+            except KeyError:
+                continue
+            if size > self._health_scan_max_rows:
+                # export_table copies the WHOLE table under its lock;
+                # past the cap that copy stalls the data plane for a
+                # 256-row sample — skip, once-logged per table
+                if name not in self._health_scan_skipped:
+                    self._health_scan_skipped.add(name)
+                    logger.warning(
+                        "table-health scan skipping %s: %d resident "
+                        "rows > %s=%d (the scan's full-table export "
+                        "would stall pushes)", name, size,
+                        HEALTH_SCAN_MAX_ROWS_ENV,
+                        self._health_scan_max_rows,
+                    )
+                continue
+            try:
+                _ids, values = self._store.export_table(name)
+            except KeyError:
+                continue
+            if values.shape[0] == 0:
+                continue
+            if values.shape[0] > self._health_sample:
+                stride = values.shape[0] // self._health_sample
+                values = values[::stride][: self._health_sample]
+            norms = np.sqrt(
+                np.sum(np.square(values.astype(np.float32)), axis=1)
+            )
+            p50 = float(np.percentile(norms, 50))
+            p99 = float(np.percentile(norms, 99))
+            exploding = int(np.sum(norms > self._row_norm_max))
+            self._m_row_norm.labels(table=name, quantile="p50").set(p50)
+            self._m_row_norm.labels(table=name, quantile="p99").set(p99)
+            self._m_exploding.labels(table=name).set(exploding)
+            pooled.append(norms)
+            exploding_total += exploding
+            per_table[name] = {
+                "p50": p50, "p99": p99, "exploding": exploding,
+                "sampled": int(norms.size),
+            }
+        if pooled:
+            norms = np.concatenate(pooled)
+            self._t_row_norm_p50 = float(np.percentile(norms, 50))
+            self._t_row_norm_p99 = float(np.percentile(norms, 99))
+        dead_fraction = 0.0
+        if self._lifecycle is not None:
+            stats = self._lifecycle.stats()
+            evicted = (
+                stats["rows_evicted_ttl"] + stats["rows_evicted_lfu"]
+            )
+            alive = stats["resident_rows"]
+            if evicted + alive > 0:
+                dead_fraction = evicted / float(evicted + alive)
+        self._m_dead_fraction.set(dead_fraction)
+        self._t_dead_row_fraction = dead_fraction
+        if exploding_total > 0 and self._t_exploding_rows == 0:
+            # journal the EDGE only: a chronically hot table must not
+            # flood the journal once per scan
+            events.emit(
+                "health_table_exploding", ps=self._ps_id,
+                rows=exploding_total,
+                tables=sorted(
+                    t for t, d in per_table.items() if d["exploding"]
+                ),
+                norm_max=self._row_norm_max,
+            )
+        self._t_exploding_rows = exploding_total
+        return {
+            "tables": per_table,
+            "dead_row_fraction": dead_fraction,
+            "exploding_rows": exploding_total,
+        }
 
     def maybe_stream_checkpoint(self, watermark, every):
         """Watermark-driven sparse checkpoint cadence (ISSUE 12): in

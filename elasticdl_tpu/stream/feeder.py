@@ -97,10 +97,16 @@ def source_from_env(training_data, reader_params=None):
 class StreamFeeder:
     def __init__(self, dispatcher, source, saved_model_path="",
                  export_every=None, max_backlog_records=None,
-                 poll_secs=0.5):
+                 poll_secs=0.5, fleet=None):
         self._dispatcher = dispatcher
         self._source = source
         self._saved_model_path = saved_model_path
+        # training-health fold (ISSUE 15): windows carrying drift
+        # stats (label rate, id-novelty rate) feed the fleet monitor's
+        # label_shift detector directly — the feeder runs in the
+        # master process, no RPC
+        self._fleet = fleet
+        self._last_window_stats = None
         self._export_every = (
             export_every
             if export_every is not None
@@ -181,6 +187,23 @@ class StreamFeeder:
             )
             self._windows_minted += 1
             minted += 1
+            stats = getattr(window, "stats", None)
+            if stats is not None:
+                # tag drift with the record offset this window lands
+                # at (== the watermark once the window completes), so
+                # a label_shift alert points at a WINDOW, not a time
+                minted_records = self._dispatcher.stream_state()[
+                    "minted_records"
+                ]
+                self._last_window_stats = dict(
+                    stats, watermark=minted_records
+                )
+                if self._fleet is not None:
+                    self._fleet.observe_stream_window(
+                        minted_records,
+                        stats["label_rate"],
+                        stats["novelty_rate"],
+                    )
         self._maybe_export()
         return minted
 
@@ -217,5 +240,6 @@ class StreamFeeder:
             "export_every": self._export_every,
             "max_backlog_records": self._max_backlog,
             "source_exhausted": bool(self._source.exhausted),
+            "last_window_stats": self._last_window_stats,
         })
         return body

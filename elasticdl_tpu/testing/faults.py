@@ -48,7 +48,7 @@ logger = _logger_factory("elasticdl_tpu.testing.faults")
 
 FAULT_SPEC_ENV = "EDL_FAULT_SPEC"
 
-KINDS = ("unavailable", "deadline", "delay", "kill-once")
+KINDS = ("unavailable", "deadline", "delay", "kill-once", "nan-batch")
 
 _role = ""
 _role_lock = threading.Lock()
@@ -118,6 +118,16 @@ class FaultSpec:
                 if calls == nth and not self._fired_kill:
                     self._fired_kill = True
                     return "kill"
+                return None
+            if self.kind == "nan-batch":
+                # deterministic numerics fault (ISSUE 15): poison the
+                # rate-th matching train batch, once per process —
+                # kill-once semantics, applied to data instead of the
+                # process
+                nth = max(1, int(self.rate))
+                if calls == nth and not self._fired_kill:
+                    self._fired_kill = True
+                    return "poison"
                 return None
             # unavailable / deadline
             if self.rate >= 1.0:
@@ -231,10 +241,12 @@ class _FaultServerInterceptor(grpc.ServerInterceptor):
                     _kill_self(method)
                 elif isinstance(action, tuple):  # ("delay", secs)
                     time.sleep(action[1])
-                else:
+                elif action in _STATUS:
                     context.abort(
                         _STATUS[action], "injected fault on %s" % method
                     )
+                # "poison" (nan-batch) is a data-plane action: it only
+                # means something at maybe_poison_batch call sites
             return inner(request, context)
 
         return grpc.unary_unary_rpc_method_handler(
@@ -261,7 +273,7 @@ class _FaultClientInterceptor(grpc.UnaryUnaryClientInterceptor):
                 _kill_self(method)
             elif isinstance(action, tuple):
                 time.sleep(action[1])
-            else:
+            elif action in _STATUS:
                 raise FaultInjectedError(_STATUS[action], method)
         return continuation(client_call_details, request)
 
@@ -282,3 +294,62 @@ def intercept_client_channel(channel):
     if not specs:
         return channel
     return grpc.intercept_channel(channel, _FaultClientInterceptor(specs))
+
+
+def maybe_poison_batch(batch, method="train_step"):
+    """Deterministic NaN-batch injection (ISSUE 15): when an armed
+    ``nan-batch`` spec matches (role, method) and its schedule fires,
+    every float feature of this batch is replaced with NaN — the
+    forward pass then yields a nonfinite loss/gradients, exactly the
+    corruption the health sentinels exist to catch. The batch's
+    labels/mask/integer ids are untouched (shapes and dtypes — and so
+    the compiled step — never change). Provably inert unset: one
+    ``_specs()`` cache check, the batch object returned as-is."""
+    specs = _specs()
+    if not specs:
+        return batch
+    fired = False
+    for spec in specs:
+        if spec.kind != "nan-batch" or not spec.matches(
+            current_role(), method
+        ):
+            continue
+        if spec.fire() == "poison":
+            fired = True
+    if not fired:
+        return batch
+    import numpy as np
+
+    raw = batch.get("features")
+    poisoned = []
+    if isinstance(raw, dict):
+        features = dict(raw)
+        for key in sorted(features):
+            arr = np.asarray(features[key])
+            if arr.dtype.kind == "f":
+                features[key] = np.full_like(arr, np.nan)
+                poisoned.append(key)
+    else:
+        # single-input models carry features as one bare array
+        features = raw
+        arr = np.asarray(raw)
+        if arr.dtype.kind == "f":
+            features = np.full_like(arr, np.nan)
+            poisoned.append("features")
+    if not poisoned:
+        shape = (
+            sorted(raw) if isinstance(raw, dict)
+            else "array%r" % (getattr(raw, "shape", None),)
+        )
+        logger.warning(
+            "nan-batch fired but the batch has no float features to "
+            "poison (features: %s)", shape,
+        )
+        return batch
+    logger.warning(
+        "fault injection: poisoned batch features %s with NaN",
+        poisoned,
+    )
+    out = dict(batch)
+    out["features"] = features
+    return out

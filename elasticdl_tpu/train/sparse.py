@@ -485,8 +485,16 @@ def _split_batch(batch, row_keys):
 
 
 @hot_path
-def make_sparse_train_step(model, loss_fn, tx, specs, compute_dtype=None):
-    """Train step that also returns d(loss)/d(embedding rows)."""
+def make_sparse_train_step(model, loss_fn, tx, specs, compute_dtype=None,
+                           health=False, guard_nonfinite=False):
+    """Train step that also returns d(loss)/d(embedding rows).
+
+    ``health=True`` (ISSUE 15) appends a fourth output — the in-graph
+    health scalars (global grad norm over dense AND row gradients +
+    nonfinite flag); ``guard_nonfinite`` keeps the previous dense
+    state on a nonfinite batch (the skip sentinel — the caller drops
+    the matching row-grad push, so the batch contributes nothing
+    anywhere). ``health=False`` emits the exact pre-health program."""
     row_keys = [spec.name + ROWS_SUFFIX for spec in specs]
 
     def train_step(state: TrainState, batch):
@@ -525,7 +533,22 @@ def make_sparse_train_step(model, loss_fn, tx, specs, compute_dtype=None):
             key[: -len(ROWS_SUFFIX)]: value
             for key, value in row_grads.items()
         }
-        return new_state, loss, named
+        if not health:
+            return new_state, loss, named
+        from elasticdl_tpu.train.step_fns import (
+            global_grad_norm,
+            guard_nonfinite_state,
+            health_scalars,
+        )
+
+        scalars = health_scalars(
+            loss, global_grad_norm(param_grads, row_grads)
+        )
+        if guard_nonfinite:
+            new_state = guard_nonfinite_state(
+                state, new_state, scalars["nonfinite"]
+            )
+        return new_state, loss, named, scalars
 
     return train_step
 
@@ -607,11 +630,29 @@ class SparseTrainer:
         cache_capacity=1_000_000,
         async_push=None,
         device_tier=None,
+        health=None,
     ):
         self._model = model
         self._tx = optimizer
         self._rng = jax.random.PRNGKey(seed)
         self._specs = list(specs)
+        # Training-health sentinels (ISSUE 15): None reads EDL_HEALTH
+        # (default on), False disables, or pass a HealthTracker. With
+        # a tracker the jitted step returns the in-graph health
+        # scalars as one extra small output; EDL_HEALTH=0 compiles the
+        # exact pre-health program (test-asserted).
+        from elasticdl_tpu.train.health import maybe_tracker
+
+        if health is None:
+            self.health = maybe_tracker(role="worker")
+        elif health is False:
+            self.health = None
+        else:
+            self.health = health
+        self._health_on = self.health is not None
+        self._health_guard = (
+            self._health_on and self.health.action == "skip"
+        )
         cache = (
             HotRowCache(cache_staleness, cache_capacity)
             if cache_staleness > 0
@@ -652,7 +693,9 @@ class SparseTrainer:
         # jitting to the first batch so they can attach mesh shardings
         self._jit_steps(
             make_sparse_train_step(
-                model, loss_fn, optimizer, self._specs, compute_dtype
+                model, loss_fn, optimizer, self._specs, compute_dtype,
+                health=self._health_on,
+                guard_nonfinite=self._health_guard,
             ),
             make_row_grads_fn(model, loss_fn, self._specs, compute_dtype),
             make_eval_step(model, compute_dtype),
@@ -852,6 +895,34 @@ class SparseTrainer:
             # read stale spillover rows)
             self.device_tier.close()
 
+    def _dispatch_train_step(self, state, prepared):
+        """Run the jitted step (health-injection hook included);
+        returns (state, loss, row_grads, health_scalars|None)."""
+        from elasticdl_tpu.testing import faults
+
+        prepared = faults.maybe_poison_batch(prepared)
+        outputs = self._train_step(state, prepared)
+        if not self._health_on:
+            state, loss, row_grads = outputs
+            return state, loss, row_grads, None
+        return outputs
+
+    def _observe_health(self, loss, scalars):
+        """Fetch the step's health scalars (the one small host
+        transfer) and fold them into the tracker. Returns True when
+        the skip sentinel says this batch contributes nothing (the
+        in-graph guard already kept the state; the caller drops the
+        push and any device-tier apply). Raises HealthSentinelError
+        under halt."""
+        if scalars is None:
+            return False
+        action = self.health.observe(
+            float(loss),
+            float(scalars["grad_norm"]),
+            bool(scalars["nonfinite"]),
+        )
+        return action == "skip"
+
     def train_step(self, state, batch):
         """batch: raw (un-prepared) batch with id features."""
         prepared, pull_info = self._prepare_once(batch)
@@ -862,8 +933,16 @@ class SparseTrainer:
             batch, prepared, pull_info
         )
         t0 = self.timing.start()
-        state, loss, row_grads = self._train_step(state, prepared)
+        state, loss, row_grads, scalars = self._dispatch_train_step(
+            state, prepared
+        )
         row_grads = self._fetch_row_grads(row_grads)
+        if self._observe_health(loss, scalars):
+            # skip sentinel: the state kept its pre-batch value
+            # in-graph; dropping the push AND the device-tier apply
+            # here means the poisoned batch reaches nothing
+            self.timing.end_record_sync("batch_process", t0, loss)
+            return state, loss
         row_grads = self._tier_apply_extract(row_grads, pull_info)
         self.timing.end_record_sync("batch_process", t0, loss)
         if self._async_push:
@@ -1053,13 +1132,19 @@ class SparseTrainer:
             """Fetch the in-flight step's row grads (fences the device)
             and fold them into the accumulator. With a device tier the
             hit grads apply in HBM first and only the miss grads come
-            to host (flight_info's push ids are miss-only)."""
+            to host (flight_info's push ids are miss-only). Health
+            scalars are observed HERE — at the fetch, not at dispatch —
+            so the sentinel check never breaks the stream's overlap;
+            a skip-sentinel batch folds nothing (and never reaches the
+            device tier)."""
             nonlocal in_flight, acc_steps
-            row_grads, flight_info = in_flight
+            row_grads, flight_info, loss, scalars = in_flight
             in_flight = None
-            grads = self._tier_apply_extract(
-                self._fetch_row_grads(row_grads), flight_info
-            )
+            fetched_grads = self._fetch_row_grads(row_grads)
+            if self._observe_health(loss, scalars):
+                acc_steps += 1
+                return
+            grads = self._tier_apply_extract(fetched_grads, flight_info)
             fetched = {
                 name: np.asarray(value)
                 for name, value in grads.items()
@@ -1085,7 +1170,9 @@ class SparseTrainer:
                 prepared, pull_info = self._tier_combine(
                     batch, prepared, pull_info
                 )
-                state, loss, row_grads = self._train_step(state, prepared)
+                state, loss, row_grads, scalars = (
+                    self._dispatch_train_step(state, prepared)
+                )
                 # Start the device->host copy of the row grads NOW:
                 # np.asarray in fold_in_flight would otherwise only
                 # begin the transfer after the lookahead pull returns,
@@ -1096,7 +1183,7 @@ class SparseTrainer:
                     for leaf in jax.tree_util.tree_leaves(row_grads):
                         if hasattr(leaf, "copy_to_host_async"):
                             leaf.copy_to_host_async()
-                in_flight = (row_grads, pull_info)
+                in_flight = (row_grads, pull_info, loss, scalars)
                 # ---- overlap window: device is busy with step N ----
                 # consumer bookkeeping first (its record report unblocks
                 # the master's next task — see docstring), then the

@@ -271,14 +271,23 @@ class SparseSpmdTrainer(SparseTrainer):
                 spec.name: self._row_grads_sharding()
                 for spec in self._specs
             }
+            out_shardings = (
+                self._state_shardings,
+                self._replicated_nd,
+                row_out,
+            )
+            if self._health_on:
+                # health scalars (ISSUE 15): replicated — the global
+                # grad norm is a full reduction, XLA psums it back to
+                # every device, and all processes see one value
+                out_shardings = out_shardings + ({
+                    "grad_norm": self._replicated_nd,
+                    "nonfinite": self._replicated_nd,
+                },)
             self._jit_train[key] = jax.jit(
                 self._train_step_fn,
                 in_shardings=(self._state_shardings, shardings),
-                out_shardings=(
-                    self._state_shardings,
-                    self._replicated_nd,
-                    row_out,
-                ),
+                out_shardings=out_shardings,
                 donate_argnums=(0,),
             )
         return self._jit_train[key](state, self._device_batch(prepared))

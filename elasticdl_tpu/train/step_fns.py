@@ -16,6 +16,43 @@ from elasticdl_tpu.data.pipeline import MASK_KEY
 from elasticdl_tpu.train.train_state import TrainState, cast_floating
 
 
+def global_grad_norm(*grad_trees):
+    """Global L2 norm over every leaf of the given gradient trees, in
+    fp32 — the health scalar the grad-explosion sentinel watches. One
+    extra reduction in-graph; no host transfer of its own."""
+    total = jnp.zeros((), jnp.float32)
+    for tree in grad_trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            total = total + jnp.sum(
+                jnp.square(leaf.astype(jnp.float32))
+            )
+    return jnp.sqrt(total)
+
+
+def health_scalars(loss, grad_norm):
+    """The in-graph health tuple (ISSUE 15): cheap scalars the trainers
+    fetch as ONE small host transfer per batch. ``nonfinite`` covers
+    the loss and — because a NaN/Inf anywhere in the gradients makes
+    their global norm nonfinite — every gradient leaf."""
+    nonfinite = jnp.logical_or(
+        jnp.logical_not(jnp.isfinite(loss)),
+        jnp.logical_not(jnp.isfinite(grad_norm)),
+    )
+    return {"grad_norm": grad_norm, "nonfinite": nonfinite}
+
+
+def guard_nonfinite_state(old_state, new_state, nonfinite):
+    """In-graph skip sentinel: when the batch's loss/grads are
+    nonfinite, keep the ENTIRE previous state (params, optimizer
+    slots, mutable collections, step) — the poisoned batch then
+    contributes nothing, matching a run that never saw it. Selected
+    per-leaf with jnp.where so the jitted program is branch-free."""
+    return jax.tree_util.tree_map(
+        lambda old, new: jnp.where(nonfinite, old, new),
+        old_state, new_state,
+    )
+
+
 def _apply_model(model, params, model_state, features, training, rngs):
     variables = {"params": params, **model_state}
     if model_state:
@@ -38,8 +75,15 @@ def _apply_model(model, params, model_state, features, training, rngs):
 
 @hot_path
 def make_train_step(model, loss_fn, tx, compute_dtype=None,
-                    grad_accum_steps=1):
+                    grad_accum_steps=1, health=False,
+                    guard_nonfinite=False):
     """Returns train_step(state, batch) -> (new_state, loss).
+
+    ``health=True`` (ISSUE 15) additionally returns a third output —
+    the in-graph health scalars dict (global grad norm + nonfinite
+    flag); with ``guard_nonfinite`` a nonfinite batch keeps the
+    previous state in-graph (the skip sentinel). ``health=False`` is
+    the exact pre-health program: no extra outputs (test-asserted).
 
     ``grad_accum_steps=k`` splits the batch into k equal microbatches
     scanned sequentially, accumulating MASK-WEIGHTED gradient sums and
@@ -106,6 +150,16 @@ def make_train_step(model, loss_fn, tx, compute_dtype=None,
             )
         }
 
+        def finish(new_state, loss, grads):
+            if not health:
+                return new_state, loss
+            scalars = health_scalars(loss, global_grad_norm(grads))
+            if guard_nonfinite:
+                new_state = guard_nonfinite_state(
+                    state, new_state, scalars["nonfinite"]
+                )
+            return new_state, loss, scalars
+
         if grad_accum_steps == 1:
             def compute_loss(params):
                 loss_sum, (weight, new_model_state) = _loss_sum(
@@ -119,7 +173,10 @@ def make_train_step(model, loss_fn, tx, compute_dtype=None,
             (loss, new_model_state), grads = jax.value_and_grad(
                 compute_loss, has_aux=True
             )(state.params)
-            return _apply_update(state, grads, loss, new_model_state)
+            new_state, loss = _apply_update(
+                state, grads, loss, new_model_state
+            )
+            return finish(new_state, loss, grads)
 
         k = int(grad_accum_steps)
 
@@ -183,9 +240,10 @@ def make_train_step(model, loss_fn, tx, compute_dtype=None,
         grads = jax.tree_util.tree_map(
             lambda g: g / weight, grads_sum
         )
-        return _apply_update(
+        new_state, loss = _apply_update(
             state, grads, loss_sum / weight, new_model_state
         )
+        return finish(new_state, loss, grads)
 
     return train_step
 

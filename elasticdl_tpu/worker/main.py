@@ -174,6 +174,7 @@ def main(argv=None):
     # SIGTERM now triggers the graceful drain instead of a bare exit
     drain_hook.bind(worker)
     from elasticdl_tpu.common.log_utils import default_logger
+    from elasticdl_tpu.train.health import HealthSentinelError
     from elasticdl_tpu.worker.worker import (
         EPOCH_RESTART_EXIT_CODE,
         MeshEpochChanged,
@@ -192,6 +193,18 @@ def main(argv=None):
                 logger.warning(
                     "distributed shutdown barrier failed (peers gone?)"
                 )
+    except HealthSentinelError as e:
+        # sentinel halt (ISSUE 15): the task was already reported
+        # failed (requeued once) and health_halt journaled by the
+        # tracker; exit nonzero with the buffers flushed so the
+        # failure is LOUD, attributable, and postmortem-readable
+        logger.error("health sentinel halt: %s", e)
+        events.emit(
+            "role_stop", worker=args.worker_id, reason="health_halt"
+        )
+        events.flush()
+        trace.flush()
+        return 1
     except MeshEpochChanged as e:
         # pod manager relaunches us with the same command line; the
         # restarted process rejoins at the new epoch and resumes from

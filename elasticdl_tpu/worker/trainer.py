@@ -29,15 +29,33 @@ class JaxTrainer:
         compute_dtype=None,
         seed=0,
         grad_accum_steps=1,
+        health=None,
     ):
         self._model = model
         self._tx = optimizer
         self._rng = jax.random.PRNGKey(seed)
+        # Training-health sentinels (ISSUE 15): None reads EDL_HEALTH
+        # (default on), False disables, or pass a HealthTracker. The
+        # jitted step then also returns the in-graph health scalars;
+        # EDL_HEALTH=0 compiles the exact pre-health program.
+        from elasticdl_tpu.train.health import maybe_tracker
+
+        if health is None:
+            self.health = maybe_tracker(role="worker")
+        elif health is False:
+            self.health = None
+        else:
+            self.health = health
+        self._health_on = self.health is not None
         compute_dtype = resolve_dtype(compute_dtype)
         self._train_step = jax.jit(
             make_train_step(
                 model, loss_fn, optimizer, compute_dtype,
                 grad_accum_steps=grad_accum_steps,
+                health=self._health_on,
+                guard_nonfinite=(
+                    self._health_on and self.health.action == "skip"
+                ),
             ),
             donate_argnums=(0,),
         )
@@ -64,7 +82,21 @@ class JaxTrainer:
 
     def train_step(self, state, batch):
         state = self.ensure_state(state, batch)
-        return self._train_step(state, batch)
+        from elasticdl_tpu.testing import faults
+
+        batch = faults.maybe_poison_batch(batch)
+        if not self._health_on:
+            return self._train_step(state, batch)
+        state, loss, scalars = self._train_step(state, batch)
+        # one small host transfer per batch; a skip-sentinel batch
+        # already kept its state in-graph (nothing else to drop on
+        # the dense path — there is no PS push); halt raises
+        self.health.observe(
+            float(loss),
+            float(scalars["grad_norm"]),
+            bool(scalars["nonfinite"]),
+        )
+        return state, loss
 
     def eval_step(self, state, batch):
         outputs = self._eval_step(state, batch["features"])

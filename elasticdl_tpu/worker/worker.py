@@ -29,6 +29,7 @@ from elasticdl_tpu.data.pipeline import (
 )
 from elasticdl_tpu.models.registry import get_model_spec
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.train.health import HealthSentinelError
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 from elasticdl_tpu.worker.trainer import JaxTrainer
 
@@ -427,6 +428,21 @@ class Worker:
             blob.tier_hits = stats["hits"]
             blob.tier_misses = stats["misses"]
             blob.tier_evictions = stats["evictions"]
+        # training health (ISSUE 15): the numerics sentinels' view of
+        # this worker's model — loss EWMA, grad norm, nonfinite tallies
+        # — feeding the master's nonfinite_loss / loss_spike /
+        # grad_explosion detectors
+        tracker = getattr(self.trainer, "health", None)
+        if tracker is not None:
+            stats = tracker.stats()
+            blob.health_loss_ewma = stats["loss_ewma"]
+            blob.health_loss_last = stats["loss_last"]
+            blob.health_grad_norm = stats["grad_norm"]
+            blob.health_nonfinite_batches = stats["nonfinite_batches"]
+            blob.health_nonfinite_streak = stats["nonfinite_streak"]
+            blob.health_loss_spikes = stats["loss_spikes"]
+            blob.health_grad_explosions = stats["grad_explosions"]
+            blob.health_skipped_batches = stats["skipped_batches"]
         return blob
 
     def _update_step_telemetry(self, real_count):
@@ -976,6 +992,15 @@ class Worker:
             # the stream so its prefetch thread stops fetching
             self.tds.report_pending_failed("checkpoint restore failed")
             self.tds.report_parked_failed("checkpoint restore failed")
+            raise
+        except HealthSentinelError as e:
+            # EDL_HEALTH_ON_NONFINITE=halt: the task fails LOUDLY —
+            # reported with the sentinel's message (a COUNTED failure,
+            # so the master requeues it exactly once toward the retry
+            # cap), parked work handed back, then the error propagates
+            # and the process exits nonzero. Never train past a halt.
+            self.tds.report_pending_failed("health halt: %s" % (e,))
+            self.tds.report_parked_failed("requeue: health halt")
             raise
         except MeshEpochChanged:
             # requeue in-flight tasks NOW: the relaunched process reuses

@@ -314,6 +314,118 @@ python scripts/postmortem.py "$EDL_EVENTS_DIR" 2>/dev/null | tee /tmp/_postmorte
 grep -q "task_dispatch" /tmp/_postmortem.out
 grep -q "per-worker summary:" /tmp/_postmortem.out
 
+echo "== tier 1d (health): training-health smoke (NaN injection -> /alerts + skip) =="
+# ISSUE 15: a real master + PS + worker deepfm job with a
+# deterministically injected NaN batch (testing/faults.py nan-batch
+# spec) under EDL_HEALTH_ON_NONFINITE=skip. The worker's health
+# sentinels must catch the batch in-graph, the master's
+# nonfinite_loss detector must raise on /alerts while the job runs,
+# the job must still COMPLETE (skip drops only the poisoned batch),
+# and the postmortem must thread the health events.
+HEALTH_DIR="$(mktemp -d)"
+export HEALTH_DIR
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, subprocess, sys, tempfile, threading, time, urllib.request
+sys.path.insert(0, "tests")
+from test_utils import create_ctr_recordio
+from elasticdl_tpu.common.grpc_utils import find_free_port
+
+events_dir = os.path.join(os.environ["HEALTH_DIR"], "events")
+os.makedirs(events_dir)
+os.environ["EDL_EVENTS_DIR"] = events_dir
+os.environ["EDL_HEALTH_ON_NONFINITE"] = "skip"
+# hold the alert through the short job so the poll can't miss it
+os.environ["EDL_HEALTH_ALERT_SECS"] = "600"
+# the injection: poison the 5th train batch of this process
+os.environ["EDL_FAULT_SPEC"] = "worker-0:train_step:nan-batch:5"
+
+train = tempfile.mkdtemp()
+create_ctr_recordio(train + "/f0.rec", num_records=512, seed=0)
+pport = find_free_port()
+ps = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.ps.server", "--ps_id", "0",
+    "--num_ps_pods", "1", "--port", str(pport),
+    "--opt_type", "adam", "--opt_args", "lr=0.01", "--use_async", "1",
+], env={**os.environ, "JAX_PLATFORMS": "cpu",
+        "EDL_FAULT_SPEC": ""})
+
+import socket
+def wait_port(port, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port)); return
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    raise TimeoutError(port)
+
+wait_port(pport)
+from elasticdl_tpu.data.readers import RecordIODataReader
+from elasticdl_tpu.master.master import Master
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+from elasticdl_tpu.testing import faults
+
+faults.set_role("worker-0")
+statz = find_free_port()
+master = Master(
+    "elasticdl_tpu.models.deepfm", training_data=train,
+    records_per_task=64, num_epochs=1,
+    port=find_free_port(), metrics_port=statz,
+)
+master.prepare()
+mc = MasterClient("localhost:%d" % master._port, worker_id=0)
+mc.reset_worker()
+worker = Worker(
+    mc, "elasticdl_tpu.models.deepfm",
+    RecordIODataReader(data_dir=train), minibatch_size=32,
+    wait_sleep_secs=0.1, ps_addrs=["localhost:%d" % pport],
+)
+wt = threading.Thread(target=worker.run, daemon=True)
+wt.start()
+rc_box = {}
+mt = threading.Thread(
+    target=lambda: rc_box.update(
+        rc=master.run(poll_secs=0.2, timeout_secs=240)
+    ),
+    daemon=True,
+)
+mt.start()
+# the injection window: poll /alerts until nonfinite_loss fires
+alert = None
+deadline = time.time() + 180
+while time.time() < deadline and mt.is_alive():
+    try:
+        alerts = json.load(urllib.request.urlopen(
+            "http://127.0.0.1:%d/alerts" % statz, timeout=5))
+    except Exception:
+        time.sleep(0.5); continue
+    hit = [a for a in alerts if a["alert"] == "nonfinite_loss"]
+    if hit:
+        alert = hit[0]
+        break
+    time.sleep(0.5)
+mt.join(timeout=300)
+wt.join(timeout=60)
+ps.terminate(); ps.wait(timeout=30)
+assert alert is not None, "nonfinite_loss never raised on /alerts"
+assert alert["skipped"] >= 1, alert
+assert rc_box.get("rc") == 0, "job did not complete under skip: %s" % rc_box
+stats = worker.trainer.health.stats()
+assert stats["nonfinite_batches"] == 1, stats
+assert stats["skipped_batches"] == 1, stats
+print("health smoke OK: nonfinite_loss on /alerts (%r), job rc 0, "
+      "1 batch skipped" % alert["alert"])
+PYEOF
+python scripts/postmortem.py "$HEALTH_DIR/events" 2>/dev/null | tee /tmp/_health_pm.out | head -5 || true
+# the sentinel + the alert thread through the postmortem timeline
+grep -q "health_nonfinite" /tmp/_health_pm.out
+grep -q "nonfinite_loss" /tmp/_health_pm.out
+grep -q "training health:" /tmp/_health_pm.out
+
 echo "== tier 1e: chaos smoke (EDL_FAULT_SPEC + control-plane crash recovery) =="
 # a live local master+PS+worker job under deterministic fault injection
 # (docs/FAULT_TOLERANCE.md): the PS answers UNAVAILABLE for its first
@@ -976,6 +1088,29 @@ printf '{"ts": "%s", "prof_overhead": %s}\n' \
   "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_prof_overhead.json)" \
   >> /tmp/ci_wire_micro.jsonl
 echo "profiler-overhead A/B journaled to /tmp/ci_wire_micro.jsonl"
+
+# Health-scalar overhead A/B (ISSUE 15): deepfm steps/s with the
+# in-graph health scalars + tracker on vs the pre-health program,
+# interleaved inside ONE process so box drift cancels. Absolute
+# steps/s are report-only (journaled below); the script hard-fails
+# the acceptance gate — measured overhead above 2% (after one
+# re-measure) or a tracker that saw no batches.
+JAX_PLATFORMS=cpu python scripts/bench_health_overhead.py | tee /tmp/_health_overhead.json
+printf '{"ts": "%s", "health_overhead": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_health_overhead.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "health-overhead A/B journaled to /tmp/ci_wire_micro.jsonl"
+
+# Span-id entropy A/B (ISSUE 15 satellite): buffered 4 KiB entropy
+# pool vs the per-call os.urandom it replaced (PR 14's profiler
+# measured the syscall at ~5-7% of traced-run host samples).
+# Report-only numbers; hard-fails only if the pool fails to beat the
+# per-call path or deals a duplicate id.
+python scripts/bench_span_entropy.py | tee /tmp/_span_entropy.json
+printf '{"ts": "%s", "span_entropy": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_span_entropy.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "span-entropy A/B journaled to /tmp/ci_wire_micro.jsonl"
 
 # Bench-trend watchdog (ISSUE 14): folds the repo's BENCH_r*.json
 # series plus everything this run just journaled above into per-metric

@@ -164,6 +164,12 @@ def summarize(timeline, dump_headers):
     evicted_ids = {}  # "table/id" -> last eviction reason
     stream = {"watermark": 0, "checkpoints": 0, "exports": 0,
               "closed": False}
+    # training health (ISSUE 15): sentinel events + the health alerts
+    # threaded per role, so "did the model break, where, and what did
+    # the sentinel do about it" is one summary read
+    health = {"nonfinite": 0, "loss_spikes": 0, "grad_explosions": 0,
+              "halts": 0, "table_exploding": 0}
+    health_roles = {}  # role -> [event kinds in order]
     job_failed = None
     for event in timeline:
         kind = event.get("event")
@@ -211,6 +217,22 @@ def summarize(timeline, dump_headers):
                 stream["closed"] = True
         elif kind == "job_failed":
             job_failed = event
+        elif kind in (
+            "health_nonfinite", "health_loss_spike",
+            "health_grad_explosion", "health_halt",
+            "health_table_exploding",
+        ):
+            tally = {
+                "health_nonfinite": "nonfinite",
+                "health_loss_spike": "loss_spikes",
+                "health_grad_explosion": "grad_explosions",
+                "health_halt": "halts",
+                "health_table_exploding": "table_exploding",
+            }[kind]
+            health[tally] += 1
+            health_roles.setdefault(
+                str(event.get("role", "?")), []
+            ).append(kind)
     for header in dump_headers:
         role = header.get("role") or ""
         # worker dumps are keyed by the role's worker id when present
@@ -225,6 +247,8 @@ def summarize(timeline, dump_headers):
         "lifecycle": lifecycle,
         "evicted_ids": evicted_ids,
         "stream": stream,
+        "health": health,
+        "health_roles": health_roles,
         "job_failed": job_failed,
     }
 
@@ -291,6 +315,13 @@ def render_text(timeline, summary, dump_headers, alert_counters):
             % (stream["watermark"], stream["checkpoints"],
                stream["exports"], stream["closed"])
         )
+    health = summary.get("health", {})
+    if any(health.values()):
+        lines.append("  training health: %r" % (health,))
+        for role, kinds in sorted(
+            summary.get("health_roles", {}).items()
+        ):
+            lines.append("    %s: %s" % (role, ", ".join(kinds)))
     if summary["job_failed"]:
         lines.append("  JOB FAILED: %r" % (summary["job_failed"],))
     return "\n".join(lines)

@@ -1,0 +1,708 @@
+"""Training-health sentinels (ISSUE 15): tracker semantics, in-graph
+scalars, the sentinel-action contract (alert/skip/halt), NaN-batch
+fault injection, PS table-health scan, stream drift stats, and the
+end-to-end /alerts + postmortem thread."""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.observability import events
+from elasticdl_tpu.observability import metrics as obs_metrics
+from elasticdl_tpu.testing import faults
+from elasticdl_tpu.train.health import (
+    HealthSentinelError,
+    HealthTracker,
+    health_enabled,
+    maybe_tracker,
+    nonfinite_action,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults._reset_for_tests()
+    yield
+    faults._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# HealthTracker semantics
+
+
+def test_tracker_loss_spike_robust_z():
+    t = HealthTracker(action="alert", spike_z=4.0, warmup_steps=5,
+                      grad_factor=0.0)
+    for _ in range(30):
+        t.observe(1.0 + np.random.RandomState(0).uniform(-0.01, 0.01),
+                  0.5, False)
+    assert t.loss_spikes == 0
+    t.observe(50.0, 0.5, False)  # way past 4 sigma of the dev EWMA
+    assert t.loss_spikes == 1
+    # the spike folded into the EWMAs AFTER the check: the next normal
+    # loss is not itself flagged as a (downward) spike storm
+    spikes = t.loss_spikes
+    t.observe(1.0, 0.5, False)
+    t.observe(1.0, 0.5, False)
+    assert t.loss_spikes <= spikes + 1
+
+
+def test_tracker_grad_explosion_absolute_and_relative():
+    t = HealthTracker(action="alert", spike_z=0.0, warmup_steps=2,
+                      grad_norm_max=100.0, grad_factor=10.0)
+    for _ in range(5):
+        t.observe(1.0, 1.0, False)
+    t.observe(1.0, 200.0, False)  # absolute ceiling
+    assert t.grad_explosions == 1
+    t2 = HealthTracker(action="alert", spike_z=0.0, warmup_steps=2,
+                       grad_norm_max=0.0, grad_factor=10.0)
+    for _ in range(5):
+        t2.observe(1.0, 1.0, False)
+    t2.observe(1.0, 50.0, False)  # 50x the EWMA
+    assert t2.grad_explosions == 1
+
+
+def test_tracker_nonfinite_streak_and_actions():
+    t = HealthTracker(action="alert")
+    assert t.observe(float("nan"), 1.0, True) is None
+    assert t.nonfinite_streak == 1
+    assert t.observe(float("nan"), 1.0, True) is None
+    assert t.nonfinite_streak == 2
+    t.observe(1.0, 1.0, False)
+    assert t.nonfinite_streak == 0
+    assert t.nonfinite_total == 2
+
+    t_skip = HealthTracker(action="skip")
+    assert t_skip.observe(float("nan"), 1.0, True) == "skip"
+    assert t_skip.skipped_batches == 1
+
+    t_halt = HealthTracker(action="halt")
+    with pytest.raises(HealthSentinelError):
+        t_halt.observe(float("nan"), 1.0, True)
+
+
+def test_tracker_warmup_suppresses_detection():
+    t = HealthTracker(action="alert", spike_z=2.0, warmup_steps=50,
+                      grad_norm_max=1.0)
+    for i in range(20):
+        t.observe(float(i * 100), 50.0, False)  # wild, but in warmup
+    assert t.loss_spikes == 0 and t.grad_explosions == 0
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv("EDL_HEALTH", raising=False)
+    monkeypatch.delenv("EDL_HEALTH_ON_NONFINITE", raising=False)
+    assert health_enabled()
+    assert nonfinite_action() == "alert"
+    assert maybe_tracker() is not None
+    monkeypatch.setenv("EDL_HEALTH", "0")
+    assert not health_enabled()
+    assert maybe_tracker() is None
+    monkeypatch.setenv("EDL_HEALTH_ON_NONFINITE", "skip")
+    assert nonfinite_action() == "skip"
+    monkeypatch.setenv("EDL_HEALTH_ON_NONFINITE", "explode")
+    with pytest.raises(ValueError):
+        nonfinite_action()
+
+
+# ---------------------------------------------------------------------------
+# in-graph scalars + EDL_HEALTH=0 inertness
+
+
+def _dense_pieces():
+    from elasticdl_tpu.models import mnist
+
+    return mnist.custom_model(), mnist.loss, mnist.optimizer()
+
+
+def _mnist_batch(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "features": rng.uniform(size=(n, 28, 28, 1)).astype(np.float32),
+        "labels": rng.randint(0, 10, n).astype(np.int64),
+        "_mask": np.ones(n, np.float32),
+    }
+
+
+def test_health_off_emits_no_extra_outputs():
+    """EDL_HEALTH=0 inertness, the acceptance contract: the factory
+    default compiles the exact pre-health program — a 2-tuple from
+    the dense step, no health dict anywhere."""
+    import jax
+
+    from elasticdl_tpu.train.step_fns import make_train_step
+    from elasticdl_tpu.train.train_state import create_train_state
+
+    model, loss_fn, tx = _dense_pieces()
+    batch = _mnist_batch()
+    step = jax.jit(make_train_step(model, loss_fn, tx))
+    state = create_train_state(
+        model, tx, jax.random.PRNGKey(0), batch["features"]
+    )
+    out = step(state, batch)
+    assert len(out) == 2  # (state, loss) — nothing else
+
+
+def test_health_on_returns_scalars_and_matches_off_state():
+    """With health on (alert mode), the extra outputs exist AND the
+    state math is bit-identical to the health-off program."""
+    import jax
+
+    from elasticdl_tpu.train.step_fns import make_train_step
+    from elasticdl_tpu.train.train_state import create_train_state
+
+    model, loss_fn, tx = _dense_pieces()
+    batch = _mnist_batch()
+    state_a = create_train_state(
+        model, tx, jax.random.PRNGKey(0), batch["features"]
+    )
+    state_b = jax.tree_util.tree_map(lambda x: x.copy(), state_a)
+    plain = jax.jit(make_train_step(model, loss_fn, tx))
+    healthy = jax.jit(make_train_step(model, loss_fn, tx, health=True))
+    new_a, loss_a = plain(state_a, batch)
+    new_b, loss_b, scalars = healthy(state_b, batch)
+    assert float(loss_a) == float(loss_b)
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(new_a), jax.tree_util.tree_leaves(new_b)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert np.isfinite(float(scalars["grad_norm"]))
+    assert not bool(scalars["nonfinite"])
+
+
+def test_guard_nonfinite_keeps_previous_state():
+    import jax
+
+    from elasticdl_tpu.train.step_fns import make_train_step
+    from elasticdl_tpu.train.train_state import create_train_state
+
+    model, loss_fn, tx = _dense_pieces()
+    batch = _mnist_batch()
+    poisoned = dict(batch)
+    poisoned["features"] = np.full_like(batch["features"], np.nan)
+    state = create_train_state(
+        model, tx, jax.random.PRNGKey(0), batch["features"]
+    )
+    before = jax.tree_util.tree_map(
+        lambda x: np.asarray(x).copy(), state
+    )
+    step = jax.jit(make_train_step(
+        model, loss_fn, tx, health=True, guard_nonfinite=True
+    ))
+    new_state, loss, scalars = step(state, poisoned)
+    assert bool(scalars["nonfinite"])
+    assert not np.isfinite(float(loss))
+    # every leaf — params, slots, step counter — kept its old value
+    for old, new in zip(
+        jax.tree_util.tree_leaves(before),
+        jax.tree_util.tree_leaves(new_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(old), np.asarray(new))
+
+
+# ---------------------------------------------------------------------------
+# nan-batch fault injection (testing/faults.py)
+
+
+def test_nan_batch_spec_fires_once_on_nth_call(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "worker-0:train_step:nan-batch:3")
+    faults._reset_for_tests()
+    faults.set_role("worker-0")
+    batch = {"features": {"x": np.ones((4, 2), np.float32),
+                          "ids": np.ones((4, 2), np.int64)},
+             "labels": np.ones(4, np.int64)}
+    out1 = faults.maybe_poison_batch(batch)
+    out2 = faults.maybe_poison_batch(batch)
+    assert out1 is batch and out2 is batch  # calls 1-2: untouched
+    out3 = faults.maybe_poison_batch(batch)
+    assert np.isnan(out3["features"]["x"]).all()  # call 3: poisoned
+    # int features and labels untouched (shapes/dtypes stable)
+    assert out3["features"]["ids"].dtype == np.int64
+    assert np.array_equal(out3["labels"], batch["labels"])
+    assert not np.isnan(batch["features"]["x"]).any()  # input not mutated
+    out4 = faults.maybe_poison_batch(batch)
+    assert out4 is batch  # once per process
+
+
+def test_nan_batch_inert_when_unset(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV, raising=False)
+    faults._reset_for_tests()
+    batch = {"features": {"x": np.ones((2, 2), np.float32)}}
+    assert faults.maybe_poison_batch(batch) is batch
+
+
+def test_nan_batch_respects_role_and_method(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "worker-7:train_step:nan-batch:1")
+    faults._reset_for_tests()
+    faults.set_role("worker-0")  # different role: never fires
+    batch = {"features": {"x": np.ones((2, 2), np.float32)}}
+    for _ in range(3):
+        assert faults.maybe_poison_batch(batch) is batch
+
+
+# ---------------------------------------------------------------------------
+# the sentinel-action contract through a real SparseTrainer
+
+
+def _ctr_batches(n, batch=16, fields=10, vocab=100, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    # batch 0 carries the FULL vocab so every later batch's ids are
+    # already materialized: the skipped run and the never-saw-it run
+    # then materialize identical row sets in identical order
+    warm = np.arange(vocab, dtype=np.int64)
+    warm = np.resize(warm, (batch, fields))
+    out.append({"features": {"ids": warm},
+                "labels": rng.randint(0, 2, batch).astype(np.float32),
+                "_mask": np.ones(batch, np.float32)})
+    for _ in range(n - 1):
+        ids = rng.randint(0, vocab, size=(batch, fields)).astype(np.int64)
+        out.append({"features": {"ids": ids},
+                    "labels": rng.randint(0, 2, batch).astype(np.float32),
+                    "_mask": np.ones(batch, np.float32)})
+    return out
+
+
+def _sparse_trainer(action, **kwargs):
+    from elasticdl_tpu.models import deepfm
+    from elasticdl_tpu.ps.local_client import LocalPSClient
+    from elasticdl_tpu.train.sparse import SparseTrainer
+
+    return SparseTrainer(
+        model=deepfm.custom_model(),
+        loss_fn=deepfm.loss,
+        optimizer=deepfm.optimizer(),
+        specs=deepfm.sparse_embedding_specs(
+            num_features=10, batch_size=16
+        ),
+        ps_client=LocalPSClient(seed=0, opt_type="adam", lr=0.01),
+        seed=0,
+        health=HealthTracker(action=action),
+        **kwargs,
+    )
+
+
+def _export_all(store):
+    out = {}
+    for name in store.table_names():
+        ids, values = store.export_table(name)
+        order = np.argsort(ids)
+        out[name] = (ids[order], values[order])
+    return out
+
+
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_skip_sentinel_ps_state_bit_identical(monkeypatch, pipelined):
+    """Acceptance: under skip, an injected-NaN run's final PS state is
+    bit-identical to a run that never saw the poisoned batch — for
+    both the sequential train_step and the pipelined train_stream.
+    The pipelined variant accumulates to ONE tail push
+    (push_interval > len(batches)): with per-step pushes the lookahead
+    pull legitimately races the background push (the async staleness
+    envelope), so per-step pulled values aren't run-comparable —
+    accumulate-then-push makes the stream's fold/drop semantics
+    deterministic, which is exactly the part skip must get right."""
+    batches = _ctr_batches(8)
+    poison_at = 4  # 1-based batch index the spec poisons
+
+    def run(trainer, run_batches):
+        state = None
+        if pipelined:
+            stream = trainer.train_stream(
+                state, run_batches, push_interval=100
+            )
+            for state, loss, _b in stream:
+                pass
+            trainer.close()
+        else:
+            for b in run_batches:
+                state, loss = trainer.train_step(state, b)
+        return _export_all(trainer.preparer._ps.store)
+
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "worker-0:train_step:nan-batch:%d" % poison_at)
+    faults._reset_for_tests()
+    faults.set_role("worker-0")
+    trainer_a = _sparse_trainer("skip")
+    state_a = run(trainer_a, batches)
+    assert trainer_a.health.skipped_batches == 1
+
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV, raising=False)
+    faults._reset_for_tests()
+    trainer_b = _sparse_trainer("skip")
+    clean = [b for i, b in enumerate(batches) if i != poison_at - 1]
+    state_b = run(trainer_b, clean)
+    assert trainer_b.health.skipped_batches == 0
+
+    assert state_a.keys() == state_b.keys()
+    for name in state_a:
+        np.testing.assert_array_equal(state_a[name][0], state_b[name][0])
+        np.testing.assert_array_equal(
+            state_a[name][1], state_b[name][1],
+            err_msg="table %s diverged" % name,
+        )
+
+
+def test_halt_sentinel_raises_and_journals(monkeypatch, tmp_path):
+    monkeypatch.setenv("EDL_EVENTS_DIR", str(tmp_path))
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "worker-0:train_step:nan-batch:2")
+    faults._reset_for_tests()
+    faults.set_role("worker-0")
+    events._reset_for_tests()
+    events.configure("worker-0")
+    try:
+        trainer = _sparse_trainer("halt")
+        batches = _ctr_batches(3)
+        state = None
+        state, _ = trainer.train_step(state, batches[0])
+        with pytest.raises(HealthSentinelError):
+            trainer.train_step(state, batches[1])
+    finally:
+        events._reset_for_tests()
+    lines = []
+    for path in tmp_path.glob("*.events.ndjson"):
+        with open(path, encoding="utf-8") as f:
+            lines += [json.loads(l) for l in f if l.strip()]
+    kinds = [e["event"] for e in lines]
+    assert "health_nonfinite" in kinds
+    assert "health_halt" in kinds
+
+
+def test_alert_mode_trains_on_and_counts(monkeypatch):
+    """Default action: the NaN propagates exactly as pre-health (the
+    batch is counted, nothing skipped, state NOT guarded)."""
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "worker-0:train_step:nan-batch:2")
+    faults._reset_for_tests()
+    faults.set_role("worker-0")
+    trainer = _sparse_trainer("alert")
+    batches = _ctr_batches(3)
+    state = None
+    state, _ = trainer.train_step(state, batches[0])
+    state, loss = trainer.train_step(state, batches[1])
+    assert not np.isfinite(float(loss))
+    assert trainer.health.nonfinite_total == 1
+    assert trainer.health.skipped_batches == 0
+
+
+def test_halt_fails_task_and_master_requeues_exactly_once(
+    monkeypatch, tmp_path
+):
+    """Acceptance: under halt, the task fails with a journaled
+    health_halt and the master requeues it exactly once — through a
+    REAL in-process master + worker."""
+    import sys
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_utils import create_mnist_recordio
+
+    from elasticdl_tpu.common.grpc_utils import find_free_port
+    from elasticdl_tpu.data.readers import RecordIODataReader
+    from elasticdl_tpu.master.master import Master
+    from elasticdl_tpu.worker.master_client import MasterClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    monkeypatch.setenv("EDL_EVENTS_DIR", str(tmp_path))
+    monkeypatch.setenv("EDL_HEALTH_ON_NONFINITE", "halt")
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV,
+                       "worker-0:train_step:nan-batch:2")
+    faults._reset_for_tests()
+    faults.set_role("worker-0")
+    events._reset_for_tests()
+    events.configure("worker-0")
+    train = tempfile.mkdtemp()
+    create_mnist_recordio(train + "/f0.rec", num_records=96, seed=0)
+    master = Master(
+        "elasticdl_tpu.models.mnist", training_data=train,
+        records_per_task=32, num_epochs=1, port=find_free_port(),
+    )
+    master.prepare()
+    try:
+        mc = MasterClient("localhost:%d" % master._port, worker_id=0)
+        mc.reset_worker()
+        worker = Worker(
+            mc, "elasticdl_tpu.models.mnist",
+            RecordIODataReader(data_dir=train),
+            minibatch_size=32, wait_sleep_secs=0.1,
+        )
+        with pytest.raises(HealthSentinelError):
+            worker.run()
+        assert worker.trainer.health.nonfinite_total == 1
+        # every held task (current + prefetched) went back exactly
+        # ONCE, as a COUNTED failure — the worker died right after,
+        # so nothing can loop the retry counter
+        requeues = [
+            e for e in _journal_events(tmp_path)
+            if e["event"] == "task_requeue"
+        ]
+        assert requeues, "no task_requeue journaled"
+        tasks = [e.get("task") for e in requeues]
+        assert len(tasks) == len(set(tasks)), requeues  # once per task
+        assert all(e.get("counted") is True for e in requeues)
+        assert all(e.get("retries") == 1 for e in requeues)
+        kinds = [e["event"] for e in _journal_events(tmp_path)]
+        assert "health_halt" in kinds
+    finally:
+        master.stop()
+        events._reset_for_tests()
+
+
+def _journal_events(events_dir):
+    lines = []
+    import glob
+
+    for path in glob.glob(str(events_dir) + "/*.events.ndjson"):
+        with open(path, encoding="utf-8") as f:
+            lines += [json.loads(l) for l in f if l.strip()]
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# PS table-health scan
+
+
+def _scan_servicer(lifecycle=None, **env):
+    from elasticdl_tpu.ps.embedding_store import NumpyEmbeddingStore
+    from elasticdl_tpu.ps.servicer import PserverServicer
+
+    store = NumpyEmbeddingStore(seed=0)
+    store.set_optimizer("sgd", lr=0.1)
+    store.create_table("emb", 8, init_scale=0.05)
+    store.lookup("emb", np.arange(64, dtype=np.int64))
+    return store, PserverServicer(
+        store, use_async=True, lifecycle=lifecycle
+    )
+
+
+def test_table_health_scan_percentiles_and_exploding(monkeypatch):
+    store, servicer = _scan_servicer()
+    store.import_table("emb", np.array([3], np.int64),
+                       np.full((1, 8), 5e3, np.float32))
+    scan = servicer.table_health_scan(force=True)
+    table = scan["tables"]["emb"]
+    assert 0 < table["p50"] < 1.0  # init-scale norms
+    assert table["exploding"] == 1
+    assert scan["exploding_rows"] == 1
+    blob = servicer.telemetry_blob()
+    assert blob.ps_exploding_rows == 1
+    assert blob.ps_row_norm_p99 > blob.ps_row_norm_p50 > 0
+
+
+def test_table_health_scan_skips_oversized_tables(monkeypatch):
+    """The scan samples via export_table (a full copy under the table
+    lock): past EDL_HEALTH_SCAN_MAX_ROWS it must SKIP the table, not
+    stall the data plane for a 256-row sample."""
+    monkeypatch.setenv("EDL_HEALTH_SCAN_MAX_ROWS", "32")
+    store, servicer = _scan_servicer()  # 64 resident rows > cap
+    scan = servicer.table_health_scan(force=True)
+    assert scan["tables"] == {}  # the only table was skipped
+    monkeypatch.setenv("EDL_HEALTH_SCAN_MAX_ROWS", "1000")
+    store2, servicer2 = _scan_servicer()
+    assert "emb" in servicer2.table_health_scan(force=True)["tables"]
+
+
+def test_table_health_scan_rate_limited_and_gated(monkeypatch):
+    store, servicer = _scan_servicer()
+    assert servicer.table_health_scan(force=True) is not None
+    # second un-forced scan inside the window: skipped
+    assert servicer.table_health_scan() is None
+    # EDL_HEALTH=0 disables the scan entirely
+    monkeypatch.setenv("EDL_HEALTH", "0")
+    store2, servicer2 = _scan_servicer()
+    assert servicer2.table_health_scan(force=True) is None
+
+
+def test_table_health_dead_row_fraction_from_lifecycle(monkeypatch):
+    monkeypatch.setenv("EDL_EMB_ADMIT_K", "1")
+    monkeypatch.setenv("EDL_EMB_MAX_ROWS", "16")
+    # just-touched rows are sweep-protected for 1 s by default; the
+    # test's rows were admitted milliseconds ago
+    monkeypatch.setenv("EDL_EMB_LFU_PROTECT_SECS", "0")
+    from elasticdl_tpu.ps.embedding_store import NumpyEmbeddingStore
+    from elasticdl_tpu.ps.servicer import PserverServicer
+    from elasticdl_tpu.stream.lifecycle import EmbeddingLifecycle
+
+    store = NumpyEmbeddingStore(seed=0)
+    store.set_optimizer("sgd", lr=0.1)
+    lifecycle = EmbeddingLifecycle.maybe_create(store)
+    assert lifecycle is not None
+    servicer = PserverServicer(
+        store, use_async=True, lifecycle=lifecycle
+    )
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    servicer._create_tables([pb.EmbeddingTableInfo(
+        name="emb", dim=4, initializer="0.05"
+    )])
+    # admit 32 ids through pushes, then sweep down to the 16-row bound
+    ids = np.arange(32, dtype=np.int64)
+    grads = np.full((32, 4), 0.1, np.float32)
+    mask = lifecycle.filter_push("emb", ids)
+    store.push_gradients("emb", ids[mask], grads[mask])
+    evicted = lifecycle.sweep()
+    assert evicted["lfu"] > 0
+    scan = servicer.table_health_scan(force=True)
+    assert scan["dead_row_fraction"] > 0
+    stats = lifecycle.stats()
+    expect = (
+        (stats["rows_evicted_ttl"] + stats["rows_evicted_lfu"])
+        / float(stats["rows_evicted_ttl"] + stats["rows_evicted_lfu"]
+                + stats["resident_rows"])
+    )
+    assert scan["dead_row_fraction"] == pytest.approx(expect)
+
+
+# ---------------------------------------------------------------------------
+# stream drift stats -> feeder -> fleet
+
+
+def test_feeder_folds_window_stats_into_fleet(tmp_path):
+    from elasticdl_tpu.master.fleet import FleetMonitor
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.stream.feeder import StreamFeeder
+    from elasticdl_tpu.stream.source import SyntheticClickstreamSource
+
+    source = SyntheticClickstreamSource(
+        str(tmp_path / "spool"), records_per_window=64,
+        hot_vocab=50, drift_per_window=10, total_records=512, seed=3,
+    )
+    dispatcher = TaskDispatcher(
+        {}, records_per_task=64, num_epochs=0, stream=True
+    )
+    fleet = FleetMonitor()
+    feeder = StreamFeeder(dispatcher, source, fleet=fleet)
+    minted = feeder.tick()
+    assert minted == 8
+    books = fleet.snapshot()["health"]["stream"]
+    assert books["windows"] == 8
+    assert books["watermark"] > 0
+    assert 0 <= books["last_label_rate"] <= 1
+    state = feeder.state()
+    assert state["last_window_stats"]["watermark"] == 512
+
+
+# ---------------------------------------------------------------------------
+# worker telemetry carries the tracker
+
+
+def test_worker_telemetry_blob_health_fields():
+    """The piggyback path: a trainer-shaped object with a tracker ->
+    TelemetryBlob fields 28-35, without standing up a Worker."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    tracker = HealthTracker(action="skip")
+    tracker.observe(0.7, 0.5, False)
+    tracker.observe(float("nan"), float("nan"), True)
+    blob = pb.TelemetryBlob()
+    stats = tracker.stats()
+    blob.health_loss_ewma = stats["loss_ewma"]
+    blob.health_nonfinite_batches = stats["nonfinite_batches"]
+    blob.health_nonfinite_streak = stats["nonfinite_streak"]
+    blob.health_skipped_batches = stats["skipped_batches"]
+    wire = pb.TelemetryBlob.FromString(blob.SerializeToString())
+    assert wire.health_nonfinite_batches == 1
+    assert wire.health_nonfinite_streak == 1
+    assert wire.health_skipped_batches == 1
+    assert wire.health_loss_ewma == pytest.approx(0.7)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: all four detectors end-to-end — raise AND clear on a
+# live FleetMonitor, visible on /alerts over HTTP, threaded by
+# postmortem
+
+
+def test_four_detectors_end_to_end_alerts_and_postmortem(
+    monkeypatch, tmp_path
+):
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ))
+    import postmortem as pm
+
+    monkeypatch.setenv("EDL_EVENTS_DIR", str(tmp_path))
+    events._reset_for_tests()
+    events.configure("master")
+    from elasticdl_tpu.master.fleet import FleetMonitor
+    from elasticdl_tpu.observability.http_server import (
+        ObservabilityServer,
+    )
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    try:
+        fleet = FleetMonitor(
+            dead_air_secs=600, health_alert_secs=0.25,
+            label_shift_delta=0.1, id_novelty_max=0.8,
+        )
+        # synthetic traces drive each detector
+        fleet.observe(0, pb.TelemetryBlob(
+            role="worker-0", health_nonfinite_batches=1,
+            health_nonfinite_streak=2, health_skipped_batches=0,
+        ))
+        fleet.observe(1, pb.TelemetryBlob(
+            role="worker-1", health_loss_spikes=3,
+            health_loss_last=9.0, health_loss_ewma=0.7,
+        ))
+        fleet.observe(2, pb.TelemetryBlob(
+            role="worker-2", health_grad_explosions=1,
+            health_grad_norm=4200.0,
+        ))
+        for i in range(6):
+            fleet.observe_stream_window(64 * (i + 1), 0.5, 0.1)
+        fleet.observe_stream_window(448, 0.9, 0.1)
+        from elasticdl_tpu.common.grpc_utils import find_free_port
+
+        server = ObservabilityServer(
+            "master", find_free_port()
+        ).start()
+        server.add_json_handler("/alerts", fleet.alerts)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                "http://localhost:%d/alerts" % server.port, timeout=5
+            ).read())
+            kinds = {a["alert"] for a in body}
+            assert kinds == {
+                "nonfinite_loss", "loss_spike", "grad_explosion",
+                "label_shift",
+            }, kinds
+            # ... and every one CLEARS: recovery blobs + window decay
+            fleet.observe(0, pb.TelemetryBlob(
+                role="worker-0", health_nonfinite_batches=1,
+            ))
+            time.sleep(0.4)
+            body = json.loads(urllib.request.urlopen(
+                "http://localhost:%d/alerts" % server.port, timeout=5
+            ).read())
+            assert body == [], body
+        finally:
+            server.stop()
+    finally:
+        events._reset_for_tests()
+    report = pm.postmortem(str(tmp_path))
+    raised = [e for e in report["timeline"]
+              if e.get("event") == "alert_raised"]
+    cleared = [e for e in report["timeline"]
+               if e.get("event") == "alert_cleared"]
+    assert {e["alert"] for e in raised} == {
+        "nonfinite_loss", "loss_spike", "grad_explosion", "label_shift"
+    }
+    assert {e["alert"] for e in cleared} == {
+        "nonfinite_loss", "loss_spike", "grad_explosion", "label_shift"
+    }
+    # the health alerts thread into the per-worker summary
+    text = pm.render_text(
+        report["timeline"], report["summary"], report["dumps"], {}
+    )
+    assert "nonfinite_loss" in text

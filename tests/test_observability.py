@@ -624,6 +624,173 @@ def test_alert_transitions_bump_counter_once(monkeypatch):
         obs_metrics.reset_default_registry()
 
 
+def test_alert_clear_and_reraise_cycle_counts_and_journals(
+    monkeypatch, tmp_path
+):
+    """Satellite (ISSUE 15): raise→clear→re-raise cycles. Only raise
+    paths were asserted before; this pins the full cycle — the counter
+    bumps once per RAISE transition (twice across the cycle), never on
+    clear, and BOTH edges land in the journal."""
+    monkeypatch.setenv("EDL_METRICS", "1")
+    monkeypatch.setenv("EDL_EVENTS_DIR", str(tmp_path))
+    obs_metrics.reset_default_registry()
+    events._reset_for_tests()
+    events.configure("master")
+    try:
+        fleet = _fleet(version_lag_max=10)
+        # raise
+        fleet.observe(-1, _blob(role="ps-0", version_lag=50))
+        assert [a["alert"] for a in fleet.evaluate()] == ["version_lag"]
+        # clear (lag recovers)
+        fleet.observe(-1, _blob(role="ps-0", version_lag=0))
+        assert fleet.evaluate() == []
+        # re-raise
+        fleet.observe(-1, _blob(role="ps-0", version_lag=80))
+        assert [a["alert"] for a in fleet.evaluate()] == ["version_lag"]
+        counter = obs_metrics.default_registry().get(
+            "edl_master_alerts_total"
+        )
+        assert counter.get("version_lag") == 2  # one per raise, none per clear
+        lines = []
+        for path in tmp_path.glob("*.events.ndjson"):
+            with open(path, encoding="utf-8") as f:
+                lines += [json.loads(l) for l in f if l.strip()]
+        edges = [
+            (e["event"], e["alert"]) for e in lines
+            if e["event"] in ("alert_raised", "alert_cleared")
+        ]
+        assert edges == [
+            ("alert_raised", "version_lag"),
+            ("alert_cleared", "version_lag"),
+            ("alert_raised", "version_lag"),
+        ], edges
+    finally:
+        obs_metrics.reset_default_registry()
+        events._reset_for_tests()
+
+
+def test_straggler_clear_and_reraise_cycle(monkeypatch):
+    """The straggler detector's clear edge (recovery) and re-raise
+    both transition correctly — cycle coverage for a second detector
+    family (fleet-relative, not threshold-absolute)."""
+    monkeypatch.setenv("EDL_METRICS", "1")
+    obs_metrics.reset_default_registry()
+    try:
+        fleet = _fleet(straggler_factor=2.0)
+        for wid, ewma in ((0, 0.1), (1, 0.1), (2, 0.9)):
+            fleet.observe(wid, _blob(step_time_ewma=ewma))
+        assert [a["alert"] for a in fleet.evaluate()] == ["straggler"]
+        fleet.observe(2, _blob(step_time_ewma=0.11))  # recovers
+        assert fleet.evaluate() == []
+        fleet.observe(2, _blob(step_time_ewma=0.95))  # degrades again
+        assert [a["alert"] for a in fleet.evaluate()] == ["straggler"]
+        counter = obs_metrics.default_registry().get(
+            "edl_master_alerts_total"
+        )
+        assert counter.get("straggler") == 2
+    finally:
+        obs_metrics.reset_default_registry()
+
+
+# ---------------------------------------------------------------------------
+# training-health detectors (ISSUE 15)
+
+
+def test_health_detectors_raise_and_clear(monkeypatch):
+    """nonfinite_loss / loss_spike / grad_explosion: raise on recent
+    counter movement (or a live streak), clear after the recency
+    window, re-raise on the next movement."""
+    import time
+
+    monkeypatch.setenv("EDL_METRICS", "1")
+    obs_metrics.reset_default_registry()
+    try:
+        fleet = _fleet(health_alert_secs=0.2)
+        fleet.observe(0, _blob(
+            role="worker-0", health_nonfinite_batches=1,
+            health_nonfinite_streak=1,
+        ))
+        fleet.observe(1, _blob(
+            role="worker-1", health_loss_spikes=1,
+            health_grad_explosions=1,
+        ))
+        kinds = {a["alert"] for a in fleet.evaluate()}
+        assert kinds == {
+            "nonfinite_loss", "loss_spike", "grad_explosion"
+        }, kinds
+        # a LIVE streak keeps nonfinite_loss firing past the window
+        time.sleep(0.3)
+        fleet.observe(0, _blob(
+            role="worker-0", health_nonfinite_batches=1,
+            health_nonfinite_streak=1,
+        ))
+        kinds = {a["alert"] for a in fleet.evaluate()}
+        assert kinds == {"nonfinite_loss"}, kinds
+        # streak ends, counters stop moving: everything clears
+        fleet.observe(0, _blob(
+            role="worker-0", health_nonfinite_batches=1,
+        ))
+        time.sleep(0.3)
+        assert fleet.evaluate() == []
+        # re-raise on the next increment
+        fleet.observe(1, _blob(
+            role="worker-1", health_loss_spikes=2,
+            health_grad_explosions=1,
+        ))
+        assert [a["alert"] for a in fleet.evaluate()] == ["loss_spike"]
+        counter = obs_metrics.default_registry().get(
+            "edl_master_alerts_total"
+        )
+        assert counter.get("loss_spike") == 2
+        assert counter.get("nonfinite_loss") == 1
+        assert counter.get("grad_explosion") == 1
+    finally:
+        obs_metrics.reset_default_registry()
+
+
+def test_label_shift_detector_tags_the_window():
+    import time
+
+    fleet = _fleet(health_alert_secs=0.2, label_shift_delta=0.1,
+                   id_novelty_max=0.8)
+    for i in range(6):  # warm the label-rate EWMA
+        fleet.observe_stream_window(128 * (i + 1), 0.5, 0.2)
+    assert fleet.evaluate() == []
+    fleet.observe_stream_window(896, 0.85, 0.2)  # label rate jumps
+    firing = fleet.evaluate()
+    assert [a["alert"] for a in firing] == ["label_shift"]
+    assert firing[0]["watermark"] == 896  # drift attributable to a window
+    assert firing[0]["reason"] == "label_rate"
+    time.sleep(0.3)  # back in band: clears after the window
+    assert fleet.evaluate() == []
+    # novelty-rate ceiling is the other trigger
+    fleet.observe_stream_window(1024, 0.5, 0.95)
+    firing = fleet.evaluate()
+    assert firing and firing[0]["reason"] == "id_novelty"
+
+
+def test_statusz_health_section():
+    fleet = _fleet()
+    fleet.observe(0, _blob(
+        role="worker-0", health_loss_ewma=0.69,
+        health_nonfinite_batches=2, health_skipped_batches=1,
+    ))
+    fleet.observe(-1, _blob(
+        role="ps-0", ps_row_norm_p50=0.07, ps_row_norm_p99=1.2,
+        ps_dead_row_fraction=0.25, ps_exploding_rows=3,
+    ))
+    fleet.observe_stream_window(512, 0.4, 0.1)
+    body = fleet.snapshot()
+    json.dumps(body)  # JSON-ready
+    health = body["health"]
+    assert health["workers"]["worker-0"]["health_nonfinite_batches"] == 2
+    assert health["workers"]["worker-0"]["health_skipped_batches"] == 1
+    assert health["ps"]["ps-0"]["ps_exploding_rows"] == 3
+    assert health["ps"]["ps-0"]["ps_dead_row_fraction"] == 0.25
+    assert health["stream"]["windows"] == 1
+    assert body["thresholds"]["health_alert_secs"] == 30.0
+
+
 def test_snapshot_carries_fleet_and_extras():
     fleet = _fleet()
     fleet.observe(0, _blob(role="worker-0", step_time_ewma=0.25,

@@ -945,6 +945,83 @@ def test_deterministic_tracer_suppression_comment_works():
 
 
 # ---------------------------------------------------------------------------
+# num-silent-nonfinite (ISSUE 15)
+
+def test_nonfinite_rule_flags_nan_aggregations_in_scope():
+    findings = findings_for("""
+        import numpy as np
+
+        def summarize(losses, grads):
+            mean = np.nanmean(losses)       # BUG: NaN batch vanishes
+            grads = np.nan_to_num(grads)    # BUG: corruption trains on
+            return mean, grads
+    """, path="elasticdl_tpu/train/fixture.py",
+        rules=["num-silent-nonfinite"])
+    assert len(findings) == 2, findings
+    assert {f.code for f in findings} == {
+        "np.nanmean", "np.nan_to_num"
+    }
+
+
+def test_nonfinite_rule_flags_bare_imported_name_and_jnp():
+    findings = findings_for("""
+        import jax.numpy as jnp
+        from numpy import nansum as ns
+
+        def fold(values):
+            return ns(values) + jnp.nanmax(values)
+    """, path="elasticdl_tpu/ps/fixture.py",
+        rules=["num-silent-nonfinite"])
+    assert {f.code for f in findings} == {"ns", "jnp.nanmax"}
+
+
+def test_nonfinite_rule_only_fires_in_hot_scopes():
+    source = """
+        import numpy as np
+
+        def report(values):
+            return np.nanmean(values)
+    """
+    # scripts/tooling summarizing "absent encoded as NaN" are fine
+    assert not findings_for(
+        source, path="scripts/bench_report.py",
+        rules=["num-silent-nonfinite"],
+    )
+    assert not findings_for(
+        source, path="elasticdl_tpu/analysis/fixture.py",
+        rules=["num-silent-nonfinite"],
+    )
+    # the training data path is not
+    assert findings_for(
+        source, path="elasticdl_tpu/worker/fixture.py",
+        rules=["num-silent-nonfinite"],
+    )
+
+
+def test_nonfinite_rule_quiet_on_finite_math():
+    assert not findings_for("""
+        import numpy as np
+
+        def fold(values, mask):
+            kept = values[mask]
+            return np.mean(kept), np.sum(kept), np.isnan(values).any()
+    """, path="elasticdl_tpu/train/fixture.py",
+        rules=["num-silent-nonfinite"])
+
+
+def test_nonfinite_rule_suppression_comment_works():
+    assert not findings_for("""
+        import numpy as np
+
+        def report(values):
+            # metrics array encodes "absent" as NaN by design
+            # edlint: disable=num-silent-nonfinite
+            return np.nanmean(values)
+    """, path="elasticdl_tpu/train/fixture.py",
+        rules=["num-silent-nonfinite"])
+
+
+# ---------------------------------------------------------------------------
 # ft-unbounded-vocab (ISSUE 12: id-keyed growth with no eviction bound)
 
 UNBOUNDED_VOCAB = """

@@ -971,3 +971,60 @@ def test_serve_drain_flushes_trace_and_journals_event(
     finally:
         events._reset_for_tests()
         trace._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# buffered span-id entropy (ISSUE 15 satellite)
+
+
+def test_entropy_pool_id_shapes_and_uniqueness():
+    """Pooled ids keep the W3C wire shape (16-hex span / 32-hex trace)
+    and never repeat across refills (10k ids spans ~20 refills of the
+    4 KiB buffer at 8 bytes/id... it spans at least 19 boundaries)."""
+    from elasticdl_tpu.observability.trace import (
+        _new_span_id,
+        _new_trace_id,
+    )
+
+    span_ids = {_new_span_id() for _ in range(10_000)}
+    assert len(span_ids) == 10_000
+    assert all(len(s) == 16 for s in span_ids)
+    trace_ids = {_new_trace_id() for _ in range(1_000)}
+    assert len(trace_ids) == 1_000
+    assert all(len(t) == 32 for t in trace_ids)
+    int(next(iter(span_ids)), 16)  # hex
+
+
+def test_entropy_pool_refills_and_resets():
+    from elasticdl_tpu.observability.trace import _EntropyPool
+
+    pool = _EntropyPool(size=32)  # tiny: force refills every 4 takes
+    taken = [pool.take(8) for _ in range(20)]
+    assert all(len(t) == 8 for t in taken)
+    assert len(set(taken)) == 20  # refills never re-deal bytes
+    # fork-safety hook: reset() empties the buffer so a child draws
+    # fresh entropy instead of replaying the parent's remainder
+    pool.reset()
+    assert pool._buf == b"" and pool._pos == 0
+    assert len(pool.take(8)) == 8  # next take refills cleanly
+
+
+def test_entropy_pool_concurrent_takes_are_distinct():
+    import threading
+
+    from elasticdl_tpu.observability.trace import _new_span_id
+
+    out = [None] * 8
+
+    def draw(i):
+        out[i] = [_new_span_id() for _ in range(2_000)]
+
+    threads = [
+        threading.Thread(target=draw, args=(i,)) for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    merged = [s for chunk in out for s in chunk]
+    assert len(set(merged)) == len(merged)
