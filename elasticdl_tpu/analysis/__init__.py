@@ -55,6 +55,8 @@ docs/STATIC_ANALYSIS.md for suppressions (``# edlint: disable=<rule>``)
 and the baseline workflow.
 """
 
+from elasticdl_tpu.common.annotations import thread_context  # noqa: F401
+
 from elasticdl_tpu.analysis.core import (  # noqa: F401
     Finding,
     RULE_NAMES,

@@ -9,9 +9,11 @@ import json
 import os
 import sys
 
+from elasticdl_tpu.analysis.callgraph import build_graph
 from elasticdl_tpu.analysis.core import (
     RULE_NAMES,
-    analyze_paths,
+    _load_units,
+    analyze_units,
     baseline_dict,
     load_baseline,
     split_baselined,
@@ -68,6 +70,13 @@ def main(argv=None):
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
+    parser.add_argument(
+        "--graph", action="store_true",
+        help="dump the whole-program call graph the conc-* rules run on "
+             "(functions, entries, lock order, cycles, unresolved "
+             "callees) as JSON and exit — debug aid for triaging a "
+             "concurrency finding",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -79,13 +88,39 @@ def main(argv=None):
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
     try:
-        findings, errors = analyze_paths(args.paths, rules=rules)
-    except (FileNotFoundError, ValueError) as e:
+        units, errors = _load_units(args.paths)
+    except FileNotFoundError as e:
         print("edlint: error: %s" % e, file=sys.stderr)
         return 2
     for path, message in errors:
         print("edlint: parse error in %s: %s" % (path, message),
               file=sys.stderr)
+
+    if args.graph:
+        json.dump(build_graph(units).to_json(), sys.stdout, indent=2,
+                  sort_keys=True)
+        sys.stdout.write("\n")
+        return 2 if errors else 0
+
+    try:
+        findings = analyze_units(units, rules=rules)
+    except ValueError as e:
+        print("edlint: error: %s" % e, file=sys.stderr)
+        return 2
+
+    # The conc-* rules degrade soundly on unresolvable callees; the
+    # contract (callgraph.py) is that degradation is surfaced, never
+    # silent. Report the count once per run.
+    if rules is None or any(r.startswith("conc-") for r in rules):
+        unknown_count, unknown_sample = build_graph(units).unknown_summary()
+        if unknown_count:
+            print(
+                "edlint: note: %d call site(s) with unresolved "
+                "possibly-package callees degraded conc-* analysis "
+                "(e.g. %s) — run --graph to inspect"
+                % (unknown_count, ", ".join(unknown_sample[:3])),
+                file=sys.stderr,
+            )
 
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as f:

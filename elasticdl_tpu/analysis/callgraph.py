@@ -1,0 +1,1127 @@
+"""edlint v2 engine: whole-program call graph with concurrency summaries.
+
+Builds, from ALL parsed units at once, a repo-wide call graph over
+``elasticdl_tpu/``:
+
+- **functions**: module-level defs, methods (attributed to their class),
+  nested defs — keyed ``"<module>:<qualname>"``;
+- **call edges**: ``self.method()``, ``self._attr.method()`` (attribute
+  types inferred from ``self._attr = ClassName(...)`` in ``__init__``),
+  module-qualified calls through import aliases, local-variable method
+  calls when the variable's type is inferable (``x = self._store``);
+- **thread entry points**: ``threading.Thread(target=...)``, executor
+  ``submit``/``map``, gRPC handler methods (public methods of
+  ``*Servicer`` classes), ``signal.signal`` handlers (reentrant);
+- **per-function summaries**: locks acquired (``with self._x_lock:`` /
+  ``.acquire()``), locks held at each call site, and blocking effects
+  (gRPC stub calls, socket/file I/O, ``np.savez``/``np.load``,
+  ``subprocess``, ``sleep``, queue ops without a timeout,
+  ``.result()``/``.join()``/``.wait()``).
+
+Lock identity is the class-qualified attribute name
+(``PserverServicer._push_lock``) — instances of the same class share an
+identity, so self-edges (A -> A) are skipped in the order graph rather
+than reported as reentrancy.
+
+The lattice is deliberately modest and the degradations explicit
+(docs/STATIC_ANALYSIS.md "edlint v2 engine"): dynamic dispatch through
+stored callbacks, locals whose type can't be traced to a constructor or
+``self`` attribute, and ``getattr`` all degrade to **unknown callee**,
+which is counted and surfaced once per run (``unknown_summary()``, the
+CLI note, ``--graph`` JSON) — never silently ignored.
+
+Thread-context contracts are declared either with
+``@thread_context("name")`` (``elasticdl_tpu.common.annotations``) or a
+``# edlint: thread=<name>`` comment on/above the ``def`` line.
+"""
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from elasticdl_tpu.analysis.core import attr_chain
+
+_THREAD_COMMENT_RE = re.compile(r"edlint:\s*thread=([\w\-]+)")
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+_IO_CALLS = {
+    "open", "io.open", "gzip.open",
+    "np.savez", "np.savez_compressed", "np.save", "np.load",
+    "numpy.savez", "numpy.savez_compressed", "numpy.save", "numpy.load",
+    "os.replace", "os.rename", "os.makedirs", "os.fsync", "os.remove",
+    "shutil.rmtree", "shutil.copy", "shutil.copytree", "shutil.move",
+    "urllib.request.urlopen",
+}
+
+_SOCKET_TAILS = {"recv", "recv_into", "send", "sendall", "connect", "accept"}
+
+# universal builtin-object method names: a failed resolution whose tail
+# is one of these is a str/dict/list/set/file receiver, not package code
+_COMMON_OBJ_METHODS = frozenset({
+    "add", "append", "appendleft", "clear", "close", "copy", "count",
+    "decode", "discard", "encode", "endswith", "extend", "format", "get",
+    "index", "insert", "items", "join", "keys", "lower", "pop", "popleft",
+    "read", "remove", "replace", "setdefault", "sort", "split",
+    "startswith", "strip", "update", "upper", "values", "write",
+})
+
+
+def _looks_lock(name):
+    low = name.lower()
+    return "lock" in low or "cond" in low or low in ("cv", "mutex")
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _kwarg(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+@dataclass
+class LockAcquire:
+    lock: str
+    line: int
+    held: tuple        # locks already held at this acquisition
+
+
+@dataclass
+class BlockEffect:
+    category: str      # io | grpc | sleep | wait | queue | subprocess | socket
+    code: str          # display code ("np.savez", "self._stub.pull", ...)
+    line: int
+    held: tuple
+
+
+@dataclass
+class CallSite:
+    display: str       # source-level callee text ("self._store.export")
+    line: int
+    held: tuple
+    callees: tuple     # resolved FunctionInfo keys (possibly several: MRO)
+    unresolved: bool   # True when this could be package code we can't see
+
+
+@dataclass
+class Entry:
+    key: str           # function key
+    context: str       # "grpc", "signal", "thread:<n>", "executor:<pool>"
+    reentrant: bool
+    reason: str        # human-readable provenance for --graph / messages
+    path: str
+    line: int
+
+
+class FunctionInfo:
+    def __init__(self, unit, node, qualname, class_info):
+        self.unit = unit
+        self.node = node
+        self.module = unit.module
+        self.qualname = qualname            # in-file qualname (Finding.symbol)
+        self.key = "%s:%s" % (unit.module, qualname)
+        self.class_info = class_info        # enclosing class (or None)
+        self.is_method = False              # directly in the class body
+        self.name = node.name
+        self.thread_context = None          # declared context name or None
+        self.reentrant = False
+        self.locks = []                     # [LockAcquire]
+        self.blocking = []                  # [BlockEffect]
+        self.calls = []                     # [CallSite]
+        self.local_defs = {}                # nested def name -> key
+
+    @property
+    def short(self):
+        return "%s.%s" % (self.module.rsplit(".", 1)[-1], self.qualname)
+
+
+class ClassInfo:
+    def __init__(self, unit, node, qualname):
+        self.unit = unit
+        self.node = node
+        self.module = unit.module
+        self.name = node.name
+        self.qualname = qualname
+        self.key = "%s:%s" % (unit.module, qualname)
+        self.base_exprs = [attr_chain(b) for b in node.bases]
+        self.bases = []                     # resolved ClassInfo, pass 2
+        self.methods = {}                   # name -> FunctionInfo key
+        self.lock_attrs = set()             # attrs assigned a lock factory
+        self.attr_types = {}                # attr -> ClassInfo
+
+    def mro(self):
+        """self + package-resolved bases, depth-first, cycle-safe."""
+        seen, order, work = set(), [], [self]
+        while work:
+            cls = work.pop(0)
+            if cls.key in seen:
+                continue
+            seen.add(cls.key)
+            order.append(cls)
+            work.extend(cls.bases)
+        return order
+
+
+class _ModuleTable:
+    """Per-module symbol table: import aliases, module-level locks,
+    module-level str constants, thread-context comment lines."""
+
+    def __init__(self, unit):
+        self.unit = unit
+        self.module = unit.module
+        self.modtail = unit.module.rsplit(".", 1)[-1]
+        self.aliases = {}       # local name -> dotted target
+        self.consts = {}        # module-level NAME -> str constant
+        self.locks = {}         # module-level name -> lock id
+        self.thread_lines = {}  # line -> declared context name
+        self._scan()
+
+    def _scan(self):
+        for lineno, text in enumerate(self.unit.source.splitlines(), 1):
+            m = _THREAD_COMMENT_RE.search(text)
+            if m and "#" in text.split(m.group(0))[0][-200:]:
+                self.thread_lines[lineno] = m.group(1)
+        for node in ast.walk(self.unit.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    self.aliases[name] = alias.asname and alias.name or (
+                        alias.name.split(".")[0]
+                    )
+                    if alias.asname:
+                        self.aliases[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg = self.module.split(".")
+                    pkg = pkg[: len(pkg) - node.level]
+                    base = ".".join(pkg + ([node.module] if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    self.aliases[alias.asname or alias.name] = (
+                        "%s.%s" % (base, alias.name) if base else alias.name
+                    )
+        for stmt in self.unit.tree.body:
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            target = stmt.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            value = _const_str(stmt.value)
+            if value is not None:
+                self.consts[target.id] = value
+            elif isinstance(stmt.value, ast.Call):
+                chain = attr_chain(stmt.value.func)
+                if chain and chain.split(".")[-1] in _LOCK_FACTORIES:
+                    self.locks[target.id] = "%s.%s" % (self.modtail, target.id)
+
+    def declared_context(self, node):
+        """Context from a # edlint: thread=<name> comment on/above a def."""
+        first = min([node.lineno] + [d.lineno for d in node.decorator_list])
+        for line in range(first - 1, node.lineno + 1):
+            if line in self.thread_lines:
+                return self.thread_lines[line]
+        return None
+
+
+class CallGraph:
+    """Whole-program index. Build with :meth:`build`; everything below
+    is derived data for the conc-* rules and ``--graph``."""
+
+    def __init__(self):
+        self.functions = {}       # key -> FunctionInfo
+        self.classes = {}         # key -> ClassInfo
+        self.tables = {}          # module -> _ModuleTable
+        self.module_funcs = {}    # module -> {name: key}
+        self.module_classes = {}  # module -> {name: ClassInfo}
+        self.modules = set()
+        self.entries = []         # [Entry]
+        self.unknown_calls = []   # [(path, line, display)]
+        self.defined_names = set()  # every def name in the package
+        self._contexts = None
+        self._acq_memo = {}
+        self._block_memo = {}
+
+    # ------------------------------------------------------------- build
+
+    @classmethod
+    def build(cls, units):
+        graph = cls()
+        for unit in units:
+            graph.modules.add(unit.module)
+            graph.tables[unit.module] = _ModuleTable(unit)
+            graph.module_funcs.setdefault(unit.module, {})
+            graph.module_classes.setdefault(unit.module, {})
+        for unit in units:
+            graph._collect(unit)
+        graph._resolve_classes()
+        graph.defined_names = {f.name for f in graph.functions.values()}
+        for info in graph.functions.values():
+            for child in info.node.body:
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info.local_defs[child.name] = "%s.%s" % (
+                        info.key, child.name
+                    )
+        for info in graph.functions.values():
+            _FuncScanner(graph, info).scan()
+        graph._collect_grpc_entries()
+        graph.entries.sort(key=lambda e: (e.path, e.line, e.context, e.key))
+        graph.unknown_calls.sort()
+        return graph
+
+    def _collect(self, unit):
+        table = self.tables[unit.module]
+
+        def rec(node, scope, class_info, parent_is_class):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    qual = ".".join(scope + [child.name])
+                    cinfo = ClassInfo(unit, child, qual)
+                    self.classes[cinfo.key] = cinfo
+                    if not scope:
+                        self.module_classes[unit.module][child.name] = cinfo
+                    rec(child, scope + [child.name], cinfo, True)
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join(scope + [child.name])
+                    # class_info is the ENCLOSING class even for closures
+                    # nested in methods: their ``self`` is the method's
+                    finfo = FunctionInfo(unit, child, qual, class_info)
+                    finfo.is_method = parent_is_class
+                    finfo.thread_context = table.declared_context(child)
+                    self._decorator_context(finfo, table)
+                    self.functions[finfo.key] = finfo
+                    if not scope:
+                        self.module_funcs[unit.module][child.name] = finfo.key
+                    if parent_is_class and child.name not in class_info.methods:
+                        class_info.methods[child.name] = finfo.key
+                    rec(child, scope + [child.name], class_info, False)
+                else:
+                    rec(child, scope, class_info, parent_is_class)
+
+        rec(unit.tree, [], None, False)
+
+    def _decorator_context(self, finfo, table):
+        for dec in finfo.node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            chain = attr_chain(dec.func)
+            if not chain or chain.split(".")[-1] != "thread_context":
+                continue
+            name = _const_str(dec.args[0]) if dec.args else None
+            if name:
+                finfo.thread_context = name
+            reentrant = _kwarg(dec, "reentrant")
+            if isinstance(reentrant, ast.Constant) and reentrant.value is True:
+                finfo.reentrant = True
+
+    def _resolve_classes(self):
+        for cinfo in self.classes.values():
+            for base in cinfo.base_exprs:
+                if base is None:
+                    continue
+                resolved = self.resolve_symbol(cinfo.module, base)
+                if resolved and resolved[0] == "class":
+                    cinfo.bases.append(resolved[1])
+        # lock attrs + attribute types, from every method body
+        for cinfo in self.classes.values():
+            for mkey in cinfo.methods.values():
+                minfo = self.functions[mkey]
+                for node in ast.walk(minfo.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for target in node.targets:
+                        if not (
+                            isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"
+                        ):
+                            continue
+                        if isinstance(node.value, ast.Call):
+                            chain = attr_chain(node.value.func)
+                            if not chain:
+                                continue
+                            if chain.split(".")[-1] in _LOCK_FACTORIES:
+                                cinfo.lock_attrs.add(target.attr)
+                                continue
+                            resolved = self.resolve_symbol(cinfo.module, chain)
+                            if resolved and resolved[0] == "class":
+                                cinfo.attr_types.setdefault(
+                                    target.attr, resolved[1]
+                                )
+
+    # -------------------------------------------------------- resolution
+
+    def resolve_symbol(self, module, dotted):
+        """Resolve a dotted name seen in ``module`` to
+        ("class", ClassInfo) | ("func", key) | ("module", name) | None."""
+        parts = dotted.split(".")
+        table = self.tables.get(module)
+        if table is None:
+            return None
+        head = parts[0]
+        if len(parts) == 1:
+            classes = self.module_classes.get(module, {})
+            if head in classes:
+                return ("class", classes[head])
+            funcs = self.module_funcs.get(module, {})
+            if head in funcs:
+                return ("func", funcs[head])
+        if head in table.aliases:
+            parts = table.aliases[head].split(".") + parts[1:]
+        # longest module prefix
+        for cut in range(len(parts), 0, -1):
+            prefix = ".".join(parts[:cut])
+            if prefix in self.modules:
+                rest = parts[cut:]
+                if not rest:
+                    return ("module", prefix)
+                classes = self.module_classes.get(prefix, {})
+                funcs = self.module_funcs.get(prefix, {})
+                if rest[0] in classes:
+                    cinfo = classes[rest[0]]
+                    if len(rest) == 1:
+                        return ("class", cinfo)
+                    if len(rest) == 2:
+                        mkey = self._method(cinfo, rest[1])
+                        if mkey:
+                            return ("func", mkey)
+                    return None
+                if len(rest) == 1 and rest[0] in funcs:
+                    return ("func", funcs[rest[0]])
+                return None
+        if len(parts) == 1:
+            return None
+        # Class.method within the same module
+        classes = self.module_classes.get(module, {})
+        if parts[0] in classes and len(parts) == 2:
+            mkey = self._method(classes[parts[0]], parts[1])
+            if mkey:
+                return ("func", mkey)
+        return None
+
+    def _method(self, cinfo, name):
+        for cls in cinfo.mro():
+            if name in cls.methods:
+                return cls.methods[name]
+        return None
+
+    def lock_owner(self, cinfo, attr):
+        for cls in cinfo.mro():
+            if attr in cls.lock_attrs:
+                return cls.name
+        return None
+
+    def ctor_key(self, cinfo):
+        return self._method(cinfo, "__init__")
+
+    # ----------------------------------------------------------- entries
+
+    def _collect_grpc_entries(self):
+        """Public methods of ``*Servicer`` classes are gRPC handler
+        entry points unless they carry an explicit thread contract."""
+        for cinfo in self.classes.values():
+            if not cinfo.name.endswith("Servicer") or cinfo.name.startswith("_"):
+                continue
+            for name, key in sorted(cinfo.methods.items()):
+                if name.startswith("_"):
+                    continue
+                finfo = self.functions[key]
+                if finfo.thread_context is not None:
+                    continue
+                self.entries.append(Entry(
+                    key=key, context="grpc", reentrant=False,
+                    reason="public method of %s" % cinfo.name,
+                    path=finfo.unit.path, line=finfo.node.lineno,
+                ))
+
+    def add_entry(self, key, context, reentrant, reason, path, line):
+        self.entries.append(Entry(key, context, reentrant, reason, path, line))
+
+    # ------------------------------------------------- derived summaries
+
+    def callers(self):
+        """key -> [(caller FunctionInfo, CallSite)]"""
+        out = {}
+        for finfo in self.functions.values():
+            for site in finfo.calls:
+                for callee in site.callees:
+                    out.setdefault(callee, []).append((finfo, site))
+        return out
+
+    def transitive_acquires(self, key, _stack=None):
+        """lock id -> call path (tuple of function keys, callee-first)
+        for every lock acquired by ``key`` or any resolved callee."""
+        if key in self._acq_memo:
+            return self._acq_memo[key]
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            return {}
+        stack.add(key)
+        finfo = self.functions.get(key)
+        out = {}
+        if finfo is not None:
+            for acq in finfo.locks:
+                out.setdefault(acq.lock, (key,))
+            for site in finfo.calls:
+                for callee in site.callees:
+                    for lock, path in self.transitive_acquires(
+                        callee, stack
+                    ).items():
+                        out.setdefault(lock, (key,) + path)
+        stack.discard(key)
+        if _stack is None or not stack:
+            self._acq_memo[key] = out
+        return out
+
+    def transitive_blocking(self, key, _stack=None):
+        """(category, code) -> call path for every blocking effect
+        reachable from ``key`` through resolved call edges."""
+        if key in self._block_memo:
+            return self._block_memo[key]
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            return {}
+        stack.add(key)
+        finfo = self.functions.get(key)
+        out = {}
+        if finfo is not None:
+            for eff in finfo.blocking:
+                out.setdefault((eff.category, eff.code), (key,))
+            for site in finfo.calls:
+                for callee in site.callees:
+                    for item, path in self.transitive_blocking(
+                        callee, stack
+                    ).items():
+                        out.setdefault(item, (key,) + path)
+        stack.discard(key)
+        if _stack is None or not stack:
+            self._block_memo[key] = out
+        return out
+
+    def lock_order_edges(self):
+        """(held, acquired) -> [provenance dict]. Self-edges skipped:
+        lock identity is class-qualified, so A -> A usually means two
+        instances of the same class, not reentrancy."""
+        edges = {}
+        for key in sorted(self.functions):
+            finfo = self.functions[key]
+            for acq in finfo.locks:
+                for held in acq.held:
+                    if held == acq.lock:
+                        continue
+                    edges.setdefault((held, acq.lock), []).append({
+                        "path": finfo.unit.path, "line": acq.line,
+                        "symbol": finfo.qualname, "via": "acquires directly",
+                    })
+            for site in finfo.calls:
+                if not site.held:
+                    continue
+                for callee in site.callees:
+                    for lock, cpath in self.transitive_acquires(callee).items():
+                        for held in site.held:
+                            if held == lock:
+                                continue
+                            via = " -> ".join(
+                                self.functions[k].short for k in cpath
+                            )
+                            edges.setdefault((held, lock), []).append({
+                                "path": finfo.unit.path, "line": site.line,
+                                "symbol": finfo.qualname, "via": via,
+                            })
+        return edges
+
+    def lock_cycles(self):
+        """Strongly-connected components (size >= 2) of the lock-order
+        graph — each is a potential ABBA deadlock. Returns a sorted list
+        of {locks, edges} dicts."""
+        edges = self.lock_order_edges()
+        adj = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index, low, on_stack, stack = {}, {}, set(), []
+        sccs, counter = [], [0]
+
+        def strongconnect(v):
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in sorted(adj.get(v, ())):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) >= 2:
+                    sccs.append(sorted(comp))
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        out = []
+        for comp in sorted(sccs):
+            members = set(comp)
+            cyc_edges = {
+                pair: provs for pair, provs in sorted(edges.items())
+                if pair[0] in members and pair[1] in members
+            }
+            out.append({"locks": comp, "edges": cyc_edges})
+        return out
+
+    def contexts(self):
+        """key -> frozenset of context names the function may run on.
+
+        Seeds: entry points and declared contracts. Propagation is a
+        fixpoint over call edges; functions with a DECLARED context
+        propagate only their contract (the violation at the crossing
+        edge is reported once, not re-propagated downstream)."""
+        if self._contexts is not None:
+            return self._contexts
+        ctx = {key: set() for key in self.functions}
+        for entry in self.entries:
+            if entry.key in ctx:
+                ctx[entry.key].add(entry.context)
+        for key, finfo in self.functions.items():
+            if finfo.thread_context:
+                ctx[key].add(finfo.thread_context)
+        changed = True
+        while changed:
+            changed = False
+            for key in sorted(self.functions):
+                finfo = self.functions[key]
+                out = (
+                    {finfo.thread_context} if finfo.thread_context
+                    else ctx[key]
+                )
+                if not out:
+                    continue
+                for site in finfo.calls:
+                    for callee in site.callees:
+                        if callee not in ctx:
+                            continue
+                        target = self.functions[callee]
+                        if target.thread_context:
+                            continue  # contract: checked at the edge
+                        if not out <= ctx[callee]:
+                            ctx[callee] |= out
+                            changed = True
+        self._contexts = {k: frozenset(v) for k, v in ctx.items()}
+        return self._contexts
+
+    def unknown_summary(self):
+        """(count, sample list) of unresolved possibly-package callees —
+        the documented lattice degradation, reported once per run."""
+        sample = [
+            "%s:%d %s" % (path, line, display)
+            for path, line, display in self.unknown_calls[:8]
+        ]
+        return len(self.unknown_calls), sample
+
+    def to_json(self):
+        """JSON-serializable dump for ``edlint --graph``."""
+        contexts = self.contexts()
+        funcs = {}
+        for key in sorted(self.functions):
+            finfo = self.functions[key]
+            acquires = self.transitive_acquires(key)
+            blocking = self.transitive_blocking(key)
+            funcs[key] = {
+                "path": finfo.unit.path,
+                "line": finfo.node.lineno,
+                "class": finfo.class_info.name if finfo.class_info else None,
+                "declared_thread": finfo.thread_context,
+                "reentrant": finfo.reentrant,
+                "contexts": sorted(contexts.get(key, ())),
+                "locks": [
+                    {"lock": a.lock, "line": a.line, "held": list(a.held)}
+                    for a in finfo.locks
+                ],
+                "blocking": [
+                    {"category": e.category, "code": e.code,
+                     "line": e.line, "held": list(e.held)}
+                    for e in finfo.blocking
+                ],
+                "calls": [
+                    {"display": s.display, "line": s.line,
+                     "held": list(s.held), "callees": list(s.callees),
+                     "unresolved": s.unresolved}
+                    for s in finfo.calls
+                ],
+                "transitive_locks": sorted(acquires),
+                "transitive_blocking": sorted(
+                    "%s:%s" % item for item in blocking
+                ),
+            }
+        unknown_count, unknown_sample = self.unknown_summary()
+        return {
+            "functions": funcs,
+            "entries": [
+                {"key": e.key, "context": e.context,
+                 "reentrant": e.reentrant, "reason": e.reason,
+                 "path": e.path, "line": e.line}
+                for e in self.entries
+            ],
+            "lock_order": [
+                {"held": a, "acquired": b, "sites": provs}
+                for (a, b), provs in sorted(self.lock_order_edges().items())
+            ],
+            "lock_cycles": [
+                {"locks": c["locks"],
+                 "edges": [
+                     {"held": a, "acquired": b, "sites": provs}
+                     for (a, b), provs in c["edges"].items()
+                 ]}
+                for c in self.lock_cycles()
+            ],
+            "unknown_callees": {
+                "count": unknown_count, "sample": unknown_sample,
+            },
+        }
+
+
+_EXTERNAL_ROOTS = frozenset({
+    "abc", "argparse", "ast", "asyncio", "atexit", "base64", "bisect",
+    "collections", "concurrent", "contextlib", "copy", "csv", "ctypes",
+    "dataclasses", "datetime", "enum", "errno", "fcntl", "fnmatch",
+    "functools", "gc", "glob", "grpc", "gzip", "hashlib", "heapq", "http",
+    "importlib", "inspect", "io", "itertools", "jax", "jnp", "json",
+    "logging", "math", "multiprocessing", "np", "numpy", "os", "pickle",
+    "platform", "pytest", "queue", "random", "re", "resource", "select",
+    "shutil", "signal", "socket", "stat", "string", "struct", "subprocess",
+    "sys", "tempfile", "textwrap", "threading", "time", "tokenize",
+    "traceback", "types", "typing", "unittest", "urllib", "uuid",
+    "warnings", "weakref", "zlib",
+})
+
+_BUILTINS = frozenset(dir(__builtins__)) | frozenset(dir(__import__("builtins")))
+
+
+class _FuncScanner:
+    """Walks ONE function body (nested defs excluded — they are their
+    own FunctionInfo) tracking the lexically-held lock set, recording
+    acquisitions, blocking effects, resolved call edges, and thread
+    entry registrations."""
+
+    def __init__(self, graph, info):
+        self.graph = graph
+        self.info = info
+        self.table = graph.tables[info.module]
+        self.cls = info.class_info
+        self.var_types = {}
+
+    # ------------------------------------------------------------ setup
+
+    def scan(self):
+        node = self.info.node
+        self._infer_var_types(node)
+        for stmt in node.body:
+            self._visit(stmt, ())
+
+    def _enclosing_sibling(self, name):
+        """Resolve a bare name to a def nested in the ENCLOSING
+        function (closures see their siblings)."""
+        if "." not in self.info.qualname:
+            return None
+        parent_qual = self.info.qualname.rsplit(".", 1)[0]
+        parent = self.graph.functions.get(
+            "%s:%s" % (self.info.module, parent_qual)
+        )
+        if parent is not None:
+            return parent.local_defs.get(name)
+        return None
+
+    def _attr_type(self, attr):
+        if self.cls is None:
+            return None
+        for cls in self.cls.mro():
+            if attr in cls.attr_types:
+                return cls.attr_types[attr]
+        return None
+
+    def _infer_var_types(self, func_node):
+        """Flow-insensitive local type env: x = ClassName(...) /
+        x = self._attr (typed attr) / x = other_typed_local."""
+        def rec(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (
+                    ast.FunctionDef, ast.AsyncFunctionDef,
+                    ast.ClassDef, ast.Lambda,
+                )):
+                    continue
+                if isinstance(child, ast.Assign) and len(child.targets) == 1:
+                    target = child.targets[0]
+                    if isinstance(target, ast.Name):
+                        ctype = self._expr_type(child.value)
+                        if ctype is not None:
+                            self.var_types.setdefault(target.id, ctype)
+                rec(child)
+        rec(func_node)
+
+    def _expr_type(self, expr):
+        if isinstance(expr, ast.Call):
+            chain = attr_chain(expr.func)
+            if chain:
+                resolved = self.graph.resolve_symbol(self.info.module, chain)
+                if resolved and resolved[0] == "class":
+                    return resolved[1]
+            return None
+        chain = attr_chain(expr)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            return self._attr_type(parts[1])
+        if len(parts) == 1:
+            return self.var_types.get(parts[0])
+        return None
+
+    # ------------------------------------------------------------ locks
+
+    def _lock_id(self, expr):
+        chain = attr_chain(expr)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if parts[0] == "self" and self.cls is not None and len(parts) == 2:
+            owner = self.graph.lock_owner(self.cls, parts[1])
+            if owner:
+                return "%s.%s" % (owner, parts[1])
+            if _looks_lock(parts[1]):
+                return "%s.%s" % (self.cls.name, parts[1])
+            return None
+        if len(parts) == 1:
+            if parts[0] in self.table.locks:
+                return self.table.locks[parts[0]]
+            if _looks_lock(parts[0]):
+                return "%s.%s" % (self.table.modtail, parts[0])
+            return None
+        head_alias = self.table.aliases.get(parts[0])
+        if head_alias:
+            full = head_alias.split(".") + parts[1:]
+            for cut in range(len(full) - 1, 0, -1):
+                prefix = ".".join(full[:cut])
+                if prefix in self.graph.modules:
+                    rest = full[cut:]
+                    mtable = self.graph.tables[prefix]
+                    if len(rest) == 1 and rest[0] in mtable.locks:
+                        return mtable.locks[rest[0]]
+                    break
+        if parts[0] == "self" and len(parts) >= 3:
+            attr_cls = self._attr_type(parts[1])
+            if attr_cls is not None:
+                owner = self.graph.lock_owner(attr_cls, parts[-1])
+                if owner:
+                    return "%s.%s" % (owner, parts[-1])
+            if _looks_lock(parts[-1]):
+                return "%s.%s" % (parts[-2], parts[-1])
+            return None
+        if _looks_lock(parts[-1]) and len(parts) >= 2:
+            return "%s.%s" % (parts[-2], parts[-1])
+        return None
+
+    # ------------------------------------------------------------- walk
+
+    def _visit(self, node, held):
+        if isinstance(node, (
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda,
+        )):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in node.items:
+                self._visit(item.context_expr, tuple(new_held))
+                lock = self._lock_id(item.context_expr)
+                if lock:
+                    self.info.locks.append(LockAcquire(
+                        lock, item.context_expr.lineno, tuple(new_held)
+                    ))
+                    new_held.append(lock)
+            for stmt in node.body:
+                self._visit(stmt, tuple(new_held))
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    # ------------------------------------------------------------ calls
+
+    def _handle_call(self, call, held):
+        chain = attr_chain(call.func)
+        if chain is None:
+            return
+        parts = chain.split(".")
+        tail = parts[-1]
+        if self._handle_registration(call, chain, parts, tail):
+            return
+        if tail in ("acquire", "release") and len(parts) >= 2:
+            lock = self._lock_id(call.func.value)
+            if lock:
+                if tail == "acquire":
+                    self.info.locks.append(
+                        LockAcquire(lock, call.lineno, held)
+                    )
+                return
+        effect = self._blocking(call, chain, parts, tail, held)
+        if effect is not None:
+            self.info.blocking.append(BlockEffect(
+                effect[0], effect[1], call.lineno, held
+            ))
+            return
+        self._resolve_call_edge(call, chain, parts, held)
+
+    def _handle_registration(self, call, chain, parts, tail):
+        """Thread/executor/signal registrations: the target function is
+        handed off to a new execution context — an entry point, NOT a
+        call edge."""
+        if tail == "Thread" and (len(parts) == 1 or parts[-2] == "threading"):
+            target = _kwarg(call, "target")
+            if target is not None:
+                ref = self._resolve_ref(target, call.lineno)
+                if ref is not None:
+                    name_kw = _kwarg(call, "name")
+                    label = _const_str(name_kw) if name_kw is not None else None
+                    self._add_entry(
+                        ref, "thread:%s" % (
+                            label or self.graph.functions[ref].name
+                        ),
+                        False, "Thread(target=...) at %s" % self.info.short,
+                        call.lineno,
+                    )
+            return True
+        if tail in ("submit", "map") and len(parts) >= 2 and call.args:
+            pool = parts[-2]
+            ref = self._resolve_ref(call.args[0], call.lineno)
+            if ref is not None:
+                self._add_entry(
+                    ref, "executor:%s" % pool, False,
+                    "%s.%s() at %s" % (pool, tail, self.info.short),
+                    call.lineno,
+                )
+            return True
+        if chain == "signal.signal" and len(call.args) >= 2:
+            ref = self._resolve_ref(call.args[1], call.lineno)
+            if ref is not None:
+                self.graph.add_entry(
+                    ref, "signal", True,
+                    "signal.signal(...) at %s" % self.info.short,
+                    self.info.unit.path, call.lineno,
+                )
+            return True
+        return False
+
+    def _add_entry(self, ref, context, reentrant, reason, line):
+        finfo = self.graph.functions[ref]
+        if finfo.thread_context is not None:
+            # the registration IS the declared handoff: the target's
+            # contract names the context this entry creates
+            return
+        self.graph.add_entry(
+            ref, context, reentrant, reason, self.info.unit.path, line
+        )
+
+    def _resolve_ref(self, expr, line):
+        """Resolve a function REFERENCE (Thread target, submit arg,
+        signal handler) to a key; unknown references are counted."""
+        if isinstance(expr, ast.Call):
+            chain = attr_chain(expr.func)
+            if chain and chain.split(".")[-1] == "partial" and expr.args:
+                return self._resolve_ref(expr.args[0], line)
+            return None
+        if isinstance(expr, ast.Lambda):
+            return None
+        chain = attr_chain(expr)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if parts[0] == "self" and self.cls is not None:
+            if len(parts) == 2:
+                key = self.graph._method(self.cls, parts[1])
+                if key:
+                    return key
+            elif len(parts) == 3:
+                attr_cls = self._attr_type(parts[1])
+                if attr_cls is not None:
+                    key = self.graph._method(attr_cls, parts[2])
+                    if key:
+                        return key
+            if (
+                parts[-1] in self.graph.defined_names
+                and parts[-1] not in _COMMON_OBJ_METHODS
+            ):
+                self.graph.unknown_calls.append(
+                    (self.info.unit.path, line, "target:" + chain)
+                )
+            return None
+        if len(parts) == 1:
+            if parts[0] in self.info.local_defs:
+                return self.info.local_defs[parts[0]]
+            if parts[0] == self.info.name:
+                return self.info.key
+            sibling = self._enclosing_sibling(parts[0])
+            if sibling:
+                return sibling
+        if parts[0] in self.var_types and len(parts) == 2:
+            key = self.graph._method(self.var_types[parts[0]], parts[1])
+            if key:
+                return key
+        resolved = self.graph.resolve_symbol(self.info.module, chain)
+        if resolved and resolved[0] == "func":
+            return resolved[1]
+        if (
+            parts[0] not in _EXTERNAL_ROOTS
+            and parts[0] not in _BUILTINS
+            and parts[-1] in self.graph.defined_names
+        ):
+            self.graph.unknown_calls.append(
+                (self.info.unit.path, line, "target:" + chain)
+            )
+        return None
+
+    def _resolve_call_edge(self, call, chain, parts, held):
+        keys, unresolved = (), False
+        if parts[0] == "self" and self.cls is not None:
+            if len(parts) == 2:
+                key = self.graph._method(self.cls, parts[1])
+                if key:
+                    keys = (key,)
+                else:
+                    unresolved = True
+            elif len(parts) == 3:
+                attr_cls = self._attr_type(parts[1])
+                if attr_cls is not None:
+                    key = self.graph._method(attr_cls, parts[2])
+                    keys = (key,) if key else ()
+                    unresolved = not keys
+                else:
+                    unresolved = True
+            else:
+                unresolved = True
+        elif parts[0] == "self":
+            unresolved = True
+        elif parts[0] in self.var_types and len(parts) == 2:
+            key = self.graph._method(self.var_types[parts[0]], parts[1])
+            if key:
+                keys = (key,)
+            else:
+                unresolved = True
+        elif len(parts) == 1 and parts[0] in self.info.local_defs:
+            keys = (self.info.local_defs[parts[0]],)
+        elif len(parts) == 1 and parts[0] == self.info.name:
+            keys = (self.info.key,)  # self-recursion
+        elif len(parts) == 1 and self._enclosing_sibling(parts[0]):
+            keys = (self._enclosing_sibling(parts[0]),)
+        else:
+            resolved = self.graph.resolve_symbol(self.info.module, chain)
+            if resolved is None:
+                # untyped local receivers (parser.add_argument, f.write)
+                # are treated as external — only bare names that could be
+                # package functions degrade to unknown (documented lattice)
+                unresolved = (
+                    len(parts) == 1
+                    and parts[0] not in _EXTERNAL_ROOTS
+                    and parts[0] not in _BUILTINS
+                )
+            elif resolved[0] == "func":
+                keys = (resolved[1],)
+            elif resolved[0] == "class":
+                ctor = self.graph.ctor_key(resolved[1])
+                keys = (ctor,) if ctor else ()
+        if unresolved and (
+            parts[-1] not in self.graph.defined_names
+            or parts[-1] in _COMMON_OBJ_METHODS
+        ):
+            # the method name exists nowhere in the package: an external
+            # object (list.append, argparse, ...), not a failed resolution
+            unresolved = False
+        if unresolved:
+            self.graph.unknown_calls.append(
+                (self.info.unit.path, call.lineno, chain)
+            )
+        if keys or unresolved:
+            self.info.calls.append(CallSite(
+                chain, call.lineno, held, keys, unresolved
+            ))
+
+    # --------------------------------------------------------- blocking
+
+    def _blocking(self, call, chain, parts, tail, held):
+        receiver = ".".join(parts[:-1])
+        if chain in _IO_CALLS:
+            return ("io", chain)
+        if tail in ("savez", "savez_compressed"):
+            return ("io", chain)
+        if tail == "sleep":
+            return ("sleep", chain)
+        if parts[0] == "subprocess":
+            return ("subprocess", chain)
+        if parts[0] == "socket" and tail in _SOCKET_TAILS:
+            return ("socket", chain)
+        if "sock" in receiver.lower() and tail in _SOCKET_TAILS:
+            return ("socket", chain)
+        if "stub" in receiver.lower() and len(parts) >= 2:
+            return ("grpc", chain)
+        if tail == "result" and len(parts) >= 2:
+            return ("wait", chain)
+        if tail == "join" and len(parts) >= 2 and not call.args:
+            return ("wait", chain)
+        if tail == "wait_for_termination":
+            return ("wait", chain)
+        if tail == "wait" and len(parts) >= 2:
+            timeout = call.args[0] if call.args else _kwarg(call, "timeout")
+            if timeout is not None and not (
+                isinstance(timeout, ast.Constant) and timeout.value is None
+            ):
+                return None
+            recv_lock = self._lock_id(call.func.value)
+            if recv_lock is not None and recv_lock in held:
+                return None  # cv-wait releases the lock it waits on
+            return ("wait", chain)
+        if tail in ("get", "put") and len(parts) >= 2:
+            low = receiver.lower()
+            if "queue" in low or low.endswith("_q"):
+                block_kw = _kwarg(call, "block")
+                if isinstance(block_kw, ast.Constant) and not block_kw.value:
+                    return None
+                timeout = _kwarg(call, "timeout")
+                if timeout is not None and not (
+                    isinstance(timeout, ast.Constant)
+                    and timeout.value is None
+                ):
+                    return None
+                if tail == "get" and call.args:
+                    return None  # dict.get(key, default) shape
+                return ("queue", chain)
+        return None
+
+
+_GRAPH_CACHE = []
+
+
+def build_graph(units):
+    """Build (or reuse) the CallGraph for this exact list of units.
+    Cached so the three conc-* rules share one build per run."""
+    key = tuple(id(u) for u in units)
+    for cached_key, graph in _GRAPH_CACHE:
+        if cached_key == key:
+            return graph
+    graph = CallGraph.build(units)
+    _GRAPH_CACHE.append((key, graph))
+    del _GRAPH_CACHE[:-4]
+    return graph

@@ -1,0 +1,181 @@
+"""conc-* rules: whole-program concurrency checks on the call graph.
+
+- ``conc-lock-order``: build the global lock-acquisition-order graph
+  (edge L1 -> L2 when L2 is acquired — directly or through any resolved
+  call chain — while L1 is held) and flag every cycle: a potential ABBA
+  deadlock across modules. Lock identity is the class-qualified
+  attribute name, so two instances of one class conflate; self-edges
+  are therefore skipped, not reported.
+
+- ``conc-blocking-under-lock``: generalizes the per-module
+  ``perf-io-under-lock`` by propagating blocking effects (gRPC, file
+  I/O, sleep, unbounded queue/future/join waits, subprocess) through
+  the call graph: a helper that does gRPC I/O is flagged when reachable
+  with a lock held, even several calls deep. A ``Condition.wait()`` on
+  the lock it releases is the cv pattern and exempt.
+
+- ``conc-thread-context``: checks declared execution-context contracts
+  (``# edlint: thread=<name>`` / ``@thread_context("<name>")``). A call
+  edge into a declared function from code whose inferred context set
+  contains anything else is flagged — passing the function as a VALUE
+  (Thread target, executor submit, queue, callback) is a handoff and
+  never flagged. Signal handlers (``signal.signal`` registrations) are
+  reentrant contexts: transitively acquiring any lock or blocking is
+  flagged once per (handler, lock) / (handler, effect-category).
+
+All three degrade explicitly: unresolved callees are counted once per
+run (``CallGraph.unknown_summary``) and surfaced by the CLI, never
+treated as safe silently — see docs/STATIC_ANALYSIS.md.
+"""
+
+from elasticdl_tpu.analysis.callgraph import build_graph
+from elasticdl_tpu.analysis.core import Finding
+
+LOCK_ORDER_RULE = "conc-lock-order"
+BLOCKING_RULE = "conc-blocking-under-lock"
+CONTEXT_RULE = "conc-thread-context"
+
+
+def run_lock_order(units):
+    graph = build_graph(units)
+    findings = []
+    for cycle in graph.lock_cycles():
+        locks = cycle["locks"]
+        code = "cycle: " + " -> ".join(locks + [locks[0]])
+        edge_bits = []
+        for (held, acquired), provs in list(cycle["edges"].items())[:4]:
+            prov = provs[0]
+            edge_bits.append("%s->%s at %s:%d" % (
+                held, acquired, prov["path"], prov["line"]
+            ))
+        (_, _), provs = next(iter(cycle["edges"].items()))
+        anchor = provs[0]
+        findings.append(Finding(
+            LOCK_ORDER_RULE, anchor["path"], anchor["line"],
+            anchor["symbol"], code,
+            "lock-order cycle (potential ABBA deadlock): %s" % (
+                "; ".join(edge_bits)
+            ),
+        ))
+    return findings
+
+
+def run_blocking_under_lock(units):
+    graph = build_graph(units)
+    findings = []
+    seen = set()
+
+    def emit(finfo, line, lock, code, message):
+        fp = (finfo.key, lock, code)
+        if fp in seen:
+            return
+        seen.add(fp)
+        findings.append(Finding(
+            BLOCKING_RULE, finfo.unit.path, line, finfo.qualname,
+            "%s under %s" % (code, lock), message,
+        ))
+
+    for key in sorted(graph.functions):
+        finfo = graph.functions[key]
+        for eff in finfo.blocking:
+            for lock in eff.held:
+                emit(
+                    finfo, eff.line, lock, eff.code,
+                    "blocking %s call %s while holding %s — every thread "
+                    "contending on the lock stalls for the call's duration"
+                    % (eff.category, eff.code, lock),
+                )
+        for site in finfo.calls:
+            if not site.held:
+                continue
+            for callee in site.callees:
+                blocking = graph.transitive_blocking(callee)
+                if not blocking:
+                    continue
+                (cat, code), path = sorted(blocking.items())[0]
+                chain = " -> ".join(
+                    graph.functions[k].short for k in path
+                )
+                for lock in site.held:
+                    emit(
+                        finfo, site.line, lock,
+                        "%s via %s" % (code, graph.functions[callee].name),
+                        "call %s while holding %s reaches blocking %s "
+                        "call %s (%d hop%s: %s)" % (
+                            site.display, lock, cat, code, len(path),
+                            "s" if len(path) != 1 else "", chain,
+                        ),
+                    )
+    return findings
+
+
+def run_thread_context(units):
+    graph = build_graph(units)
+    contexts = graph.contexts()
+    findings = []
+    seen = set()
+
+    # 1) call edges that cross into a declared context
+    for key in sorted(graph.functions):
+        finfo = graph.functions[key]
+        for site in finfo.calls:
+            for callee in site.callees:
+                target = graph.functions[callee]
+                contract = target.thread_context
+                if not contract:
+                    continue
+                if finfo.thread_context:
+                    bad = {finfo.thread_context} - {contract}
+                else:
+                    bad = set(contexts.get(key, ())) - {contract}
+                if not bad:
+                    continue
+                fp = (key, callee, tuple(sorted(bad)))
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                findings.append(Finding(
+                    CONTEXT_RULE, finfo.unit.path, site.line,
+                    finfo.qualname,
+                    "%s[%s] from %s" % (
+                        target.name, contract, ",".join(sorted(bad))
+                    ),
+                    "%s is declared thread=%s but this call edge runs in "
+                    "context(s) %s — hand off through a queue/executor/"
+                    "flag instead of calling across threads" % (
+                        target.short, contract, ", ".join(sorted(bad))
+                    ),
+                ))
+
+    # 2) reentrant (signal) entries must take no locks and never block
+    handled = set()
+    for entry in graph.entries:
+        if not entry.reentrant or entry.key in handled:
+            continue
+        handled.add(entry.key)
+        finfo = graph.functions.get(entry.key)
+        if finfo is None:
+            continue
+        for lock, path in sorted(graph.transitive_acquires(entry.key).items()):
+            chain = " -> ".join(graph.functions[k].short for k in path)
+            findings.append(Finding(
+                CONTEXT_RULE, finfo.unit.path, finfo.node.lineno,
+                finfo.qualname, "signal-lock: %s" % lock,
+                "signal handler %s acquires %s (%s) — a handler may "
+                "interrupt the very code holding that lock; handlers "
+                "must be reentrant-safe (set a flag, do the work on a "
+                "normal thread)" % (finfo.name, lock, chain),
+            ))
+        by_category = {}
+        for (cat, code), path in sorted(graph.transitive_blocking(entry.key).items()):
+            by_category.setdefault(cat, (code, path))
+        for cat, (code, path) in sorted(by_category.items()):
+            chain = " -> ".join(graph.functions[k].short for k in path)
+            findings.append(Finding(
+                CONTEXT_RULE, finfo.unit.path, finfo.node.lineno,
+                finfo.qualname, "signal-blocking: %s" % cat,
+                "signal handler %s reaches blocking %s call %s (%s) — "
+                "handlers must not block; defer to a flag polled off "
+                "the signal path" % (finfo.name, cat, code, chain),
+            ))
+    return findings

@@ -216,10 +216,12 @@ def _load_units(paths):
 def _rules_by_name(names=None):
     # imported here to avoid a cycle (rule modules import core helpers)
     from elasticdl_tpu.analysis import (
+        concurrency,
         determinism,
         deterministic_tracer,
         fault_tolerance,
         hot_path,
+        knobs,
         lock_discipline,
         numerics,
         obs_hot_path,
@@ -234,6 +236,10 @@ def _rules_by_name(names=None):
 
     registry = {
         "lock-discipline": lock_discipline.run,
+        "conc-lock-order": concurrency.run_lock_order,
+        "conc-blocking-under-lock": concurrency.run_blocking_under_lock,
+        "conc-thread-context": concurrency.run_thread_context,
+        "knob-registry": knobs.run,
         "jax-hot-path": hot_path.run,
         "obs-hot-path": obs_hot_path.run,
         "obs-span-no-context": obs_span.run,
@@ -261,6 +267,10 @@ def _rules_by_name(names=None):
 
 RULE_NAMES = (
     "lock-discipline",
+    "conc-lock-order",
+    "conc-blocking-under-lock",
+    "conc-thread-context",
+    "knob-registry",
     "jax-hot-path",
     "obs-hot-path",
     "obs-span-no-context",

@@ -1,0 +1,157 @@
+"""knob-registry: every EDL_* env knob goes through env_utils and is
+documented.
+
+Two finding shapes:
+
+- ``raw-env: <KNOB>`` — an ``EDL_*`` environment read that bypasses
+  ``common/env_utils`` (``os.environ[...]`` / ``os.environ.get`` /
+  ``os.getenv``) anywhere outside env_utils itself. Ad-hoc parsing is
+  how knobs drift: three modules grow three different int-parse
+  fallbacks for the same variable.
+
+- ``undocumented: <KNOB>`` — a knob name read anywhere in
+  ``elasticdl_tpu/`` that appears in no ``docs/*.md`` knob table.
+  Reported once per knob, anchored at the first read site. The docs
+  corpus is discovered by walking up from the scanned files to the
+  repo root (the directory holding ``docs/``); when no docs directory
+  exists — synthetic unit-test sources — the documentation check is
+  skipped and only raw-read findings are produced.
+
+Knob names resolve through module-level string constants
+(``_FLUSH_ENV = "EDL_X"; env_int(_FLUSH_ENV, 4)``). Dynamic names
+(f-strings, templates) are skipped: the repo's dynamic reads are the
+preprocessing analyzer's per-feature handoff protocol, not knobs, and
+an unresolvable name can't be matched against the docs anyway.
+"""
+
+import ast
+import os
+import re
+
+from elasticdl_tpu.analysis.core import Finding, attr_chain, walk_with_scope
+
+RULE = "knob-registry"
+
+_ENV_HELPERS = {"env_int", "env_float", "env_str", "env_bool"}
+_KNOB_RE = re.compile(r"^EDL_[A-Z0-9_]+$")
+
+
+def _module_consts(tree):
+    consts = {}
+    for stmt in tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        ):
+            consts[stmt.targets[0].id] = stmt.value.value
+    return consts
+
+
+def _knob_name(node, consts):
+    """Resolve a knob-name expression to a string, or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _docs_corpus(units):
+    """Concatenated docs/*.md text, discovered by walking up from the
+    scanned files; None when no docs directory is reachable."""
+    for unit in units:
+        probe = os.path.dirname(os.path.abspath(unit.path))
+        for _ in range(8):
+            docs = os.path.join(probe, "docs")
+            if os.path.isdir(docs):
+                chunks = []
+                for name in sorted(os.listdir(docs)):
+                    if name.endswith(".md"):
+                        try:
+                            with open(
+                                os.path.join(docs, name),
+                                "r", encoding="utf-8",
+                            ) as f:
+                                chunks.append(f.read())
+                        except OSError:
+                            continue
+                if chunks:
+                    return "\n".join(chunks)
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break
+            probe = parent
+    return None
+
+
+def run(units):
+    findings = []
+    # (knob, unit, line, symbol) of every read, in scan order
+    reads = []
+    for unit in units:
+        if unit.module.endswith("common.env_utils"):
+            continue
+        consts = _module_consts(unit.tree)
+        for node, scope in walk_with_scope(unit.tree):
+            # raw subscript read: os.environ["EDL_X"]
+            if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                chain = attr_chain(node.value)
+                if chain in ("os.environ", "environ"):
+                    knob = _knob_name(node.slice, consts)
+                    if knob is not None and _KNOB_RE.match(knob):
+                        findings.append(Finding(
+                            RULE, unit.path, node.lineno, scope,
+                            "raw-env: %s" % (knob or "<dynamic>"),
+                            "EDL knob read bypasses common/env_utils — "
+                            "use env_int/env_float/env_str/env_bool so "
+                            "parsing and fallbacks stay uniform",
+                        ))
+                        reads.append((knob, unit, node.lineno, scope))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if chain is None or not node.args:
+                continue
+            tail = chain.split(".")[-1]
+            if chain in ("os.environ.get", "environ.get", "os.getenv",
+                         "getenv"):
+                knob = _knob_name(node.args[0], consts)
+                if knob is None or not _KNOB_RE.match(knob):
+                    # non-EDL env var, or a dynamic name (the analyzer
+                    # handoff protocol): not a knob — not auditable
+                    continue
+                findings.append(Finding(
+                    RULE, unit.path, node.lineno, scope,
+                    "raw-env: %s" % knob,
+                    "EDL knob read bypasses common/env_utils — use "
+                    "env_int/env_float/env_str/env_bool so parsing and "
+                    "fallbacks stay uniform",
+                ))
+                reads.append((knob, unit, node.lineno, scope))
+            elif tail in _ENV_HELPERS:
+                knob = _knob_name(node.args[0], consts)
+                if knob:
+                    reads.append((knob, unit, node.lineno, scope))
+
+    corpus = _docs_corpus(units)
+    if corpus is not None:
+        reported = set()
+        for knob, unit, line, scope in reads:
+            if knob in reported:
+                continue
+            reported.add(knob)
+            if knob not in corpus:
+                findings.append(Finding(
+                    RULE, unit.path, line, scope,
+                    "undocumented: %s" % knob,
+                    "knob %s is read here but appears in no docs/*.md "
+                    "knob table — document the default, the unit, and "
+                    "which role consumes it" % knob,
+                ))
+    return findings

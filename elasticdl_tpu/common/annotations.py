@@ -14,3 +14,26 @@ def hot_path(fn):
     """Identity decorator: marks ``fn`` (or the closures a factory
     returns) as step-path code for edlint's jax-hot-path rule."""
     return fn
+
+
+def thread_context(name, reentrant=False):
+    """Identity decorator declaring an execution-context contract for
+    edlint's ``conc-thread-context`` rule: the function must only run
+    on the named thread/context ("ps-poll", "tier-dispatch", ...).
+    Call edges reaching it from any other inferred context are flagged;
+    handing the function off as a value (Thread target, executor
+    submit, queue) is the sanctioned way to enter its context.
+
+    ``reentrant=True`` additionally asserts the function is safe to run
+    re-entrantly (signal-handler discipline): it must transitively take
+    no locks and never block. Runtime cost: nothing.
+
+    The comment form ``# edlint: thread=<name>`` on/above a ``def`` is
+    equivalent for code that must not import this module.
+    """
+    del name, reentrant  # consumed statically by edlint, not at runtime
+
+    def deco(fn):
+        return fn
+
+    return deco

@@ -7,7 +7,8 @@ same way the reference does (args.py:543-565).
 """
 
 import argparse
-import os
+
+from elasticdl_tpu.common.env_utils import env_int
 
 
 def _add_common(parser):
@@ -176,7 +177,7 @@ def parse_worker_args(argv=None):
     parser.add_argument(
         "--consensus_interval",
         type=int,
-        default=int(os.environ.get("EDL_CONSENSUS_INTERVAL", "1")),
+        default=env_int("EDL_CONSENSUS_INTERVAL", 1),
     )
     # observability: /metrics + /healthz + /readyz on this port
     # (0/unset = disabled; falls back to EDL_METRICS_PORT)

@@ -32,3 +32,29 @@ def env_float(name, default):
     except ValueError:
         logger.warning("ignoring non-numeric %s=%r", name, raw)
         return float(default)
+
+
+def env_str(name, default=""):
+    """os.environ[name] with ``default`` for unset (empty counts as
+    set: an operator exporting FOO= means "explicitly blank")."""
+    return os.environ.get(name, default)
+
+
+_TRUTHY = frozenset({"1", "true", "yes", "on"})
+_FALSY = frozenset({"0", "false", "no", "off", ""})
+
+
+def env_bool(name, default=False):
+    """Boolean knob: 1/true/yes/on and 0/false/no/off (case-blind).
+    Unset returns ``default``; an unrecognized value logs a warning and
+    falls back — same loud-typo contract as env_int."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(default)
+    low = raw.strip().lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    logger.warning("ignoring non-boolean %s=%r", name, raw)
+    return bool(default)

@@ -12,6 +12,7 @@ from concurrent import futures
 import grpc
 
 from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import trace as _trace
 
@@ -139,7 +140,7 @@ UDS_DIR_ENV = "EDL_PS_UDS_DIR"
 def uds_socket_path(port, uds_dir=None):
     """The socket path a PS serving on ``port`` binds under
     EDL_PS_UDS_DIR, or None when the knob is unset."""
-    directory = uds_dir or os.environ.get(UDS_DIR_ENV, "")
+    directory = uds_dir or env_str(UDS_DIR_ENV, "")
     if not directory:
         return None
     return os.path.join(

@@ -7,11 +7,11 @@ tests and CPU-mesh dry runs): it is applied through jax.config after
 import, which wins over the sitecustomize registration.
 """
 
-import os
+from elasticdl_tpu.common.env_utils import env_str
 
 
 def apply_platform_overrides():
-    platform = os.environ.get("EDL_PLATFORM")
+    platform = env_str("EDL_PLATFORM", "")
     if platform:
         import jax
 

@@ -28,10 +28,10 @@ Wire-path hot spots live here (ISSUE 5):
   scatter-add again — measured in scripts/bench_wire_micro.py.)
 """
 
-import os
 
 import numpy as np
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 WIRE_DTYPE_ENV = "EDL_WIRE_DTYPE"
@@ -60,7 +60,7 @@ def wire_dtype():
     processes restarted with new knobs) see changes; the lookup is two
     dict probes, far below wire-serialization cost.
     """
-    value = os.environ.get(WIRE_DTYPE_ENV, "")
+    value = env_str(WIRE_DTYPE_ENV, "")
     key = value.strip().lower()
     if key not in _WIRE_DTYPES:
         raise ValueError(

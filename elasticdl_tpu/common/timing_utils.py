@@ -22,6 +22,7 @@ import contextlib
 import os
 import time
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import metrics as obs_metrics
 
@@ -37,7 +38,7 @@ STEP_PHASE = "batch_process"
 class Timing:
     def __init__(self, enabled=None):
         if enabled is None:
-            enabled = os.environ.get("EDL_TIMING", "") not in ("", "0")
+            enabled = env_str("EDL_TIMING", "") not in ("", "0")
         self._enabled = enabled
         self._totals = {}
         self._counts = {}
@@ -136,7 +137,7 @@ class Timing:
 def trace(name="edl_train"):
     """jax.profiler trace region -> EDL_PROFILE_DIR (view in
     TensorBoard's trace viewer). No-op when the env var is unset."""
-    profile_dir = os.environ.get(PROFILE_DIR_ENV, "")
+    profile_dir = env_str(PROFILE_DIR_ENV, "")
     if not profile_dir:
         yield
         return
@@ -149,7 +150,7 @@ def trace(name="edl_train"):
 @contextlib.contextmanager
 def step_annotation(name, step):
     """Named sub-region inside a trace (StepTraceAnnotation)."""
-    if not os.environ.get(PROFILE_DIR_ENV, ""):
+    if not env_str(PROFILE_DIR_ENV, ""):
         yield
         return
     import jax

@@ -218,6 +218,7 @@ class EmbeddingClient:
         if self._cache is not None:
             self._cache.advance()
 
+    # edlint: thread=prepare
     def invalidate(self):
         """Drop every cached row — the backing PS restarted, so cached
         values no longer reflect its store. Thread-safe when the cache

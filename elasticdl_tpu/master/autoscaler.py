@@ -60,10 +60,10 @@ Knobs (env, constructor args override for tests):
 - ``EDL_DRAIN_DEADLINE_SECS``  — master-side drain fallback deadline
 """
 
-import os
 import threading
 import time
 
+from elasticdl_tpu.common.env_utils import env_float, env_int, env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
@@ -90,12 +90,9 @@ DEPARTED_CAP = 256
 
 
 def _env_num(name, default, cast=float):
-    try:
-        return cast(os.environ.get(name, "") or default)
-    except ValueError:
-        logger.warning("ignoring non-numeric %s=%r", name,
-                       os.environ.get(name))
-        return cast(default)
+    if cast is int:
+        return env_int(name, default)
+    return env_float(name, default)
 
 
 class DrainManager:
@@ -371,7 +368,7 @@ class ElasticController:
         """The controller iff ``EDL_AUTOSCALE`` is on AND the scaler
         speaks the protocol; else None (static fleet, exactly as
         before)."""
-        if os.environ.get(AUTOSCALE_ENV, "") not in ("1", "true", "on"):
+        if env_str(AUTOSCALE_ENV, "") not in ("1", "true", "on"):
             return None
         if scaler is None or not hasattr(scaler, "scale_up"):
             logger.warning(

@@ -51,11 +51,11 @@ Everything is plain dict/float work under one lock, sized for a scan
 thread ticking at 1 Hz over hundreds of roles — no numpy, no RPC.
 """
 
-import os
 import threading
 import time
 
 from elasticdl_tpu.common.env_utils import env_float as _env_float
+from elasticdl_tpu.common.env_utils import env_str as _env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
@@ -752,7 +752,7 @@ class FleetMonitor:
             }
         body = {
             "ts": now,
-            "job": os.environ.get(events.JOB_NAME_ENV, ""),
+            "job": _env_str(events.JOB_NAME_ENV, ""),
             "uptime_secs": round(now - self._started_at, 2),
             "fleet": roles,
             "drained": drained,

@@ -40,6 +40,7 @@ import os
 import threading
 import time
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 
 logger = _logger_factory("elasticdl_tpu.master.state_store")
@@ -225,7 +226,7 @@ class MasterStateJournal:
     def maybe_create(cls, **kwargs):
         """The journal iff ``EDL_STATE_DIR`` is set; else None (the
         zero-overhead disabled path)."""
-        state_dir = os.environ.get(STATE_DIR_ENV, "")
+        state_dir = env_str(STATE_DIR_ENV, "")
         if not state_dir:
             return None
         return cls(state_dir, **kwargs)

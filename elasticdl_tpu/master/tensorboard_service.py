@@ -21,6 +21,7 @@ import subprocess
 import threading
 import time
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 
 logger = _logger_factory("elasticdl_tpu.master.tensorboard_service")
@@ -157,7 +158,7 @@ class TensorboardService:
         if spawn_tensorboard is None:
             # opt-in: serving dashboards from the master pod only makes
             # sense where something can reach its port
-            spawn_tensorboard = os.environ.get(
+            spawn_tensorboard = env_str(
                 "EDL_SPAWN_TENSORBOARD", ""
             ) not in ("", "0")
         self._spawn = spawn_tensorboard

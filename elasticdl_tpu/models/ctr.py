@@ -194,13 +194,11 @@ _VARIANTS = {"wide_deep": WideDeep, "dcn": DCN, "xdeepfm": XDeepFM}
 
 
 def custom_model(variant="dcn", vocab=None, embed_dim=None):
-    import os
+    from elasticdl_tpu.common.env_utils import env_int, env_str
 
-    variant = os.environ.get("EDL_CTR_VARIANT", variant)
-    vocab = int(os.environ.get("EDL_CTR_VOCAB", vocab or VOCAB))
-    embed_dim = int(
-        os.environ.get("EDL_CTR_EMBED_DIM", embed_dim or EMBED_DIM)
-    )
+    variant = env_str("EDL_CTR_VARIANT", variant)
+    vocab = env_int("EDL_CTR_VOCAB", vocab or VOCAB)
+    embed_dim = env_int("EDL_CTR_EMBED_DIM", embed_dim or EMBED_DIM)
     return _VARIANTS[variant](vocab=vocab, embed_dim=embed_dim)
 
 

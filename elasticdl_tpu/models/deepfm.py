@@ -91,12 +91,12 @@ def sparse_embedding_specs(num_features=NUM_FIELDS, batch_size=64,
     chip) via ``capacity=min(batch*fields, MAX_ID_CAPACITY)`` or
     EDL_SPARSE_ID_CAPACITY, as the bench config does; overflow raises
     a clear ValueError naming the knob (train/sparse.py)."""
-    import os
+    from elasticdl_tpu.common.env_utils import env_int
 
     if capacity is None:
-        capacity = int(os.environ.get(
+        capacity = env_int(
             "EDL_SPARSE_ID_CAPACITY", batch_size * num_features
-        ))
+        )
     shape_key = (capacity, batch_size, num_features)
     if (
         capacity < batch_size * num_features

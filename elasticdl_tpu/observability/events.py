@@ -33,6 +33,7 @@ import sys
 import threading
 import time
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 
 logger = _logger_factory("elasticdl_tpu.observability.events")
@@ -155,7 +156,7 @@ class EventJournal:
         self.dir = events_dir
         # pid override for tests emulating several roles in one process
         self.pid = os.getpid() if pid is None else pid
-        self.job = os.environ.get(JOB_NAME_ENV, "")
+        self.job = env_str(JOB_NAME_ENV, "")
         self.path = os.path.join(
             events_dir, "%s-%d.events.ndjson" % (role, self.pid)
         )
@@ -263,7 +264,7 @@ def configure(role):
     once from each role's entry point (extra calls re-bind the role).
     Returns the journal or None when journaling is disabled."""
     global _journal
-    events_dir = os.environ.get(EVENTS_DIR_ENV, "")
+    events_dir = env_str(EVENTS_DIR_ENV, "")
     with _journal_lock:
         if not events_dir:
             _journal = None

@@ -21,11 +21,11 @@ Installed by ``common/grpc_utils.build_server`` (server side, via
 both helpers are no-ops: no interceptor sits on the hot path at all.
 """
 
-import os
 import time
 
 import grpc
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.observability import metrics
 from elasticdl_tpu.observability import trace
 
@@ -182,7 +182,7 @@ def server_interceptors(registry=None):
     """Interceptor tuple for grpc.server(); empty when both metrics
     and tracing are disabled."""
     if registry is None and not metrics.metrics_enabled():
-        if os.environ.get(trace.TRACE_DIR_ENV, ""):
+        if env_str(trace.TRACE_DIR_ENV, ""):
             return (TraceServerInterceptor(),)
         return ()
     return (ServerMetricsInterceptor(registry=registry),)

@@ -25,10 +25,10 @@ benchmarks are unaffected unless they opt in.
 
 import http.server
 import json
-import os
 import threading
 import urllib.parse
 
+from elasticdl_tpu.common.env_utils import env_int, env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import metrics as metrics_mod
 
@@ -48,12 +48,7 @@ def resolve_port(cli_port=None):
     then 0 (disabled)."""
     if cli_port:
         return int(cli_port)
-    try:
-        return int(os.environ.get(PORT_ENV, "0") or "0")
-    except ValueError:
-        logger.warning("ignoring non-integer %s=%r", PORT_ENV,
-                       os.environ.get(PORT_ENV))
-        return 0
+    return env_int(PORT_ENV, 0)
 
 
 class ObservabilityServer:
@@ -119,7 +114,7 @@ class ObservabilityServer:
                         "application/openmetrics-text" in accept
                         and "text/plain" not in accept
                     )
-                    env_gated = os.environ.get(
+                    env_gated = env_str(
                         EXEMPLARS_ENV, ""
                     ) not in ("", "0")
                     text = server.registry.render(

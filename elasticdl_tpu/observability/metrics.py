@@ -18,9 +18,9 @@ first instrument is constructed: role entry points publish
 this reason.
 """
 
-import os
 import threading
 import time
+from elasticdl_tpu.common.env_utils import env_int, env_str
 
 ENABLE_ENV = "EDL_METRICS"
 PORT_ENV = "EDL_METRICS_PORT"
@@ -51,15 +51,12 @@ def metrics_enabled():
     instrumented hot paths pay a single empty method call, which is
     what keeps benchmark step time identical to the uninstrumented
     build (ISSUE 2 acceptance)."""
-    flag = os.environ.get(ENABLE_ENV, "")
+    flag = env_str(ENABLE_ENV, "")
     if flag == "0":
         return False
     if flag:
         return True
-    try:
-        return int(os.environ.get(PORT_ENV, "0") or "0") > 0
-    except ValueError:
-        return False
+    return env_int(PORT_ENV, 0) > 0
 
 
 def _escape_label_value(value):

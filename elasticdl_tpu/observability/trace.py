@@ -51,6 +51,7 @@ import os
 import threading
 import time
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 
 logger = _logger_factory("elasticdl_tpu.observability.trace")
@@ -88,7 +89,7 @@ def sample_rate():
     """Head-sampling probability for new root spans: EDL_TRACE_SAMPLE,
     default 1.0 (EDL_TRACE_DIR alone keeps tracing everything)."""
     global _sample_cache
-    raw = os.environ.get(SAMPLE_ENV, "")
+    raw = env_str(SAMPLE_ENV, "")
     if raw == _sample_cache[0]:
         return _sample_cache[1]
     try:
@@ -104,7 +105,7 @@ def tail_keep_ms():
     """Tail-keep threshold (ms): an UNSAMPLED root span at least this
     slow flushes its locally buffered spans anyway. 0 (default) = off."""
     global _tail_cache
-    raw = os.environ.get(TAIL_KEEP_ENV, "")
+    raw = env_str(TAIL_KEEP_ENV, "")
     if raw == _tail_cache[0]:
         return _tail_cache[1]
     try:
@@ -290,7 +291,7 @@ def configure(role):
     once from each role's entry point (extra calls re-bind the role).
     Returns the writer or None when tracing is disabled."""
     global _writer
-    trace_dir = os.environ.get(TRACE_DIR_ENV, "")
+    trace_dir = env_str(TRACE_DIR_ENV, "")
     with _writer_lock:
         if not trace_dir:
             _writer = None

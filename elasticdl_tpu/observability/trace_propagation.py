@@ -20,10 +20,10 @@ thread-local read.
 """
 
 import collections
-import os
 
 import grpc
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.observability import trace
 
 
@@ -68,7 +68,7 @@ def intercept_trace_channel(channel):
     """The channel itself when tracing is disabled or head sampling is
     0 (no trace can ever need propagation); a context-propagating
     wrapper otherwise."""
-    if not os.environ.get(trace.TRACE_DIR_ENV, ""):
+    if not env_str(trace.TRACE_DIR_ENV, ""):
         return channel
     if trace.sample_rate() <= 0.0:
         return channel

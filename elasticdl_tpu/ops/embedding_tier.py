@@ -34,11 +34,11 @@ a row nothing ever reads.
 """
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 
 logger = _logger_factory("elasticdl_tpu.ops.embedding_tier")
@@ -59,7 +59,7 @@ TIER_OPT_SLOTS = {
 def resolve_kernel(kind=None):
     """-> "pallas" | "jnp". ``auto`` picks pallas only on a TPU
     backend; CPU CI exercises the jnp path (same call sites)."""
-    kind = (kind or os.environ.get(KERNEL_ENV, "auto")).strip().lower()
+    kind = (kind or env_str(KERNEL_ENV, "auto")).strip().lower()
     if kind not in ("auto", "pallas", "jnp"):
         raise ValueError(
             "%s must be auto|pallas|jnp (got %r)" % (KERNEL_ENV, kind)

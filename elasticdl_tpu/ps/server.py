@@ -15,6 +15,7 @@ import time
 import grpc
 
 from elasticdl_tpu.common.args import add_bool_argument
+from elasticdl_tpu.common.env_utils import env_int, env_str
 from elasticdl_tpu.common.grpc_utils import build_server, uds_socket_path
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import events, http_server, profiler, trace
@@ -86,6 +87,11 @@ class _DelayedServicer:
 class ParameterServer:
     def __init__(self, args):
         self.args = args
+        # SIGTERM arrival marker: a plain bool write is the only thing
+        # the signal handler does (atomic, lock-free, reentrant-safe);
+        # run() polls it and performs the actual drain (_finish_term)
+        self._term_flag = False
+        self._term_previous = None
         if getattr(args, "metrics_port", 0):
             # programmatic construction (no CLI entry ran): publish the
             # knob before the servicer builds its instruments, or the
@@ -164,7 +170,7 @@ class ParameterServer:
             )
         self._master_client = master_client
         self._telemetry_on = (
-            os.environ.get("EDL_TELEMETRY", "") != "0"
+            env_str("EDL_TELEMETRY", "") != "0"
         )
         self.servicer = PserverServicer(
             self.store,
@@ -253,9 +259,11 @@ class ParameterServer:
                 "model_initialized", self.servicer.model_initialized
             )
         # SIGTERM graceful stop (ISSUE 7): the pod manager stops PS
-        # pods with SIGTERM, which skips atexit. Chain order: this
-        # handler registers LAST, so it runs FIRST — flush the round
-        # buffer + save a final complete checkpoint (servicer
+        # pods with SIGTERM, which skips atexit. The handler itself
+        # only sets a flag (it may interrupt the poll thread mid-
+        # lifecycle_tick with the push lock held); run() notices
+        # within one poll tick and performs the drain — flush the
+        # round buffer + save a final complete checkpoint (servicer
         # .graceful_stop) — then chains the flight-recorder hook
         # (installed in main() before us), which dumps the event ring,
         # flushes the journal, and exits 0.
@@ -286,23 +294,17 @@ class ParameterServer:
             pass
 
     def _install_sigterm_stop(self):
-        previous = signal.getsignal(signal.SIGTERM)
+        self._term_previous = signal.getsignal(signal.SIGTERM)
 
         def _on_term(signum, frame):
-            try:
-                # stop taking new pushes; in-flight handlers finish
-                # under the push lock graceful_stop is about to take
-                self.server.stop(grace=1.0)
-            except Exception:
-                logger.exception("server stop at SIGTERM failed")
-            self._cleanup_uds()
-            self.servicer.graceful_stop()
-            events.emit("role_stop", reason="sigterm_drain")
-            events.flush()
-            if callable(previous):
-                previous(signum, frame)
-            else:
-                sys.exit(0)
+            # Flag-only: the handler interrupts the poll thread, which
+            # may be INSIDE lifecycle_tick/table_health_scan holding
+            # the push lock — draining here (graceful_stop re-takes
+            # that lock, AsyncCheckpointer.stop joins its thread)
+            # self-deadlocks until the pod's SIGKILL. The poll loop
+            # observes the flag within one tick and runs the same
+            # drain with no servicer lock held (_finish_term).
+            self._term_flag = True
 
         try:
             signal.signal(signal.SIGTERM, _on_term)
@@ -314,6 +316,28 @@ class ParameterServer:
                 "not on main thread; PS SIGTERM flush not installed"
             )
 
+    def _finish_term(self):
+        """The deferred SIGTERM drain (what the handler used to do
+        inline): runs on the poll thread between ticks, where no
+        servicer lock is held. Same order as before — stop the
+        server, round-buffer flush + final checkpoint, then chain the
+        flight-recorder hook (which dumps the ring and exits 0)."""
+        try:
+            # stop taking new pushes; in-flight handlers finish
+            # under the push lock graceful_stop is about to take
+            self.server.stop(grace=1.0)
+        except Exception:
+            logger.exception("server stop at SIGTERM failed")
+        self._cleanup_uds()
+        self.servicer.graceful_stop()
+        events.emit("role_stop", reason="sigterm_drain")
+        events.flush()
+        previous = self._term_previous
+        if callable(previous):
+            previous(signal.SIGTERM, None)
+        return 0
+
+    # edlint: thread=ps-poll
     def run(self, poll_secs=5.0):
         """Serve until the master stops answering (reference: PS pods poll
         the master pod's status, parameter_server.py:129-153).
@@ -330,7 +354,12 @@ class ParameterServer:
         last_sweep = time.time()
         if self._master_client is None:
             if self.lifecycle is None:
-                self.server.wait_for_termination()
+                # bounded wait so a SIGTERM flag is noticed within one
+                # poll even though the handler no longer stops the
+                # server itself
+                while self.server.wait_for_termination(timeout=poll_secs):
+                    if self._term_flag:
+                        return self._finish_term()
                 self.servicer.finish_checkpoints()
                 return 0
             # masterless (embedded/test) but lifecycle on: the sweep
@@ -340,6 +369,8 @@ class ParameterServer:
             # NB grpc's wait_for_termination(timeout) returns True on
             # TIMEOUT (still serving) and False once terminated.
             while self.server.wait_for_termination(timeout=sweep_secs):
+                if self._term_flag:
+                    return self._finish_term()
                 self.servicer.lifecycle_tick()
                 self.servicer.table_health_scan()
             self.servicer.finish_checkpoints()
@@ -348,15 +379,12 @@ class ParameterServer:
         # must comfortably cover a master pod relaunch + state-journal
         # replay (ISSUE 4) — the old 3-strike rule (15 s) made every
         # recoverable master restart take the whole PS fleet with it
-        try:
-            gone_polls = int(
-                os.environ.get("EDL_PS_MASTER_GONE_POLLS", "") or 18
-            )
-        except ValueError:
-            gone_polls = 18
+        gone_polls = env_int("EDL_PS_MASTER_GONE_POLLS", 18)
         misses = 0
         while True:
             time.sleep(poll_secs)
+            if self._term_flag:
+                return self._finish_term()
             info = self._master_client.get_comm_info()
             if info.mesh_epoch < 0:  # RPC failure marker
                 misses += 1

@@ -17,13 +17,13 @@ Reference parity: elasticdl/python/ps/servicer.py and go/pkg/ps/server.go
 """
 
 import concurrent.futures
-import os
 import sys
 import threading
 import time
 
 import numpy as np
 
+from elasticdl_tpu.common.env_utils import env_float, env_int
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import (
     blob_to_ndarray,
@@ -149,12 +149,7 @@ class PserverServicer:
         # released inside the native applies, a small pool turns a
         # multi-table push into parallel per-table applies (each
         # guarded by its table's shared_mutex). 0/1/unset = inline.
-        try:
-            apply_threads = int(
-                os.environ.get(APPLY_THREADS_ENV, "") or 1
-            )
-        except ValueError:
-            apply_threads = 1
+        apply_threads = env_int(APPLY_THREADS_ENV, 1)
         self._apply_pool = None
         if apply_threads > 1:
             self._apply_pool = concurrent.futures.ThreadPoolExecutor(
@@ -178,7 +173,6 @@ class PserverServicer:
         # old inline behavior.
         self._ckpt_async = None
         if checkpoint_saver is not None:
-            from elasticdl_tpu.common.env_utils import env_int
             from elasticdl_tpu.ps.checkpoint import AsyncCheckpointer
 
             if env_int(CKPT_ASYNC_ENV, 1):
@@ -328,7 +322,6 @@ class PserverServicer:
         # telemetry blob carries between scans, the per-table gauges,
         # and the scan's rate limit. The scan runs on the poll loop
         # (ps/server.py), never on an RPC handler.
-        from elasticdl_tpu.common.env_utils import env_float, env_int
         from elasticdl_tpu.train.health import health_enabled
 
         self._health_scan_on = health_enabled()
@@ -1080,6 +1073,7 @@ class PserverServicer:
                 logger.exception("final sparse checkpoint failed")
         events.flush()
 
+    # edlint: thread=ps-poll
     def lifecycle_tick(self):
         """One TTL/LFU eviction sweep (ps/server.py calls this on its
         5 s master poll). No-op without a lifecycle. Returns the
@@ -1088,6 +1082,7 @@ class PserverServicer:
             return None
         return self._lifecycle.sweep()
 
+    # edlint: thread=ps-poll
     def table_health_scan(self, force=False):
         """Table-health scan (ISSUE 15), on the poll loop — NEVER on
         an RPC handler: sampled per-table row-norm percentiles, the
@@ -1186,6 +1181,7 @@ class PserverServicer:
             "exploding_rows": exploding_total,
         }
 
+    # edlint: thread=ps-poll
     def maybe_stream_checkpoint(self, watermark, every):
         """Watermark-driven sparse checkpoint cadence (ISSUE 12): in
         streaming mode there are no epoch boundaries and the version

@@ -20,12 +20,12 @@ package.
 """
 
 import collections
-import os
 import threading
 import time
 
 import numpy as np
 
+from elasticdl_tpu.common.env_utils import env_float, env_int
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import metrics, trace
 
@@ -40,14 +40,9 @@ _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 def _env_num(name, default, cast):
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return default
-    try:
-        return cast(raw)
-    except ValueError:
-        logger.warning("ignoring non-numeric %s=%r", name, raw)
-        return default
+    if cast is int:
+        return env_int(name, default)
+    return env_float(name, default)
 
 
 class QueueFull(Exception):

@@ -72,7 +72,7 @@ class ServingEngine:
             watch_secs = _env_num(WATCH_SECS_ENV, 2.0, float)
         self._watch_secs = float(watch_secs)
         self._model = None          # the active ServingModel
-        self._swap_lock = threading.Lock()  # serializes load/swap only
+        self._swap_lock = threading.Lock()  # guards the stamp CAS only
         self._template = None       # (features, rows) of a recent batch
         self._stopped = threading.Event()
         self.swaps = 0
@@ -181,55 +181,63 @@ class ServingEngine:
         )
 
     def _load_and_swap(self):
+        # build + warm OUTSIDE the swap lock: _build reads the export
+        # from disk (np.load) and warm compiles — anyone contending on
+        # the lock must not stall behind seconds of IO + XLA. The lock
+        # guards only the stamp compare-and-swap; a builder that loses
+        # the race to the same stamp drops its replacement.
+        previous = self._model
+        replacement = self._build()
+        if previous is not None and replacement.stamp == previous.stamp:
+            return False
+        # warm BEFORE the swap: the compile (and the cache priming
+        # pull) happens while the old version still takes traffic,
+        # so the swap itself is one reference assignment
+        template = self._template
+        if template is not None:
+            try:
+                replacement.warm(template[0], template[1])
+            except Exception:
+                logger.exception(
+                    "warm-up of export %s failed; swapping cold",
+                    replacement.stamp,
+                )
         with self._swap_lock:
             previous = self._model
-            replacement = self._build()
             if previous is not None and replacement.stamp == previous.stamp:
                 return False
-            # warm BEFORE the swap: the compile (and the cache priming
-            # pull) happens while the old version still takes traffic,
-            # so the swap itself is one reference assignment
-            template = self._template
-            if template is not None:
-                try:
-                    replacement.warm(template[0], template[1])
-                except Exception:
-                    logger.exception(
-                        "warm-up of export %s failed; swapping cold",
-                        replacement.stamp,
-                    )
             self._model = replacement
+        self._m_model_info.labels(
+            version=str(replacement.step)
+        ).set(1)
+        if previous is not None:
             self._m_model_info.labels(
-                version=str(replacement.step)
-            ).set(1)
-            if previous is not None:
-                self._m_model_info.labels(
-                    version=str(previous.step)
-                ).set(0)
-                self.swaps += 1
-                self._m_swaps.inc()
-                events.emit(
-                    "version_swapped",
-                    from_step=previous.step,
-                    to_step=replacement.step,
-                    stamp=replacement.stamp,
-                )
-                logger.info(
-                    "model version swapped: step %d -> %d (%s)",
-                    previous.step, replacement.step, replacement.stamp,
-                )
-            else:
-                events.emit(
-                    "model_loaded",
-                    step=replacement.step,
-                    stamp=replacement.stamp,
-                    path=str(self.export_dir),
-                )
-                logger.info(
-                    "model loaded: step %d (%s)",
-                    replacement.step, replacement.stamp,
-                )
-            return True
+                version=str(previous.step)
+            ).set(0)
+            self.swaps += 1
+            self._m_swaps.inc()
+            events.emit(
+                "version_swapped",
+                from_step=previous.step,
+                to_step=replacement.step,
+                stamp=replacement.stamp,
+            )
+            logger.info(
+                "model version swapped: step %d -> %d (%s)",
+                previous.step, replacement.step, replacement.stamp,
+            )
+        else:
+            events.emit(
+                "model_loaded",
+                step=replacement.step,
+                stamp=replacement.stamp,
+                path=str(self.export_dir),
+            )
+            logger.info(
+                "model loaded: step %d (%s)",
+                replacement.step, replacement.stamp,
+            )
+        return True
 
     def _watch_loop(self):
         while not self._stopped.wait(self._watch_secs):

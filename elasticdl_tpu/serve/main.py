@@ -18,6 +18,7 @@ import sys
 import threading
 import time
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 
 logger = _logger_factory("elasticdl_tpu.serve.main")
@@ -119,11 +120,16 @@ class ServeRole:
                 worker_id=-(1000 + args.serve_id),
                 worker_host="",
             )
-            if os.environ.get("EDL_TELEMETRY", "") != "0":
+            if env_str("EDL_TELEMETRY", "") != "0":
                 self._master_client.telemetry_provider = self.telemetry_blob
         self.server = None
         self.observability = None
         self._drained = threading.Event()
+        # SIGTERM arrival marker: a plain bool write is the only thing
+        # the signal handler does (atomic, lock-free, reentrant-safe);
+        # run() polls it and performs the actual drain (_finish_term)
+        self._term_flag = False
+        self._term_previous = None
         self._qps_window = (time.monotonic(), 0)  # (ts, served_total)
 
     def telemetry_blob(self):
@@ -192,14 +198,16 @@ class ServeRole:
         return self
 
     def _install_sigterm_drain(self):
-        previous = signal.getsignal(signal.SIGTERM)
+        self._term_previous = signal.getsignal(signal.SIGTERM)
 
         def _on_term(signum, frame):
-            self.drain(reason="sigterm")
-            if callable(previous):
-                previous(signum, frame)
-            else:
-                sys.exit(0)
+            # Flag-only: the handler interrupts the main thread, which
+            # may be inside the batcher or the event journal holding
+            # their locks — draining here (MicroBatcher.drain takes
+            # _cond and joins the batch thread) self-deadlocks until
+            # the pod's SIGKILL. run() observes the flag within one
+            # poll tick and drains with no lock held (_finish_term).
+            self._term_flag = True
 
         try:
             signal.signal(signal.SIGTERM, _on_term)
@@ -207,6 +215,16 @@ class ServeRole:
             logger.warning(
                 "not on main thread; serve SIGTERM drain not installed"
             )
+
+    def _finish_term(self):
+        """The deferred SIGTERM drain (what the handler used to do
+        inline), on the run loop with no lock held; then chains the
+        flight-recorder hook (which dumps the ring and exits 0)."""
+        self.drain(reason="sigterm")
+        previous = self._term_previous
+        if callable(previous):
+            previous(signal.SIGTERM, None)
+        return 0
 
     def drain(self, reason="shutdown"):
         """Stop admitting, flush the queue, stop the server. Idempotent
@@ -244,10 +262,16 @@ class ServeRole:
         the poll exists only to feed fleet telemetry while a master is
         around."""
         if self._master_client is None:
-            self.server.wait_for_termination()
+            # bounded wait so a SIGTERM flag is noticed within one poll
+            # even though the handler no longer stops the server itself
+            while self.server.wait_for_termination(timeout=poll_secs):
+                if self._term_flag:
+                    return self._finish_term()
             return 0
         while not self._drained.is_set():
             time.sleep(poll_secs)
+            if self._term_flag:
+                return self._finish_term()
             try:
                 self._master_client.get_comm_info()
             except Exception:
@@ -271,9 +295,10 @@ def main(argv=None):
         os.environ[http_server.PORT_ENV] = str(args.metrics_port)
     from elasticdl_tpu.observability import events
 
-    # SIGTERM chain order (the PS pattern): crash hooks install first,
-    # prepare()'s drain handler registers last so it runs FIRST — stop
-    # admitting + flush — then chains into the ring dump + exit 0
+    # SIGTERM chain order (the PS pattern): crash hooks install first;
+    # prepare()'s handler registers last and only flags — run() then
+    # drains (stop admitting + flush) off the signal path and chains
+    # into the ring dump + exit 0
     events.install_crash_hooks()
     return ServeRole(args).prepare().run()
 

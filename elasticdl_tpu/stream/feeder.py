@@ -25,10 +25,9 @@ minting exactly after the last journaled window — no window delivered
 twice (done-exactly-once extended to watermark tasks).
 """
 
-import os
 import threading
 
-from elasticdl_tpu.common.env_utils import env_float, env_int
+from elasticdl_tpu.common.env_utils import env_float, env_int, env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import events
 
@@ -57,7 +56,7 @@ def source_from_env(training_data, reader_params=None):
     - ``EDL_STREAM=replay``: bounded replay of whatever reader
       ``training_data`` resolves to, EDL_STREAM_PASSES times.
     """
-    mode = os.environ.get(STREAM_ENV, "").strip().lower()
+    mode = env_str(STREAM_ENV, "").strip().lower()
     if not mode or mode == "0":
         return None
     window_records = env_int(WINDOW_RECORDS_ENV, 512)

@@ -42,6 +42,7 @@ import time
 
 import grpc
 
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 
 logger = _logger_factory("elasticdl_tpu.testing.faults")
@@ -144,7 +145,7 @@ class FaultSpec:
 def _specs():
     """Parsed specs for the current env value (cached per value)."""
     global _cache
-    raw = os.environ.get(FAULT_SPEC_ENV, "")
+    raw = env_str(FAULT_SPEC_ENV, "")
     with _cache_lock:
         if raw == _cache[0]:
             return _cache[1]

@@ -42,12 +42,12 @@ in-process LocalPSClient); the lockstep/sync trainers leave it off.
 """
 
 import concurrent.futures
-import os
 import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from elasticdl_tpu.common.env_utils import env_int, env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.ops import embedding_tier as tier_ops
@@ -83,28 +83,24 @@ class DeviceTierConfig:
         """None when the tier is disabled (EDL_DEVICE_TIER unset/0)."""
         from elasticdl_tpu.common.args import bool_flag
 
-        raw = os.environ.get(ENABLE_ENV, "").strip()
+        raw = env_str(ENABLE_ENV, "").strip()
         if not raw or not bool_flag(raw):
             return None
         config = cls()
-        config.capacity = int(os.environ.get(ROWS_ENV, config.capacity))
-        config.promote_hits = int(
-            os.environ.get(PROMOTE_ENV, config.promote_hits)
-        )
-        config.ttl = int(os.environ.get(TTL_ENV, config.ttl))
-        config.stage_budget = int(
-            os.environ.get(STAGE_ENV, config.stage_budget)
-        )
-        config.opt_type = os.environ.get(OPT_ENV, config.opt_type).lower()
-        raw_args = os.environ.get(OPT_ARGS_ENV, "")
+        config.capacity = env_int(ROWS_ENV, config.capacity)
+        config.promote_hits = env_int(PROMOTE_ENV, config.promote_hits)
+        config.ttl = env_int(TTL_ENV, config.ttl)
+        config.stage_budget = env_int(STAGE_ENV, config.stage_budget)
+        config.opt_type = env_str(OPT_ENV, config.opt_type).lower()
+        raw_args = env_str(OPT_ARGS_ENV, "")
         if raw_args:
             from elasticdl_tpu.train.optimizers import parse_opt_args
 
             config.opt_args = {
                 k: float(v) for k, v in parse_opt_args(raw_args).items()
             }
-        config.writeback_steps = int(
-            os.environ.get(WRITEBACK_ENV, config.writeback_steps)
+        config.writeback_steps = env_int(
+            WRITEBACK_ENV, config.writeback_steps
         )
         return config
 

@@ -40,11 +40,10 @@ the overhead contract (ci tier 1f) gates the whole feature at 2% of
 deepfm steps/s.
 """
 
-import os
 import threading
 import time
 
-from elasticdl_tpu.common.env_utils import env_float, env_int
+from elasticdl_tpu.common.env_utils import env_float, env_int, env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
@@ -69,13 +68,13 @@ def health_enabled():
     """EDL_HEALTH gate: default ON (the scalars are in-graph and the
     tracker is three float ops per batch); ``0`` disables — and is
     provably inert (no extra jitted outputs, test-asserted)."""
-    return os.environ.get(HEALTH_ENV, "").strip() != "0"
+    return env_str(HEALTH_ENV, "").strip() != "0"
 
 
 def nonfinite_action():
     """The sentinel action for a nonfinite batch; misconfiguration
     fails at construction time, not mid-job."""
-    raw = os.environ.get(ON_NONFINITE_ENV, "").strip().lower()
+    raw = env_str(ON_NONFINITE_ENV, "").strip().lower()
     if not raw:
         return "alert"
     if raw not in ACTIONS:

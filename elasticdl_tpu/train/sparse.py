@@ -16,13 +16,13 @@ capacity so XLA compiles the step once.
 """
 
 import concurrent.futures
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from elasticdl_tpu.common.annotations import hot_path
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
 from elasticdl_tpu.data.pipeline import MASK_KEY
@@ -258,6 +258,7 @@ class SparseBatchPreparer:
             if unique.size
         }
 
+    # edlint: thread=prepare
     def prepare(self, batch):
         """Returns (batch with rows/indices features, pull_info) where
         pull_info = {name: (push_ids, n)} for the grad push (all unique
@@ -712,7 +713,7 @@ class SparseTrainer:
         if async_push is None:
             from elasticdl_tpu.common.args import bool_flag
 
-            raw = os.environ.get(ASYNC_PUSH_ENV, "").strip()
+            raw = env_str(ASYNC_PUSH_ENV, "").strip()
             # same bool spellings as every other knob (common/args
             # .bool_flag): "false"/"no" must disable, not silently
             # enable; garbage fails loudly at construction

@@ -13,6 +13,7 @@ from elasticdl_tpu.common.args import (
     parse_worker_args,
     symbol_overrides_from_args,
 )
+from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import configure as configure_logging
 from elasticdl_tpu.data.readers import create_data_reader
 from elasticdl_tpu.worker.master_client import MasterClient
@@ -20,7 +21,7 @@ from elasticdl_tpu.worker.worker import Worker
 
 
 def main(argv=None):
-    if os.environ.get("EDL_FAULTHANDLER"):
+    if env_str("EDL_FAULTHANDLER", ""):
         # stack dumps on demand (kill -USR1 <pid>): lockstep multi-host
         # hangs are otherwise invisible
         import faulthandler

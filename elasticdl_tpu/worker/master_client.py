@@ -13,12 +13,12 @@ the epoch moves, this client re-registers (reset_worker) so the new
 master process knows the worker before it carries on.
 """
 
-import os
 import socket
 
 import grpc
 
 from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.common.env_utils import env_float
 from elasticdl_tpu.common.grpc_utils import build_channel, retry_call
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability.grpc_metrics import instrument_channel
@@ -30,12 +30,9 @@ logger = _logger_factory("elasticdl_tpu.worker.master_client")
 
 # how long get_task keeps retrying a CONNECTION failure before reading
 # it as job-over: must cover a master pod relaunch + journal replay
-try:
-    MASTER_RETRY_BUDGET_SECS = float(
-        os.environ.get("EDL_MASTER_RETRY_BUDGET_SECS", "") or 120.0
-    )
-except ValueError:
-    MASTER_RETRY_BUDGET_SECS = 120.0
+MASTER_RETRY_BUDGET_SECS = env_float(
+    "EDL_MASTER_RETRY_BUDGET_SECS", 120.0
+)
 
 
 class MasterClient:

@@ -18,6 +18,7 @@ import time
 import numpy as np
 
 from elasticdl_tpu.common.constants import Mode
+from elasticdl_tpu.common.env_utils import env_float, env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
@@ -337,12 +338,7 @@ class Worker:
         self._step_flops = float(
             getattr(self.trainer, "step_flops", 0) or 0
         )
-        try:
-            self._peak_flops = float(
-                os.environ.get("EDL_PEAK_FLOPS_PER_SEC", "0") or 0
-            )
-        except ValueError:
-            self._peak_flops = 0.0
+        self._peak_flops = env_float("EDL_PEAK_FLOPS_PER_SEC", 0.0)
         for cb in self._callbacks:
             cb.set_worker(self)
         # Heartbeat keeps master-side liveness fresh while the worker is
@@ -379,7 +375,7 @@ class Worker:
         # Cost: two time.time() calls + a few float ops per BATCH (not
         # per compiled step) and one tiny proto per RPC; EDL_TELEMETRY=0
         # opts out entirely.
-        self._telemetry_on = os.environ.get("EDL_TELEMETRY", "") != "0"
+        self._telemetry_on = env_str("EDL_TELEMETRY", "") != "0"
         self._step_ewma = 0.0
         self._last_examples_per_sec = 0.0
         self._prev_batch_end = 0.0
@@ -543,12 +539,7 @@ class Worker:
             "worker_draining", worker=self._mc.worker_id, reason=reason,
             initiator="worker",
         )
-        try:
-            deadline = float(
-                os.environ.get("EDL_DRAIN_DEADLINE_SECS", "") or 45.0
-            )
-        except ValueError:
-            deadline = 45.0
+        deadline = env_float("EDL_DRAIN_DEADLINE_SECS", 45.0)
         # the watchdog bounds a wedged drain (a stuck collective, a PS
         # that stopped answering): past the deadline the process dies
         # NOW and the master's requeue-on-death fallback takes over —

@@ -1,0 +1,501 @@
+"""edlint v2 call-graph engine tests (PR 16).
+
+The engine (elasticdl_tpu.analysis.callgraph) builds a whole-program
+index — per-function lock/blocking summaries, resolved call edges,
+thread entry points — that the three conc-* rules consume. These tests
+exercise the engine on small synthetic multi-module programs: symbol
+resolution (methods, inheritance, import aliases), entry detection,
+transitive summaries, the documented unknown-callee degradation, and
+the rule-level behaviors the acceptance list names: a cross-module
+lock-order cycle, blocking propagated >= 2 call hops under a lock, and
+the PR 6 pulling-thread cache-invalidation race in its pre-fix shape.
+"""
+
+import textwrap
+
+from elasticdl_tpu.analysis.callgraph import CallGraph
+from elasticdl_tpu.analysis.concurrency import (
+    BLOCKING_RULE,
+    CONTEXT_RULE,
+    LOCK_ORDER_RULE,
+    run_blocking_under_lock,
+    run_lock_order,
+    run_thread_context,
+)
+from elasticdl_tpu.analysis.core import Unit
+
+
+def _units(*sources):
+    """sources: (relative path under elasticdl_tpu/, source)."""
+    return [
+        Unit("elasticdl_tpu/" + path, textwrap.dedent(src))
+        for path, src in sources
+    ]
+
+
+def _graph(*sources):
+    return CallGraph.build(_units(*sources))
+
+
+# ---------------------------------------------------------------------------
+# symbol resolution
+
+
+def test_method_call_edge_and_lock_propagation():
+    graph = _graph(("pkg/store.py", """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def save(self):
+                self._locked_write()
+
+            def _locked_write(self):
+                with self._lock:
+                    pass
+    """))
+    save = graph.functions["elasticdl_tpu.pkg.store:Store.save"]
+    assert any(
+        "elasticdl_tpu.pkg.store:Store._locked_write" in site.callees
+        for site in save.calls
+    )
+    acquired = graph.transitive_acquires(save.key)
+    assert "Store._lock" in acquired
+    # path is caller-first: save -> _locked_write
+    assert [graph.functions[k].name for k in acquired["Store._lock"]] == [
+        "save", "_locked_write",
+    ]
+
+
+def test_inherited_method_resolves_through_mro():
+    graph = _graph(("pkg/roles.py", """
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def guard(self):
+                with self._lock:
+                    pass
+
+        class Worker(Base):
+            def step(self):
+                self.guard()
+    """))
+    step = graph.functions["elasticdl_tpu.pkg.roles:Worker.step"]
+    assert any(
+        "elasticdl_tpu.pkg.roles:Base.guard" in site.callees
+        for site in step.calls
+    )
+    assert "Base._lock" in graph.transitive_acquires(step.key)
+
+
+def test_aliased_cross_module_import_resolves():
+    graph = _graph(
+        ("pkg/m1.py", """
+            from elasticdl_tpu.pkg import m2 as registry
+
+            def tick():
+                registry.record()
+        """),
+        ("pkg/m2.py", """
+            def record():
+                pass
+        """),
+    )
+    tick = graph.functions["elasticdl_tpu.pkg.m1:tick"]
+    assert any(
+        "elasticdl_tpu.pkg.m2:record" in site.callees for site in tick.calls
+    )
+
+
+def test_typed_attribute_receiver_resolves_cross_module():
+    graph = _graph(
+        ("pkg/owner.py", """
+            from elasticdl_tpu.pkg.helper import Helper
+
+            class Owner:
+                def __init__(self):
+                    self.helper = Helper()
+
+                def go(self):
+                    self.helper.work()
+        """),
+        ("pkg/helper.py", """
+            class Helper:
+                def work(self):
+                    pass
+        """),
+    )
+    go = graph.functions["elasticdl_tpu.pkg.owner:Owner.go"]
+    assert any(
+        "elasticdl_tpu.pkg.helper:Helper.work" in site.callees
+        for site in go.calls
+    )
+
+
+# ---------------------------------------------------------------------------
+# entry points
+
+
+def test_thread_executor_and_signal_entries():
+    graph = _graph(("pkg/role.py", """
+        import signal
+        import threading
+
+        class Role:
+            def start(self, pool):
+                threading.Thread(
+                    target=self._loop, name="edl-push", daemon=True
+                ).start()
+                pool.submit(self._flush)
+                signal.signal(signal.SIGTERM, self._on_term)
+
+            def _loop(self):
+                pass
+
+            def _flush(self):
+                pass
+
+            def _on_term(self, signum, frame):
+                pass
+    """))
+    entries = {e.key: e for e in graph.entries}
+    loop = entries["elasticdl_tpu.pkg.role:Role._loop"]
+    assert loop.context == "thread:edl-push" and not loop.reentrant
+    flush = entries["elasticdl_tpu.pkg.role:Role._flush"]
+    assert flush.context == "executor:pool"
+    term = entries["elasticdl_tpu.pkg.role:Role._on_term"]
+    assert term.context == "signal" and term.reentrant
+
+
+def test_grpc_servicer_public_methods_are_entries():
+    graph = _graph(("pkg/svc.py", """
+        class PserverServicer:
+            def push_gradient(self, request, context):
+                pass
+
+            def _internal(self):
+                pass
+    """))
+    contexts = {e.key: e.context for e in graph.entries}
+    assert contexts.get(
+        "elasticdl_tpu.pkg.svc:PserverServicer.push_gradient"
+    ) == "grpc"
+    assert "elasticdl_tpu.pkg.svc:PserverServicer._internal" not in contexts
+
+
+def test_registration_of_declared_target_is_the_handoff():
+    """Submitting a function whose contract names the context being
+    created is the declared handoff: no entry, and the contract seeds
+    the context fixpoint instead."""
+    graph = _graph(("pkg/prep.py", """
+        class Preparer:
+            # edlint: thread=prepare
+            def prepare(self, batch):
+                pass
+
+        class Trainer:
+            def start(self, pool, preparer):
+                pool.submit(preparer.prepare, None)
+    """))
+    key = "elasticdl_tpu.pkg.prep:Preparer.prepare"
+    assert graph.functions[key].thread_context == "prepare"
+    assert key not in {e.key for e in graph.entries}
+    assert graph.contexts()[key] == frozenset({"prepare"})
+
+
+# ---------------------------------------------------------------------------
+# unknown-callee degradation
+
+
+def test_unresolved_package_name_degrades_to_unknown_not_safe():
+    graph = _graph(("pkg/dyn.py", """
+        def process():
+            pass
+
+        class Runner:
+            def go(self):
+                self.process()
+    """))
+    count, sample = graph.unknown_summary()
+    assert count == 1
+    assert "self.process" in sample[0]
+
+
+def test_external_receivers_are_not_unknown():
+    graph = _graph(("pkg/ext.py", """
+        import argparse
+
+        def build(items):
+            parser = argparse.ArgumentParser()
+            parser.add_argument("--x")
+            items.append(1)
+    """))
+    assert graph.unknown_summary()[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# conc-lock-order: cross-module ABBA cycle
+
+
+_CYCLE_M1 = ("pkg/m1.py", """
+    import threading
+
+    from elasticdl_tpu.pkg import m2
+
+    _DISPATCH_LOCK = threading.Lock()
+
+    def dispatch():
+        with _DISPATCH_LOCK:
+            m2.record()
+
+    def audit():
+        with _DISPATCH_LOCK:
+            pass
+""")
+
+_CYCLE_M2 = ("pkg/m2.py", """
+    import threading
+
+    from elasticdl_tpu.pkg import m1
+
+    _REG_LOCK = threading.Lock()
+
+    def record():
+        with _REG_LOCK:
+            pass
+
+    def flush():
+        with _REG_LOCK:
+            m1.audit()
+""")
+
+
+def test_lock_order_detects_cross_module_cycle():
+    units = _units(_CYCLE_M1, _CYCLE_M2)
+    graph = CallGraph.build(units)
+    cycles = graph.lock_cycles()
+    assert len(cycles) == 1
+    assert set(cycles[0]["locks"]) == {"m1._DISPATCH_LOCK", "m2._REG_LOCK"}
+    findings = run_lock_order(units)
+    assert len(findings) == 1
+    assert findings[0].rule == LOCK_ORDER_RULE
+    assert "m1._DISPATCH_LOCK" in findings[0].code
+    assert "m2._REG_LOCK" in findings[0].code
+
+
+def test_lock_order_quiet_on_consistent_order():
+    # same two modules, but m2.flush no longer calls back into m1:
+    # every path acquires DISPATCH before REG
+    clean_m2 = (_CYCLE_M2[0], _CYCLE_M2[1].replace(
+        "        m1.audit()", "        pass"
+    ))
+    units = _units(_CYCLE_M1, clean_m2)
+    assert CallGraph.build(units).lock_cycles() == []
+    assert run_lock_order(units) == []
+
+
+# ---------------------------------------------------------------------------
+# conc-blocking-under-lock: >= 2-hop transitive propagation
+
+
+_TWO_HOP = ("pkg/ckpt.py", """
+    import threading
+
+    class Saver:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def save(self):
+            with self._lock:
+                self._persist()
+
+        def _persist(self):
+            self._write_file()
+
+        def _write_file(self):
+            with open("/tmp/x", "w") as f:
+                f.write("data")
+""")
+
+
+def test_blocking_propagates_two_hops_under_lock():
+    units = _units(_TWO_HOP)
+    graph = CallGraph.build(units)
+    save_key = "elasticdl_tpu.pkg.ckpt:Saver.save"
+    blocking = graph.transitive_blocking(save_key)
+    paths = {code: path for (_, code), path in blocking.items()}
+    assert "open" in paths
+    # save -> _persist -> _write_file: the effect sits 2 call hops deep
+    assert [graph.functions[k].name for k in paths["open"]] == [
+        "save", "_persist", "_write_file",
+    ]
+    findings = run_blocking_under_lock(units)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == BLOCKING_RULE
+    assert f.symbol == "Saver.save"
+    assert f.code == "open via _persist under Saver._lock"
+    assert "2 hops" in f.message
+
+
+def test_blocking_quiet_when_io_hoisted_out_of_lock():
+    hoisted = (_TWO_HOP[0], _TWO_HOP[1].replace(
+        "        def save(self):\n"
+        "            with self._lock:\n"
+        "                self._persist()",
+        "        def save(self):\n"
+        "            self._persist()\n"
+        "            with self._lock:\n"
+        "                pass",
+    ))
+    assert run_blocking_under_lock(_units(hoisted)) == []
+
+
+def test_condition_wait_on_released_lock_is_exempt():
+    units = _units(("pkg/cv.py", """
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def get(self):
+                with self._cond:
+                    self._cond.wait()
+    """))
+    assert run_blocking_under_lock(units) == []
+
+
+# ---------------------------------------------------------------------------
+# conc-thread-context: the PR 6 pulling-thread race, pre-fix shape
+
+
+_PR6_RACE = ("pkg/sparse.py", """
+    from elasticdl_tpu.pkg.cache import RowCache
+
+    class PSClient:
+        def __init__(self):
+            self.cache = RowCache()
+
+        def _on_restart(self, shard):
+            self.cache.invalidate()
+
+        def _push(self, grads):
+            self._on_restart(0)
+
+    class Trainer:
+        def __init__(self):
+            self.client = PSClient()
+
+        def step(self, pool, grads):
+            pool.submit(self.client._push, grads)
+""")
+
+_PR6_CACHE = ("pkg/cache.py", """
+    class RowCache:
+        def __init__(self):
+            self._rows = {}
+
+        # edlint: thread=prepare
+        def invalidate(self):
+            self._rows.clear()
+""")
+
+
+def test_pr6_pulling_thread_invalidation_race_is_caught():
+    """PR 6's bug before its fix: the gradient-push path (an executor
+    thread) detected a PS relaunch and called the row cache's
+    invalidate() directly, racing the prepare thread that owns the
+    cache. With invalidate() declared thread=prepare, the engine infers
+    the push path's executor context and flags the crossing edge."""
+    findings = run_thread_context(_units(_PR6_RACE, _PR6_CACHE))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == CONTEXT_RULE
+    assert f.symbol == "PSClient._on_restart"
+    assert f.code == "invalidate[prepare] from executor:pool"
+
+
+def test_pr6_fix_shape_is_quiet():
+    """Post-fix shape: the restart hook runs on the prepare thread
+    itself (the preparer polls a flag and invalidates from its own
+    context), so the only caller's context matches the contract."""
+    fixed = ("pkg/sparse.py", """
+        from elasticdl_tpu.pkg.cache import RowCache
+
+        class Preparer:
+            def __init__(self):
+                self.cache = RowCache()
+
+            # edlint: thread=prepare
+            def prepare(self, batch):
+                self.cache.invalidate()
+
+        class Trainer:
+            def __init__(self):
+                self.preparer = Preparer()
+
+            def step(self, pool, batch):
+                pool.submit(self.preparer.prepare, batch)
+    """)
+    assert run_thread_context(_units(fixed, _PR6_CACHE)) == []
+
+
+# ---------------------------------------------------------------------------
+# conc-thread-context: reentrant signal handlers (the PR 16 SIGTERM fix)
+
+
+def test_signal_handler_taking_locks_is_flagged():
+    """The exact pre-fix shape of ps/server.py's SIGTERM handler:
+    draining inline acquires the servicer lock from a handler that may
+    have interrupted the very thread holding it."""
+    units = _units(("pkg/server.py", """
+        import signal
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._push_lock = threading.Lock()
+                signal.signal(signal.SIGTERM, self._on_term)
+
+            def _on_term(self, signum, frame):
+                self.graceful_stop()
+
+            def graceful_stop(self):
+                with self._push_lock:
+                    pass
+    """))
+    findings = run_thread_context(units)
+    codes = {f.code for f in findings}
+    assert "signal-lock: Server._push_lock" in codes
+    assert all(f.symbol == "Server._on_term" for f in findings)
+
+
+def test_flag_only_signal_handler_is_quiet():
+    units = _units(("pkg/server.py", """
+        import signal
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._push_lock = threading.Lock()
+                self._term_flag = False
+                signal.signal(signal.SIGTERM, self._on_term)
+
+            def _on_term(self, signum, frame):
+                self._term_flag = True
+
+            def run(self):
+                if self._term_flag:
+                    self.graceful_stop()
+
+            def graceful_stop(self):
+                with self._push_lock:
+                    pass
+    """))
+    assert run_thread_context(units) == []

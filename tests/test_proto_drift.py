@@ -1,0 +1,236 @@
+"""proto <-> pb2 drift check (PR 16 satellite).
+
+The repo regenerates ``elasticdl_tpu_pb2.py`` without protoc by
+patching the serialized FileDescriptorProto programmatically (see the
+header of the generated file), which means the human-edited
+``elasticdl_tpu.proto`` text and the descriptors Python actually loads
+can silently diverge: a field renumbered in one but not the other is a
+wire-corruption bug that no unit test of either side catches.
+
+This test parses the .proto text directly (messages, fields, numbers,
+labels, scalar/message/enum types, map entries, enum values) and
+compares it, exhaustively in both directions, against the descriptors
+``elasticdl_tpu_pb2`` registered in the default pool.
+"""
+
+import os
+import re
+
+from google.protobuf import descriptor as _descriptor
+
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+PROTO_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "elasticdl_tpu", "proto", "elasticdl_tpu.proto",
+)
+
+_FIELD_RE = re.compile(
+    r"^(?:(repeated|optional)\s+)?"
+    r"(map\s*<\s*(\w+)\s*,\s*([\w.]+)\s*>|[\w.]+)\s+"
+    r"(\w+)\s*=\s*(\d+)\s*;"
+)
+
+_SCALAR_TYPES = {
+    "double": _descriptor.FieldDescriptor.TYPE_DOUBLE,
+    "float": _descriptor.FieldDescriptor.TYPE_FLOAT,
+    "int32": _descriptor.FieldDescriptor.TYPE_INT32,
+    "int64": _descriptor.FieldDescriptor.TYPE_INT64,
+    "uint32": _descriptor.FieldDescriptor.TYPE_UINT32,
+    "uint64": _descriptor.FieldDescriptor.TYPE_UINT64,
+    "sint32": _descriptor.FieldDescriptor.TYPE_SINT32,
+    "sint64": _descriptor.FieldDescriptor.TYPE_SINT64,
+    "fixed32": _descriptor.FieldDescriptor.TYPE_FIXED32,
+    "fixed64": _descriptor.FieldDescriptor.TYPE_FIXED64,
+    "bool": _descriptor.FieldDescriptor.TYPE_BOOL,
+    "string": _descriptor.FieldDescriptor.TYPE_STRING,
+    "bytes": _descriptor.FieldDescriptor.TYPE_BYTES,
+}
+
+
+def _strip_comments(text):
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def parse_proto(path):
+    """Minimal proto3 parser for this file's feature set: top-level and
+    nested messages, one enum, scalar/message fields, repeated,
+    proto3 optional, and map<k, v>. Returns (messages, enums) where
+    messages maps dotted message name -> {field name: spec dict}."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = _strip_comments(f.read())
+    messages, enums = {}, {}
+    stack = []  # (kind, name) of open message/enum blocks
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        m = re.match(r"^message\s+(\w+)\s*\{(\s*\})?", line)
+        if m:
+            name = ".".join(
+                [n for k, n in stack if k == "message"] + [m.group(1)]
+            )
+            messages[name] = {}
+            if m.group(2) is None:  # "message Empty {}" opens and closes
+                stack.append(("message", m.group(1)))
+            continue
+        m = re.match(r"^enum\s+(\w+)\s*\{", line)
+        if m:
+            stack.append(("enum", m.group(1)))
+            enums[m.group(1)] = {}
+            continue
+        if line.startswith("}"):
+            if stack:
+                stack.pop()
+            continue
+        if not stack:
+            continue
+        if stack[-1][0] == "enum":
+            m = re.match(r"^(\w+)\s*=\s*(\d+)\s*;", line)
+            if m:
+                enums[stack[-1][1]][m.group(1)] = int(m.group(2))
+            continue
+        m = _FIELD_RE.match(line)
+        if not m:
+            continue
+        label, type_text, map_key, map_value, fname, number = m.groups()
+        current = ".".join(n for k, n in stack if k == "message")
+        messages[current][fname] = {
+            "number": int(number),
+            "label": label,
+            "type": type_text if map_key is None else "map",
+            "map_key": map_key,
+            "map_value": map_value,
+        }
+    assert not stack, "unbalanced braces parsing %s" % path
+    return messages, enums
+
+
+def _descriptor_messages():
+    """dotted name -> Descriptor for every non-map-entry message."""
+    out = {}
+
+    def rec(desc, prefix):
+        name = prefix + desc.name
+        out[name] = desc
+        for nested in desc.nested_types:
+            if nested.GetOptions().map_entry:
+                continue
+            rec(nested, name + ".")
+
+    for desc in pb.DESCRIPTOR.message_types_by_name.values():
+        rec(desc, "")
+    return out
+
+
+def _check_field(msg_name, fname, spec, field):
+    where = "%s.%s" % (msg_name, fname)
+    assert field.number == spec["number"], (
+        "%s: .proto says field number %d, pb2 descriptor says %d — "
+        "renumbering only one side corrupts the wire" % (
+            where, spec["number"], field.number
+        )
+    )
+    if spec["type"] == "map":
+        assert field.message_type is not None and (
+            field.message_type.GetOptions().map_entry
+        ), "%s: .proto declares a map, pb2 field is not a map entry" % where
+        key_f = field.message_type.fields_by_name["key"]
+        value_f = field.message_type.fields_by_name["value"]
+        assert key_f.type == _SCALAR_TYPES[spec["map_key"]], (
+            "%s: map key type drift" % where
+        )
+        if spec["map_value"] in _SCALAR_TYPES:
+            assert value_f.type == _SCALAR_TYPES[spec["map_value"]], (
+                "%s: map value type drift" % where
+            )
+        else:
+            assert value_f.message_type is not None, (
+                "%s: map value should be message %s"
+                % (where, spec["map_value"])
+            )
+            assert value_f.message_type.name == spec["map_value"].split(
+                "."
+            )[-1], "%s: map value message drift" % where
+        return
+    expected_repeated = spec["label"] == "repeated"
+    if hasattr(field, "is_repeated"):  # protobuf >= 5 spelling
+        attr = field.is_repeated
+        is_repeated = attr() if callable(attr) else attr
+    else:
+        is_repeated = field.label == field.LABEL_REPEATED
+    assert is_repeated == expected_repeated, (
+        "%s: repeated/singular drift" % where
+    )
+    if spec["label"] == "optional":
+        assert field.has_presence, (
+            "%s: .proto says proto3 optional but pb2 field has no "
+            "presence tracking" % where
+        )
+    if spec["type"] in _SCALAR_TYPES:
+        assert field.type == _SCALAR_TYPES[spec["type"]], (
+            "%s: scalar type drift (.proto %s, pb2 type enum %d)"
+            % (where, spec["type"], field.type)
+        )
+    elif field.type == field.TYPE_ENUM:
+        assert field.enum_type.name == spec["type"].split(".")[-1], (
+            "%s: enum type drift" % where
+        )
+    else:
+        assert field.type == field.TYPE_MESSAGE, (
+            "%s: .proto says message %s, pb2 disagrees"
+            % (where, spec["type"])
+        )
+        assert field.message_type.name == spec["type"].split(".")[-1], (
+            "%s: message type drift (.proto %s, pb2 %s)"
+            % (where, spec["type"], field.message_type.name)
+        )
+
+
+def test_pb2_descriptors_match_proto_text():
+    messages, enums = parse_proto(PROTO_PATH)
+    assert messages, "parsed no messages from %s" % PROTO_PATH
+    desc_messages = _descriptor_messages()
+
+    assert set(messages) == set(desc_messages), (
+        "message set drift:\n  only in .proto: %s\n  only in pb2: %s" % (
+            sorted(set(messages) - set(desc_messages)),
+            sorted(set(desc_messages) - set(messages)),
+        )
+    )
+    for msg_name, fields in sorted(messages.items()):
+        desc = desc_messages[msg_name]
+        desc_fields = dict(desc.fields_by_name)
+        assert set(fields) == set(desc_fields), (
+            "%s field-set drift:\n  only in .proto: %s\n  only in pb2: %s"
+            % (
+                msg_name,
+                sorted(set(fields) - set(desc_fields)),
+                sorted(set(desc_fields) - set(fields)),
+            )
+        )
+        numbers = [s["number"] for s in fields.values()]
+        assert len(numbers) == len(set(numbers)), (
+            "%s reuses a field number in the .proto text" % msg_name
+        )
+        for fname, spec in sorted(fields.items()):
+            _check_field(msg_name, fname, spec, desc_fields[fname])
+
+
+def test_pb2_enums_match_proto_text():
+    _messages, enums = parse_proto(PROTO_PATH)
+    desc_enums = dict(pb.DESCRIPTOR.enum_types_by_name)
+    assert set(enums) == set(desc_enums), "enum set drift"
+    for name, values in enums.items():
+        desc_values = {
+            v.name: v.number for v in desc_enums[name].values
+        }
+        assert values == desc_values, (
+            "enum %s drift: .proto %s, pb2 %s" % (name, values, desc_values)
+        )
+
+
+def test_pb2_file_metadata_matches():
+    assert pb.DESCRIPTOR.name == "elasticdl_tpu/proto/elasticdl_tpu.proto"
+    assert pb.DESCRIPTOR.package == "elasticdl_tpu"

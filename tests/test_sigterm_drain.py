@@ -1,0 +1,207 @@
+"""Regression tests for the PR 16 concurrency fixes (edlint v2 triage).
+
+The conc-thread-context rule flagged both role SIGTERM handlers as
+reentrancy hazards: the old handlers drained inline, and draining takes
+locks (the PS's push lock via graceful_stop, the batcher's _cond via
+MicroBatcher.drain). A signal interrupting the very thread that holds
+one of those locks self-deadlocks until the pod's SIGKILL. The fix is
+the worker's _draining pattern: the handler performs exactly one plain
+bool write and the run loop drains off the signal path (_finish_term).
+
+conc-blocking-under-lock likewise flagged ServingEngine._load_and_swap
+for holding _swap_lock across np.load + XLA warm-up; the lock now
+guards only the stamp compare-and-swap.
+
+These tests pin the fixed shapes without booting full roles: they run
+the unbound methods against recording stubs, so a revert to inline
+draining (or to building under the lock) fails here as well as at the
+edlint gate.
+"""
+
+import signal
+import threading
+
+from elasticdl_tpu.ps.server import ParameterServer
+from elasticdl_tpu.serve.engine import ServingEngine
+from elasticdl_tpu.serve.main import ServeRole
+
+
+class _Recorder:
+    """Records method calls by name, in order."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        def _record(*args, **kwargs):
+            self.calls.append(name)
+
+        return _record
+
+
+def _install_and_capture(install, stub):
+    """Run an _install_sigterm_* method on a stub and hand back the
+    handler it registered, restoring the process handler afterwards."""
+    original = signal.getsignal(signal.SIGTERM)
+    try:
+        install(stub)
+        handler = signal.getsignal(signal.SIGTERM)
+    finally:
+        signal.signal(signal.SIGTERM, original)
+    assert callable(handler) and handler is not original
+    return handler
+
+
+# ---------------------------------------------------------------------------
+# PS role
+
+
+class _PSStub:
+    def __init__(self):
+        self._term_flag = False
+        self._term_previous = None
+        self.log = []
+        self.server = _Recorder()
+        self.servicer = _Recorder()
+
+    def _cleanup_uds(self):
+        self.log.append("cleanup_uds")
+
+
+def test_ps_sigterm_handler_only_sets_flag():
+    """The handler must not touch the server or servicer: it may be
+    interrupting lifecycle_tick mid-push-lock, where graceful_stop
+    (which re-takes that lock) deadlocks. Holding an unrelated lock
+    while invoking it shows the handler never blocks on anything."""
+    stub = _PSStub()
+    handler = _install_and_capture(
+        ParameterServer._install_sigterm_stop, stub
+    )
+    guard = threading.Lock()
+    with guard:
+        handler(signal.SIGTERM, None)
+    assert stub._term_flag is True
+    assert stub.server.calls == []
+    assert stub.servicer.calls == []
+
+
+def test_ps_finish_term_preserves_drain_order():
+    """_finish_term must keep the pre-fix sequence: stop the server
+    (no new pushes), drop the UDS socket, graceful_stop (round-buffer
+    flush + final checkpoint), then chain the crash-hook handler."""
+    stub = _PSStub()
+    chained = []
+    stub._term_previous = lambda signum, frame: chained.append(signum)
+    assert ParameterServer._finish_term(stub) == 0
+    assert stub.server.calls == ["stop"]
+    assert stub.log == ["cleanup_uds"]
+    assert stub.servicer.calls == ["graceful_stop"]
+    assert chained == [signal.SIGTERM]
+
+
+def test_ps_finish_term_tolerates_uncallable_previous():
+    """SIG_DFL/SIG_IGN previous handlers are ints, not callables; the
+    chain must skip them instead of raising mid-drain."""
+    stub = _PSStub()
+    stub._term_previous = signal.SIG_DFL
+    assert ParameterServer._finish_term(stub) == 0
+    assert stub.servicer.calls == ["graceful_stop"]
+
+
+# ---------------------------------------------------------------------------
+# serve role
+
+
+class _ServeStub:
+    def __init__(self):
+        self._term_flag = False
+        self._term_previous = None
+        self.drained = []
+
+    def drain(self, reason="shutdown"):
+        self.drained.append(reason)
+
+
+def test_serve_sigterm_handler_only_sets_flag():
+    stub = _ServeStub()
+    handler = _install_and_capture(
+        ServeRole._install_sigterm_drain, stub
+    )
+    handler(signal.SIGTERM, None)
+    assert stub._term_flag is True
+    assert stub.drained == []
+
+
+def test_serve_finish_term_drains_then_chains():
+    stub = _ServeStub()
+    chained = []
+    stub._term_previous = lambda signum, frame: chained.append(signum)
+    assert ServeRole._finish_term(stub) == 0
+    assert stub.drained == ["sigterm"]
+    assert chained == [signal.SIGTERM]
+
+
+# ---------------------------------------------------------------------------
+# serving engine swap lock
+
+
+class _FakeModel:
+    def __init__(self, stamp, step):
+        self.stamp = stamp
+        self.step = step
+        self.warmed_under_lock = None
+
+    def warm(self, features, rows):
+        pass
+
+
+class _Gauge:
+    def labels(self, **kwargs):
+        return self
+
+    def set(self, value):
+        pass
+
+
+class _Counter:
+    def inc(self):
+        pass
+
+
+class _EngineStub:
+    """Just enough of ServingEngine for the unbound _load_and_swap."""
+
+    def __init__(self, active=None):
+        self._swap_lock = threading.Lock()
+        self._model = active
+        self._template = (object(), object())
+        self._m_model_info = _Gauge()
+        self._m_swaps = _Counter()
+        self.swaps = 0
+        self.export_dir = "/tmp/none"
+        self.built_under_lock = []
+
+    def _build(self):
+        self.built_under_lock.append(self._swap_lock.locked())
+        return _FakeModel("stamp-b", 2)
+
+
+def test_engine_builds_and_warms_outside_swap_lock():
+    """_build reads the export from disk and warm compiles; neither may
+    run under _swap_lock or every reader contending on a concurrent
+    swap stalls behind seconds of IO + XLA."""
+    stub = _EngineStub(active=_FakeModel("stamp-a", 1))
+    assert ServingEngine._load_and_swap(stub) is True
+    assert stub.built_under_lock == [False]
+    assert stub._model.stamp == "stamp-b"
+    assert stub.swaps == 1
+
+
+def test_engine_swap_drops_same_stamp_replacement():
+    """A builder that loses the race to the same stamp must drop its
+    replacement inside the CAS, not double-swap."""
+    active = _FakeModel("stamp-b", 2)
+    stub = _EngineStub(active=active)
+    assert ServingEngine._load_and_swap(stub) is False
+    assert stub._model is active
+    assert stub.swaps == 0

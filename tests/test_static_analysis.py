@@ -774,6 +774,58 @@ _CLI_POSITIVE_FIXTURES = {
         def arm(callback):
             sys.settrace(callback)
     """),
+    "conc-lock-order": ("bad_order.py", """
+        import threading
+
+        class Pipeline:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """),
+    "conc-blocking-under-lock": ("bad_blocking.py", """
+        import threading
+
+        class Saver:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def save(self):
+                with self._lock:
+                    self._write()
+
+            def _write(self):
+                with open("/tmp/x", "w") as f:
+                    f.write("data")
+    """),
+    "conc-thread-context": ("bad_handler.py", """
+        import signal
+        import threading
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                signal.signal(signal.SIGTERM, self._on_term)
+
+            def _on_term(self, signum, frame):
+                with self._lock:
+                    pass
+    """),
+    "knob-registry": ("bad_knob.py", """
+        import os
+
+        def port():
+            return os.getenv("EDL_FAKE_PORT", "0")
+    """),
 }
 
 
@@ -792,6 +844,43 @@ def test_cli_exits_zero_on_clean_file(tmp_path):
     good.write_text("def f():\n    return 1\n")
     result = _run_cli([str(good), "--no-baseline"], cwd=str(tmp_path))
     assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_cli_graph_dumps_call_graph_json(tmp_path):
+    bad = tmp_path / "bad_order.py"
+    bad.write_text(textwrap.dedent(
+        _CLI_POSITIVE_FIXTURES["conc-lock-order"][1]
+    ))
+    result = _run_cli(["--graph", str(bad)], cwd=str(tmp_path))
+    assert result.returncode == 0, result.stdout + result.stderr
+    graph = json.loads(result.stdout)
+    assert set(graph) == {
+        "functions", "entries", "lock_order", "lock_cycles",
+        "unknown_callees",
+    }
+    assert graph["lock_cycles"], "ABBA fixture should produce a cycle"
+
+
+def test_cli_surfaces_unknown_callee_degradation(tmp_path):
+    source = """
+        import threading
+
+        def helper():
+            pass
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                self.helper()
+    """
+    bad = tmp_path / "degraded.py"
+    bad.write_text(textwrap.dedent(source))
+    result = _run_cli([str(bad), "--no-baseline"], cwd=str(tmp_path))
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "unresolved possibly-package callee" in result.stderr
+    assert "self.helper" in result.stderr
 
 
 # ---------------------------------------------------------------------------
@@ -1087,6 +1176,218 @@ def test_unbounded_vocab_quiet_for_non_id_iterables():
                     self._slots[b] = 1
     """, path="elasticdl_tpu/ps/cache.py",
         rules=["ft-unbounded-vocab"])
+
+
+# ---------------------------------------------------------------------------
+# conc-* whole-program rules (PR 16) — engine-level coverage lives in
+# tests/test_callgraph.py; here each rule gets its positive fixture, a
+# clean twin, and suppression mechanics
+
+_ABBA = """
+    import threading
+
+    class Pipeline:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def forward(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def backward(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_conc_lock_order_flags_abba_cycle():
+    findings = findings_for(_ABBA, rules=["conc-lock-order"])
+    assert len(findings) == 1
+    assert "Pipeline._a" in findings[0].code
+    assert "Pipeline._b" in findings[0].code
+
+
+def test_conc_lock_order_quiet_on_consistent_order():
+    clean = _ABBA.replace(
+        "            with self._b:\n"
+        "                with self._a:",
+        "            with self._a:\n"
+        "                with self._b:",
+    )
+    assert clean != _ABBA
+    assert not findings_for(clean, rules=["conc-lock-order"])
+
+
+_BLOCKING_HELPER = """
+    import threading
+
+    class Saver:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def save(self):
+            with self._lock:
+                self._write()
+
+        def _write(self):
+            with open("/tmp/x", "w") as f:
+                f.write("data")
+"""
+
+
+def test_conc_blocking_under_lock_flags_transitive_io():
+    findings = findings_for(
+        _BLOCKING_HELPER, rules=["conc-blocking-under-lock"]
+    )
+    assert len(findings) == 1
+    assert findings[0].symbol == "Saver.save"
+    assert findings[0].code == "open via _write under Saver._lock"
+
+
+def test_conc_blocking_under_lock_quiet_when_hoisted():
+    clean = _BLOCKING_HELPER.replace(
+        "            with self._lock:\n"
+        "                self._write()",
+        "            self._write()\n"
+        "            with self._lock:\n"
+        "                pass",
+    )
+    assert clean != _BLOCKING_HELPER
+    assert not findings_for(clean, rules=["conc-blocking-under-lock"])
+
+
+def test_conc_blocking_under_lock_suppression_comment_works():
+    suppressed = _BLOCKING_HELPER.replace(
+        "            self._write()",
+        "            self._write()  "
+        "# edlint: disable=conc-blocking-under-lock",
+    )
+    assert not findings_for(suppressed, rules=["conc-blocking-under-lock"])
+
+
+_SIGNAL_LOCK = """
+    import signal
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            signal.signal(signal.SIGTERM, self._on_term)
+
+        def _on_term(self, signum, frame):
+            with self._lock:
+                pass
+"""
+
+
+def test_conc_thread_context_flags_lock_in_signal_handler():
+    findings = findings_for(_SIGNAL_LOCK, rules=["conc-thread-context"])
+    assert len(findings) == 1
+    assert findings[0].code == "signal-lock: Server._lock"
+
+
+def test_conc_thread_context_quiet_on_flag_only_handler():
+    clean = _SIGNAL_LOCK.replace(
+        "            with self._lock:\n"
+        "                pass",
+        "            self._term_flag = True",
+    )
+    assert clean != _SIGNAL_LOCK
+    assert not findings_for(clean, rules=["conc-thread-context"])
+
+
+def test_conc_thread_context_flags_declared_contract_crossing():
+    findings = findings_for("""
+        import threading
+
+        class Cache:
+            # edlint: thread=prepare
+            def invalidate(self):
+                pass
+
+        class Client:
+            def __init__(self):
+                self.cache = Cache()
+
+            def _push(self, grads):
+                self.cache.invalidate()
+
+        class Trainer:
+            def __init__(self):
+                self.client = Client()
+
+            def start(self, pool):
+                pool.submit(self.client._push, None)
+    """, rules=["conc-thread-context"])
+    assert len(findings) == 1
+    assert findings[0].symbol == "Client._push"
+    assert findings[0].code == "invalidate[prepare] from executor:pool"
+
+
+# ---------------------------------------------------------------------------
+# knob-registry (PR 16 satellite)
+
+
+def test_knob_registry_flags_raw_env_reads():
+    findings = findings_for("""
+        import os
+
+        PORT_ENV = "EDL_FAKE_PORT"
+
+        def port():
+            return int(os.getenv(PORT_ENV, "0"))
+
+        def host():
+            return os.environ["EDL_FAKE_HOST"]
+    """, rules=["knob-registry"])
+    codes = {f.code for f in findings}
+    assert "raw-env: EDL_FAKE_PORT" in codes  # const-resolved name
+    assert "raw-env: EDL_FAKE_HOST" in codes  # subscript read
+
+
+def test_knob_registry_quiet_on_env_utils_helpers_and_non_knobs():
+    # EDL_CONSENSUS_INTERVAL is a documented knob (docs discovery walks
+    # up from the fixture path to the repo's docs/ corpus), so the
+    # env_int read passes both the raw-read and the documented check
+    assert not findings_for("""
+        import os
+
+        from elasticdl_tpu.common.env_utils import env_int
+
+        def interval():
+            return env_int("EDL_CONSENSUS_INTERVAL", 1)
+
+        def home():
+            return os.getenv("HOME", "")
+
+        def dynamic(name):
+            return os.getenv("EDL_FEATURE_%s" % name, "")
+    """, rules=["knob-registry"])
+
+
+def test_knob_registry_flags_undocumented_helper_read():
+    findings = findings_for("""
+        from elasticdl_tpu.common.env_utils import env_int
+
+        def weird():
+            return env_int("EDL_NO_SUCH_KNOB_ANYWHERE", 0)
+    """, rules=["knob-registry"])
+    assert [f.code for f in findings] == [
+        "undocumented: EDL_NO_SUCH_KNOB_ANYWHERE"
+    ]
+
+
+def test_knob_registry_suppression_comment_works():
+    assert not findings_for("""
+        import os
+
+        def port():
+            # edlint: disable=knob-registry
+            return os.getenv("EDL_FAKE_PORT", "0")
+    """, rules=["knob-registry"])
 
 
 # ---------------------------------------------------------------------------
