@@ -46,6 +46,12 @@ for (or refuses to pay for):
   ``deque()`` constructors in the serving package: the serving tier's
   contract is admission control, so every queue carries a bound
   (maxsize/maxlen) and overload sheds instead of buffering.
+- ``serve-affinity-unbounded-ring`` — no per-replica/per-affinity-key
+  ``self.X`` container growth in the serving package without a cleanup
+  entry point (deregister/forget/remove/expire/reap/clear) on the
+  owning class: replicas churn under the autoscaler, and router-side
+  books keyed by replica id leak at exactly the churn rate unless a
+  departure deletes them.
 - ``xhost-determinism``   — no set-ordered or filesystem-ordered
   iteration in checkpoint/export/gradient-aggregation paths, where
   ordering must match across hosts.

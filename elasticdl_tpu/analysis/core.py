@@ -231,6 +231,7 @@ def _rules_by_name(names=None):
         perf_io,
         perf_wire,
         serve_queue,
+        serve_ring,
         unbounded_vocab,
     )
 
@@ -250,6 +251,7 @@ def _rules_by_name(names=None):
         "perf-gil-held-apply": perf_gil.run,
         "perf-io-under-lock": perf_io.run,
         "serve-unbounded-queue": serve_queue.run,
+        "serve-affinity-unbounded-ring": serve_ring.run,
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
         "ft-retry-no-jitter": fault_tolerance.run_retry_no_jitter,
@@ -281,6 +283,7 @@ RULE_NAMES = (
     "perf-gil-held-apply",
     "perf-io-under-lock",
     "serve-unbounded-queue",
+    "serve-affinity-unbounded-ring",
     "ft-swallowed-except",
     "ft-grpc-timeout",
     "ft-retry-no-jitter",

@@ -1,0 +1,154 @@
+"""serve-affinity-unbounded-ring: per-replica/per-key state with no
+cleanup entry point in the serving tier.
+
+The failure class behind ISSUE 17's router review: the routing tier
+keeps per-replica and per-affinity-key books — ring placements,
+in-flight counters, canary tallies, subprocess tables — that grow one
+entry per replica id (or hashed key) the fleet has EVER seen. Replicas
+churn: the autoscaler spawns and drains them, SIGKILLed pods re-register
+under fresh ids, and a router that never deletes the dead id's entries
+leaks memory at exactly the rate elasticity works. Same shape as
+``ft-unbounded-vocab`` (id-keyed growth with no way to shrink), scoped
+to the serving fleet's persistent state.
+
+What fires, in files under a ``serve/`` package directory only:
+
+- a statement that GROWS persistent (attribute-rooted, ``self.X``)
+  state keyed by a replica/affinity identity: ``self.d[rid] = ...``
+  subscript assignment, ``self.d.setdefault(replica_id, ...)``, or
+  ``self.s.add(key_hash)`` — where the key expression reads as an
+  identity (``replica_id``, ``rid``, ``affinity_key``, ``key_hash``,
+  ``pid``);
+- UNLESS the enclosing class (or module, for top-level code) defines a
+  cleanup entry point — any of ``deregister``/``deregister_replica``,
+  ``forget``/``forget_replica``, ``remove``/``remove_replica``,
+  ``expire``, ``evict``, ``prune``, ``reap``, ``release``, or
+  ``clear`` — a class that CAN delete a departed replica's entries is
+  allowed to insert them.
+
+Locals are out of scope by construction (a per-call dict dies with the
+call); only attribute-rooted containers persist across requests. False
+positives are one ``# edlint: disable=serve-affinity-unbounded-ring``
+away, with the justification the suppression comment forces.
+"""
+
+import ast
+import os
+
+from elasticdl_tpu.analysis.core import Finding, attr_chain, self_attr_target
+
+RULE = "serve-affinity-unbounded-ring"
+
+_SCOPED_DIRS = {"serve"}
+
+# key spellings that mean "a replica or affinity identity flows here"
+_ID_NAMES = {"replica_id", "rid", "affinity_key", "key_hash", "pid"}
+
+# an enclosing class/module with any of these defines a way to drop a
+# departed replica's entries: growth is then lifecycle-managed
+_CLEANUP_METHODS = {
+    "deregister", "deregister_replica", "forget", "forget_replica",
+    "remove", "remove_replica", "expire", "evict", "prune", "reap",
+    "release", "clear",
+}
+
+
+def _in_scope(path):
+    parts = path.replace(os.sep, "/").split("/")
+    return bool(_SCOPED_DIRS & set(parts))
+
+
+def _is_identity_key(node):
+    """The key expression derives from a replica/affinity identity:
+    a name or attribute tail in the identity vocabulary, directly or
+    through int()/str()-style conversion calls."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id.lower() in _ID_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr.lower() in _ID_NAMES:
+            return True
+    return False
+
+
+def _growth_statements(tree):
+    """Yield (lineno, code) for identity-keyed growth of persistent
+    (``self.X``) containers."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if not isinstance(target, ast.Subscript):
+                    continue
+                attr = self_attr_target(target)
+                if attr is None:
+                    continue  # locals die with the call
+                if _is_identity_key(target.slice):
+                    yield node.lineno, "self.%s[...] =" % attr
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("setdefault", "add")
+                and node.args
+            ):
+                continue
+            chain = attr_chain(func.value)
+            if chain is None or not chain.startswith("self."):
+                continue
+            if _is_identity_key(node.args[0]):
+                yield node.lineno, "%s.%s()" % (chain, func.attr)
+
+
+def _scope_methods(unit):
+    """{class name or '<module>': defined method/function names} —
+    the cleanup-entry-point lookup."""
+    scopes = {"<module>": set()}
+    for node in ast.walk(unit.tree):
+        if isinstance(node, ast.ClassDef):
+            scopes[node.name] = {
+                child.name
+                for child in node.body
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+            }
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes["<module>"].add(node.name)
+    return scopes
+
+
+def run(units):
+    from elasticdl_tpu.analysis.core import walk_with_scope
+
+    findings = []
+    for unit in units:
+        if not _in_scope(unit.path):
+            continue
+        scopes = _scope_methods(unit)
+        # line -> enclosing qualname, to label findings
+        growth = dict(_growth_statements(unit.tree))
+        if not growth:
+            continue
+        line_scope = {}
+        for node, scope in walk_with_scope(unit.tree):
+            if hasattr(node, "lineno") and node.lineno in growth:
+                line_scope.setdefault(node.lineno, scope)
+        for lineno, code in sorted(growth.items()):
+            scope = line_scope.get(lineno, "<module>")
+            owner = scope.split(".", 1)[0]
+            defined = scopes.get(owner, scopes["<module>"])
+            if defined & _CLEANUP_METHODS:
+                continue
+            findings.append(Finding(
+                rule=RULE,
+                path=unit.path,
+                line=lineno,
+                symbol=scope,
+                code=code,
+                message=(
+                    "per-replica/per-key state grows one entry per "
+                    "identity with no cleanup entry point (no "
+                    "deregister/forget/remove/expire/reap/clear on "
+                    "%r) — replica churn leaks this container; drop "
+                    "entries when the replica leaves the fleet" % owner
+                ),
+            ))
+    return findings

@@ -166,7 +166,10 @@ def _predict_online(parsed):
                 }
             else:
                 features = np.asarray(features)[:real]
-            outputs, _, _ = client.predict(features)
+            outputs, _, _ = client.predict(
+                features,
+                affinity_key=getattr(parsed, "affinity_key", 0),
+            )
             if processor is not None:
                 processor.process(outputs, 0)
             results.append(outputs["output"])
@@ -184,27 +187,47 @@ def _predict_online(parsed):
 def serve(parsed):
     """Submit the online serving role's pod (or dump YAML): the
     ``elasticdl predict`` job type grown into a long-running
-    low-latency tier (docs/SERVING.md)."""
-    command = [
-        "python", "-m", "elasticdl_tpu.serve.main",
-        "--serve_id=0",
-        "--port=%d" % parsed.port,
-        "--model_zoo=%s" % parsed.model_zoo,
-        "--export_dir=%s" % parsed.export_dir,
-    ]
-    for flag in ("model_def", "model_params", "ps_addrs", "master_addr",
-                 "compute_dtype"):
-        value = getattr(parsed, flag, "")
-        if value:
-            command.append("--%s=%s" % (flag, value))
-    if parsed.max_batch:
-        command.append("--max_batch=%d" % parsed.max_batch)
-    if parsed.max_delay_ms >= 0:
-        command.append("--max_delay_ms=%s" % parsed.max_delay_ms)
-    if parsed.queue_depth:
-        command.append("--queue_depth=%d" % parsed.queue_depth)
-    if parsed.deadline_ms >= 0:
-        command.append("--deadline_ms=%s" % parsed.deadline_ms)
+    low-latency tier (docs/SERVING.md). With ``--router`` the pod is
+    the fleet's router (ISSUE 17): replicas are serve pods submitted
+    with ``--router_addr`` pointing at it."""
+    if getattr(parsed, "router", False):
+        command = [
+            "python", "-m", "elasticdl_tpu.serve.router_main",
+            "--router_id=0",
+            "--port=%d" % parsed.port,
+        ]
+        if parsed.min_replicas >= 0:
+            command.append("--min_replicas=%d" % parsed.min_replicas)
+        if parsed.max_replicas >= 0:
+            command.append("--max_replicas=%d" % parsed.max_replicas)
+        role, index_name = "router", "router-0"
+    else:
+        if not parsed.model_zoo or not parsed.export_dir:
+            raise ValueError(
+                "edl serve needs --model_zoo and --export_dir "
+                "(or --router for the fleet router pod)"
+            )
+        command = [
+            "python", "-m", "elasticdl_tpu.serve.main",
+            "--serve_id=0",
+            "--port=%d" % parsed.port,
+            "--model_zoo=%s" % parsed.model_zoo,
+            "--export_dir=%s" % parsed.export_dir,
+        ]
+        for flag in ("model_def", "model_params", "ps_addrs",
+                     "master_addr", "compute_dtype", "router_addr"):
+            value = getattr(parsed, flag, "")
+            if value:
+                command.append("--%s=%s" % (flag, value))
+        if parsed.max_batch:
+            command.append("--max_batch=%d" % parsed.max_batch)
+        if parsed.max_delay_ms >= 0:
+            command.append("--max_delay_ms=%s" % parsed.max_delay_ms)
+        if parsed.queue_depth:
+            command.append("--queue_depth=%d" % parsed.queue_depth)
+        if parsed.deadline_ms >= 0:
+            command.append("--deadline_ms=%s" % parsed.deadline_ms)
+        role, index_name = "serve", "serve-0"
     if parsed.metrics_port:
         command.append("--metrics_port=%d" % parsed.metrics_port)
 
@@ -218,8 +241,8 @@ def serve(parsed):
         cluster_spec=getattr(parsed, "cluster_spec", ""),
     )
     manifest = client.build_pod_manifest(
-        "elasticdl-%s-serve-0" % parsed.job_name,
-        "serve",
+        "elasticdl-%s-%s" % (parsed.job_name, index_name),
+        role,
         0,
         command,
         resource_requests=client_args.parse_resource_string(

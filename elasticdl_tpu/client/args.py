@@ -228,20 +228,55 @@ def add_predict_arguments(parser):
         "prediction data through its Predict RPC instead of submitting "
         "a batch prediction job",
     )
+    parser.add_argument(
+        "--affinity_key",
+        type=int,
+        default=0,
+        help="online mode against a fleet router (ISSUE 17): affinity "
+        "key stamped on every request so this stream keeps hitting the "
+        "same replica (and its hot embedding cache); 0 = spread",
+    )
     _add_model_symbol_and_log_arguments(parser)
 
 
 def add_serve_arguments(parser):
     """``edl serve``: submit the online serving role (ISSUE 8) —
     loads a train/export.py artifact, serves Predict, hot-swaps new
-    export versions with zero downtime (docs/SERVING.md)."""
-    parser.add_argument("--model_zoo", required=True)
+    export versions with zero downtime (docs/SERVING.md). With
+    ``--router`` (ISSUE 17) the pod is the fleet ROUTER instead:
+    affinity routing + failover + canary over replicas that register
+    via ``--router_addr``."""
+    parser.add_argument(
+        "--router", action="store_true", default=False,
+        help="submit the serving-fleet router (serve.router_main) "
+        "instead of a single serve pod; replicas are serve pods "
+        "submitted with --router_addr (docs/SERVING.md 'Fleet "
+        "topology')",
+    )
+    parser.add_argument(
+        "--router_addr", default="",
+        help="host:port of a fleet router this serve pod should "
+        "register with (replica mode); empty = standalone pod",
+    )
+    parser.add_argument(
+        "--min_replicas", type=int, default=-1,
+        help="router mode: autoscaler floor (<0 = EDL_SERVE_MIN_REPLICAS)",
+    )
+    parser.add_argument(
+        "--max_replicas", type=int, default=-1,
+        help="router mode: autoscaler ceiling "
+        "(<0 = EDL_SERVE_MAX_REPLICAS)",
+    )
+    # required for serve pods, unused by --router (validated in
+    # api.serve — argparse can't express the either/or)
+    parser.add_argument("--model_zoo", default="")
     parser.add_argument("--model_def", default="")
     parser.add_argument("--model_params", default="")
     parser.add_argument(
-        "--export_dir", required=True,
+        "--export_dir", default="",
         help="train/export.py artifact directory (typically a shared "
-        "volume the training job exports into)",
+        "volume the training job exports into); required unless "
+        "--router",
     )
     parser.add_argument("--ps_addrs", default="")
     parser.add_argument("--master_addr", default="")
@@ -262,6 +297,7 @@ _CLIENT_ONLY = {
     "yaml",
     # online-predict mode runs entirely client-side (api.predict)
     "serving_addr",
+    "affinity_key",
     "docker_base_url",
     "docker_tlscert",
     "docker_tlskey",

@@ -23,6 +23,19 @@ def int_to_id(value: int, bucket_num: int) -> int:
     return int(value) % bucket_num
 
 
+def stable_u64(token: str) -> int:
+    """Process-stable 64-bit hash of a string token.
+
+    The serving router's consistent-hash ring (ISSUE 17) places replica
+    vnodes and affinity keys on a shared u64 circle. Python's builtin
+    ``hash`` is salted per process, so ring positions would differ between
+    the router and any offline tooling replaying a journal; sha256 keeps
+    placement reproducible the same way shard routing above does.
+    """
+    digest = hashlib.sha256(token.encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
 def scatter_ids(ids, bucket_num: int):
     """Group embedding ids by destination shard.
 

@@ -70,6 +70,16 @@ class HotRowCache:
         self.capacity = int(capacity)
         self.ttl_secs = ttl_secs
         self._clock = 0
+        # invalidation epoch: clear() bumps it, and a put() stamped
+        # with an older epoch is DROPPED. This closes the serving-tier
+        # race where a PS restored-stamp invalidation (clear, from any
+        # thread) lands between an in-flight fill's PS fetch and its
+        # put: without the check the fill re-inserts rows pulled from
+        # the DEAD process with fresh stamps, and they serve for up to
+        # ttl_secs. Fleet replicas share the PS tier, so every PS
+        # relaunch runs this race on every replica
+        # (test-pinned in tests/test_embedding_client.py).
+        self.generation = 0
         # name -> (sorted ids [n], rows [n, dim], pull stamps [n]);
         # vectorized (searchsorted/merge) — per-id dict loops cost
         # ~10 ms/step at CTR batch sizes
@@ -127,16 +137,26 @@ class HotRowCache:
 
     def clear(self):
         """Invalidate every cached row (e.g. the PS they were pulled
-        from relaunched); hit/miss tallies are kept."""
+        from relaunched); hit/miss tallies are kept. Also bumps the
+        generation so in-flight fills that fetched from the old PS
+        cannot re-insert behind the clear."""
         with self._lock:
             self._tables.clear()
+            self.generation += 1
 
     def hit_rate(self):
         """Lifetime hit fraction (0.0 before any traffic)."""
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
 
-    def put(self, name, new_ids, new_rows):
+    def put(self, name, new_ids, new_rows, if_generation=None):
+        """Insert freshly pulled rows. ``if_generation`` (the caller's
+        ``generation`` snapshot from BEFORE its PS fetch) makes the
+        insert conditional: if a clear() ran since the snapshot, the
+        rows came from a store identity that no longer exists and the
+        put is silently dropped — the next request re-pulls from the
+        live PS. None (training's single-writer discipline, where the
+        clear runs on the pulling thread itself) inserts always."""
         new_ids = np.asarray(new_ids, dtype=np.int64)
         new_rows = np.asarray(new_rows, dtype=np.float32)
         if new_ids.size and np.any(np.diff(new_ids) <= 0):
@@ -145,6 +165,10 @@ class HotRowCache:
             new_rows = new_rows[first]
         stamp_dtype = np.float64 if self.ttl_secs is not None else np.int64
         with self._lock:
+            if if_generation is not None and (
+                if_generation != self.generation
+            ):
+                return
             new_stamps = np.full(new_ids.shape, self._now(),
                                  dtype=stamp_dtype)
             entry = self._tables.get(name)
@@ -232,12 +256,15 @@ class EmbeddingClient:
         return self._cache.hit_rate() if self._cache is not None else 0.0
 
     # ------------------------------------------------------------------
-    def _assemble(self, name, unique, cached_mask, cached_rows, fetched):
+    def _assemble(self, name, unique, cached_mask, cached_rows, fetched,
+                  generation=None):
         """Merge cache hits and one fresh fetch into [n_unique, dim]
         fp32, recording the fetched rows in the cache. The single home
         of the cache-fill protocol — the per-table and batched pull
         paths both end here, so a staleness/fill rule change cannot
-        fork between them."""
+        fork between them. ``generation`` is the cache generation
+        snapshot taken BEFORE the PS fetch: the conditional put drops
+        the fill if an invalidation (PS relaunch) ran in between."""
         if cached_rows is not None:
             dim = cached_rows.shape[1]
         else:
@@ -249,7 +276,8 @@ class EmbeddingClient:
         if missing.size:
             fetched = _rows_f32(fetched)
             rows[~cached_mask] = fetched
-            self._cache.put(name, missing, fetched)
+            self._cache.put(name, missing, fetched,
+                            if_generation=generation)
         return rows
 
     def pull(self, name, unique):
@@ -258,13 +286,14 @@ class EmbeddingClient:
         unique = np.asarray(unique, dtype=np.int64)
         if self._cache is None:
             return _rows_f32(self._ps.pull_embedding_vectors(name, unique))
+        generation = self._cache.generation
         cached_mask, cached_rows = self._cache.split(name, unique)
         missing = unique[~cached_mask]
         fetched = None
         if missing.size:
             fetched = self._ps.pull_embedding_vectors(name, missing)
         return self._assemble(name, unique, cached_mask, cached_rows,
-                              fetched)
+                              fetched, generation=generation)
 
     def _fan_out(self, ids_by_table):
         """Per-table thread fan-out for clients without the fused batch
@@ -303,6 +332,7 @@ class EmbeddingClient:
             return {
                 name: _rows_f32(fetched[name]) for name in ids_by_table
             }
+        generation = self._cache.generation
         to_pull = {}
         cache_parts = {}  # name -> (cached_mask, cached_rows)
         for name, unique in ids_by_table.items():
@@ -316,6 +346,7 @@ class EmbeddingClient:
         for name, unique in ids_by_table.items():
             cached_mask, cached_rows = cache_parts[name]
             out[name] = self._assemble(
-                name, unique, cached_mask, cached_rows, fetched.get(name)
+                name, unique, cached_mask, cached_rows, fetched.get(name),
+                generation=generation,
             )
         return out

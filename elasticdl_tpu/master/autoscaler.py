@@ -95,6 +95,63 @@ def _env_num(name, default, cast=float):
     return env_float(name, default)
 
 
+class DecisionGate:
+    """Hold + cooldown hysteresis shared by every autoscaler.
+
+    ISSUE 17 grew a second control loop (the serving-fleet
+    ``ReplicaAutoscaler``) with the same damping contract as the
+    training ``ElasticController``: a condition must PERSIST for
+    ``hold_secs`` before it may fire (one transient spike buys
+    nothing), and after any decision the gate stays closed for
+    ``cooldown_secs`` (let the previous resize land before judging
+    again). Conditions are named so each direction keeps its own hold
+    timer while the cooldown is shared — exactly the
+    ``_grow_since``/``_shrink_since``/``_last_action`` bookkeeping the
+    controller used inline before the extraction.
+    """
+
+    def __init__(self, hold_secs, cooldown_secs):
+        self._hold = float(hold_secs)
+        self._cooldown = float(cooldown_secs)
+        self._lock = threading.Lock()
+        self._since = {}  # condition name -> first-observed ts
+        self._last_action = None  # no decision yet: no cooldown
+
+    def observe(self, condition, want, now):
+        """Feed one tick's reading of ``condition``. Returns True when
+        the condition has held for ``hold_secs`` and the gate is out of
+        cooldown; a False ``want`` resets that condition's hold timer.
+        The hold timer keeps accumulating THROUGH a cooldown window so
+        a condition that persisted across it fires the moment the
+        cooldown lifts."""
+        with self._lock:
+            if not want:
+                self._since.pop(condition, None)
+                return False
+            since = self._since.setdefault(condition, now)
+            if now - since < self._hold:
+                return False
+            return not (
+                self._last_action is not None
+                and now - self._last_action < self._cooldown
+            )
+
+    def fired(self, condition, now):
+        """Record a decision: starts the shared cooldown and resets
+        ``condition``'s hold timer (the other conditions keep theirs —
+        a grow must not forgive a brewing shrink signal's history)."""
+        with self._lock:
+            self._last_action = now
+            self._since.pop(condition, None)
+
+    def in_cooldown(self, now):
+        with self._lock:
+            return (
+                self._last_action is not None
+                and now - self._last_action < self._cooldown
+            )
+
+
 class DrainManager:
     """Tracks workers the control plane is removing ON PURPOSE, from
     ``begin_drain`` to the worker's ``deregister_worker`` ack — or to
@@ -346,9 +403,7 @@ class ElasticController:
         )
         self._tag = tag
         self._lock = threading.Lock()
-        self._last_action = None  # no decision yet: no cooldown
-        self._grow_since = None
-        self._shrink_since = None
+        self._gate = DecisionGate(self._hold, self._cooldown)
         # after a grow: measure throughput once the fleet settles; a
         # grow that bought < gain_floor of the pre-grow per-worker
         # throughput sets the ceiling
@@ -433,10 +488,6 @@ class ElasticController:
 
         with self._lock:
             min_w, max_w = self._min, self._max
-            in_cooldown = (
-                self._last_action is not None
-                and now - self._last_action < self._cooldown
-            )
 
         # -- budget enforcement: a lowered ceiling shrinks immediately
         # (no hold, no cooldown — the budget is an order, not a signal
@@ -473,15 +524,7 @@ class ElasticController:
             effective >= self._gain_ceiling
         ):
             want_grow = False  # adding workers stopped paying
-        with self._lock:
-            if want_grow:
-                if self._grow_since is None:
-                    self._grow_since = now
-                held = now - self._grow_since >= self._hold
-            else:
-                self._grow_since = None
-                held = False
-        if want_grow and held and not in_cooldown:
+        if self._gate.observe("grow", want_grow, now):
             delta = min(
                 self._step,
                 max_w - total,
@@ -509,15 +552,7 @@ class ElasticController:
             and effective > min_w
             and doing < effective
         )
-        with self._lock:
-            if want_shrink:
-                if self._shrink_since is None:
-                    self._shrink_since = now
-                held = now - self._shrink_since >= self._hold
-            else:
-                self._shrink_since = None
-                held = False
-        if want_shrink and held and not in_cooldown:
+        if self._gate.observe("shrink", want_shrink, now):
             target = max(min_w, doing)
             delta = min(self._step, effective - target)
             if delta > 0:
@@ -566,9 +601,8 @@ class ElasticController:
         added = len(started) if started is not None else delta
         if added <= 0:
             return  # scaler couldn't place any (pool exhausted)
+        self._gate.fired("grow", now)
         with self._lock:
-            self._last_action = now
-            self._grow_since = None
             if throughput > 0:
                 self._pending_gain = {
                     "measure_at": now + self._gain_settle,
@@ -595,9 +629,8 @@ class ElasticController:
         victims = self._pick_victims(delta, live)
         if not victims:
             return
+        self._gate.fired("shrink", now)
         with self._lock:
-            self._last_action = now
-            self._shrink_since = None
             self._last_decision = {
                 "direction": "shrink", "delta": len(victims),
                 "workers": len(live), "queue_depth": queue,

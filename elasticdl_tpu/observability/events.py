@@ -108,6 +108,19 @@ EVENT_TYPES = frozenset({
     "serve_drained",         # SIGTERM drain: admissions stopped, queue
                              #   flushed (+ reason, flushed, served,
                              #   shed)
+    # serving fleet (ISSUE 17): router-side replica lifecycle + canary
+    "replica_registered",    # replica joined the router's ring
+                             #   (+ replica, addr, stamp)
+    "replica_lost",          # heartbeats stopped; pulled from the ring
+                             #   (+ replica, silent_secs)
+    "replica_draining",      # router stopped routing to a shrink
+                             #   victim (+ replica, reason)
+    "canary_started",        # new export takes the canary slice
+                             #   (+ export, members, fraction)
+    "canary_promoted",       # judge passed; fleet directed to the new
+                             #   export (+ export, reasons)
+    "canary_rolled_back",    # judge failed; canary members directed
+                             #   back to incumbent (+ export, reasons)
     # distributed tracing (ISSUE 9)
     "trace_flushed",         # a drain path flushed the trace buffer to
                              #   EDL_TRACE_DIR (+ reason)

@@ -15,6 +15,7 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 _MASTER_SERVICE = "elasticdl_tpu.Master"
 _PSERVER_SERVICE = "elasticdl_tpu.Pserver"
 _SERVE_SERVICE = "elasticdl_tpu.Serve"
+_ROUTER_SERVICE = "elasticdl_tpu.Router"
 
 # method name -> (request class, response class)
 _MASTER_METHODS = {
@@ -71,6 +72,19 @@ _SERVE_METHODS = {
     "model_info": (pb.Empty, pb.ModelInfoResponse),
 }
 
+# Serving-fleet router (ISSUE 17): the router also serves the full
+# Serve surface (clients point --serving_addr at it unchanged); this
+# service is the replica-facing control plane. register announces a
+# replica (addr + capacity + loaded stamp), heartbeat carries the
+# replica's telemetry and returns directives (drain, target export
+# version for canary/promote), deregister is the exactly-once drain
+# ack reused from the ISSUE 7/8 scale-down path.
+_ROUTER_METHODS = {
+    "register_replica": (pb.RegisterReplicaRequest, pb.RegisterReplicaResponse),
+    "heartbeat_replica": (pb.ReplicaHeartbeatRequest, pb.ReplicaHeartbeatResponse),
+    "deregister_replica": (pb.DeregisterReplicaRequest, pb.Empty),
+}
+
 
 class _Stub:
     """Builds unary-unary callables for each method of a service."""
@@ -103,6 +117,11 @@ class ServeStub(_Stub):
         super().__init__(channel, _SERVE_SERVICE, _SERVE_METHODS)
 
 
+class RouterStub(_Stub):
+    def __init__(self, channel):
+        super().__init__(channel, _ROUTER_SERVICE, _ROUTER_METHODS)
+
+
 def _add_service(server, servicer, service_name, methods):
     handlers = {}
     for name, (req_cls, resp_cls) in methods.items():
@@ -126,3 +145,7 @@ def add_pserver_servicer_to_server(servicer, server):
 
 def add_serve_servicer_to_server(servicer, server):
     _add_service(server, servicer, _SERVE_SERVICE, _SERVE_METHODS)
+
+
+def add_router_servicer_to_server(servicer, server):
+    _add_service(server, servicer, _ROUTER_SERVICE, _ROUTER_METHODS)

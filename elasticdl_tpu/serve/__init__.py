@@ -19,5 +19,19 @@ package serves it — the ``elasticdl predict`` job type of the reference
 - ``main.py``     — the role entry point (probes, flight recorder,
   SIGTERM graceful drain, optional fleet-telemetry piggyback).
 
-See docs/SERVING.md for topology and knobs.
+The fleet layer (ISSUE 17) fronts N such replicas with a fifth role:
+
+- ``router.py``      — same ``Serve`` gRPC surface, consistent-hash
+  affinity routing with bounded-retry failover, per-replica in-flight
+  caps (shed, don't spill).
+- ``fleet.py``       — replica registry (register/heartbeat/expire),
+  replica autoscaler reusing the master's ``DecisionGate``, subprocess
+  replica placement for bench/CI.
+- ``canary.py``      — telemetry-judged canary rollout: fraction slice
+  on new exports, TV-distance + failure-rate judge, auto
+  promote/rollback, every decision journaled.
+- ``router_main.py`` — the router role entry point.
+
+See docs/SERVING.md for topology and knobs ("Fleet topology" for the
+router tier).
 """

@@ -17,15 +17,22 @@ class ServeClient:
         self._channel = instrument_channel(build_channel(addr))
         self._stub = ServeStub(self._channel)
 
-    def predict(self, features, deadline_secs=None, deadline_ms=0):
+    def predict(self, features, deadline_secs=None, deadline_ms=0,
+                affinity_key=0):
         """``features``: dict of batch-leading arrays, or one bare
         array (single-input models). Returns (outputs dict, model
         step, model stamp). ``deadline_secs`` sets the gRPC deadline;
         ``deadline_ms`` rides in-message. The server sheds (never
         serves late) a request that outlives the TIGHTER of the two —
         so deadline_ms is honored even under this client's default
-        transport timeout."""
-        request = pb.PredictRequest(deadline_ms=int(deadline_ms))
+        transport timeout. ``affinity_key`` (a user/entity id) only
+        matters against a fleet router: same key -> same replica, so
+        its hot embedding cache keeps serving that id range; a single
+        serve pod ignores it."""
+        request = pb.PredictRequest(
+            deadline_ms=int(deadline_ms),
+            affinity_key=int(affinity_key),
+        )
         if not isinstance(features, dict):
             features = {SINGLE_INPUT_KEY: features}
         for name, value in features.items():

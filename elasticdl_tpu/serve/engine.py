@@ -15,6 +15,7 @@ identity machinery) invalidates the shared cache from whatever thread
 detected it — the cache is built ``thread_safe=True`` for exactly this.
 """
 
+import os
 import threading
 import time
 
@@ -43,9 +44,17 @@ class ServingEngine:
                  compute_dtype=None, max_batch=None, max_delay_ms=None,
                  queue_depth=None, deadline_ms=None, cache_ttl_secs=None,
                  cache_capacity=1_000_000, watch_secs=None,
-                 registry=None):
+                 registry=None, directed=False):
         self.model_zoo = model_zoo
         self.export_dir = export_dir
+        # directed mode (ISSUE 17 fleet replicas): export_dir is a
+        # VERSIONED ROOT (one subdirectory per export bundle) and the
+        # router steers which version this replica loads via
+        # set_target(); undirected (single-pod) keeps the flat layout
+        # and autonomously follows whatever lands in export_dir
+        self._directed = bool(directed)
+        self._target_rel = None  # None = no directive yet: newest wins
+        self._loaded_rel = ""
         self._ps = ps_client
         self._compute_dtype = compute_dtype
         self.spec = get_model_spec(
@@ -139,6 +148,40 @@ class ServingEngine:
         return self._model is not None
 
     @property
+    def loaded_export(self):
+        """Rel name of the loaded version under the export root
+        (directed mode); "" for the flat single-pod layout."""
+        return self._loaded_rel
+
+    def set_target(self, rel):
+        """Directed mode: the router told this replica which version to
+        run (canary membership, promote, or rollback). The watcher
+        picks the change up on its next tick — the swap machinery is
+        exactly the single-pod hot swap, including the in-flight
+        requests finishing on the version that admitted them."""
+        if not self._directed or not rel or rel == self._target_rel:
+            return
+        self._target_rel = rel
+        logger.info("export target directed to %r", rel)
+
+    def _resolve_export(self):
+        """(directory, rel) the engine should be serving right now."""
+        if not self._directed:
+            return self.export_dir, ""
+        rel = self._target_rel
+        if not rel:
+            # no directive yet (bootstrap): newest complete bundle —
+            # the router adopts whatever the fleet converged on as the
+            # incumbent and pins everyone from then on
+            from elasticdl_tpu.serve.fleet import scan_export_versions
+
+            versions = scan_export_versions(self.export_dir)
+            if not versions:
+                return self.export_dir, ""
+            rel = versions[-1][0]
+        return os.path.join(self.export_dir, rel), rel
+
+    @property
     def model(self):
         return self._model
 
@@ -170,10 +213,10 @@ class ServingEngine:
 
         self._ps.resync_hook = _chained
 
-    def _build(self):
+    def _build(self, export_dir):
         return ServingModel(
             self.spec,
-            self.export_dir,
+            export_dir,
             max_batch=self.batcher.max_batch,
             ps_client=self._ps,
             cache=self.cache,
@@ -186,8 +229,9 @@ class ServingEngine:
         # the lock must not stall behind seconds of IO + XLA. The lock
         # guards only the stamp compare-and-swap; a builder that loses
         # the race to the same stamp drops its replacement.
+        export_dir, rel = self._resolve_export()
         previous = self._model
-        replacement = self._build()
+        replacement = self._build(export_dir)
         if previous is not None and replacement.stamp == previous.stamp:
             return False
         # warm BEFORE the swap: the compile (and the cache priming
@@ -207,6 +251,7 @@ class ServingEngine:
             if previous is not None and replacement.stamp == previous.stamp:
                 return False
             self._model = replacement
+            self._loaded_rel = rel
         self._m_model_info.labels(
             version=str(replacement.step)
         ).set(1)
@@ -242,7 +287,7 @@ class ServingEngine:
     def _watch_loop(self):
         while not self._stopped.wait(self._watch_secs):
             try:
-                signature = export_signature(self.export_dir)
+                signature = export_signature(self._resolve_export()[0])
                 model = self._model
                 if signature is None:
                     continue

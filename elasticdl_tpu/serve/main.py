@@ -45,6 +45,19 @@ def parse_serve_args(argv=None):
         help="optional: piggyback serving telemetry on the master's "
         "fleet view (/statusz)",
     )
+    parser.add_argument(
+        "--router_addr", default="",
+        help="fleet mode (ISSUE 17): register with the serving router "
+        "at this address and heartbeat telemetry + export versions; "
+        "--export_dir then names the VERSIONED export root (one "
+        "subdirectory per bundle) and the router directs which "
+        "version this replica loads",
+    )
+    parser.add_argument(
+        "--advertise_addr", default="",
+        help="address the router should reach this replica at "
+        "(default 127.0.0.1:<port> — the local-subprocess topology)",
+    )
     # must match the training job's compute dtype for prediction parity
     parser.add_argument("--compute_dtype", default="")
     parser.add_argument(
@@ -107,6 +120,7 @@ class ServeRole:
                 args.cache_ttl_secs if args.cache_ttl_secs >= 0 else None
             ),
             watch_secs=args.watch_secs if args.watch_secs >= 0 else None,
+            directed=bool(args.router_addr),
         )
         self._master_client = None
         if args.master_addr:
@@ -124,6 +138,15 @@ class ServeRole:
                 self._master_client.telemetry_provider = self.telemetry_blob
         self.server = None
         self.observability = None
+        # fleet link (ISSUE 17): register/heartbeat with the router
+        self.replica_id = "serve-%d-%d" % (args.serve_id, os.getpid())
+        self._advertise_addr = (
+            args.advertise_addr or "127.0.0.1:%d" % args.port
+        )
+        self._router_stub = None
+        self._fleet_thread = None
+        self._registered = False
+        self._drain_reason = "sigterm"
         self._drained = threading.Event()
         # SIGTERM arrival marker: a plain bool write is the only thing
         # the signal handler does (atomic, lock-free, reentrant-safe);
@@ -191,11 +214,119 @@ class ServeRole:
                 "model_loaded", lambda: self.engine.loaded
             )
         self._install_sigterm_drain()
+        if self.args.router_addr:
+            self._start_fleet_link()
         logger.info(
             "serve %d on :%d (export %s)",
             self.args.serve_id, self.args.port, self.args.export_dir,
         )
         return self
+
+    # -- fleet link (ISSUE 17) -----------------------------------------
+    def _start_fleet_link(self):
+        from elasticdl_tpu.common.grpc_utils import build_channel
+        from elasticdl_tpu.proto import services
+
+        self._router_stub = services.RouterStub(
+            build_channel(self.args.router_addr)
+        )
+        self._fleet_thread = threading.Thread(
+            target=self._fleet_loop, name="edl-serve-fleet", daemon=True
+        )
+        self._fleet_thread.start()
+
+    def _fleet_loop(self):
+        """Register with the router, then heartbeat until drained.
+
+        Heartbeats carry telemetry + the loaded/newest-available export
+        versions UP and directives DOWN: ``target_export`` steers the
+        directed engine (canary/promote/rollback) and ``drain`` routes
+        this replica through the exact SIGTERM drain path a kubelet
+        eviction would (stop admitting, flush, deregister, exit 0) —
+        the run loop just sees the same flag the signal handler sets."""
+        from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+        from elasticdl_tpu.serve.fleet import scan_export_versions
+
+        heartbeat_secs = 2.0
+        while not (self._drained.is_set() or self._term_flag):
+            try:
+                if not self._registered:
+                    resp = self._router_stub.register_replica(
+                        pb.RegisterReplicaRequest(
+                            replica_id=self.replica_id,
+                            addr=self._advertise_addr,
+                            max_batch=self.engine.batcher.max_batch,
+                            model_stamp=self.engine.model_info()["stamp"],
+                            telemetry=self.telemetry_blob(),
+                        ),
+                        timeout=5.0,
+                    )
+                    if resp.heartbeat_secs > 0:
+                        heartbeat_secs = resp.heartbeat_secs
+                    if resp.target_export:
+                        self.engine.set_target(resp.target_export)
+                    self._registered = True
+                    logger.info(
+                        "registered with router %s as %s",
+                        self.args.router_addr, self.replica_id,
+                    )
+                else:
+                    versions = scan_export_versions(self.args.export_dir)
+                    newest = versions[-1] if versions else ("", 0, "")
+                    info = self.engine.model_info()
+                    resp = self._router_stub.heartbeat_replica(
+                        pb.ReplicaHeartbeatRequest(
+                            replica_id=self.replica_id,
+                            loaded_export=self.engine.loaded_export,
+                            loaded_stamp=info["stamp"],
+                            available_export=newest[0],
+                            available_stamp=newest[2],
+                            draining=self._term_flag,
+                            telemetry=self.telemetry_blob(),
+                        ),
+                        timeout=5.0,
+                    )
+                    if not resp.known:
+                        # the router restarted (or expired us while
+                        # partitioned): re-register from scratch
+                        self._registered = False
+                        continue
+                    if resp.target_export:
+                        self.engine.set_target(resp.target_export)
+                    if resp.drain:
+                        self._drain_reason = "router_drain"
+                        self._term_flag = True
+                        return
+            except Exception:
+                # router unreachable: keep trying — the tier outlives
+                # a router restart, and re-registration is idempotent
+                logger.debug("router link hiccup", exc_info=True)
+            time.sleep(heartbeat_secs if self._registered else 1.0)
+
+    def _deregister(self, reason):
+        """The exactly-once drain ack (fleet mode): tell the router the
+        queue is flushed so it forgets this replica with no
+        ``replica_lost`` alert. Best-effort — a dead router just means
+        the heartbeat timeout journals the loss instead."""
+        if self._router_stub is None or not self._registered:
+            return
+        self._registered = False
+        from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+        try:
+            self._router_stub.deregister_replica(
+                pb.DeregisterReplicaRequest(
+                    replica_id=self.replica_id,
+                    reason=reason,
+                    served=self.engine.batcher.served_total,
+                    shed=self.engine.batcher.shed_total,
+                ),
+                timeout=5.0,
+            )
+        except Exception:
+            logger.warning(
+                "drain ack to router failed (router gone?)", exc_info=True
+            )
 
     def _install_sigterm_drain(self):
         self._term_previous = signal.getsignal(signal.SIGTERM)
@@ -219,8 +350,11 @@ class ServeRole:
     def _finish_term(self):
         """The deferred SIGTERM drain (what the handler used to do
         inline), on the run loop with no lock held; then chains the
-        flight-recorder hook (which dumps the ring and exits 0)."""
-        self.drain(reason="sigterm")
+        flight-recorder hook (which dumps the ring and exits 0). A
+        router drain directive funnels through the same flag with its
+        own reason — the ISSUE 7/8 contract: shrink victims exit
+        through the graceful path, not a bare kill."""
+        self.drain(reason=self._drain_reason)
         previous = self._term_previous
         if callable(previous):
             previous(signal.SIGTERM, None)
@@ -235,6 +369,10 @@ class ServeRole:
             return
         self._drained.set()
         flushed = self.engine.drain()
+        # drain ack AFTER the flush (the count in the ack is final)
+        # and BEFORE the server stops — the router already stopped
+        # routing here the moment it directed the drain
+        self._deregister(reason)
         # trace flush ARMS here, before the crash hooks run (ISSUE 9):
         # the queue just finished flushing, so every request span is
         # final — a SIGKILL-grace-window race after this line loses
@@ -261,6 +399,10 @@ class ServeRole:
         NOT stop serving — the inference tier outlives training jobs;
         the poll exists only to feed fleet telemetry while a master is
         around."""
+        if self.args.router_addr:
+            # fleet mode drains on a router directive too; poll tight
+            # enough that a shrink victim leaves within ~a second
+            poll_secs = min(poll_secs, 1.0)
         if self._master_client is None:
             # bounded wait so a SIGTERM flag is noticed within one poll
             # even though the handler no longer stops the server itself

@@ -1,30 +1,57 @@
 #!/usr/bin/env python
-"""Serving-tier bench: open-loop Zipfian load at fixed QPS, with a
-mid-run zero-downtime version swap (ISSUE 8).
+"""Serving-tier bench: open-loop Zipfian load through the real stack.
 
-Topology: a deepfm model trained briefly in-process (LocalExecutor),
-exported, then served through the REAL stack — gRPC Serve service,
-admission-controlled micro-batcher, read-only embedding client with
-TTL cache against the trained store. The load generator is OPEN-LOOP
-(requests fire on a fixed schedule regardless of completions — the
-only honest way to measure a serving tier: closed-loop generators
-self-throttle exactly when the server degrades) with Zipfian ids, the
-id distribution the hot-row stack exists for.
+Two modes, one harness (ISSUE 8 + ISSUE 17):
 
-Mid-run, the trainer exports a NEWER version into the watched
-directory. The HARD GATE (exit 1): the swap must complete and ZERO
-requests may fail or shed across the whole run — in-flight requests
-finish on the version that admitted them, new ones ride the warmed
-replacement. p50/p99 latency and QPS/chip are REPORT-ONLY (journaled
-by ci.sh tier 1f like the wire and tier benches; absolute numbers
-flake across boxes).
+**Single-pod (default)** — a deepfm model trained briefly in-process
+(LocalExecutor), exported, then served through the REAL stack — gRPC
+Serve service, admission-controlled micro-batcher, read-only embedding
+client with TTL cache against the trained store. Mid-run, the trainer
+exports a NEWER version into the watched directory. The HARD GATE
+(exit 1): the swap must complete and ZERO requests may fail or shed
+across the whole run.
 
-Env knobs: BENCH_SERVING_QPS (default 150), BENCH_SERVING_SECS (8),
-BENCH_SERVING_SWAP_AT (0.5 = mid-run fraction).
+**Fleet (--router --replicas N)** — the same load generator pointed at
+the ISSUE 17 router fronting N serve-replica SUBPROCESSES over a real
+PS subprocess and a versioned export root. The run drives the full
+fleet lifecycle under continuous open-loop traffic:
+
+  1. spin-up     — N replicas spawn, register, load v1;
+  2. SIGKILL     — one replica is hard-killed mid-traffic; its keys
+                   fail over, the autoscaler's floor replaces it;
+  3. promote     — a healthy v2 export lands; the canary slice loads
+                   it, the judge promotes on matching prediction
+                   distributions;
+  4. rollback    — a POISONED v3 export lands (params scrambled, so
+                   its prediction distribution drifts); the judge
+                   rolls the canary back and blacklists the stamp.
+
+HARD GATES (exit 1): zero failed client requests across all phases,
+the killed replica replaced (floor restored), the canary BOTH promoted
+v2 AND rolled back v3, and every scale/canary decision journaled with
+its reasons. Latency and QPS are REPORT-ONLY (journaled by ci.sh tier
+1f like the other benches; absolute numbers flake across boxes).
+
+The load generator is OPEN-LOOP (requests fire on a fixed schedule
+regardless of completions — the only honest way to measure a serving
+tier: closed-loop generators self-throttle exactly when the server
+degrades) with Zipfian ids and cycling affinity keys.
+
+Env knobs, single-pod: BENCH_SERVING_QPS (default 150),
+BENCH_SERVING_SECS (8), BENCH_SERVING_SWAP_AT (0.5).
+Fleet: BENCH_FLEET_QPS (0 = auto-scale by CPU count — this bench runs
+on 1-CPU CI boxes), BENCH_FLEET_CANARY_MIN (30 requests per arm),
+BENCH_FLEET_DEADLINE_SECS (120 — generous: a request landing on a
+cold replica pays its jit compile), BENCH_FLEET_TIMEOUT_SECS (900
+per-phase watchdog).
 """
 
+import argparse
 import json
 import os
+import signal
+import socket
+import subprocess
 import sys
 import tempfile
 import threading
@@ -35,6 +62,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
 
 import numpy as np  # noqa: E402
 
+_VOCAB = 1000
+_ZIPF_A = 1.3
+_ROWS_PER_REQUEST = 4
+_FIELDS = 10
+
 
 def _env_float(name, default):
     try:
@@ -43,11 +75,59 @@ def _env_float(name, default):
         return default
 
 
-def main():
+def _train_executor(tmp):
+    """Brief in-process deepfm training run; returns the executor."""
+    from test_utils import create_ctr_recordio
+    from elasticdl_tpu.train.local_executor import LocalExecutor
+
+    data = os.path.join(tmp, "data")
+    os.makedirs(data, exist_ok=True)
+    create_ctr_recordio(
+        data + "/f0.rec", num_records=256, vocab=_VOCAB, seed=0
+    )
+    executor = LocalExecutor(
+        "elasticdl_tpu.models.deepfm", training_data=data,
+        minibatch_size=32, num_epochs=1,
+    )
+    executor.train()
+    return executor
+
+
+def _advance_training(executor, steps):
+    """Train a few more steps so the next export's step really moves."""
+    batches = []
+    for batch in executor._batches(executor._train_reader, "training"):
+        batches.append(batch)
+        if len(batches) >= steps:
+            break
+    for batch in batches:
+        executor.state, _ = executor.trainer.train_step(
+            executor.state, batch
+        )
+
+
+def _zipf_ids(rng):
+    raw = rng.zipf(_ZIPF_A, size=(_ROWS_PER_REQUEST, _FIELDS))
+    return np.minimum(raw, _VOCAB - 1).astype(np.int64)
+
+
+def _percentiles(latencies):
+    if not latencies:
+        return None, None
+    lat_ms = np.asarray(latencies) * 1e3
+    return (
+        round(float(np.percentile(lat_ms, 50)), 2),
+        round(float(np.percentile(lat_ms, 99)), 2),
+    )
+
+
+# ======================================================================
+# single-pod mode (ISSUE 8)
+# ======================================================================
+def run_single():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax  # noqa: E402 (after platform pin)
 
-    from test_utils import create_ctr_recordio  # noqa: E402
     from elasticdl_tpu.common.grpc_utils import (  # noqa: E402
         build_server,
         find_free_port,
@@ -60,28 +140,16 @@ def main():
     from elasticdl_tpu.serve.engine import ServingEngine  # noqa: E402
     from elasticdl_tpu.serve.servicer import ServeServicer  # noqa: E402
     from elasticdl_tpu.train.export import export_train_state  # noqa: E402
-    from elasticdl_tpu.train.local_executor import LocalExecutor  # noqa: E402
 
     events.configure("bench-serving")
 
     qps = _env_float("BENCH_SERVING_QPS", 150.0)
     duration = _env_float("BENCH_SERVING_SECS", 8.0)
     swap_at = _env_float("BENCH_SERVING_SWAP_AT", 0.5)
-    vocab = 1000
-    zipf_a = 1.3
-    rows_per_request = 4
-    fields = 10
 
     # ---- train + export ------------------------------------------------
     tmp = tempfile.mkdtemp(prefix="edl-bench-serving-")
-    create_ctr_recordio(
-        tmp + "/f0.rec", num_records=256, vocab=vocab, seed=0
-    )
-    executor = LocalExecutor(
-        "elasticdl_tpu.models.deepfm", training_data=tmp,
-        minibatch_size=32, num_epochs=1,
-    )
-    executor.train()
+    executor = _train_executor(tmp)
     export_dir = os.path.join(tmp, "export")
     export_train_state(executor.state, export_dir)
 
@@ -101,7 +169,7 @@ def main():
     first_step = engine.model.step
 
     # warm the compiled shape out of the measurement
-    warm_ids = np.ones((rows_per_request, fields), np.int64)
+    warm_ids = np.ones((_ROWS_PER_REQUEST, _FIELDS), np.int64)
     client.predict({"ids": warm_ids}, deadline_secs=60)
 
     # ---- open-loop load ------------------------------------------------
@@ -115,10 +183,6 @@ def main():
     pool_lock = threading.Lock()
     inflight = 0
     max_inflight = 0
-
-    def zipf_ids():
-        raw = rng.zipf(zipf_a, size=(rows_per_request, fields))
-        return np.minimum(raw, vocab - 1).astype(np.int64)
 
     def fire(i, ids):
         nonlocal inflight, max_inflight
@@ -138,15 +202,7 @@ def main():
         time.sleep(duration * swap_at)
         t0 = time.monotonic()
         # train a few more steps so the exported step really moves
-        batches = []
-        for batch in executor._batches(executor._train_reader, "training"):
-            batches.append(batch)
-            if len(batches) >= 3:
-                break
-        for batch in batches:
-            executor.state, _ = executor.trainer.train_step(
-                executor.state, batch
-            )
+        _advance_training(executor, steps=3)
         export_train_state(executor.state, export_dir)
         while engine.swaps == 0 and time.monotonic() - t0 < 30:
             time.sleep(0.02)
@@ -162,7 +218,7 @@ def main():
         delay = target - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        ids = zipf_ids()
+        ids = _zipf_ids(rng)
         with pool_lock:
             inflight += 1
             max_inflight = max(max_inflight, inflight)
@@ -180,12 +236,7 @@ def main():
     served = [lat for lat in latencies if lat is not None]
     # all-failed runs must still reach the hard-gate diagnostics (and
     # the journaled report) instead of crashing on an empty percentile
-    if served:
-        lat_ms = np.asarray(served) * 1e3
-        p50_ms = round(float(np.percentile(lat_ms, 50)), 2)
-        p99_ms = round(float(np.percentile(lat_ms, 99)), 2)
-    else:
-        p50_ms = p99_ms = None
+    p50_ms, p99_ms = _percentiles(served)
     chips = max(jax.device_count(), 1)
     new_step = engine.model.step
     report = {
@@ -244,6 +295,449 @@ def main():
             print("  - %s" % reason, file=sys.stderr)
         return 1
     return 0
+
+
+# ======================================================================
+# fleet mode (ISSUE 17)
+# ======================================================================
+def _wait_port(port, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port))
+            return True
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    return False
+
+
+def _poison_bundle(path):
+    """Scramble a bundle's dense params so its prediction distribution
+    drifts hard off the incumbent's — the canary judge must roll it
+    back on TV distance, not on crashes (the model stays finite)."""
+    npz = os.path.join(path, "model.npz")
+    data = np.load(npz)
+    arrays = {name: data[name] for name in data.files}
+    for name, arr in arrays.items():
+        if name.startswith("params/"):
+            arrays[name] = (arr * 6.0 + 4.0).astype(arr.dtype)
+    np.savez(npz, **arrays)
+
+
+def run_fleet(replicas):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    qps = _env_float("BENCH_FLEET_QPS", 0.0)
+    if qps <= 0:
+        # auto-scale to the box: the gates are invariants (zero
+        # failures, both canary cycles), not throughput — 1-CPU CI
+        # boxes run the same protocol at lower pressure
+        qps = max(6.0, 4.0 * (os.cpu_count() or 1))
+    canary_min = int(_env_float("BENCH_FLEET_CANARY_MIN", 30))
+    deadline_secs = _env_float("BENCH_FLEET_DEADLINE_SECS", 120.0)
+    watchdog = _env_float("BENCH_FLEET_TIMEOUT_SECS", 900.0)
+
+    tmp = tempfile.mkdtemp(prefix="edl-bench-fleet-")
+    events_dir = os.path.join(tmp, "events")
+    root = os.path.join(tmp, "exports")
+    log_dir = os.path.join(tmp, "logs")
+    for d in (events_dir, root, log_dir):
+        os.makedirs(d)
+    # the canary controller and registry read their knobs from env at
+    # construction; pin the bench's envelope before importing anything
+    os.environ["EDL_EVENTS_DIR"] = events_dir
+    os.environ["EDL_CANARY_FRACTION"] = os.environ.get(
+        "EDL_CANARY_FRACTION", "0.5"
+    )
+    os.environ["EDL_CANARY_MIN_REQUESTS"] = str(canary_min)
+    os.environ.setdefault("EDL_CANARY_DRIFT_MAX", "0.25")
+    # the judge must outlive cold-replica compiles; the bench's own
+    # watchdog is the timeout that matters
+    os.environ["EDL_CANARY_TIMEOUT_SECS"] = str(watchdog)
+
+    from elasticdl_tpu.common.grpc_utils import (  # noqa: E402
+        build_server,
+        find_free_port,
+    )
+    from elasticdl_tpu.models import deepfm  # noqa: E402
+    from elasticdl_tpu.observability import events  # noqa: E402
+    from elasticdl_tpu.proto.services import (  # noqa: E402
+        add_router_servicer_to_server,
+        add_serve_servicer_to_server,
+    )
+    from elasticdl_tpu.serve.client import ServeClient  # noqa: E402
+    from elasticdl_tpu.serve.fleet import (  # noqa: E402
+        ReplicaAutoscaler,
+        SubprocessReplicaScaler,
+    )
+    from elasticdl_tpu.serve.model import export_signature  # noqa: E402
+    from elasticdl_tpu.serve.router import RouterServicer  # noqa: E402
+    from elasticdl_tpu.train.export import export_train_state  # noqa: E402
+    from elasticdl_tpu.worker.ps_client import PSClient  # noqa: E402
+    from test_utils import load_journal  # noqa: E402
+
+    events.configure("bench-fleet")
+    gate_failures = []
+    phases = {}
+
+    def wait_until(condition, what, timeout=None):
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else watchdog
+        )
+        while time.monotonic() < deadline:
+            if condition():
+                return True
+            time.sleep(0.25)
+        gate_failures.append("timed out waiting for %s" % what)
+        return False
+
+    # ---- train + v1 into the versioned root ----------------------------
+    executor = _train_executor(tmp)
+    export_train_state(executor.state, os.path.join(root, "v00001"))
+
+    # ---- real PS subprocess, seeded with the trained rows --------------
+    base_env = {
+        **os.environ, "JAX_PLATFORMS": "cpu", "EDL_EVENTS_DIR": events_dir,
+    }
+    pport = find_free_port()
+    ps = subprocess.Popen([
+        sys.executable, "-m", "elasticdl_tpu.ps.server", "--ps_id", "0",
+        "--num_ps_pods", "1", "--port", str(pport),
+        "--opt_type", "adam", "--opt_args", "lr=0.001", "--use_async", "1",
+    ], env=base_env)
+    if not _wait_port(pport):
+        print("BENCH GATE FAILED:\n  - PS never came up", file=sys.stderr)
+        return 1
+    seed_client = PSClient(["localhost:%d" % pport])
+    specs = deepfm.sparse_embedding_specs(batch_size=32)
+    seed_client.push_embedding_table_infos(
+        [(s.name, s.dim, str(float(s.init_scale))) for s in specs]
+    )
+    store = executor.trainer.preparer._ps.store
+    seed_client.push_embedding_rows({
+        s.name: store.export_table(s.name) for s in specs
+    })
+
+    # ---- in-process router + subprocess replica fleet ------------------
+    servicer = RouterServicer(
+        # 15s timeout: a replica's heartbeat thread starves for several
+        # seconds while jit compiles on a 1-CPU CI box — 4-5s would
+        # expire live-but-compiling replicas
+        heartbeat_secs=1.0, replica_timeout_secs=15.0,
+        inflight_cap=max(64, int(qps) * 4),
+        failover_retries=max(2, replicas - 1),
+    )
+    server = build_server()
+    add_serve_servicer_to_server(servicer, server)
+    add_router_servicer_to_server(servicer, server)
+    rport = find_free_port()
+    server.add_insecure_port("[::]:%d" % rport)
+    server.start()
+    scaler = SubprocessReplicaScaler(
+        "127.0.0.1:%d" % rport, root,
+        extra_args=[
+            "--model_zoo", "elasticdl_tpu.models.deepfm",
+            "--ps_addrs", "localhost:%d" % pport,
+            "--max_batch", "32", "--max_delay_ms", "5",
+            "--queue_depth", "256",
+        ],
+        env=base_env, log_dir=log_dir,
+    )
+    # floor == the fleet size: the only grow this bench should see is
+    # the below-floor replacement after the SIGKILL. The cooldown must
+    # outlast a replica's cold start (jax import + model load) or the
+    # floor check re-fires into a spawn storm.
+    autoscaler = ReplicaAutoscaler(
+        servicer.registry, scaler,
+        min_replicas=replicas, max_replicas=replicas + 1, step=1,
+        hold_secs=1.0, cooldown_secs=60.0,
+        queue_per_replica=1e9, qps_per_replica=1e9,
+    )
+
+    def all_loaded():
+        state = servicer.registry.state()
+        return (
+            len(servicer.registry.routable_ids()) >= replicas
+            and len(state) >= replicas
+            and all(v["loaded_stamp"] for v in state.values())
+        )
+
+    # ---- phase 0: spin-up ----------------------------------------------
+    t0 = time.monotonic()
+    scaler.scale_up(replicas)
+    if not wait_until(all_loaded, "initial %d replicas" % replicas):
+        _fleet_report(
+            {}, phases, gate_failures, replicas, qps, 0, [], [],
+        )
+        return 1
+    phases["spinup_secs"] = round(time.monotonic() - t0, 1)
+
+    # the control loop starts AFTER manual placement so the
+    # autoscaler's floor check can't race the first spawn
+    stop_ticks = threading.Event()
+
+    def ticker():
+        while not stop_ticks.is_set():
+            time.sleep(0.5)
+            try:
+                servicer.tick()
+                scaler.reap()
+                autoscaler.tick()
+            except Exception:
+                pass
+
+    tick_thread = threading.Thread(target=ticker, daemon=True)
+    tick_thread.start()
+
+    # ---- warm every replica's compiled forward -------------------------
+    client = ServeClient("localhost:%d" % rport)
+    warm_ids = np.ones((_ROWS_PER_REQUEST, _FIELDS), np.int64)
+    for key in range(replicas * 8):
+        client.predict(
+            {"ids": warm_ids}, deadline_secs=max(180.0, deadline_secs),
+            affinity_key=key,
+        )
+
+    # ---- continuous open-loop load -------------------------------------
+    rng = np.random.RandomState(42)
+    stop_load = threading.Event()
+    failures = []
+    latencies = []
+    book_lock = threading.Lock()
+    outstanding = [0]
+    total_sent = [0]
+
+    def fire(i, ids):
+        start = time.perf_counter()
+        try:
+            client.predict(
+                {"ids": ids}, deadline_secs=deadline_secs,
+                affinity_key=i % 509,
+            )
+            with book_lock:
+                latencies.append(time.perf_counter() - start)
+        except Exception as e:  # the hard gate counts every failure
+            with book_lock:
+                failures.append((i, repr(e)))
+        finally:
+            with book_lock:
+                outstanding[0] -= 1
+
+    def generator():
+        interval = 1.0 / qps
+        i = 0
+        next_t = time.monotonic()
+        while not stop_load.is_set():
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(0.05, next_t - now))
+                continue
+            next_t += interval
+            ids = _zipf_ids(rng)
+            with book_lock:
+                outstanding[0] += 1
+            threading.Thread(
+                target=fire, args=(i, ids), daemon=True
+            ).start()
+            i += 1
+        total_sent[0] = i
+
+    load_start = time.monotonic()
+    load_thread = threading.Thread(target=generator, daemon=True)
+    load_thread.start()
+    time.sleep(3.0)
+
+    # ---- phase A: SIGKILL one replica mid-traffic ----------------------
+    victim = sorted(servicer.registry.routable_ids())[0]
+    victim_pid = int(victim.rsplit("-", 1)[1])
+    tA = time.monotonic()
+    scaler.kill(victim_pid, sig=signal.SIGKILL)
+    ok = wait_until(
+        lambda: (
+            victim not in servicer.registry.live_ids() and all_loaded()
+        ),
+        "below-floor replacement after SIGKILL of %s" % victim,
+    )
+    if ok:
+        phases["replace_secs"] = round(time.monotonic() - tA, 1)
+
+    # ---- phase B: healthy v2 export -> canary promote ------------------
+    v2_stamp = None
+    if ok:
+        _advance_training(executor, steps=3)
+        export_train_state(executor.state, os.path.join(root, "v00002"))
+        v2_stamp = export_signature(os.path.join(root, "v00002"))
+        tB = time.monotonic()
+        ok = wait_until(
+            lambda: (
+                servicer.state()["canary"]["incumbent"]["stamp"]
+                == v2_stamp
+            ),
+            "canary promote of v00002",
+        )
+        if ok:
+            phases["promote_secs"] = round(time.monotonic() - tB, 1)
+
+    # ---- phase C: poisoned v3 export -> forced rollback ----------------
+    v3_stamp = None
+    if ok:
+        _advance_training(executor, steps=2)
+        staging = os.path.join(tmp, "staging-v00003")
+        export_train_state(executor.state, staging)
+        _poison_bundle(staging)
+        # atomic publish: replicas scan the root every heartbeat and
+        # must never see the pre-poison bundle under this name
+        os.rename(staging, os.path.join(root, "v00003"))
+        v3_stamp = export_signature(os.path.join(root, "v00003"))
+        tC = time.monotonic()
+        ok = wait_until(
+            lambda: (
+                v3_stamp in servicer.state()["canary"]["rejected"]
+            ),
+            "canary rollback of poisoned v00003",
+        )
+        if ok:
+            phases["rollback_secs"] = round(time.monotonic() - tC, 1)
+            # the members must land back on the incumbent
+            wait_until(
+                lambda: all(
+                    v["loaded_stamp"] == v2_stamp
+                    for v in servicer.registry.state().values()
+                    if not v["draining"]
+                ),
+                "canary members reloading the incumbent",
+                timeout=max(300.0, watchdog / 3),
+            )
+
+    # ---- wind down -----------------------------------------------------
+    stop_load.set()
+    load_thread.join(timeout=10)
+    drain_deadline = time.monotonic() + deadline_secs + 30
+    while time.monotonic() < drain_deadline:
+        with book_lock:
+            if outstanding[0] <= 0:
+                break
+        time.sleep(0.25)
+    else:
+        gate_failures.append(
+            "%d requests still in flight at wind-down" % outstanding[0]
+        )
+    wall = time.monotonic() - load_start
+    stop_ticks.set()
+    tick_thread.join(timeout=5)
+    final_state = servicer.state()
+    client.close()
+    server.stop(0)
+    scaler.stop_all()
+    ps.terminate()
+    ps.wait(timeout=30)
+    events.flush()
+
+    # ---- journal gates: every decision explained -----------------------
+    journal = load_journal(events_dir)
+    lost = [
+        e for e in journal
+        if e["event"] == "replica_lost" and e.get("replica") == victim
+    ]
+    grows = [
+        e for e in journal
+        if e["event"] == "scale_decision"
+        and e.get("tag") == "serve" and e.get("direction") == "grow"
+    ]
+    promoted = [
+        e for e in journal
+        if e["event"] == "canary_promoted" and e.get("export") == "v00002"
+    ]
+    rolled_back = [
+        e for e in journal
+        if e["event"] == "canary_rolled_back"
+        and e.get("export") == "v00003"
+    ]
+    if not lost:
+        gate_failures.append(
+            "SIGKILLed replica %s never journaled replica_lost" % victim
+        )
+    if not any(
+        any(str(r).startswith("below_floor") for r in e.get("reasons", []))
+        for e in grows
+    ):
+        gate_failures.append(
+            "no below_floor scale_decision journaled for the replacement"
+        )
+    if v2_stamp and not (promoted and promoted[0].get("reasons")):
+        gate_failures.append(
+            "canary_promoted for v00002 missing (or carries no reasons)"
+        )
+    if v3_stamp and not (rolled_back and rolled_back[0].get("reasons")):
+        gate_failures.append(
+            "canary_rolled_back for v00003 missing (or carries no "
+            "reasons)"
+        )
+    if failures:
+        gate_failures.append(
+            "%d client requests FAILED across the run (first: %s) — "
+            "the fleet must hold zero failures through kill, promote "
+            "and rollback" % (len(failures), failures[0][1])
+        )
+
+    report = _fleet_report(
+        final_state, phases, gate_failures, replicas, qps,
+        total_sent[0], latencies, failures, wall=wall,
+        promoted=promoted, rolled_back=rolled_back, grows=grows,
+    )
+    return 1 if gate_failures else 0
+
+
+def _fleet_report(state, phases, gate_failures, replicas, qps, total,
+                  latencies, failures, wall=None, promoted=(),
+                  rolled_back=(), grows=()):
+    p50_ms, p99_ms = _percentiles(latencies)
+    report = {
+        "mode": "fleet",
+        "replicas": replicas,
+        "qps_target": qps,
+        "qps_achieved": (
+            round(len(latencies) / wall, 1) if wall else None
+        ),
+        "requests": total,
+        "served": len(latencies),
+        "failed": len(failures),
+        "p50_ms": p50_ms,
+        "p99_ms": p99_ms,
+        "phases": phases,
+        "scale_decisions": len(grows),
+        "canary": {
+            "promoted": [e.get("stamp") for e in promoted],
+            "rolled_back": [e.get("stamp") for e in rolled_back],
+            "final": (state or {}).get("canary", {}).get("incumbent"),
+        },
+    }
+    print(json.dumps(report))
+    if gate_failures:
+        print("BENCH GATE FAILED:", file=sys.stderr)
+        for reason in gate_failures:
+            print("  - %s" % reason, file=sys.stderr)
+    return report
+
+
+def main():
+    parser = argparse.ArgumentParser("bench_serving")
+    parser.add_argument(
+        "--router", action="store_true",
+        help="fleet mode: router + --replicas serve subprocesses over "
+        "a real PS and a versioned export root (ISSUE 17)",
+    )
+    parser.add_argument(
+        "--replicas", type=int, default=4,
+        help="fleet size for --router (the ISSUE 17 acceptance floor "
+        "is 4)",
+    )
+    args = parser.parse_args()
+    if args.router:
+        return run_fleet(max(2, args.replicas))
+    return run_single()
 
 
 if __name__ == "__main__":

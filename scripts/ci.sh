@@ -817,6 +817,203 @@ ps.terminate(); ps.wait(timeout=30)
 print("serving smoke OK: clean SIGTERM drain journaled")
 PYEOF
 
+echo "== tier 1e++ (fleet): serving-fleet smoke (router + 2 replicas + real PS) =="
+# ISSUE 17: the fleet topology as real subprocesses — a router_main
+# role self-managing two serve-replica subprocesses over a seeded PS
+# and a versioned export root. One replica is SIGKILLed mid-traffic:
+# ZERO client requests may fail (affinity failover + the autoscaler's
+# below-floor replacement), the loss and every scale decision are
+# journaled with reasons, and a v2 export canary-promotes under live
+# traffic. scripts/postmortem.py then threads the whole incident into
+# one timeline.
+FLEET_DIR="$(mktemp -d)"
+export FLEET_DIR
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, signal, socket, subprocess, sys, tempfile, time
+import urllib.request
+sys.path.insert(0, "tests")
+import numpy as np
+from test_utils import create_ctr_recordio, load_journal
+from elasticdl_tpu.common.grpc_utils import find_free_port
+
+events_dir = os.path.join(os.environ["FLEET_DIR"], "events")
+root = os.path.join(os.environ["FLEET_DIR"], "exports")
+os.makedirs(events_dir); os.makedirs(root)
+train = tempfile.mkdtemp()
+create_ctr_recordio(train + "/f0.rec", num_records=128, seed=0)
+
+from elasticdl_tpu.train.local_executor import LocalExecutor
+from elasticdl_tpu.train.export import export_train_state
+from elasticdl_tpu.serve.model import export_signature
+executor = LocalExecutor(
+    "elasticdl_tpu.models.deepfm", training_data=train,
+    minibatch_size=32, num_epochs=1,
+)
+executor.train()
+export_train_state(executor.state, os.path.join(root, "v00001"))
+
+base_env = {
+    **os.environ, "JAX_PLATFORMS": "cpu", "EDL_EVENTS_DIR": events_dir,
+    # tight fleet clocks so the smoke converges fast; the scale
+    # cooldown still outlasts a replica cold start (spawn-storm guard)
+    "EDL_ROUTER_HEARTBEAT_SECS": "1",
+    "EDL_ROUTER_REPLICA_TIMEOUT_SECS": "15",
+    "EDL_SERVE_SCALE_COOLDOWN_SECS": "45",
+    "EDL_CANARY_FRACTION": "0.5",
+    "EDL_CANARY_MIN_REQUESTS": "15",
+    "EDL_CANARY_TIMEOUT_SECS": "600",
+}
+pport, rport, mport = find_free_port(), find_free_port(), find_free_port()
+ps = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.ps.server", "--ps_id", "0",
+    "--num_ps_pods", "1", "--port", str(pport),
+    "--opt_type", "adam", "--opt_args", "lr=0.001", "--use_async", "1",
+], env=base_env)
+
+def wait_port(port, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port)); return
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    raise TimeoutError(port)
+
+wait_port(pport)
+from elasticdl_tpu.worker.ps_client import PSClient
+from elasticdl_tpu.models import deepfm
+seed_client = PSClient(["localhost:%d" % pport])
+specs = deepfm.sparse_embedding_specs(batch_size=32)
+seed_client.push_embedding_table_infos(
+    [(s.name, s.dim, str(float(s.init_scale))) for s in specs]
+)
+store = executor.trainer.preparer._ps.store
+seed_client.push_embedding_rows({
+    s.name: store.export_table(s.name) for s in specs
+})
+
+router = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.serve.router_main",
+    "--router_id", "0", "--port", str(rport),
+    "--min_replicas", "2", "--max_replicas", "3",
+    "--export_root", root,
+    "--replica_args",
+    "--model_zoo elasticdl_tpu.models.deepfm "
+    "--ps_addrs localhost:%d --max_batch 32 --max_delay_ms 5 "
+    "--queue_depth 256" % pport,
+    "--metrics_port", str(mport),
+], env=base_env)
+wait_port(rport)
+
+def routerz():
+    return json.loads(urllib.request.urlopen(
+        "http://localhost:%d/routerz" % mport, timeout=5
+    ).read())
+
+def wait_fleet(cond, what, timeout=300):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if cond(routerz()):
+                return
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(what)
+
+# the router's own floor check places the initial pair (that grow is
+# journaled like any other decision)
+wait_fleet(
+    lambda s: len(s["replicas"]) >= 2
+    and all(v["loaded_stamp"] for v in s["replicas"].values()),
+    "2 replicas registered + loaded",
+)
+
+from elasticdl_tpu.serve.client import ServeClient
+client = ServeClient("localhost:%d" % rport)
+rng = np.random.RandomState(0)
+
+def fire(key, budget=60):
+    ids = rng.randint(0, 1000, size=(4, 10)).astype(np.int64)
+    outputs, _, stamp = client.predict(
+        {"ids": ids}, deadline_secs=budget, affinity_key=key
+    )
+    assert np.isfinite(outputs["output"]).all()
+    return stamp
+
+# warm both replicas' compiled forwards: distinct keys spread over the
+# ring; generous budget — the first hit per replica pays its jit
+for key in range(16):
+    fire(key, budget=180)
+print("fleet smoke: fleet warmed through the router")
+
+# SIGKILL one replica mid-traffic. ZERO failures allowed: its keys
+# fail over to ring successors and the floor replaces it.
+victim = sorted(routerz()["replicas"])[0]
+os.kill(int(victim.rsplit("-", 1)[1]), signal.SIGKILL)
+for key in range(30):
+    fire(key, budget=120)
+print("fleet smoke: 30/30 predicts OK across the SIGKILL")
+wait_fleet(
+    lambda s: victim not in s["replicas"] and len(s["replicas"]) >= 2
+    and all(v["loaded_stamp"] for v in s["replicas"].values()),
+    "below-floor replacement", timeout=300,
+)
+print("fleet smoke: %s replaced (floor restored)" % victim)
+
+# v2 export -> canary promote under live traffic (the judge needs both
+# arms' books filled, so keep firing while it deliberates)
+for batch in executor._batches(executor._train_reader, "training"):
+    executor.state, _ = executor.trainer.train_step(
+        executor.state, batch
+    )
+    break
+export_train_state(executor.state, os.path.join(root, "v00002"))
+v2 = export_signature(os.path.join(root, "v00002"))
+deadline = time.time() + 600
+key = 0
+while time.time() < deadline:
+    if routerz()["canary"]["incumbent"]["stamp"] == v2:
+        break
+    fire(key % 509, budget=120)
+    key += 1
+    time.sleep(0.05)
+else:
+    raise TimeoutError("canary never promoted v00002")
+print("fleet smoke: canary promoted v00002 under live traffic")
+
+client.close()
+router.send_signal(signal.SIGTERM)
+rc = router.wait(timeout=120)
+assert rc == 0, "router exited rc=%s (clean drain expected)" % rc
+ps.terminate(); ps.wait(timeout=30)
+
+merged = load_journal(events_dir)
+names = [e["event"] for e in merged]
+lost = [e for e in merged if e["event"] == "replica_lost"
+        and e.get("replica") == victim]
+assert lost, "replica_lost for %s not journaled: %s" % (
+    victim, sorted(set(names)))
+grows = [e for e in merged if e["event"] == "scale_decision"
+         and e.get("tag") == "serve" and e.get("direction") == "grow"]
+assert len(grows) >= 2 and all(e.get("reasons") for e in grows), grows
+assert any(str(r).startswith("below_floor")
+           for e in grows for r in e.get("reasons", [])), grows
+promoted = [e for e in merged if e["event"] == "canary_promoted"]
+assert promoted and promoted[0].get("reasons"), sorted(set(names))
+assert "canary_started" in names and "replica_registered" in names, (
+    sorted(set(names)))
+print("fleet smoke OK: kill -> failover -> replacement -> promote")
+PYEOF
+# the postmortem must thread the fleet incident into one timeline
+python scripts/postmortem.py "$FLEET_DIR/events" 2>/dev/null \
+  | tee /tmp/_fleet_postmortem.out | head -5 || true
+grep -q "replica_lost" /tmp/_fleet_postmortem.out
+grep -q "canary_promoted" /tmp/_fleet_postmortem.out
+
 echo "== tier 1e+++: UDS local transport smoke (co-located worker+PS) =="
 # ISSUE 11: a real master+PS+worker deepfm job with the PS and worker
 # sharing EDL_PS_UDS_DIR — the worker's PS channel must ride the unix
@@ -1089,6 +1286,22 @@ printf '{"ts": "%s", "serving": %s}\n' \
   "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_serving.json)" \
   >> /tmp/ci_wire_micro.jsonl
 echo "serving bench journaled to /tmp/ci_wire_micro.jsonl"
+
+# Serving-FLEET bench (ISSUE 17): the same open-loop load pointed at
+# the router fronting 4 serve-replica subprocesses over a real PS and
+# a versioned export root. Latency/QPS are REPORT-ONLY (journaled
+# below; the QPS target auto-scales by CPU count — 1-CPU CI boxes run
+# the same protocol at lower pressure); the script hard-fails only on
+# the fleet invariants — a failed client request anywhere across the
+# replica SIGKILL, the canary promote, or the forced rollback; the
+# killed replica not replaced; either canary cycle not completing; or
+# a scale/canary decision missing its journaled reasons.
+JAX_PLATFORMS=cpu python scripts/bench_serving.py --router --replicas 4 \
+  | tee /tmp/_serving_fleet.json
+printf '{"ts": "%s", "bench_serving_fleet": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_serving_fleet.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "serving-fleet bench journaled to /tmp/ci_wire_micro.jsonl"
 
 # Device-tier A-B (ISSUE 6): deepfm steps/s with the HBM hot set on vs
 # off under an emulated per-row wire cost, plus the warm-phase hit

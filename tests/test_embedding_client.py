@@ -261,3 +261,80 @@ def test_read_only_preparer_never_writes():
     # a PS-relaunch hook must not re-arm registration either
     preparer._on_ps_restart(0)
     preparer.prepare(batch)
+
+
+# ---------------------------------------------------------------------------
+# PS-restart invalidation vs in-flight fill (ISSUE 17 S1)
+
+
+def test_clear_racing_inflight_fill_drops_the_fill():
+    """A PS restored-stamp invalidation (cache.clear, any thread) that
+    lands between a fill's PS fetch and its put must WIN: the fetched
+    rows came from the dead process and may not be re-inserted behind
+    the clear. The caller still gets its rows (the response is what it
+    is); only the cache insert is dropped, so the next request
+    re-pulls from the live PS."""
+    ps = _CountingClient(seed=0)
+    _tables(ps)
+    cache = HotRowCache(ttl_secs=60.0, thread_safe=True)
+    client = EmbeddingClient(ps, cache=cache, read_only=True)
+    ids = np.arange(8, dtype=np.int64)
+
+    real_batch = ps.pull_embedding_batch
+
+    def racing_batch(ids_by_table):
+        out = real_batch(ids_by_table)
+        cache.clear()  # the invalidation lands mid-fill, post-fetch
+        return out
+
+    ps.pull_embedding_batch = racing_batch
+    rows = client.pull_tables({"a": ids})
+    # the racing request is still served its rows
+    np.testing.assert_array_equal(
+        rows["a"], ps.store.lookup("a", ids)
+    )
+    ps.pull_embedding_batch = real_batch
+    before = ps.pulled_ids
+    client.pull_tables({"a": ids})
+    # every id hits the wire again: the stale fill never entered
+    assert ps.pulled_ids - before == ids.size
+
+
+def test_clear_racing_single_table_pull_drops_the_fill():
+    """Same pin for the per-table pull path (clients without the fused
+    batch RPC) — both paths share _assemble, but the generation
+    snapshot happens per entry point."""
+    ps = _CountingClient(seed=0)
+    _tables(ps)
+    cache = HotRowCache(ttl_secs=60.0, thread_safe=True)
+    client = EmbeddingClient(ps, cache=cache, read_only=True)
+    ids = np.arange(6, dtype=np.int64)
+
+    real_pull = ps.pull_embedding_vectors
+
+    def racing_pull(name, pull_ids):
+        out = real_pull(name, pull_ids)
+        cache.clear()
+        return out
+
+    ps.pull_embedding_vectors = racing_pull
+    client.pull("a", ids)
+    ps.pull_embedding_vectors = real_pull
+    mask, _ = cache.split("a", ids)
+    assert not mask.any()  # nothing from the raced fill was cached
+
+
+def test_generation_unraced_fill_still_caches():
+    """The conditional put must not break the happy path: with no
+    clear in flight, fills cache exactly as before."""
+    ps = _CountingClient(seed=0)
+    _tables(ps)
+    cache = HotRowCache(ttl_secs=60.0, thread_safe=True)
+    client = EmbeddingClient(ps, cache=cache, read_only=True)
+    ids = np.arange(5, dtype=np.int64)
+    client.pull("a", ids)
+    mask, _ = cache.split("a", ids)
+    assert mask.all()
+    assert cache.generation == 0
+    cache.clear()
+    assert cache.generation == 1

@@ -1,0 +1,901 @@
+"""Serving-fleet unit tests (ISSUE 17).
+
+Pins the data- and control-plane contracts of the router tier:
+
+- consistent-hash ring: one join/leave moves ~1/N of the key space and
+  NOTHING else (property-tested over fleet sizes), draining replicas
+  stay on the ring but out of routing, failover walks distinct
+  successors only;
+- replica registry: register/heartbeat/deregister lifecycle, silence
+  expiry journals ``replica_lost``, deregister is the exactly-once
+  ``drain_ack``;
+- router failover: UNAVAILABLE fails over, never the same replica
+  twice, bounded attempts, in-flight cap sheds instead of spilling;
+- replica autoscaler: below-floor replacement is immediate, grow/shrink
+  ride the DecisionGate, victims are coldest-first and canary members
+  are spared, every decision journaled;
+- canary judge: full promote cycle, drift rollback, rejected stamps
+  never retried, slice assignment is stable per key.
+"""
+
+import json
+import os
+import threading
+import time
+
+import grpc
+import pytest
+
+from elasticdl_tpu.common.hash_utils import stable_u64
+from elasticdl_tpu.master.autoscaler import DecisionGate
+from elasticdl_tpu.observability import events
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.serve.canary import (
+    CanaryController,
+    PredictionStats,
+    total_variation,
+)
+from elasticdl_tpu.serve.fleet import (
+    ReplicaAutoscaler,
+    ReplicaRegistry,
+    scan_export_versions,
+)
+from elasticdl_tpu.serve.router import HashRing, RouterServicer
+from tests.test_utils import load_journal
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _register(target, rid, max_batch=32, stamp="", qps=0.0):
+    """Register ``rid`` on a RouterServicer or ReplicaRegistry. The
+    addr never connects (gRPC channels are lazy), so no server needed."""
+    request = pb.RegisterReplicaRequest(
+        replica_id=rid,
+        addr="127.0.0.1:1",
+        max_batch=max_batch,
+        model_stamp=stamp,
+        telemetry=pb.TelemetryBlob(role="serve", serve_qps=qps),
+    )
+    if isinstance(target, RouterServicer):
+        return target.register_replica(request, None)
+    return target.register(request)
+
+
+def _heartbeat(registry, rid, qps=0.0, queue=0, shed=0,
+               loaded=("", ""), available=("", ""), now=None):
+    request = pb.ReplicaHeartbeatRequest(
+        replica_id=rid,
+        loaded_export=loaded[0],
+        loaded_stamp=loaded[1],
+        available_export=available[0],
+        available_stamp=available[1],
+        telemetry=pb.TelemetryBlob(
+            role="serve", serve_qps=qps,
+            serve_queue_depth=queue, serve_shed_total=shed,
+        ),
+    )
+    return registry.heartbeat(request, now=now)
+
+
+class _Abort(Exception):
+    def __init__(self, code, detail):
+        super().__init__(detail)
+        self.code = code
+        self.detail = detail
+
+
+class _Ctx:
+    """Just enough grpc.ServicerContext for the router's predict."""
+
+    def __init__(self, remaining=5.0):
+        self._remaining = remaining
+
+    def time_remaining(self):
+        return self._remaining
+
+    def abort(self, code, detail):
+        raise _Abort(code, detail)
+
+
+class _RpcFailure(grpc.RpcError):
+    def __init__(self, code, detail="injected"):
+        self._code = code
+        self._detail = detail
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._detail
+
+
+class _FakeStub:
+    """Replica stand-in wired into registry entries after register."""
+
+    def __init__(self, stamp="100:1:1", fail=None, max_batch=64):
+        self.stamp = stamp
+        self.fail = fail
+        self.max_batch = max_batch
+        self.predicts = 0
+
+    def predict(self, request, timeout=None):
+        self.predicts += 1
+        if self.fail is not None:
+            raise self.fail
+        return pb.PredictResponse(model_step=1, model_stamp=self.stamp)
+
+    def model_info(self, request, timeout=None):
+        if self.fail is not None:
+            raise self.fail
+        return pb.ModelInfoResponse(
+            loaded=True, step=1, stamp=self.stamp,
+            model_zoo="zoo", max_batch=self.max_batch,
+        )
+
+
+def _plant_stub(servicer, rid, stub):
+    entry = servicer.registry.get(rid)
+    assert entry is not None
+    entry.stub = stub
+    return stub
+
+
+def _servicer(**kwargs):
+    kwargs.setdefault("heartbeat_secs", 1.0)
+    kwargs.setdefault("replica_timeout_secs", 30.0)
+    return RouterServicer(**kwargs)
+
+
+@pytest.fixture
+def journal(tmp_path, monkeypatch):
+    events_dir = tmp_path / "events"
+    events_dir.mkdir()
+    monkeypatch.setenv(events.EVENTS_DIR_ENV, str(events_dir))
+    events.configure("router-0")
+    yield events_dir
+    events.flush()
+    events._reset_for_tests()
+
+
+def _journaled(events_dir, event):
+    return [e for e in load_journal(events_dir) if e["event"] == event]
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+
+
+def _key_owners(ring, keys):
+    return {k: ring.lookup(stable_u64("key:%d" % k)) for k in keys}
+
+
+@pytest.mark.parametrize("fleet", [3, 4, 8])
+def test_ring_single_leave_moves_only_the_victims_keys(fleet):
+    """Removing one replica moves EXACTLY the victim's keys (~1/N of
+    the space) and no one else's — the affinity property the embedding
+    caches buy their hit rate with."""
+    ring = HashRing()
+    for i in range(fleet):
+        ring.add("r%d" % i)
+    keys = range(4000)
+    before = _key_owners(ring, keys)
+    victim = "r1"
+    ring.remove(victim)
+    after = _key_owners(ring, keys)
+    moved = [k for k in keys if before[k] != after[k]]
+    # nothing moved that the victim did not own
+    assert all(before[k] == victim for k in moved)
+    # every victim key found a new home (ring still non-empty)
+    assert all(after[k] is not None for k in moved)
+    # the victim owned ~1/N of the space (vnode placement variance
+    # allows slack, but well under 2/N)
+    assert len(moved) == sum(1 for k in keys if before[k] == victim)
+    assert len(moved) <= 2.0 * len(list(keys)) / fleet
+
+
+@pytest.mark.parametrize("fleet", [3, 7])
+def test_ring_single_join_steals_only_for_the_newcomer(fleet):
+    ring = HashRing()
+    for i in range(fleet):
+        ring.add("r%d" % i)
+    keys = range(4000)
+    before = _key_owners(ring, keys)
+    ring.add("newcomer")
+    after = _key_owners(ring, keys)
+    moved = [k for k in keys if before[k] != after[k]]
+    # a moved key moved TO the newcomer, never between incumbents
+    assert all(after[k] == "newcomer" for k in moved)
+    assert len(moved) <= 2.0 * len(list(keys)) / (fleet + 1)
+
+
+def test_ring_successors_distinct_and_complete():
+    ring = HashRing()
+    members = {"a", "b", "c", "d"}
+    for rid in members:
+        ring.add(rid)
+    for key in range(50):
+        order = list(ring.successors(stable_u64("key:%d" % key)))
+        assert len(order) == len(members)
+        assert set(order) == members
+        assert order[0] == ring.lookup(stable_u64("key:%d" % key))
+
+
+def test_ring_placement_is_process_stable():
+    """A router restart rebuilds the identical ring from re-registered
+    replicas: placement hashes sha256, never the salted builtin."""
+    a, b = HashRing(), HashRing()
+    for rid in ("r0", "r1", "r2"):
+        a.add(rid)
+    for rid in ("r2", "r0", "r1"):  # registration order is irrelevant
+        b.add(rid)
+    for key in range(500):
+        h = stable_u64("key:%d" % key)
+        assert a.lookup(h) == b.lookup(h)
+
+
+def test_ring_empty_and_idempotent_ops():
+    ring = HashRing()
+    assert ring.lookup(123) is None
+    ring.add("only")
+    ring.add("only")  # re-add is a no-op, not a double placement
+    assert len(ring.members()) == 1
+    ring.remove("ghost")  # unknown remove is a no-op
+    assert ring.lookup(123) == "only"
+    ring.remove("only")
+    assert ring.lookup(123) is None
+
+
+# ---------------------------------------------------------------------------
+# replica registry
+
+
+def test_registry_lifecycle_and_exactly_once_drain_ack(journal):
+    joined, left = [], []
+    registry = ReplicaRegistry(
+        on_join=joined.append, on_leave=left.append,
+        heartbeat_secs=1.0, timeout_secs=30.0,
+    )
+    _register(registry, "serve-a", stamp="100:1:1")
+    assert joined == ["serve-a"]
+    known, drain, _ = _heartbeat(registry, "serve-a", qps=5.0)
+    assert known and not drain
+    # unknown replica: told to re-register, never silently adopted
+    known, _, _ = _heartbeat(registry, "stranger")
+    assert not known
+
+    ack = pb.DeregisterReplicaRequest(
+        replica_id="serve-a", reason="shutdown", served=42, shed=1,
+    )
+    assert registry.deregister(ack) is True
+    assert registry.deregister(ack) is False  # exactly-once
+    assert left == ["serve-a"]
+    events.flush()
+    acks = _journaled(journal, "drain_ack")
+    assert len(acks) == 1
+    assert acks[0]["replica"] == "serve-a"
+    assert acks[0]["served"] == 42
+    assert _journaled(journal, "replica_registered")
+    assert not _journaled(journal, "replica_lost")
+
+
+def test_registry_expire_journals_replica_lost(journal):
+    left = []
+    registry = ReplicaRegistry(
+        on_leave=left.append, heartbeat_secs=1.0, timeout_secs=5.0,
+    )
+    now = 1000.0
+    registry.register(
+        pb.RegisterReplicaRequest(replica_id="serve-a",
+                                  addr="127.0.0.1:1"),
+        now=now,
+    )
+    assert registry.expire(now=now + 4.9) == []
+    assert registry.expire(now=now + 5.1) == ["serve-a"]
+    assert left == ["serve-a"]
+    assert registry.live_ids() == []
+    events.flush()
+    lost = _journaled(journal, "replica_lost")
+    assert len(lost) == 1 and lost[0]["replica"] == "serve-a"
+
+
+def test_registry_draining_stays_on_ring_but_unroutable(journal):
+    ring = HashRing()
+    registry = ReplicaRegistry(
+        on_join=ring.add, on_leave=ring.remove,
+        heartbeat_secs=1.0, timeout_secs=30.0,
+    )
+    for rid in ("serve-a", "serve-b"):
+        _register(registry, rid)
+    assert registry.begin_drain("serve-a", reason="scale_down") is True
+    assert registry.begin_drain("serve-a") is False  # idempotent
+    # out of routing...
+    assert not registry.is_routable("serve-a")
+    assert registry.routable_ids() == ["serve-b"]
+    # ...but still on the ring: its keys move only when it LEAVES
+    assert set(ring.members()) == {"serve-a", "serve-b"}
+    # the drain directive rides the next heartbeat down
+    _, drain, _ = _heartbeat(registry, "serve-a")
+    assert drain
+    events.flush()
+    draining = _journaled(journal, "replica_draining")
+    assert len(draining) == 1 and draining[0]["reason"] == "scale_down"
+
+
+def test_registry_rejoin_replaces_without_ring_churn():
+    ring = HashRing()
+    joins = []
+
+    def on_join(rid):
+        joins.append(rid)
+        ring.add(rid)
+
+    registry = ReplicaRegistry(
+        on_join=on_join, on_leave=ring.remove,
+        heartbeat_secs=1.0, timeout_secs=30.0,
+    )
+    _register(registry, "serve-a", stamp="100:1:1")
+    _register(registry, "serve-a", stamp="200:1:1")  # relaunched pod
+    assert joins == ["serve-a"]  # one ring placement, zero churn
+    assert registry.get("serve-a").loaded_stamp == "200:1:1"
+
+
+def test_registry_min_max_batch_is_fleet_tightest():
+    registry = ReplicaRegistry(heartbeat_secs=1.0, timeout_secs=30.0)
+    _register(registry, "serve-a", max_batch=64)
+    _register(registry, "serve-b", max_batch=16)
+    assert registry.min_max_batch() == 16
+    registry.begin_drain("serve-b")
+    assert registry.min_max_batch() == 64  # draining out of the answer
+
+
+def test_registry_telemetry_totals_exclude_draining():
+    registry = ReplicaRegistry(heartbeat_secs=1.0, timeout_secs=30.0)
+    for rid in ("serve-a", "serve-b"):
+        _register(registry, rid)
+    _heartbeat(registry, "serve-a", qps=10.0, queue=4)
+    _heartbeat(registry, "serve-b", qps=30.0, queue=8)
+    registry.begin_drain("serve-b")
+    totals = registry.telemetry_totals()
+    assert totals["replicas"] == 1
+    assert totals["qps"] == pytest.approx(10.0)
+    assert totals["queue_depth"] == 4
+
+
+# ---------------------------------------------------------------------------
+# router data plane: affinity, failover, caps
+
+
+def _routing_order(servicer, affinity_key):
+    key_hash = stable_u64("k:%d" % affinity_key)
+    return list(servicer.ring.successors(key_hash)), affinity_key
+
+
+def test_router_failover_skips_dead_never_retries_same(journal):
+    servicer = _servicer(failover_retries=2)
+    for rid in ("serve-a", "serve-b", "serve-c"):
+        _register(servicer, rid)
+    order, key = _routing_order(servicer, affinity_key=7)
+    stubs = {rid: _plant_stub(servicer, rid, _FakeStub()) for rid in order}
+    stubs[order[0]].fail = _RpcFailure(grpc.StatusCode.UNAVAILABLE)
+
+    request = pb.PredictRequest(affinity_key=key)
+    response = servicer.predict(request, _Ctx())
+    assert response.model_stamp == "100:1:1"
+    # dead primary tried exactly once, the next distinct successor
+    # served, the third was never bothered
+    assert stubs[order[0]].predicts == 1
+    assert stubs[order[1]].predicts == 1
+    assert stubs[order[2]].predicts == 0
+
+
+def test_router_failover_bounded_and_distinct(journal):
+    servicer = _servicer(failover_retries=1)  # at most 2 attempts
+    for rid in ("serve-a", "serve-b", "serve-c"):
+        _register(servicer, rid)
+    order, key = _routing_order(servicer, affinity_key=7)
+    stubs = {
+        rid: _plant_stub(
+            servicer, rid,
+            _FakeStub(fail=_RpcFailure(grpc.StatusCode.UNAVAILABLE)),
+        )
+        for rid in order
+    }
+    with pytest.raises(_Abort) as info:
+        servicer.predict(pb.PredictRequest(affinity_key=key), _Ctx())
+    assert info.value.code == grpc.StatusCode.UNAVAILABLE
+    # retries+1 attempts total, never the same replica twice
+    assert sum(s.predicts for s in stubs.values()) == 2
+    assert max(s.predicts for s in stubs.values()) == 1
+
+
+def test_router_skips_draining_replica(journal):
+    servicer = _servicer()
+    for rid in ("serve-a", "serve-b", "serve-c"):
+        _register(servicer, rid)
+    order, key = _routing_order(servicer, affinity_key=7)
+    stubs = {rid: _plant_stub(servicer, rid, _FakeStub()) for rid in order}
+    servicer.registry.begin_drain(order[0])
+    servicer.predict(pb.PredictRequest(affinity_key=key), _Ctx())
+    # the draining primary was never even attempted
+    assert stubs[order[0]].predicts == 0
+    assert stubs[order[1]].predicts == 1
+
+
+def test_router_affinity_is_sticky(journal):
+    servicer = _servicer()
+    for rid in ("serve-a", "serve-b", "serve-c"):
+        _register(servicer, rid)
+    for rid in ("serve-a", "serve-b", "serve-c"):
+        _plant_stub(servicer, rid, _FakeStub())
+    order, key = _routing_order(servicer, affinity_key=99)
+    for _ in range(10):
+        servicer.predict(pb.PredictRequest(affinity_key=key), _Ctx())
+    counts = {
+        rid: servicer.registry.get(rid).stub.predicts
+        for rid in ("serve-a", "serve-b", "serve-c")
+    }
+    assert counts[order[0]] == 10  # same key -> same replica, always
+    assert sum(counts.values()) == 10
+
+
+def test_router_inflight_cap_sheds_instead_of_spilling(journal):
+    servicer = _servicer(inflight_cap=1)
+    for rid in ("serve-a", "serve-b"):
+        _register(servicer, rid)
+    order, key = _routing_order(servicer, affinity_key=7)
+    stubs = {rid: _plant_stub(servicer, rid, _FakeStub()) for rid in order}
+    # occupy the primary's single slot as a stuck in-flight forward
+    assert servicer._acquire(order[0])
+    with pytest.raises(_Abort) as info:
+        servicer.predict(pb.PredictRequest(affinity_key=key), _Ctx())
+    # shed at the router — NOT spilled onto the healthy successor
+    # (retrying overload elsewhere would just smear it)
+    assert info.value.code == grpc.StatusCode.RESOURCE_EXHAUSTED
+    assert stubs[order[0]].predicts == 0
+    assert stubs[order[1]].predicts == 0
+
+
+def test_router_inflight_released_after_forward(journal):
+    servicer = _servicer(inflight_cap=1)
+    _register(servicer, "serve-a")
+    _plant_stub(servicer, "serve-a", _FakeStub())
+    for _ in range(5):  # cap 1 + serial requests: releases must happen
+        servicer.predict(pb.PredictRequest(affinity_key=3), _Ctx())
+    assert servicer.state()["inflight"] == {}
+
+
+def test_router_no_replica_aborts_unavailable(journal):
+    servicer = _servicer()
+    with pytest.raises(_Abort) as info:
+        servicer.predict(pb.PredictRequest(affinity_key=1), _Ctx())
+    assert info.value.code == grpc.StatusCode.UNAVAILABLE
+
+
+def test_router_non_unavailable_error_propagates(journal):
+    """INVALID_ARGUMENT (bad feature shape) must NOT fail over: the
+    request is wrong everywhere, and retrying it N times would just
+    multiply the damage."""
+    servicer = _servicer(failover_retries=3)
+    for rid in ("serve-a", "serve-b"):
+        _register(servicer, rid)
+    order, key = _routing_order(servicer, affinity_key=7)
+    stubs = {
+        rid: _plant_stub(
+            servicer, rid,
+            _FakeStub(
+                fail=_RpcFailure(grpc.StatusCode.INVALID_ARGUMENT, "bad"),
+            ),
+        )
+        for rid in order
+    }
+    with pytest.raises(_Abort) as info:
+        servicer.predict(pb.PredictRequest(affinity_key=key), _Ctx())
+    assert info.value.code == grpc.StatusCode.INVALID_ARGUMENT
+    assert sum(s.predicts for s in stubs.values()) == 1
+
+
+def test_router_model_info_tightens_max_batch(journal):
+    servicer = _servicer()
+    _register(servicer, "serve-a", max_batch=64)
+    _register(servicer, "serve-b", max_batch=16)
+    for rid in ("serve-a", "serve-b"):
+        _plant_stub(servicer, rid, _FakeStub(max_batch=64))
+    info = servicer.model_info(pb.Empty(), _Ctx())
+    assert info.loaded
+    # whatever replica answered, the advertised cap fits EVERY replica
+    assert info.max_batch == 16
+
+
+def test_router_replica_loss_cleans_ring_and_inflight(journal):
+    servicer = _servicer(replica_timeout_secs=5.0)
+    now = 1000.0
+    servicer.registry.register(
+        pb.RegisterReplicaRequest(replica_id="serve-a",
+                                  addr="127.0.0.1:1"),
+        now=now,
+    )
+    assert servicer._acquire("serve-a")
+    servicer.registry.expire(now=now + 6.0)
+    assert servicer.ring.members() == []
+    assert servicer.state()["inflight"] == {}
+
+
+# ---------------------------------------------------------------------------
+# decision gate (extracted hold+cooldown hysteresis)
+
+
+def test_decision_gate_hold_then_fire_then_cooldown():
+    gate = DecisionGate(hold_secs=2.0, cooldown_secs=5.0)
+    assert not gate.observe("grow", True, 0.0)  # hold starts
+    assert not gate.observe("grow", True, 1.9)
+    assert gate.observe("grow", True, 2.1)  # held through
+    gate.fired("grow", 2.1)
+    assert gate.in_cooldown(2.2)
+    # condition still true, but the cooldown blocks a re-fire...
+    assert not gate.observe("grow", True, 4.0)
+    # ...and the hold kept accumulating THROUGH the cooldown, so the
+    # moment cooldown ends the (long-held) condition fires again
+    assert gate.observe("grow", True, 7.2)
+
+
+def test_decision_gate_reset_on_condition_drop():
+    gate = DecisionGate(hold_secs=2.0, cooldown_secs=1.0)
+    assert not gate.observe("grow", True, 0.0)
+    gate.observe("grow", False, 1.0)  # condition dropped: hold resets
+    assert not gate.observe("grow", True, 2.5)  # only 0s held again
+    assert gate.observe("grow", True, 4.6)
+
+
+def test_decision_gate_conditions_are_independent_holds():
+    gate = DecisionGate(hold_secs=2.0, cooldown_secs=1.0)
+    gate.observe("grow", True, 0.0)
+    gate.observe("shrink", True, 1.0)
+    assert gate.observe("grow", True, 2.1)
+    gate.fired("grow", 2.1)  # cooldown is SHARED...
+    assert not gate.observe("shrink", True, 3.05)
+    # ...but shrink's own hold survived the grow firing
+    assert gate.observe("shrink", True, 3.2)
+
+
+# ---------------------------------------------------------------------------
+# replica autoscaler
+
+
+class _FakeScaler:
+    def __init__(self, place=True):
+        self.requests = []
+        self.place = place
+
+    def scale_up(self, n):
+        self.requests.append(n)
+        return list(range(n)) if self.place else []
+
+
+def _fleet(n, qps_each=0.0, queue_each=0):
+    registry = ReplicaRegistry(heartbeat_secs=1.0, timeout_secs=30.0)
+    for i in range(n):
+        rid = "serve-%d" % i
+        _register(registry, rid)
+        _heartbeat(registry, rid, qps=qps_each, queue=queue_each)
+    return registry
+
+
+def test_autoscaler_below_floor_replaces_immediately(journal):
+    """A SIGKILLed replica leaves the tier under its floor: the
+    replacement is spawned on the NEXT tick — the hold damps signals,
+    not contractual capacity."""
+    registry = _fleet(1)
+    scaler = _FakeScaler()
+    autoscaler = ReplicaAutoscaler(
+        registry, scaler, min_replicas=3, max_replicas=6,
+        hold_secs=30.0, cooldown_secs=5.0,
+    )
+    autoscaler.tick(now=1000.0)  # no hold wait despite hold_secs=30
+    assert scaler.requests == [2]
+    # ...but the cooldown still applies: no spawn-storm on the next tick
+    autoscaler.tick(now=1001.0)
+    assert scaler.requests == [2]
+    events.flush()
+    decisions = _journaled(journal, "scale_decision")
+    assert len(decisions) == 1
+    assert decisions[0]["direction"] == "grow"
+    assert decisions[0]["tag"] == "serve"
+    assert "below_floor" in decisions[0]["reasons"][0]
+
+
+def test_autoscaler_grow_on_sustained_queue(journal):
+    registry = _fleet(2, qps_each=10.0, queue_each=50)  # 25/replica
+    scaler = _FakeScaler()
+    autoscaler = ReplicaAutoscaler(
+        registry, scaler, min_replicas=1, max_replicas=4, step=1,
+        hold_secs=2.0, cooldown_secs=10.0,
+        queue_per_replica=16.0, qps_per_replica=100.0,
+    )
+    autoscaler.tick(now=1000.0)
+    assert scaler.requests == []  # hold not yet satisfied
+    autoscaler.tick(now=1002.5)
+    assert scaler.requests == [1]
+    events.flush()
+    decisions = _journaled(journal, "scale_decision")
+    assert len(decisions) == 1
+    assert any("queue" in r for r in decisions[0]["reasons"])
+
+
+def test_autoscaler_respects_ceiling(journal):
+    registry = _fleet(2, queue_each=500)
+    scaler = _FakeScaler()
+    autoscaler = ReplicaAutoscaler(
+        registry, scaler, min_replicas=1, max_replicas=2,
+        hold_secs=0.1, cooldown_secs=0.1, queue_per_replica=1.0,
+    )
+    autoscaler.tick(now=1000.0)
+    autoscaler.tick(now=1001.0)
+    assert scaler.requests == []  # saturated but at max_replicas
+
+
+def test_autoscaler_shrink_drains_coldest_spares_canary(journal):
+    registry = _fleet(3)
+    _heartbeat(registry, "serve-0", qps=0.5)  # coldest, but canary
+    _heartbeat(registry, "serve-1", qps=1.0)  # coldest non-canary
+    _heartbeat(registry, "serve-2", qps=8.0)
+    registry.set_target(["serve-0"], "v1", canary=True)
+    scaler = _FakeScaler()
+    autoscaler = ReplicaAutoscaler(
+        registry, scaler, min_replicas=1, max_replicas=4, step=1,
+        hold_secs=2.0, cooldown_secs=1.0, qps_per_replica=100.0,
+    )
+    autoscaler.tick(now=1000.0)
+    autoscaler.tick(now=1002.5)
+    # the victim drains through the registry (router stops routing
+    # first, the pod exits after its deregister ack) — never a kill
+    entry = registry.get("serve-1")
+    assert entry is not None and entry.draining
+    assert not registry.get("serve-0").draining  # canary spared
+    assert not registry.get("serve-2").draining  # hottest spared
+    events.flush()
+    decisions = _journaled(journal, "scale_decision")
+    assert len(decisions) == 1
+    assert decisions[0]["direction"] == "shrink"
+    assert decisions[0]["victims"] == ["serve-1"]
+
+
+def test_autoscaler_never_shrinks_below_floor(journal):
+    registry = _fleet(2)
+    scaler = _FakeScaler()
+    autoscaler = ReplicaAutoscaler(
+        registry, scaler, min_replicas=2, max_replicas=4,
+        hold_secs=0.1, cooldown_secs=0.1, qps_per_replica=100.0,
+    )
+    for i in range(20):
+        autoscaler.tick(now=1000.0 + i)
+    assert all(
+        not registry.get(rid).draining for rid in registry.live_ids()
+    )
+
+
+# ---------------------------------------------------------------------------
+# canary rollout judge
+
+
+def _canary_fleet(n=4, loaded=("v1", "100:1:1")):
+    registry = ReplicaRegistry(heartbeat_secs=1.0, timeout_secs=30.0)
+    for i in range(n):
+        rid = "serve-%d" % i
+        _register(registry, rid)
+        _heartbeat(registry, rid, loaded=loaded, available=loaded)
+    return registry
+
+
+def _feed(controller, stamp, value, count, outcome="ok"):
+    for _ in range(count):
+        controller.note_result(stamp, value, outcome)
+
+
+def test_canary_adopts_incumbent_and_pins_fleet(journal):
+    registry = _canary_fleet()
+    controller = CanaryController(
+        registry, fraction=0.5, min_requests=10,
+        drift_max=0.2, timeout_secs=60.0,
+    )
+    controller.tick(now=1000.0)
+    state = controller.state()
+    assert state["incumbent"] == {"export": "v1", "stamp": "100:1:1"}
+    # the whole fleet is pinned: no replica may autonomously chase a
+    # newer bundle once the canary machine owns version moves
+    for rid in registry.live_ids():
+        assert registry.get(rid).target_export == "v1"
+
+
+def test_canary_adopt_waits_for_first_heartbeat(journal):
+    # register carries only the model STAMP; the export NAME arrives
+    # with the first heartbeat. Adopting before then would crown an
+    # incumbent with an empty export name — a version no replica can
+    # be directed back to on rollback.
+    registry = ReplicaRegistry(heartbeat_secs=1.0, timeout_secs=30.0)
+    _register(registry, "serve-0", stamp="100:1:1")
+    controller = CanaryController(
+        registry, fraction=0.5, min_requests=10,
+        drift_max=0.2, timeout_secs=60.0,
+    )
+    controller.tick(now=1000.0)
+    assert controller.state()["incumbent"] == {"export": "", "stamp": ""}
+    _heartbeat(registry, "serve-0", loaded=("v1", "100:1:1"),
+               available=("v1", "100:1:1"))
+    controller.tick(now=1001.0)
+    assert controller.state()["incumbent"] == {
+        "export": "v1", "stamp": "100:1:1",
+    }
+
+
+def test_canary_full_promote_cycle(journal):
+    registry = _canary_fleet()
+    controller = CanaryController(
+        registry, fraction=0.5, min_requests=10,
+        drift_max=0.2, timeout_secs=60.0,
+    )
+    controller.tick(now=1000.0)  # adopt v1
+    # a new bundle appears in heartbeats
+    for rid in registry.live_ids():
+        _heartbeat(registry, rid, loaded=("v1", "100:1:1"),
+                   available=("v2", "200:1:1"))
+    controller.tick(now=1001.0)
+    assert controller.active()
+    members = controller.canary_members()
+    assert len(members) == 2  # fraction 0.5 of 4
+    for rid in members:
+        entry = registry.get(rid)
+        assert entry.canary and entry.target_export == "v2"
+    # same prediction distribution on both arms, no failures: promote
+    _feed(controller, "200:1:1", 0.5, 20)
+    _feed(controller, "100:1:1", 0.5, 20)
+    controller.tick(now=1002.0)
+    state = controller.state()
+    assert state["state"] == "idle"
+    assert state["incumbent"] == {"export": "v2", "stamp": "200:1:1"}
+    for rid in registry.live_ids():  # everyone directed to v2
+        assert registry.get(rid).target_export == "v2"
+    events.flush()
+    assert len(_journaled(journal, "canary_started")) == 1
+    promoted = _journaled(journal, "canary_promoted")
+    assert len(promoted) == 1
+    assert promoted[0]["export"] == "v2"
+    assert promoted[0]["reasons"]  # measured numbers, not a bare flip
+
+
+def test_canary_rollback_on_drift_and_never_retries(journal):
+    registry = _canary_fleet()
+    controller = CanaryController(
+        registry, fraction=0.25, min_requests=10,
+        drift_max=0.2, timeout_secs=60.0,
+    )
+    controller.tick(now=1000.0)
+    for rid in registry.live_ids():
+        _heartbeat(registry, rid, loaded=("v1", "100:1:1"),
+                   available=("v2", "200:1:1"))
+    controller.tick(now=1001.0)
+    members = controller.canary_members()
+    assert len(members) == 1  # fraction 0.25 of 4
+    # disjoint prediction distributions: TV = 1.0 >> 0.2
+    _feed(controller, "200:1:1", 0.95, 20)
+    _feed(controller, "100:1:1", 0.05, 20)
+    controller.tick(now=1002.0)
+    state = controller.state()
+    assert state["state"] == "idle"
+    assert state["incumbent"]["export"] == "v1"  # unchanged
+    assert state["rejected"] == ["200:1:1"]
+    for rid in members:  # members steered back to the incumbent
+        entry = registry.get(rid)
+        assert entry.target_export == "v1" and not entry.canary
+    # the bad bundle is still the newest available — but rejected
+    # stamps are never retried
+    controller.tick(now=1003.0)
+    assert not controller.active()
+    events.flush()
+    rolled = _journaled(journal, "canary_rolled_back")
+    assert len(rolled) == 1
+    assert any("drift" in r for r in rolled[0]["reasons"])
+
+
+def test_canary_failure_regression_rolls_back(journal):
+    registry = _canary_fleet()
+    controller = CanaryController(
+        registry, fraction=0.25, min_requests=10,
+        drift_max=0.5, timeout_secs=60.0,
+    )
+    controller.tick(now=1000.0)
+    for rid in registry.live_ids():
+        _heartbeat(registry, rid, loaded=("v1", "100:1:1"),
+                   available=("v2", "200:1:1"))
+    controller.tick(now=1001.0)
+    # identical distributions, but the canary sheds a third of its
+    # traffic — a slower model is a regression even when not drifted
+    _feed(controller, "200:1:1", 0.5, 10)
+    _feed(controller, "200:1:1", None, 5, outcome="shed")
+    _feed(controller, "100:1:1", 0.5, 20)
+    controller.tick(now=1002.0)
+    events.flush()
+    rolled = _journaled(journal, "canary_rolled_back")
+    assert len(rolled) == 1
+    assert any("failure regression" in r for r in rolled[0]["reasons"])
+
+
+def test_canary_timeout_rolls_back(journal):
+    registry = _canary_fleet()
+    controller = CanaryController(
+        registry, fraction=0.25, min_requests=1000,
+        drift_max=0.2, timeout_secs=30.0,
+    )
+    controller.tick(now=1000.0)
+    for rid in registry.live_ids():
+        _heartbeat(registry, rid, loaded=("v1", "100:1:1"),
+                   available=("v2", "200:1:1"))
+    controller.tick(now=1001.0)
+    assert controller.active()
+    controller.tick(now=1001.0 + 31.0)
+    assert not controller.active()
+    events.flush()
+    rolled = _journaled(journal, "canary_rolled_back")
+    assert len(rolled) == 1
+    assert any("timeout" in r for r in rolled[0]["reasons"])
+
+
+def test_canary_slice_is_stable_and_sized():
+    registry = _canary_fleet()
+    controller = CanaryController(
+        registry, fraction=0.25, min_requests=10,
+        drift_max=0.2, timeout_secs=60.0,
+    )
+    assert controller.assign_arm(123) == "incumbent"  # idle: everyone
+    controller.tick(now=1000.0)
+    for rid in registry.live_ids():
+        _heartbeat(registry, rid, loaded=("v1", "100:1:1"),
+                   available=("v2", "200:1:1"))
+    controller.tick(now=1001.0)
+    arms = [controller.assign_arm(h) for h in range(20000)]
+    fraction = arms.count("canary") / len(arms)
+    assert fraction == pytest.approx(0.25, abs=0.01)
+    # stable per key: a user either IS in the canary or is not
+    assert arms[:100] == [controller.assign_arm(h) for h in range(100)]
+
+
+def test_prediction_stats_and_total_variation():
+    a, b = PredictionStats(), PredictionStats()
+    for _ in range(10):
+        a.observe_prediction(0.05)
+        b.observe_prediction(0.95)
+    assert total_variation(a.distribution(), b.distribution()) == 1.0
+    assert total_variation(a.distribution(), a.distribution()) == 0.0
+    a.observe_outcome("ok")
+    a.observe_outcome("shed")
+    assert a.failure_rate() == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# versioned-export discovery
+
+
+def _write_bundle(root, name, step):
+    path = os.path.join(root, name)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "model.npz"), "wb") as f:
+        f.write(b"npz")
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump({"step": step}, f)
+
+
+def test_scan_export_versions_orders_and_skips_incomplete(tmp_path):
+    root = str(tmp_path)
+    _write_bundle(root, "v2", 200)
+    _write_bundle(root, "v1", 100)
+    os.makedirs(os.path.join(root, "torn"))  # publisher mid-write
+    with open(os.path.join(root, "torn", "model.npz"), "wb") as f:
+        f.write(b"npz")  # no manifest yet: invisible
+    with open(os.path.join(root, "stray.txt"), "w") as f:
+        f.write("not a bundle")
+    versions = scan_export_versions(root)
+    assert [(name, step) for name, step, _ in versions] == [
+        ("v1", 100), ("v2", 200),
+    ]
+    assert scan_export_versions(os.path.join(root, "missing")) == []

@@ -116,6 +116,7 @@ class _ServeStub:
     def __init__(self):
         self._term_flag = False
         self._term_previous = None
+        self._drain_reason = "sigterm"  # router drain overrides (ISSUE 17)
         self.drained = []
 
     def drain(self, reason="shutdown"):
@@ -179,9 +180,15 @@ class _EngineStub:
         self._m_swaps = _Counter()
         self.swaps = 0
         self.export_dir = "/tmp/none"
+        self._loaded_rel = ""
         self.built_under_lock = []
 
-    def _build(self):
+    def _resolve_export(self):
+        # undirected single-pod mode (the fleet's directed mode is
+        # covered by tests/test_serving_fleet.py)
+        return self.export_dir, ""
+
+    def _build(self, export_dir):
         self.built_under_lock.append(self._swap_lock.locked())
         return _FakeModel("stamp-b", 2)
 

@@ -1168,6 +1168,91 @@ def test_unbounded_vocab_quiet_outside_store_layers():
     )
 
 
+# ---------------------------------------------------------------------------
+# serve-affinity-unbounded-ring (ISSUE 17: replica-keyed growth with no
+# cleanup entry point in the serving tier)
+
+UNBOUNDED_RING = """
+    class Router:
+        def register(self, replica_id, addr):
+            self._addrs[replica_id] = addr
+
+        def admit(self, replica_id):
+            self._inflight.setdefault(replica_id, 0)
+"""
+
+
+def test_ring_rule_flags_replica_keyed_growth_without_cleanup():
+    findings = findings_for(
+        UNBOUNDED_RING, path="elasticdl_tpu/serve/fixture.py",
+        rules=["serve-affinity-unbounded-ring"],
+    )
+    assert len(findings) == 2
+    assert {f.code for f in findings} == {
+        "self._addrs[...] =", "self._inflight.setdefault()",
+    }
+    assert all("deregister" in f.message for f in findings)
+
+
+def test_ring_rule_quiet_with_cleanup_entry_point():
+    assert not findings_for("""
+        class Router:
+            def register(self, replica_id, addr):
+                self._addrs[replica_id] = addr
+
+            def deregister(self, replica_id):
+                self._addrs.pop(replica_id, None)
+    """, path="elasticdl_tpu/serve/fixture.py",
+        rules=["serve-affinity-unbounded-ring"])
+
+
+def test_ring_rule_flags_set_add_and_attribute_keys():
+    findings = findings_for("""
+        class Scaler:
+            def spawn(self, proc):
+                self._seen.add(proc.pid)
+    """, path="elasticdl_tpu/serve/fixture.py",
+        rules=["serve-affinity-unbounded-ring"])
+    assert len(findings) == 1
+    assert findings[0].code == "self._seen.add()"
+
+
+def test_ring_rule_quiet_for_locals_and_non_identity_keys():
+    # a per-call dict dies with the call; a name-keyed config does not
+    # track replica churn — neither is the leak class
+    assert not findings_for("""
+        class Router:
+            def tally(self, replica_id):
+                votes = {}
+                votes[replica_id] = 1
+                return votes
+
+            def configure(self, name, value):
+                self._options[name] = value
+    """, path="elasticdl_tpu/serve/fixture.py",
+        rules=["serve-affinity-unbounded-ring"])
+
+
+def test_ring_rule_quiet_outside_serve_package():
+    # the same growth in the master's worker table is the training
+    # fleet's lifecycle, owned by other rules
+    assert not findings_for(
+        UNBOUNDED_RING, path="elasticdl_tpu/master/fixture.py",
+        rules=["serve-affinity-unbounded-ring"],
+    )
+
+
+def test_ring_rule_suppression_comment_works():
+    assert not findings_for("""
+        class Router:
+            def register(self, replica_id, addr):
+                # bounded by the k8s pod quota, entries reused by id
+                # edlint: disable=serve-affinity-unbounded-ring
+                self._addrs[replica_id] = addr
+    """, path="elasticdl_tpu/serve/fixture.py",
+        rules=["serve-affinity-unbounded-ring"])
+
+
 def test_unbounded_vocab_quiet_for_non_id_iterables():
     assert not findings_for("""
         class Cache:
