@@ -17,6 +17,11 @@ for (or refuses to pay for):
   blocks in modules that bypass ``build_channel``: the trace context
   propagates only through the channel interceptor, so a raw-channel
   stub call orphans the remote half of the trace.
+- ``obs-bare-jit``        — no bare ``jax.jit``/``pjit`` in the
+  train/ops/serve/worker scopes: compiled steps go through
+  ``observability.device.instrumented_jit`` (identical when
+  ``EDL_DEVICE_OBS=0``) so every recompile is counted,
+  shape-attributed, and visible to the ``recompile_storm`` detector.
 - ``num-silent-nonfinite`` — no ``np.nan*`` aggregations or
   ``nan_to_num`` in train/ps/worker scopes: silently masking
   nonfinite values is exactly what the ISSUE-15 health sentinels

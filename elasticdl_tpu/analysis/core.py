@@ -224,6 +224,7 @@ def _rules_by_name(names=None):
         knobs,
         lock_discipline,
         numerics,
+        obs_bare_jit,
         obs_hot_path,
         obs_span,
         perf_gather,
@@ -242,6 +243,7 @@ def _rules_by_name(names=None):
         "conc-thread-context": concurrency.run_thread_context,
         "knob-registry": knobs.run,
         "jax-hot-path": hot_path.run,
+        "obs-bare-jit": obs_bare_jit.run,
         "obs-hot-path": obs_hot_path.run,
         "obs-span-no-context": obs_span.run,
         "obs-deterministic-tracer": deterministic_tracer.run,
@@ -274,6 +276,7 @@ RULE_NAMES = (
     "conc-thread-context",
     "knob-registry",
     "jax-hot-path",
+    "obs-bare-jit",
     "obs-hot-path",
     "obs-span-no-context",
     "obs-deterministic-tracer",

@@ -36,7 +36,7 @@ from elasticdl_tpu.analysis.core import (
 
 RULE = "jax-hot-path"
 
-_JIT_NAMES = {"jit", "pjit"}
+_JIT_NAMES = {"jit", "pjit", "instrumented_jit"}
 _TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic"}
 _SYNC_CALLS = {"jax.device_get", "np.asarray", "numpy.asarray"}
 # int() stays legal: hot functions routinely int() static config

@@ -1,0 +1,118 @@
+"""obs-bare-jit: a ``jax.jit``/``pjit`` call outside the ISSUE-18
+recompile sentinel, on a module the sentinel is contractually wired
+through.
+
+``observability/device.instrumented_jit`` is the ONLY sanctioned way
+to build a compiled step in the training / serving / worker scopes:
+it is byte-identical to ``jax.jit`` when ``EDL_DEVICE_OBS=0``, and
+with it on it is what makes a steady-state recompile *observable* —
+counted, shape-attributed, journaled, and visible to the master's
+``recompile_storm`` detector. A bare ``jax.jit`` in these scopes is a
+blind spot: its recompiles happen, stall steps, and never show up
+anywhere. The CI gate "zero unexpected recompiles after warmup" is
+only as strong as this rule's zero-findings gate.
+
+What fires: any call whose callee's leaf name is ``jit`` or ``pjit``
+(``jax.jit(...)``, ``jit(...)``, ``jax.experimental.pjit.pjit(...)``)
+— including inside ``partial(jax.jit, ...)`` and as a decorator — in
+a module whose dotted name starts with ``elasticdl_tpu.train.``,
+``elasticdl_tpu.ops.``, ``elasticdl_tpu.serve.`` or
+``elasticdl_tpu.worker.``. The ``parallel/`` research trainers are
+deliberately out of scope (not on the elastic worker's step path),
+and ``observability/device.py`` itself is where the one legitimate
+``jax.jit`` call lives.
+
+Legitimate exceptions exist — ``train_state.create_train_state``'s
+init jit must inline inside outer traces, where the sentinel's host
+bookkeeping cannot run — and are one
+``# edlint: disable=obs-bare-jit`` away, with the reason on the same
+lines the suppression covers.
+"""
+
+import ast
+
+from elasticdl_tpu.analysis.core import (
+    Finding,
+    attr_chain,
+    walk_with_scope,
+)
+
+RULE = "obs-bare-jit"
+
+_JIT_LEAVES = {"jit", "pjit"}
+
+_SCOPE_PREFIXES = (
+    "elasticdl_tpu.train.",
+    "elasticdl_tpu.ops.",
+    "elasticdl_tpu.serve.",
+    "elasticdl_tpu.worker.",
+)
+
+
+def _in_scope(module):
+    return any(module.startswith(p) for p in _SCOPE_PREFIXES)
+
+
+def _jit_leaf(func):
+    """'jit'/'pjit' when ``func`` resolves to a bare jit factory,
+    else None. ``instrumented_jit`` has a different leaf name and
+    never matches."""
+    if isinstance(func, ast.Name):
+        return func.id if func.id in _JIT_LEAVES else None
+    chain = attr_chain(func)
+    if chain is None:
+        return None
+    leaf = chain.split(".")[-1]
+    return leaf if leaf in _JIT_LEAVES else None
+
+
+def run(units):
+    findings = []
+    for unit in units:
+        if not _in_scope(unit.module):
+            continue
+        for node, scope in walk_with_scope(unit.tree):
+            targets = []
+            if isinstance(node, ast.Call):
+                leaf = _jit_leaf(node.func)
+                if leaf:
+                    targets.append((node, leaf))
+                else:
+                    # partial(jax.jit, ...) builds a bare jit factory
+                    chain = attr_chain(node.func)
+                    if (
+                        chain
+                        and chain.split(".")[-1] == "partial"
+                        and node.args
+                    ):
+                        leaf = _jit_leaf(node.args[0])
+                        if leaf:
+                            targets.append((node, leaf))
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # @jax.jit / @pjit decorators (bare, no call)
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        leaf = _jit_leaf(dec)
+                        if leaf:
+                            targets.append((dec, leaf))
+            for target, leaf in targets:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=unit.path,
+                        line=target.lineno,
+                        symbol=scope,
+                        code="%s()" % leaf,
+                        message=(
+                            "bare %s in an instrumented scope: use "
+                            "observability.device.instrumented_jit "
+                            "so recompiles are counted, "
+                            "shape-attributed, and visible to the "
+                            "recompile_storm detector (identical to "
+                            "jax.jit when EDL_DEVICE_OBS=0)" % leaf
+                        ),
+                    )
+                )
+    return findings

@@ -47,6 +47,18 @@ and the stream feeder's per-window drift stats:
   watermark the offending window was tagged with, so drift is
   attributable to a window.
 
+Device-runtime detectors (ISSUE 18) — fed by the workers' XLA
+compile ledger and HBM gauges (TelemetryBlob fields 40-51):
+
+- **recompile_storm** — a worker's cumulative xla_recompiles counter
+  moved by at least ``EDL_RECOMPILE_STORM_MIN`` (default 3) within
+  ``EDL_RECOMPILE_STORM_SECS`` (default 60 s): steady-state shape
+  churn, each hit a full XLA compile on the step path. Clears by
+  itself as the recency window drains.
+- **hbm_pressure**    — a worker's device bytes-in-use exceeds
+  ``EDL_HBM_PRESSURE_MAX`` (default 0.9) of its reported device
+  limit; a limit of 0 (unknown capacity) never fires.
+
 Everything is plain dict/float work under one lock, sized for a scan
 thread ticking at 1 Hz over hundreds of roles — no numpy, no RPC.
 """
@@ -69,11 +81,16 @@ VERSION_LAG_MAX_ENV = "EDL_VERSION_LAG_MAX"
 HEALTH_ALERT_SECS_ENV = "EDL_HEALTH_ALERT_SECS"
 LABEL_SHIFT_DELTA_ENV = "EDL_LABEL_SHIFT_DELTA"
 ID_NOVELTY_MAX_ENV = "EDL_ID_NOVELTY_MAX"
+RECOMPILE_STORM_MIN_ENV = "EDL_RECOMPILE_STORM_MIN"
+RECOMPILE_STORM_SECS_ENV = "EDL_RECOMPILE_STORM_SECS"
+HBM_PRESSURE_MAX_ENV = "EDL_HBM_PRESSURE_MAX"
 
 ALERT_KINDS = (
     "straggler", "dead_air", "stuck_round", "version_lag",
     # training health (ISSUE 15)
     "nonfinite_loss", "loss_spike", "grad_explosion", "label_shift",
+    # device runtime (ISSUE 18)
+    "recompile_storm", "hbm_pressure",
 )
 
 # worker-health cumulative counters watched for recent movement:
@@ -103,7 +120,7 @@ class _RoleState:
     __slots__ = (
         "role", "worker_id", "last_seen", "blob",
         "stuck_since", "stuck_fill", "stuck_version",
-        "health_marks",
+        "health_marks", "recompile_last", "recompile_marks",
     )
 
     def __init__(self, role, worker_id, now):
@@ -121,6 +138,13 @@ class _RoleState:
         # the recency window, which is what makes raise→clear
         # observable for one-off events
         self.health_marks = {}
+        # recompile-storm window (ISSUE 18): last cumulative
+        # xla_recompiles plus [(ts, delta), ...] of observed INCREASES
+        # — the detector fires on the in-window delta sum, so warmup
+        # compiles (recompiles staying 0) never trip it and the alert
+        # self-clears once shapes stabilize and the window drains
+        self.recompile_last = None
+        self.recompile_marks = []
 
 
 class FleetMonitor:
@@ -133,6 +157,9 @@ class FleetMonitor:
         health_alert_secs=None,
         label_shift_delta=None,
         id_novelty_max=None,
+        recompile_storm_min=None,
+        recompile_storm_secs=None,
+        hbm_pressure_max=None,
     ):
         self._straggler_factor = (
             straggler_factor
@@ -169,6 +196,24 @@ class FleetMonitor:
             id_novelty_max
             if id_novelty_max is not None
             else _env_float(ID_NOVELTY_MAX_ENV, 0.9)
+        )
+        # device-runtime knobs (ISSUE 18): a storm is >= min recompiles
+        # observed across a worker's telemetry within the window; HBM
+        # pressure is bytes-in-use over the reported device limit
+        self._recompile_storm_min = (
+            recompile_storm_min
+            if recompile_storm_min is not None
+            else _env_float(RECOMPILE_STORM_MIN_ENV, 3.0)
+        )
+        self._recompile_storm_secs = (
+            recompile_storm_secs
+            if recompile_storm_secs is not None
+            else _env_float(RECOMPILE_STORM_SECS_ENV, 60.0)
+        )
+        self._hbm_pressure_max = (
+            hbm_pressure_max
+            if hbm_pressure_max is not None
+            else _env_float(HBM_PRESSURE_MAX_ENV, 0.9)
         )
         # stream drift books (fed by the feeder, in-process — the
         # stream has no RPC of its own): label-rate EWMA over windows
@@ -313,6 +358,24 @@ class FleetMonitor:
                     float(blob.ps_dead_row_fraction), 4
                 ),
                 "ps_exploding_rows": int(blob.ps_exploding_rows),
+                # device runtime (ISSUE 18): XLA compile ledger, HBM
+                # gauges, and cost-model step attribution — what the
+                # recompile_storm / hbm_pressure detectors and the
+                # /statusz device section read
+                "xla_compiles": int(blob.xla_compiles),
+                "xla_recompiles": int(blob.xla_recompiles),
+                "xla_compile_secs_total": round(
+                    float(blob.xla_compile_secs_total), 3
+                ),
+                "hbm_bytes_in_use": int(blob.hbm_bytes_in_use),
+                "hbm_peak_bytes": int(blob.hbm_peak_bytes),
+                "hbm_limit_bytes": int(blob.hbm_limit_bytes),
+                "device_live_buffers": int(blob.device_live_buffers),
+                "tier_hbm_bytes": int(blob.tier_hbm_bytes),
+                "cost_step_flops": float(blob.cost_step_flops),
+                "cost_step_bytes": float(blob.cost_step_bytes),
+                "h2d_bytes": int(blob.h2d_bytes),
+                "d2h_bytes": int(blob.d2h_bytes),
             }
             # recency bookkeeping for the health-counter detectors: a
             # cumulative counter that moved since the last sighting
@@ -329,6 +392,20 @@ class FleetMonitor:
                     state.health_marks[blob_key] = (value, now)
                 elif value < prev[0]:
                     state.health_marks[blob_key] = (value, prev[1])
+            # recompile-storm bookkeeping (ISSUE 18): stamp the DELTA
+            # of the cumulative recompile counter into the recency
+            # window; a counter that went backwards is a restarted
+            # worker — reset the baseline, mark nothing
+            recompiles = state.blob["xla_recompiles"]
+            prev = state.recompile_last
+            if prev is not None and recompiles > prev:
+                state.recompile_marks.append((now, recompiles - prev))
+            state.recompile_last = recompiles
+            cutoff = now - self._recompile_storm_secs
+            state.recompile_marks = [
+                mark for mark in state.recompile_marks
+                if mark[0] > cutoff
+            ]
             # stuck-round bookkeeping: the clock restarts whenever the
             # fill grows or the store version advances
             fill = int(blob.round_buffer_fill)
@@ -608,6 +685,39 @@ class FleetMonitor:
                             "health_grad_norm", 0.0
                         )
                     desired[(kind, wid)] = detail
+                # device-runtime detectors (ISSUE 18). recompile_storm:
+                # the in-window recompile delta sum crossed the floor —
+                # steady-state shape churn (unpadded batches, dtype
+                # flapping), each hit a full XLA compile on the step
+                # path. Clears by itself as the window drains.
+                cutoff = now - self._recompile_storm_secs
+                in_window = sum(
+                    delta for ts, delta in state.recompile_marks
+                    if ts > cutoff
+                )
+                if in_window >= self._recompile_storm_min:
+                    desired[("recompile_storm", wid)] = {
+                        "since": now,
+                        "recompiles_in_window": in_window,
+                        "window_secs": self._recompile_storm_secs,
+                        "xla_recompiles": state.blob["xla_recompiles"],
+                        "compile_secs_total": state.blob[
+                            "xla_compile_secs_total"
+                        ],
+                    }
+                # hbm_pressure: bytes-in-use over the reported device
+                # limit (limit 0 = unknown capacity, never fires)
+                limit = state.blob["hbm_limit_bytes"]
+                in_use = state.blob["hbm_bytes_in_use"]
+                if limit > 0 and in_use / limit > self._hbm_pressure_max:
+                    desired[("hbm_pressure", wid)] = {
+                        "since": now,
+                        "hbm_bytes_in_use": in_use,
+                        "hbm_limit_bytes": limit,
+                        "fraction": round(in_use / limit, 4),
+                        "max_fraction": self._hbm_pressure_max,
+                        "tier_hbm_bytes": state.blob["tier_hbm_bytes"],
+                    }
         # label_shift (ISSUE 15): the most recent out-of-band stream
         # window is inside the recency window
         shift_ts = self._stream_health["shift_ts"]
@@ -750,6 +860,30 @@ class FleetMonitor:
                 "ps": health_ps,
                 "stream": stream_health,
             }
+            # device-runtime section (ISSUE 18): every worker's XLA
+            # compile ledger, HBM occupancy, and cost-model step
+            # attribution in one place — "is the device OK" is one
+            # /statusz read, same contract as the health section
+            device = {}
+            for wid, state in self._roles.items():
+                if state.blob is None or wid < 0:
+                    continue
+                if not state.blob.get("xla_compiles"):
+                    # role never compiled anything (PS-style worker
+                    # ids, obs disabled): no device story to tell
+                    continue
+                device[state.role] = {
+                    key: state.blob[key]
+                    for key in (
+                        "xla_compiles", "xla_recompiles",
+                        "xla_compile_secs_total",
+                        "hbm_bytes_in_use", "hbm_peak_bytes",
+                        "hbm_limit_bytes", "device_live_buffers",
+                        "tier_hbm_bytes",
+                        "cost_step_flops", "cost_step_bytes",
+                        "h2d_bytes", "d2h_bytes",
+                    )
+                }
         body = {
             "ts": now,
             "job": _env_str(events.JOB_NAME_ENV, ""),
@@ -758,6 +892,7 @@ class FleetMonitor:
             "drained": drained,
             "alerts": firing,
             "health": health,
+            "device": device,
             "thresholds": {
                 "straggler_factor": self._straggler_factor,
                 "dead_air_secs": self._dead_air_secs,
@@ -766,6 +901,9 @@ class FleetMonitor:
                 "health_alert_secs": self._health_alert_secs,
                 "label_shift_delta": self._label_shift_delta,
                 "id_novelty_max": self._id_novelty_max,
+                "recompile_storm_min": self._recompile_storm_min,
+                "recompile_storm_secs": self._recompile_storm_secs,
+                "hbm_pressure_max": self._hbm_pressure_max,
             },
         }
         if extra:

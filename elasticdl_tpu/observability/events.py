@@ -158,6 +158,13 @@ EVENT_TYPES = frozenset({
                              #   rows beyond EDL_HEALTH_ROW_NORM_MAX
                              #   (+ ps, rows, tables, norm_max; edge-
                              #   journaled per scan transition)
+    # device-runtime observability (ISSUE 18)
+    "xla_recompile",         # a wrapped step fn compiled AGAIN — a new
+                             #   argument signature after warmup
+                             #   (+ fn, compiles, seconds, changed
+                             #   [leaf: old -> new provenance],
+                             #   signature) — the journal line the
+                             #   recompile_storm postmortem reads
 })
 
 

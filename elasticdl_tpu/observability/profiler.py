@@ -97,6 +97,10 @@ _SEGMENT_BY_SPAN = {
     "ps_push": "push",
     "ps_push_rows": "push",
     "ps_apply_push": "apply",
+    # device runtime (ISSUE 18): the recompile sentinel's compile
+    # spans and explicit host<->device transfer spans
+    "compile": "compile",
+    "transfer": "transfer",
 }
 
 

@@ -165,7 +165,7 @@ class ServeRole:
         elapsed = max(now - last_ts, 1e-6)
         self._qps_window = (now, served)
         info = self.engine.model_info()
-        return pb.TelemetryBlob(
+        blob = pb.TelemetryBlob(
             role="serve-%d" % self.args.serve_id,
             serve_qps=(served - last_served) / elapsed,
             serve_queue_depth=batcher.pending_count(),
@@ -177,6 +177,24 @@ class ServeRole:
                 else 0.0
             ),
         )
+        # device runtime (ISSUE 18): the replica's compile ledger +
+        # HBM gauges — a serve recompile means a request batch dodged
+        # the padded-shape contract, which the fleet's recompile_storm
+        # detector should hear about like any worker's churn
+        from elasticdl_tpu.observability import device as device_obs
+
+        dev = device_obs.telemetry()
+        if dev:
+            blob.xla_compiles = dev["xla_compiles"]
+            blob.xla_recompiles = dev["xla_recompiles"]
+            blob.xla_compile_secs_total = dev["xla_compile_secs_total"]
+            blob.hbm_bytes_in_use = dev["hbm_bytes_in_use"]
+            blob.hbm_peak_bytes = dev["hbm_peak_bytes"]
+            blob.hbm_limit_bytes = dev["hbm_limit_bytes"]
+            blob.device_live_buffers = dev["device_live_buffers"]
+            blob.h2d_bytes = dev["h2d_bytes"]
+            blob.d2h_bytes = dev["d2h_bytes"]
+        return blob
 
     # ------------------------------------------------------------------
     def prepare(self):

@@ -19,6 +19,7 @@ import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.data.pipeline import MASK_KEY, normalize_outputs
+from elasticdl_tpu.observability import device as device_obs
 from elasticdl_tpu.train.export import load_exported
 from elasticdl_tpu.train.step_fns import make_eval_step
 from elasticdl_tpu.train.train_state import TrainState, resolve_dtype
@@ -77,8 +78,11 @@ class ServingModel:
         params, model_state, step = load_exported(export_path)
         self.step = int(step)
         model = spec.custom_model()
-        self._eval_fn = jax.jit(
-            make_eval_step(model, resolve_dtype(compute_dtype))
+        # recompile sentinel (ISSUE 18): padded batches mean exactly
+        # one compile per loaded version; anything more is shape churn
+        self._eval_fn = device_obs.instrumented_jit(
+            make_eval_step(model, resolve_dtype(compute_dtype)),
+            name="serve_eval",
         )
         # opt_state is the trainer's business; the eval forward reads
         # only params + model_state

@@ -19,6 +19,9 @@ whose firing schedule is a pure function of the spec:
     - ``kill-once``: the PROCESS dies by SIGKILL on the ``rate``-th
       matching call (once per process lifetime; relaunch with the spec
       cleared or it dies again).
+    - ``nan-batch`` / ``shape-churn``: data-plane faults applied at
+      ``maybe_poison_batch`` call sites on the train-batch path, not
+      at the gRPC boundary (see that function's docstring).
 - ``rate``   — for unavailable/deadline: values >= 1 are a
   deterministic BURST (the first ``int(rate)`` matching calls fail,
   later ones pass — the "PS comes back after N retries" shape);
@@ -49,7 +52,10 @@ logger = _logger_factory("elasticdl_tpu.testing.faults")
 
 FAULT_SPEC_ENV = "EDL_FAULT_SPEC"
 
-KINDS = ("unavailable", "deadline", "delay", "kill-once", "nan-batch")
+KINDS = (
+    "unavailable", "deadline", "delay", "kill-once", "nan-batch",
+    "shape-churn",
+)
 
 _role = ""
 _role_lock = threading.Lock()
@@ -129,6 +135,16 @@ class FaultSpec:
                 if calls == nth and not self._fired_kill:
                     self._fired_kill = True
                     return "poison"
+                return None
+            if self.kind == "shape-churn":
+                # deterministic shape fault (ISSUE 18): the first
+                # int(rate) matching batches each lose a DIFFERENT
+                # number of trailing rows (call 1 loses 1, call 2
+                # loses 2, ...) — every churned batch is a fresh
+                # shape, so each one is a fresh XLA compile: the
+                # recompile storm the sentinel exists to catch
+                if calls <= max(1, int(self.rate)):
+                    return ("churn", calls)
                 return None
             # unavailable / deadline
             if self.rate >= 1.0:
@@ -297,26 +313,84 @@ def intercept_client_channel(channel):
     return grpc.intercept_channel(channel, _FaultClientInterceptor(specs))
 
 
+def _churn_batch(batch, drop_rows):
+    """Truncate ``drop_rows`` trailing rows off every batch-leading
+    array (features, labels, mask) — the deterministic stand-in for
+    "somebody turned padding off mid-run"."""
+    import numpy as np
+
+    raw = batch.get("features")
+    leaves = raw.values() if isinstance(raw, dict) else (raw,)
+    sizes = [
+        np.asarray(leaf).shape[0]
+        for leaf in leaves
+        if getattr(np.asarray(leaf), "ndim", 0)
+    ]
+    if not sizes:
+        return batch
+    batch_size = max(sizes)
+    if batch_size <= drop_rows:
+        logger.warning(
+            "shape-churn fired but the batch has only %d rows "
+            "(wanted to drop %d); leaving it alone",
+            batch_size, drop_rows,
+        )
+        return batch
+    keep = batch_size - drop_rows
+
+    def cut(value):
+        arr = np.asarray(value)
+        if arr.ndim and arr.shape[0] == batch_size:
+            return arr[:keep]
+        return value
+
+    out = {}
+    for key, value in batch.items():
+        if isinstance(value, dict):
+            out[key] = {k: cut(v) for k, v in value.items()}
+        else:
+            out[key] = cut(value)
+    logger.warning(
+        "fault injection: shape-churn truncated batch %d -> %d rows",
+        batch_size, keep,
+    )
+    return out
+
+
 def maybe_poison_batch(batch, method="train_step"):
-    """Deterministic NaN-batch injection (ISSUE 15): when an armed
-    ``nan-batch`` spec matches (role, method) and its schedule fires,
-    every float feature of this batch is replaced with NaN — the
-    forward pass then yields a nonfinite loss/gradients, exactly the
-    corruption the health sentinels exist to catch. The batch's
-    labels/mask/integer ids are untouched (shapes and dtypes — and so
-    the compiled step — never change). Provably inert unset: one
-    ``_specs()`` cache check, the batch object returned as-is."""
+    """Deterministic data-plane injection, applied right before the
+    jitted train step. Two kinds:
+
+    - ``nan-batch`` (ISSUE 15): every float feature of this batch is
+      replaced with NaN — the forward pass then yields a nonfinite
+      loss/gradients, exactly the corruption the health sentinels
+      exist to catch. Shapes and dtypes — and so the compiled step —
+      never change.
+    - ``shape-churn`` (ISSUE 18): the batch loses its trailing rows
+      (the padding the pipeline added to keep shapes stable), a
+      DIFFERENT count per firing — every churned batch hands XLA a
+      shape it has never compiled, which is the recompile storm the
+      device-runtime sentinel exists to catch. Numerics untouched.
+
+    Provably inert unset: one ``_specs()`` cache check, the batch
+    object returned as-is."""
     specs = _specs()
     if not specs:
         return batch
     fired = False
+    churn_rows = 0
     for spec in specs:
-        if spec.kind != "nan-batch" or not spec.matches(
-            current_role(), method
-        ):
+        if spec.kind not in ("nan-batch", "shape-churn"):
             continue
-        if spec.fire() == "poison":
+        if not spec.matches(current_role(), method):
+            continue
+        action = spec.fire()
+        if action == "poison":
             fired = True
+        elif isinstance(action, tuple) and action[0] == "churn":
+            churn_rows = max(churn_rows, action[1])
+    if churn_rows:
+        batch = _churn_batch(batch, churn_rows)
     if not fired:
         return batch
     import numpy as np

@@ -49,6 +49,7 @@ import numpy as np
 
 from elasticdl_tpu.common.env_utils import env_int, env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import device as device_obs
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.ops import embedding_tier as tier_ops
 
@@ -320,7 +321,10 @@ class DeviceEmbeddingTier:
                     replicated,
                     replicated,
                 )
-            fn = jax.jit(base, donate_argnums=(0,), **kwargs)
+            fn = device_obs.instrumented_jit(
+                base, name="tier_insert_gather:%s" % table.name,
+                donate_argnums=(0,), **kwargs
+            )
             self._jit_cache[key] = fn
         return fn
 
@@ -345,7 +349,10 @@ class DeviceEmbeddingTier:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 kwargs["out_shardings"] = NamedSharding(self._mesh, P())
-            fn = jax.jit(functools.partial(gather), **kwargs)
+            fn = device_obs.instrumented_jit(
+                functools.partial(gather),
+                name="tier_gather:%s" % table.name, **kwargs
+            )
             self._jit_cache[key] = fn
         return fn
 
@@ -373,7 +380,10 @@ class DeviceEmbeddingTier:
                 kwargs["out_shardings"] = self._state_shardings(
                     table.state
                 )
-            fn = jax.jit(base, donate_argnums=(0,), **kwargs)
+            fn = device_obs.instrumented_jit(
+                base, name="tier_apply:%s" % table.name,
+                donate_argnums=(0,), **kwargs
+            )
             self._jit_cache[key] = fn
         return fn
 
@@ -1021,6 +1031,22 @@ class DeviceEmbeddingTier:
             "hit_rate": self.hits / lookups if lookups else 0.0,
             "occupancy": resident / capacity if capacity else 0.0,
         }
+
+    def hbm_bytes(self, per_table=False):
+        """Device bytes the tier's table state pins (rows + optimizer
+        slots), attributed per table when asked — the HBM-accounting
+        side of ISSUE 18's device section. Lock-free: table state
+        arrays are replaced, never resized, so nbytes is stable."""
+        sizes = {
+            name: sum(
+                int(getattr(value, "nbytes", 0))
+                for value in table.state.values()
+            )
+            for name, table in self._tables.items()
+        }
+        if per_table:
+            return sizes
+        return sum(sizes.values())
 
     def table_rows(self, name):
         """Resident (id, row) snapshot — tests and debugging."""

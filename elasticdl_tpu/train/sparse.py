@@ -26,6 +26,7 @@ from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
 from elasticdl_tpu.data.pipeline import MASK_KEY
+from elasticdl_tpu.observability import device as device_obs
 from elasticdl_tpu.observability import trace
 # HotRowCache lives in the extracted embedding-client library (ISSUE 8)
 # so the serving tier shares the training pull/cache stack; re-exported
@@ -802,7 +803,11 @@ class SparseTrainer:
             if step_ctx is None:
                 out[name] = grads
             else:
-                out[name] = np.asarray(grads)[step_ctx["push_pos"]]
+                with device_obs.transfer_span(
+                    "d2h", getattr(grads, "nbytes", 0)
+                ):
+                    host = np.asarray(grads)
+                out[name] = host[step_ctx["push_pos"]]
         return out
 
     def flush_device_tier(self):
@@ -812,10 +817,35 @@ class SparseTrainer:
             self.device_tier.flush()
 
     def _jit_steps(self, train_step_fn, row_grads_fn, eval_step_fn):
-        """Compile the three step callables; single-device default."""
-        self._train_step = jax.jit(train_step_fn, donate_argnums=(0,))
-        self._row_grads = jax.jit(row_grads_fn)
-        self._eval_step = jax.jit(eval_step_fn)
+        """Compile the three step callables; single-device default.
+        instrumented_jit (ISSUE 18) counts compiles vs cache hits per
+        step fn and is plain jax.jit when EDL_DEVICE_OBS=0."""
+        self._train_step = device_obs.instrumented_jit(
+            train_step_fn, name="sparse_train_step", donate_argnums=(0,)
+        )
+        self._row_grads = device_obs.instrumented_jit(
+            row_grads_fn, name="sparse_row_grads"
+        )
+        self._eval_step = device_obs.instrumented_jit(
+            eval_step_fn, name="sparse_eval_step"
+        )
+
+    @property
+    def cost_step_flops(self):
+        """Executable-reported FLOPs of one sparse train batch: the
+        fused train step plus the row-grads pass (both run per batch).
+        0.0 until first compile / where cost analysis is unavailable."""
+        return sum(
+            float(getattr(fn, "cost_flops", 0.0))
+            for fn in (self._train_step, self._row_grads)
+        )
+
+    @property
+    def cost_step_bytes(self):
+        return sum(
+            float(getattr(fn, "cost_bytes", 0.0))
+            for fn in (self._train_step, self._row_grads)
+        )
 
     def _fetch_row_grads(self, row_grads):
         """Bring the step's row gradients to per-table host-pushable
@@ -1041,7 +1071,12 @@ class SparseTrainer:
         self._prep_memo = None
         prepared, _ = self._tier_combine(batch, prepared, pull_info)
         outputs = self._eval_step(state, prepared["features"])
-        return jax.tree_util.tree_map(np.asarray, outputs)
+        nbytes = sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(outputs)
+        )
+        with device_obs.transfer_span("d2h", nbytes):
+            return jax.tree_util.tree_map(np.asarray, outputs)
 
     # ------------------------------------------------------------------
     def train_stream(self, state, batches, on_first_batch=None,

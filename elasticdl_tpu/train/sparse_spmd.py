@@ -54,6 +54,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.data.pipeline import pad_batch
+from elasticdl_tpu.observability import device as device_obs
 from elasticdl_tpu.parallel.mesh import (
     batch_sharding,
     build_mesh,
@@ -144,6 +145,35 @@ class SparseSpmdTrainer(SparseTrainer):
     def _structure_key(features):
         return tuple(sorted(features))
 
+    @property
+    def cost_step_flops(self):
+        """One batch runs exactly one structure key's train + row-grads
+        programs; take the largest compiled key (the steady-state full
+        batch) rather than summing across keys."""
+        return (
+            max(
+                (float(getattr(fn, "cost_flops", 0.0))
+                 for fn in self._jit_train.values()), default=0.0
+            )
+            + max(
+                (float(getattr(fn, "cost_flops", 0.0))
+                 for fn in self._jit_rgrads.values()), default=0.0
+            )
+        )
+
+    @property
+    def cost_step_bytes(self):
+        return (
+            max(
+                (float(getattr(fn, "cost_bytes", 0.0))
+                 for fn in self._jit_train.values()), default=0.0
+            )
+            + max(
+                (float(getattr(fn, "cost_bytes", 0.0))
+                 for fn in self._jit_rgrads.values()), default=0.0
+            )
+        )
+
     # -- sharding layout (the multi-host subclass re-points rows) ------
     def _rows_in_sharding(self):
         """Pulled rows buffer: replicated — every device gathers
@@ -200,10 +230,11 @@ class SparseSpmdTrainer(SparseTrainer):
         )
         self._invalidate_compiled()
         with self.mesh:
-            return jax.jit(
+            return device_obs.instrumented_jit(
                 lambda rng, feats: create_train_state(
                     self._model, self._tx, rng, feats
                 ),
+                name="spmd_init",
                 out_shardings=self._state_shardings,
             )(init_rng, self._init_features(sample_features))
 
@@ -284,8 +315,12 @@ class SparseSpmdTrainer(SparseTrainer):
                     "grad_norm": self._replicated_nd,
                     "nonfinite": self._replicated_nd,
                 },)
-            self._jit_train[key] = jax.jit(
+            # one sentinel-wrapped jit per batch structure key: a
+            # recompile WITHIN a key's wrapper is the shape-churn
+            # anomaly; a new key is a new program by design
+            self._jit_train[key] = device_obs.instrumented_jit(
                 self._train_step_fn,
+                name="spmd_train_step",
                 in_shardings=(self._state_shardings, shardings),
                 out_shardings=out_shardings,
                 donate_argnums=(0,),
@@ -300,8 +335,9 @@ class SparseSpmdTrainer(SparseTrainer):
                 spec.name: self._row_grads_sharding()
                 for spec in self._specs
             }
-            self._jit_rgrads[key] = jax.jit(
+            self._jit_rgrads[key] = device_obs.instrumented_jit(
                 self._row_grads_fn,
+                name="spmd_row_grads",
                 in_shardings=(self._state_shardings, shardings),
                 out_shardings=row_out,
             )
@@ -314,8 +350,9 @@ class SparseSpmdTrainer(SparseTrainer):
                 feature: self._feature_sharding(feature)
                 for feature in features
             }
-            self._jit_eval[key] = jax.jit(
+            self._jit_eval[key] = device_obs.instrumented_jit(
                 self._eval_step_fn,
+                name="spmd_eval_step",
                 in_shardings=(self._state_shardings, feature_shardings),
                 out_shardings=self._replicated_nd,
             )
@@ -504,7 +541,9 @@ class MultiHostSparseSpmdTrainer(LockstepMixin, SparseSpmdTrainer):
         prepared, _ = self._prepare_once(batch)
         self._prep_memo = None
         if self._local_eval is None:
-            self._local_eval = jax.jit(self._eval_step_fn)
+            self._local_eval = device_obs.instrumented_jit(
+                self._eval_step_fn, name="spmd_local_eval"
+            )
         if self._eval_cache is None or self._eval_cache[0] is not state:
             self._eval_cache = (state, self.local_state(state))
         outputs = self._local_eval(

@@ -27,8 +27,10 @@ def create_train_state(model, tx, rng, sample_features):
     # jit the init: eager flax init compiles (and dispatches) every
     # primitive separately — ~30 s of per-op XLA compiles for a model
     # with large host-side row buffers; one traced program is seconds.
-    # Inside an outer trace (SpmdTrainer's sharded init) jit inlines.
-    variables = jax.jit(
+    # Inside an outer trace (SpmdTrainer's sharded init) jit inlines —
+    # which is why this stays a BARE jax.jit: the ISSUE-18 sentinel
+    # wrapper would run its host bookkeeping at trace time there.
+    variables = jax.jit(  # edlint: disable=obs-bare-jit
         lambda r, feats: model.init(r, feats, training=False)
     )(rng, sample_features)
     variables = dict(variables)

@@ -11,6 +11,7 @@ parallel/spmd_trainer.py — both wrap the same step functions
 import jax
 import numpy as np
 
+from elasticdl_tpu.observability import device as device_obs
 from elasticdl_tpu.train.step_fns import make_eval_step, make_train_step
 from elasticdl_tpu.train.train_state import (
     TrainState,
@@ -48,7 +49,10 @@ class JaxTrainer:
             self.health = health
         self._health_on = self.health is not None
         compute_dtype = resolve_dtype(compute_dtype)
-        self._train_step = jax.jit(
+        # recompile sentinels (ISSUE 18): instrumented_jit IS jax.jit
+        # when EDL_DEVICE_OBS=0; on, each compile is counted, timed,
+        # provenance-diffed, and cost-analyzed
+        self._train_step = device_obs.instrumented_jit(
             make_train_step(
                 model, loss_fn, optimizer, compute_dtype,
                 grad_accum_steps=grad_accum_steps,
@@ -57,9 +61,12 @@ class JaxTrainer:
                     self._health_on and self.health.action == "skip"
                 ),
             ),
+            name="train_step",
             donate_argnums=(0,),
         )
-        self._eval_step = jax.jit(make_eval_step(model, compute_dtype))
+        self._eval_step = device_obs.instrumented_jit(
+            make_eval_step(model, compute_dtype), name="eval_step"
+        )
 
     # ------------------------------------------------------------------
     def create_state(self, sample_features) -> TrainState:
@@ -98,6 +105,22 @@ class JaxTrainer:
         )
         return state, loss
 
+    @property
+    def cost_step_flops(self):
+        """Executable-reported FLOPs of one train step (0.0 until the
+        first compile, or where cost analysis is unavailable) — the
+        worker MFU bridge prefers this over a hand-coded table."""
+        return float(getattr(self._train_step, "cost_flops", 0.0))
+
+    @property
+    def cost_step_bytes(self):
+        return float(getattr(self._train_step, "cost_bytes", 0.0))
+
     def eval_step(self, state, batch):
         outputs = self._eval_step(state, batch["features"])
-        return jax.tree_util.tree_map(np.asarray, outputs)
+        nbytes = sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(outputs)
+        )
+        with device_obs.transfer_span("d2h", nbytes):
+            return jax.tree_util.tree_map(np.asarray, outputs)

@@ -20,6 +20,7 @@ import numpy as np
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.common.env_utils import env_float, env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import device as device_obs
 from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.observability import trace
@@ -439,6 +440,29 @@ class Worker:
             blob.health_loss_spikes = stats["loss_spikes"]
             blob.health_grad_explosions = stats["grad_explosions"]
             blob.health_skipped_batches = stats["skipped_batches"]
+        # device runtime (ISSUE 18): compile ledger, HBM gauges, and
+        # cost-model step attribution — what the recompile_storm /
+        # hbm_pressure detectors and the fleet /statusz device section
+        # read. Empty dict (obs disabled) leaves the fields zero.
+        dev = device_obs.telemetry()
+        if dev:
+            blob.xla_compiles = dev["xla_compiles"]
+            blob.xla_recompiles = dev["xla_recompiles"]
+            blob.xla_compile_secs_total = dev["xla_compile_secs_total"]
+            blob.hbm_bytes_in_use = dev["hbm_bytes_in_use"]
+            blob.hbm_peak_bytes = dev["hbm_peak_bytes"]
+            blob.hbm_limit_bytes = dev["hbm_limit_bytes"]
+            blob.device_live_buffers = dev["device_live_buffers"]
+            blob.h2d_bytes = dev["h2d_bytes"]
+            blob.d2h_bytes = dev["d2h_bytes"]
+            blob.cost_step_flops = float(
+                getattr(self.trainer, "cost_step_flops", 0.0) or 0.0
+            )
+            blob.cost_step_bytes = float(
+                getattr(self.trainer, "cost_step_bytes", 0.0) or 0.0
+            )
+            if tier is not None:
+                blob.tier_hbm_bytes = tier.hbm_bytes()
         return blob
 
     def _update_step_telemetry(self, real_count):
@@ -740,10 +764,18 @@ class Worker:
         step_secs = self._timing.last_seconds.get("batch_process")
         if step_secs:
             self._m_examples_per_sec.set(real / step_secs)
-            if self._step_flops and self._peak_flops:
-                self._m_mfu.set(
-                    self._step_flops / (step_secs * self._peak_flops)
-                )
+            if self._peak_flops:
+                # cost-model attribution (ISSUE 18): prefer XLA's own
+                # cost_analysis() of the compiled step (exact for the
+                # program actually running) over the trainer's static
+                # step_flops table
+                flops = float(
+                    getattr(self.trainer, "cost_step_flops", 0.0) or 0.0
+                ) or self._step_flops
+                if flops:
+                    self._m_mfu.set(
+                        flops / (step_secs * self._peak_flops)
+                    )
         self._m_version.set(self._version)
         if (
             self._report_version_steps

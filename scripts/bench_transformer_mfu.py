@@ -52,6 +52,23 @@ def xla_memory_fields(compiled):
         return {}
 
 
+def xla_cost_flops(compiled, steps):
+    """XLA's own cost_analysis() FLOPs for ONE step, or 0.0 where the
+    backend exposes none. The compiled program runs ``steps`` scanned
+    steps, so the program total divides down. This is the same number
+    the ISSUE-18 device-obs layer feeds the worker's MFU gauge — the
+    cross-check below keeps the hand count honest."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return 0.0
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return 0.0
+    return float(cost.get("flops", 0.0)) / max(steps, 1)
+
+
 def model_train_flops(d, layers, seq, batch, vocab, mlp_ratio=4):
     """Exact matmul FLOPs for one train step (fwd + bwd = 3x fwd)."""
     tokens = batch * seq
@@ -211,6 +228,29 @@ def main():
     except Exception:
         pass
     mem.update(xla_memory_fields(compiled))
+
+    # cost-model cross-check (ISSUE 18): XLA's own count of the
+    # program actually compiled, beside the hand count. Disagreement
+    # >10% means one of them is wrong — usually the hand count after
+    # an architecture change (new attention kind, remat recompute the
+    # hand count deliberately excludes showing up in XLA's total).
+    xla_flops = xla_cost_flops(compiled, args.steps)
+    if xla_flops:
+        mem["xla_tflop_per_step"] = round(xla_flops / 1e12, 2)
+        mem["xla_mfu"] = round(
+            xla_flops / (elapsed / args.steps) / peak, 4
+        )
+        disagreement = abs(xla_flops - flops) / max(xla_flops, flops)
+        mem["flops_disagreement"] = round(disagreement, 4)
+        if disagreement > 0.10:
+            print(
+                "WARNING: hand-counted FLOPs (%.2f T) and XLA "
+                "cost_analysis (%.2f T) disagree by %.0f%% — "
+                "re-derive model_train_flops for this config"
+                % (flops / 1e12, xla_flops / 1e12,
+                   disagreement * 100),
+                file=sys.stderr,
+            )
 
     print(json.dumps({
         "config": config,

@@ -30,6 +30,7 @@ Usage:
 import argparse
 import glob
 import json
+import math
 import os
 import sys
 
@@ -56,11 +57,15 @@ def lower_is_better(name):
 
 def _flatten(prefix, value, out):
     """Numeric leaves of a nested dict as dotted names (bools and
-    strings dropped; lists skipped — per-item series are not trends)."""
+    strings dropped; lists skipped — per-item series are not trends).
+    Non-finite leaves are dropped too: a NaN in a trajectory poisons
+    min()/max() and then ``v == best`` matches nothing, so one bad
+    bench line would crash the whole tier-1f watchdog."""
     if isinstance(value, bool):
         return
     if isinstance(value, (int, float)):
-        out[prefix] = float(value)
+        if math.isfinite(value):
+            out[prefix] = float(value)
     elif isinstance(value, dict):
         for key, sub in value.items():
             _flatten("%s.%s" % (prefix, key) if prefix else str(key),
@@ -82,8 +87,11 @@ def load_bench_rounds(repo_root):
         parsed = payload.get("parsed") or {}
         metrics = {}
         name = parsed.get("metric")
-        if name and isinstance(parsed.get("value"), (int, float)):
-            metrics[str(name)] = float(parsed["value"])
+        value = parsed.get("value")
+        if (name and isinstance(value, (int, float))
+                and not isinstance(value, bool)
+                and math.isfinite(value)):
+            metrics[str(name)] = float(value)
         extra = parsed.get("extra")
         if isinstance(extra, dict):
             _flatten("", extra, metrics)
@@ -164,7 +172,11 @@ def analyze(series, threshold=0.2):
                 best > 0 and latest < best * (1.0 - threshold)
             )
             ratio = latest / best if best else 1.0
-        best_label = next(l for l, v in points if v == best)
+        # default guards StopIteration if a non-finite value ever slips
+        # past ingestion (NaN == NaN is False, so it matches nothing)
+        best_label = next(
+            (l for l, v in points if v == best), latest_label
+        )
         entry = {
             "points": len(points),
             "direction": "lower" if lower else "higher",

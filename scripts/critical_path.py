@@ -24,6 +24,13 @@ apply               ``ps_apply_push`` and ``Pserver/push_*`` handler
 compute             the ``train_batch`` root's self time (forward /
                     backward / device step) and ``serve_batch_run``
                     (the batched forward)
+compile             ``compile`` spans from the ISSUE-18 recompile
+                    sentinel — XLA compiles caught on the step path;
+                    a steady-state trace showing this segment IS the
+                    recompile storm, attributed to the step it stalled
+transfer            ``transfer`` spans (ISSUE 18): explicit host<->
+                    device movement — output fetches, device-tier
+                    gradient extraction
 shed                the full duration of a predict trace whose root
                     failed with RESOURCE_EXHAUSTED / DEADLINE_EXCEEDED
 other               anything unrecognized (kept visible, never dropped)
@@ -80,6 +87,10 @@ _SEGMENT_BY_NAME = {
     "ps_push_rows": "push",
     "ps_apply_push": "apply",
     "serve_batch_run": "compute",
+    # device runtime (ISSUE 18): the recompile sentinel's compile
+    # spans and explicit host<->device transfer spans
+    "compile": "compile",
+    "transfer": "transfer",
 }
 
 # root-span name -> segment its SELF time belongs to

@@ -170,6 +170,13 @@ def summarize(timeline, dump_headers):
     health = {"nonfinite": 0, "loss_spikes": 0, "grad_explosions": 0,
               "halts": 0, "table_exploding": 0}
     health_roles = {}  # role -> [event kinds in order]
+    # device runtime (ISSUE 18): recompile sentinel events + the storm
+    # alerts threaded per role, with the LAST recompile's shape
+    # provenance kept verbatim — "what shape changed" is the whole
+    # debugging story of a recompile storm
+    device = {"recompiles": 0, "recompile_storms": 0,
+              "hbm_pressure": 0, "compile_secs": 0.0}
+    device_roles = {}  # role -> {"recompiles": n, "last_changed": [..]}
     job_failed = None
     for event in timeline:
         kind = event.get("event")
@@ -187,6 +194,10 @@ def summarize(timeline, dump_headers):
             except (TypeError, ValueError):
                 pass
             workers[target]["alerts"].append(event.get("alert"))
+            if event.get("alert") == "recompile_storm":
+                device["recompile_storms"] += 1
+            elif event.get("alert") == "hbm_pressure":
+                device["hbm_pressure"] += 1
         elif kind == "round_open":
             rounds["opened"] += 1
         elif kind == "round_close":
@@ -233,6 +244,18 @@ def summarize(timeline, dump_headers):
             health_roles.setdefault(
                 str(event.get("role", "?")), []
             ).append(kind)
+        elif kind == "xla_recompile":
+            device["recompiles"] += 1
+            device["compile_secs"] += float(event.get("seconds", 0.0))
+            entry = device_roles.setdefault(
+                str(event.get("role", "?")),
+                {"recompiles": 0, "fns": [], "last_changed": []},
+            )
+            entry["recompiles"] += 1
+            fn = event.get("fn", "?")
+            if fn not in entry["fns"]:
+                entry["fns"].append(fn)
+            entry["last_changed"] = event.get("changed", [])
     for header in dump_headers:
         role = header.get("role") or ""
         # worker dumps are keyed by the role's worker id when present
@@ -249,6 +272,8 @@ def summarize(timeline, dump_headers):
         "stream": stream,
         "health": health,
         "health_roles": health_roles,
+        "device": device,
+        "device_roles": device_roles,
         "job_failed": job_failed,
     }
 
@@ -322,6 +347,17 @@ def render_text(timeline, summary, dump_headers, alert_counters):
             summary.get("health_roles", {}).items()
         ):
             lines.append("    %s: %s" % (role, ", ".join(kinds)))
+    device = summary.get("device", {})
+    if any(device.values()):
+        lines.append("  device runtime: %r" % (device,))
+        for role, entry in sorted(
+            summary.get("device_roles", {}).items()
+        ):
+            lines.append(
+                "    %s: recompiles=%d fns=%s last_changed=%s"
+                % (role, entry["recompiles"], ",".join(entry["fns"]),
+                   entry["last_changed"])
+            )
     if summary["job_failed"]:
         lines.append("  JOB FAILED: %r" % (summary["job_failed"],))
     return "\n".join(lines)

@@ -1,0 +1,186 @@
+"""Bench-trend watchdog robustness (ISSUE 18 satellite): the tier-1f
+lane pipes whatever BENCH_r*.json and journal lines exist into
+scripts/bench_trend.py, so malformed records — missing metric keys,
+NaN/absent fields, single-point trajectories — must degrade to
+"skipped" rather than crash the watchdog."""
+
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+import bench_trend  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def write_round(root, index, payload):
+    path = os.path.join(root, "BENCH_r%d.json" % index)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps(payload))
+    return path
+
+
+def write_journal(path, records):
+    with open(path, "w", encoding="utf-8") as f:
+        for record in records:
+            f.write(json.dumps(record) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# ingestion: missing/absent fields
+
+
+def test_bench_round_missing_metric_keys_skipped(tmp_path):
+    root = str(tmp_path)
+    write_round(root, 1, {})  # no parsed at all
+    write_round(root, 2, {"parsed": {"metric": "steps_per_sec"}})  # no value
+    write_round(root, 3, {"parsed": {"value": 4.2}})  # no metric name
+    write_round(root, 4, {"parsed": None})  # explicit null
+    write_round(
+        root, 5,
+        {"parsed": {"metric": "steps_per_sec", "value": "fast"}},
+    )  # non-numeric value
+    rounds = bench_trend.load_bench_rounds(root)
+    assert rounds == []
+
+
+def test_bench_round_bool_value_is_not_a_metric(tmp_path):
+    # bool is an int subclass; True must not become a 1.0 data point
+    root = str(tmp_path)
+    write_round(
+        root, 1, {"parsed": {"metric": "converged", "value": True}}
+    )
+    assert bench_trend.load_bench_rounds(root) == []
+
+
+def test_bench_round_corrupt_json_skipped(tmp_path, capsys):
+    root = str(tmp_path)
+    with open(os.path.join(root, "BENCH_r1.json"), "w") as f:
+        f.write("{not json")
+    write_round(
+        root, 2, {"parsed": {"metric": "steps_per_sec", "value": 10.0}}
+    )
+    rounds = bench_trend.load_bench_rounds(root)
+    assert [label for label, _ in rounds] == ["BENCH_r2"]
+    assert "skipping" in capsys.readouterr().err
+
+
+def test_journal_torn_and_non_dict_lines_skipped(tmp_path):
+    path = os.path.join(str(tmp_path), "journal.jsonl")
+    with open(path, "w") as f:
+        f.write('{"ts": "t0", "wire_micro": {"p50_ms": 1.5}}\n')
+        f.write('{"ts": "t1", "wire_mic')  # torn tail
+        f.write("\n[1, 2, 3]\n")  # JSON but not an object
+        f.write('{"ts": "t2", "wire_micro": "oops"}\n')  # payload not dict
+    entries = bench_trend.load_journal(path)
+    assert len(entries) == 1
+    assert entries[0][1] == {"p50_ms": 1.5}
+
+
+def test_journal_missing_file_is_empty(tmp_path):
+    assert bench_trend.load_journal(
+        os.path.join(str(tmp_path), "nope.jsonl")
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# NaN / non-finite fields
+
+
+def test_nan_and_inf_leaves_dropped_at_ingestion(tmp_path):
+    root = str(tmp_path)
+    write_round(root, 1, {"parsed": {
+        "metric": "steps_per_sec",
+        "value": float("nan"),  # NaN headline must not become a point
+        "extra": {
+            "deepfm": {"steps_per_sec": 100.0, "stall_ms": float("nan")},
+            "mfu": float("inf"),
+        },
+    }})
+    rounds = bench_trend.load_bench_rounds(root)
+    assert len(rounds) == 1
+    _, metrics = rounds[0]
+    assert metrics == {"deepfm.steps_per_sec": 100.0}
+    assert all(math.isfinite(v) for v in metrics.values())
+
+
+def test_nan_trajectory_does_not_crash_analyze():
+    # Even if a non-finite value slips past ingestion, analyze() must
+    # not raise (min/max with NaN is poisoned and NaN == NaN is False,
+    # which used to StopIteration out of the best-label lookup).
+    series = {
+        "steps_per_sec": [
+            ("r1", float("nan")), ("r2", 100.0), ("r3", 90.0),
+        ],
+        "p99_ms": [("r1", 2.0), ("r2", float("nan"))],
+    }
+    metrics, regressions = bench_trend.analyze(series, threshold=0.2)
+    assert set(metrics) == {"steps_per_sec", "p99_ms"}
+    assert isinstance(regressions, list)
+
+
+# ---------------------------------------------------------------------------
+# single-point trajectories
+
+
+def test_single_point_trajectory_is_skipped_not_crashed(tmp_path):
+    series = {"steps_per_sec": [("r1", 100.0)]}
+    metrics, regressions = bench_trend.analyze(series)
+    assert metrics == {}
+    assert regressions == []
+
+
+def test_main_with_single_point_round_exits_clean(tmp_path, capsys):
+    root = str(tmp_path)
+    write_round(
+        root, 1, {"parsed": {"metric": "steps_per_sec", "value": 10.0}}
+    )
+    rc = bench_trend.main([
+        "--repo-root", root,
+        "--journal", os.path.join(root, "absent.jsonl"),
+    ])
+    # data exists (so not the exit-1 "nothing to watch" path) but one
+    # point is a value, not a trend — zero tracked metrics, no crash
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["tracked_metrics"] == 0
+    assert report["regressions"] == []
+
+
+def test_main_no_data_at_all_returns_1(tmp_path, capsys):
+    root = str(tmp_path)
+    rc = bench_trend.main([
+        "--repo-root", root,
+        "--journal", os.path.join(root, "absent.jsonl"),
+    ])
+    assert rc == 1
+    assert "nothing to watch" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# end-to-end sanity: real regression still detected through main()
+
+
+def test_main_flags_regression_across_sources(tmp_path, capsys):
+    root = str(tmp_path)
+    write_round(root, 1, {"parsed": {
+        "metric": "deepfm_steps_per_sec", "value": 100.0,
+    }})
+    journal = write_journal(
+        os.path.join(root, "j.jsonl"),
+        [{"ts": "t0", "wire_micro": {"deepfm_steps_per_sec": 50.0}}],
+    )
+    rc = bench_trend.main(
+        ["--repo-root", root, "--journal", journal]
+    )
+    assert rc == 0  # report-only by contract
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["tracked_metrics"] == 1
+    (entry,) = report["regressions"]
+    assert entry["metric"] == "deepfm_steps_per_sec"
+    assert entry["best"] == 100.0 and entry["latest"] == 50.0

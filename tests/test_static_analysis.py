@@ -1476,6 +1476,70 @@ def test_knob_registry_suppression_comment_works():
 
 
 # ---------------------------------------------------------------------------
+# obs-bare-jit (ISSUE 18)
+
+def test_obs_bare_jit_flags_bare_jit_in_train_scope():
+    findings = findings_for("""
+        import jax
+
+        class Trainer:
+            def __init__(self, fn):
+                self._step = jax.jit(fn, donate_argnums=(0,))  # BUG
+    """, path="elasticdl_tpu/train/fixture.py", rules=["obs-bare-jit"])
+    assert len(findings) == 1, findings
+    assert findings[0].code == "jit()"
+    assert findings[0].symbol == "Trainer.__init__"
+
+
+def test_obs_bare_jit_flags_pjit_partial_and_decorator():
+    findings = findings_for("""
+        import jax
+        from functools import partial
+        from jax.experimental.pjit import pjit
+
+        def build(fn):
+            a = pjit(fn)                       # BUG
+            b = partial(jax.jit, static_argnums=(1,))  # BUG
+            return a, b
+
+        @jax.jit
+        def decorated(x):                      # BUG (decorator)
+            return x
+    """, path="elasticdl_tpu/serve/fixture.py", rules=["obs-bare-jit"])
+    assert sorted(f.code for f in findings) == ["jit()", "jit()", "pjit()"]
+
+
+def test_obs_bare_jit_quiet_on_instrumented_and_out_of_scope():
+    # the sanctioned wrapper has a different leaf name
+    assert not findings_for("""
+        from elasticdl_tpu.observability import device as device_obs
+
+        class Trainer:
+            def __init__(self, fn):
+                self._step = device_obs.instrumented_jit(
+                    fn, name="train_step", donate_argnums=(0,))
+    """, path="elasticdl_tpu/train/fixture.py", rules=["obs-bare-jit"])
+    # parallel/ research trainers are deliberately out of scope
+    assert not findings_for("""
+        import jax
+
+        def build(fn):
+            return jax.jit(fn)
+    """, path="elasticdl_tpu/parallel/fixture.py", rules=["obs-bare-jit"])
+
+
+def test_obs_bare_jit_suppression_comment_works():
+    assert not findings_for("""
+        import jax
+
+        def init(model, rng, feats):
+            return jax.jit(  # edlint: disable=obs-bare-jit
+                lambda r, f: model.init(r, f)
+            )(rng, feats)
+    """, path="elasticdl_tpu/train/fixture.py", rules=["obs-bare-jit"])
+
+
+# ---------------------------------------------------------------------------
 # the gate
 
 @pytest.mark.lint
