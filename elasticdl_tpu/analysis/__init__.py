@@ -35,6 +35,11 @@ for (or refuses to pay for):
 - ``ft-swallowed-except`` / ``ft-grpc-timeout`` — fault-tolerance
   hygiene: no broad except that swallows without logging/re-raising,
   no gRPC stub call without a deadline.
+- ``ft-deadline-no-propagation`` — no nested stub call on a request
+  path (``*Servicer`` method / ``@thread_context`` def) restarting the
+  deadline clock with a fresh literal or module-default ``timeout=``;
+  wrap the default in ``common.overload.rpc_timeout()`` so the caller's
+  remaining budget caps the fan-out.
 - ``perf-varint-ids``     — no per-element Python-loop serialization
   into repeated proto fields (``.extend(int(i) for i in ids)``); use
   the packed ``ids_blob`` wire field or ``astype().tolist()``.

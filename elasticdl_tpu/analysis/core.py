@@ -256,6 +256,8 @@ def _rules_by_name(names=None):
         "serve-affinity-unbounded-ring": serve_ring.run,
         "ft-swallowed-except": fault_tolerance.run_swallowed_except,
         "ft-grpc-timeout": fault_tolerance.run_grpc_timeout,
+        "ft-deadline-no-propagation":
+            fault_tolerance.run_deadline_no_propagation,
         "ft-retry-no-jitter": fault_tolerance.run_retry_no_jitter,
         "ft-sigterm-no-chain": fault_tolerance.run_sigterm_no_chain,
         "ft-unbounded-vocab": unbounded_vocab.run,
@@ -289,6 +291,7 @@ RULE_NAMES = (
     "serve-affinity-unbounded-ring",
     "ft-swallowed-except",
     "ft-grpc-timeout",
+    "ft-deadline-no-propagation",
     "ft-retry-no-jitter",
     "ft-sigterm-no-chain",
     "ft-unbounded-vocab",

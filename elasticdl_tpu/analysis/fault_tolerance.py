@@ -24,6 +24,21 @@ graceful drain -> exit, observability/events.py + worker/drain.py), so
 an overwriting registration severs the links behind it — the drain
 hook must capture the previous handler (``getsignal``) and call it.
 
+``ft-deadline-no-propagation`` — a gRPC stub call made FROM a request
+path (a method of a ``*Servicer`` class, or a function carrying a
+``@thread_context`` contract — the repo's marker for code running on a
+server/executor thread) that passes a fresh numeric-literal or
+module-default ``timeout=`` instead of the propagated deadline budget.
+The caller that fanned out to this code had a deadline; restarting the
+clock here lets a nested RPC outlive it, so the client gives up, the
+server keeps burning PS capacity on an answer nobody is waiting for,
+and under overload that zombie work IS the collapse. Wrap the default
+in ``common.overload.rpc_timeout(default)`` (caps by the remaining
+caller budget carried in thread-local state / the
+``edl-deadline-budget`` header) or pass a value derived from it.
+Timeouts already computed in a Name or any call expression are trusted
+as derived.
+
 ``ft-retry-no-jitter`` — a retry loop that sleeps a deterministically
 GROWING backoff (``delay``, then ``delay = min(delay * 2, cap)``)
 without any randomness retries in lockstep across a fleet: every
@@ -243,6 +258,88 @@ def run_sigterm_no_chain(units):
                     ),
                 )
             )
+    return findings
+
+
+def _budget_scopes(tree):
+    """Qualnames of defs that run on a request/executor path: methods
+    of a ``*Servicer`` class, plus any def decorated with
+    ``@thread_context(...)`` (the repo's thread-contract marker)."""
+    scopes = set()
+    for node, scope in walk_with_scope(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qual = scope.split(".")
+        if len(qual) >= 2 and "Servicer" in qual[-2]:
+            scopes.add(scope)
+            continue
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            chain = attr_chain(target)
+            if chain is not None and (
+                chain.split(".")[-1] == "thread_context"
+            ):
+                scopes.add(scope)
+                break
+    return scopes
+
+
+def _fresh_timeout(value):
+    """The timeout shapes that restart the deadline clock: a numeric
+    literal, or a bare module-default constant (``GRPC.DEFAULT_*``).
+    A Name or any call expression is trusted as a derived deadline."""
+    if isinstance(value, ast.Constant) and isinstance(
+        value.value, (int, float)
+    ):
+        return repr(value.value)
+    chain = attr_chain(value)
+    if chain is not None and "DEFAULT" in chain.split(".")[-1].upper():
+        return chain
+    return None
+
+
+def run_deadline_no_propagation(units):
+    findings = []
+    for unit in units:
+        scopes = _budget_scopes(unit.tree)
+        if not scopes:
+            continue
+        for node, scope in walk_with_scope(unit.tree):
+            if scope not in scopes or not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            receiver = attr_chain(func.value)
+            if receiver is None or "stub" not in receiver.lower():
+                continue
+            if func.attr.startswith("_") or func.attr in ("close",):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "timeout":
+                    continue
+                fresh = _fresh_timeout(kw.value)
+                if fresh is None:
+                    continue
+                findings.append(
+                    Finding(
+                        rule="ft-deadline-no-propagation",
+                        path=unit.path,
+                        line=node.lineno,
+                        symbol=scope,
+                        code="%s.%s(timeout=%s)"
+                        % (receiver, func.attr, fresh),
+                        message=(
+                            "nested RPC %s.%s() on a request path "
+                            "restarts the deadline clock with "
+                            "timeout=%s; it can outlive the caller's "
+                            "budget and burn capacity on abandoned "
+                            "work — wrap the default in "
+                            "common.overload.rpc_timeout()"
+                            % (receiver, func.attr, fresh)
+                        ),
+                    )
+                )
     return findings
 
 

@@ -11,6 +11,7 @@ from concurrent import futures
 
 import grpc
 
+from elasticdl_tpu.common import overload
 from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
@@ -66,7 +67,8 @@ def _await_reconnect(channel, timeout_secs):
 
 
 def retry_call(fn, what, budget_secs, retryable=RETRYABLE_CODES,
-               base_delay=0.5, max_delay=10.0, rng=None, channel=None):
+               base_delay=0.5, max_delay=10.0, rng=None, channel=None,
+               target=None, fail_fast_when_open=False):
     """Call ``fn`` with FULL-JITTER exponential backoff on retryable
     gRPC errors, up to ``budget_secs`` of wall clock.
 
@@ -84,44 +86,133 @@ def retry_call(fn, what, budget_secs, retryable=RETRYABLE_CODES,
     instead of just sleeping, and when the peer comes back early the
     retry fires after only a small residual jitter draw rather than
     the full backoff.
+
+    Overload discipline (ISSUE 19, common/overload.py), engaged only
+    when ``target`` names the peer:
+
+    - ``budget_secs`` is first capped by the thread's propagated
+      deadline budget, and the whole loop runs inside that budget so
+      every attempt's channel-interceptor timeout shrinks with the
+      remainder — a nested fan-out can never outlive its caller.
+    - a RESOURCE_EXHAUSTED carrying the server's ``edl-retry-after-ms``
+      pushback trailer is retried at the SERVER's pace: the hint seeds
+      the wait, full jitter rides on top, and consecutive pushbacks
+      double it (capped 8x) — all separate from the connection-failure
+      jitter ceiling, which pushback never grows.
+    - each retry spends a per-target retry-budget token; an empty
+      bucket raises ``RetryBudgetExhausted`` (fail fast — bounded
+      amplification) instead of sleeping.
+    - the per-(target, method-class) circuit breaker paces attempts:
+      open = wait out the probe window (still inside the budget), or
+      raise ``CircuitOpenError`` immediately when the caller set
+      ``fail_fast_when_open`` because it has a degraded fallback
+      (brownout pulls). Connection-shaped failures feed the breaker;
+      pushback does not.
     """
-    draw = (rng or random).uniform
+    jitter = (rng or random).uniform
+    budget_secs = overload.rpc_timeout(budget_secs)
     deadline = time.monotonic() + budget_secs
+    breaker = (
+        overload.breaker_for(target, overload.method_class(what))
+        if target is not None else None
+    )
+    retry_budget = (
+        overload.retry_budget_for(target) if target is not None else None
+    )
     ceiling = base_delay
     attempt = 0
-    while True:
-        attempt += 1
-        try:
-            # each attempt is its OWN child span (ISSUE 9): a retried
-            # RPC shows as N sibling spans — the failed attempts carry
-            # error/code args — never one span double-ended, and the
-            # propagated parent the server sees is the attempt that
-            # actually reached it
-            if _trace.enabled():
-                with _trace.span("rpc_attempt", what=what,
-                                 attempt=attempt):
-                    return fn()
-            return fn()
-        except grpc.RpcError as e:
-            code = e.code() if hasattr(e, "code") else None
-            delay = draw(0.0, ceiling)
-            if code not in retryable or (
-                time.monotonic() + delay > deadline
-            ):
-                raise
-            logger.warning(
-                "%s unavailable (%s); retrying in %.2fs", what, code,
-                delay,
-            )
-            if channel is not None:
-                if _await_reconnect(channel, delay):
-                    # peer is back: keep a small residual jitter so a
-                    # fleet whose ready-futures all completed at the
-                    # same instant doesn't slam it in unison
-                    time.sleep(draw(0.0, min(0.25, delay)))
+    pushback_streak = 0
+    with overload.budget(budget_secs):
+        while True:
+            if breaker is not None:
+                wait = breaker.admit_delay()
+                if wait > 0:
+                    if fail_fast_when_open or (
+                        time.monotonic() + wait > deadline
+                    ):
+                        raise overload.CircuitOpenError(
+                            breaker.target, breaker.kind
+                        )
+                    time.sleep(wait)
+                    continue
+            attempt += 1
+            try:
+                # each attempt is its OWN child span (ISSUE 9): a
+                # retried RPC shows as N sibling spans — the failed
+                # attempts carry error/code args — never one span
+                # double-ended, and the propagated parent the server
+                # sees is the attempt that actually reached it
+                if _trace.enabled():
+                    with _trace.span("rpc_attempt", what=what,
+                                     attempt=attempt):
+                        result = fn()
+                else:
+                    result = fn()
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                pushback = overload.retry_after_hint(e)
+                if breaker is not None and code in RETRYABLE_CODES:
+                    breaker.record_failure()
+                if pushback is not None and (
+                    code == grpc.StatusCode.RESOURCE_EXHAUSTED
+                ):
+                    # server pushback: the hint SEEDS the pacing (the
+                    # connection-failure jitter ceiling is untouched),
+                    # with full jitter on top and doubling on
+                    # consecutive pushbacks — waiters polling at the
+                    # bare hint in lockstep race each freed slot and
+                    # mostly miss, re-amplifying the very load the
+                    # server is shedding
+                    delay = (
+                        pushback * (1.0 + jitter(0.0, 1.0))
+                        * (1 << min(pushback_streak, 3))
+                    )
+                    pushback_streak += 1
+                else:
+                    pushback = None
+                    pushback_streak = 0
+                    delay = jitter(0.0, ceiling)
+                    if code not in retryable:
+                        raise
+                if time.monotonic() + delay > deadline:
+                    raise
+                if retry_budget is not None and not retry_budget.spend():
+                    overload.note_budget_exhausted(target)
+                    logger.warning(
+                        "%s: retry budget for %s exhausted; failing "
+                        "fast", what, target,
+                    )
+                    raise overload.RetryBudgetExhausted(
+                        target, code
+                    ) from e
+                if pushback is not None:
+                    overload.note_pushback_wait(target)
+                    logger.warning(
+                        "%s pushed back by %s; retrying in %.2fs",
+                        what, target or "peer", delay,
+                    )
+                    time.sleep(delay)
+                    continue
+                logger.warning(
+                    "%s unavailable (%s); retrying in %.2fs", what,
+                    code, delay,
+                )
+                if channel is not None:
+                    if _await_reconnect(channel, delay):
+                        # peer is back: keep a small residual jitter
+                        # so a fleet whose ready-futures all completed
+                        # at the same instant doesn't slam it in
+                        # unison
+                        time.sleep(jitter(0.0, min(0.25, delay)))
+                else:
+                    time.sleep(delay)
+                ceiling = min(ceiling * 2, max_delay)
             else:
-                time.sleep(delay)
-            ceiling = min(ceiling * 2, max_delay)
+                if breaker is not None:
+                    breaker.record_success()
+                if retry_budget is not None:
+                    retry_budget.record_success()
+                return result
 
 
 # Zero-copy local transport (ISSUE 11): on a TPU-VM host the PS is
@@ -180,6 +271,13 @@ def build_channel(addr: str) -> grpc.Channel:
         logger.info("channel to %s riding the local socket %s", addr, uds)
         addr = uds
     channel = grpc.insecure_channel(addr, options=_CHANNEL_OPTIONS)
+    # deadline-budget propagation (ISSUE 19, common/overload.py):
+    # innermost — caps each attempt's timeout by the thread's
+    # remaining budget and carries the remainder to the peer as
+    # edl-deadline-budget metadata. Identity pass-through under
+    # EDL_DEADLINE_BUDGET=0, and zero-cost per call when no budget
+    # scope is open.
+    channel = overload.intercept_budget_channel(channel)
     # trace-context propagation (observability/trace_propagation.py):
     # identity pass-through unless EDL_TRACE_DIR is set with a nonzero
     # sample rate. Inner of the fault interceptor on purpose: a
@@ -216,6 +314,10 @@ def build_server(max_workers: int = 64, instrument: bool = True) -> grpc.Server:
     )
 
     interceptors = tuple(interceptors) + fault_interceptors()
+    # deadline-budget adoption (ISSUE 19): a handler whose caller sent
+    # edl-deadline-budget metadata runs inside that remaining budget,
+    # so the server's own nested RPCs inherit the caller's clock
+    interceptors = interceptors + overload.server_budget_interceptors()
     return grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         # so_reuseport=0: every role here is one-process-per-port, and

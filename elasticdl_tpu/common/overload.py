@@ -1,0 +1,637 @@
+"""Overload discipline for the training plane (ISSUE 19): deadline
+budgets, retry budgets, circuit breakers, and server pushback.
+
+Four cooperating pieces, all inert until their knob is set or the
+caller opts in:
+
+- **Deadline-budget propagation.** A caller opens ``with budget(secs):``
+  and every nested RPC inherits the REMAINING wall-clock budget instead
+  of minting a fresh default timeout at each hop: the channel
+  interceptor (installed by ``build_channel``) caps each attempt's
+  ``timeout=`` by the remainder and carries it across the wire in an
+  ``edl-deadline-budget`` metadata header (the ``edl-traceparent``
+  pattern), and the server interceptor (installed by ``build_server``)
+  re-opens the budget around the handler so the server's own fan-outs
+  inherit it too. Budgets carry REMAINING SECONDS, never absolute
+  deadlines — peer wall clocks are not trusted (the incarnation-epoch
+  lesson). ``bind_budget`` re-homes the thread-local budget into
+  executor threads, the ``trace.bind_context`` twin.
+
+- **Retry budgets.** A per-target token bucket: successes earn
+  ``EDL_RETRY_BUDGET_RATIO`` tokens (default 0.1 — ~10% of successful
+  traffic may be retries), each retry attempt spends one. An exhausted
+  bucket fails fast (counted + journaled) instead of amplifying an
+  overloaded peer's load: with every client retrying each failure N
+  times, the peer sees N× its capacity exactly when it can least
+  afford it.
+
+- **Circuit breakers.** A closed/open/half-open breaker per
+  (target, method class). ``EDL_CIRCUIT_FAILURES`` consecutive
+  connection-shaped failures open it; after ``EDL_CIRCUIT_RESET_SECS``
+  ONE probe attempt is admitted (half-open) and its outcome closes or
+  re-opens the breaker. ``retry_call`` PACES on an open breaker —
+  waits out the probe window within its budget rather than hammering —
+  and only fails fast when the caller opted in (pulls with a brownout
+  fallback). Transitions are journaled (``circuit_open`` /
+  ``circuit_half_open`` / ``circuit_closed``) and gauged.
+
+- **Server pushback.** An overloaded server answers RESOURCE_EXHAUSTED
+  with an ``edl-retry-after-ms`` trailer (see ps/servicer.py admission
+  control); ``retry_after_hint`` reads it back and ``retry_call``
+  paces by the SERVER's hint instead of its own backoff schedule —
+  the server knows its backlog, the client doesn't.
+
+Everything here must stay cheap enough for the per-RPC path: state
+lookups are one dict get under a short lock, and the disabled paths
+(``EDL_DEADLINE_BUDGET=0``, breaker/budget never engaged because no
+``target=`` was passed) add nothing to the call.
+"""
+
+import collections
+import threading
+import time
+
+import grpc
+
+from elasticdl_tpu.common.env_utils import env_float, env_int, env_str
+from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import events
+from elasticdl_tpu.observability import metrics as obs_metrics
+
+logger = _logger_factory("elasticdl_tpu.common.overload")
+
+# remaining-seconds metadata header (metadata keys must be lowercase)
+METADATA_KEY = "edl-deadline-budget"
+# server pushback trailer: how long the client should wait before the
+# retry, in milliseconds (a trailer because it rides the error status)
+RETRY_AFTER_KEY = "edl-retry-after-ms"
+
+DEADLINE_BUDGET_ENV = "EDL_DEADLINE_BUDGET"
+RETRY_BUDGET_TOKENS_ENV = "EDL_RETRY_BUDGET_TOKENS"
+RETRY_BUDGET_RATIO_ENV = "EDL_RETRY_BUDGET_RATIO"
+CIRCUIT_FAILURES_ENV = "EDL_CIRCUIT_FAILURES"
+CIRCUIT_RESET_SECS_ENV = "EDL_CIRCUIT_RESET_SECS"
+# Brownout (ISSUE 19): consecutive overload-class push failures after
+# which the trainer skips the batch's push bit-exactly (PR 15's skip
+# machinery) instead of wedging the step loop. 0 (default) disables
+# the whole degraded mode — pulls then never fail fast into the stale-
+# cache path either, preserving pre-ISSUE-19 retry semantics exactly.
+BROWNOUT_SKIP_AFTER_ENV = "EDL_BROWNOUT_SKIP_AFTER"
+
+
+def brownout_skip_after():
+    return env_int(BROWNOUT_SKIP_AFTER_ENV, 0)
+
+
+def brownout_enabled():
+    return brownout_skip_after() > 0
+
+
+def circuit_reset_secs():
+    """The breaker's open->half-open window — also the deadline budget
+    a browned-out trainer grants each probe push (train/sparse.py)."""
+    return env_float(CIRCUIT_RESET_SECS_ENV, 5.0)
+
+_state = threading.local()
+_lock = threading.Lock()
+
+
+class OverloadError(grpc.RpcError):
+    """A locally-decided overload failure (no wire attempt was made).
+
+    Subclasses grpc.RpcError and answers code()/details() so every
+    existing ``except grpc.RpcError`` / ``e.code()`` handler treats it
+    exactly like the transport error it stands in for.
+    """
+
+    def __init__(self, code, details):
+        super().__init__(details)
+        self._code = code
+        self._details = details
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+
+class CircuitOpenError(OverloadError):
+    def __init__(self, target, kind):
+        super().__init__(
+            grpc.StatusCode.UNAVAILABLE,
+            "circuit open for %s/%s" % (target, kind),
+        )
+        self.target = target
+        self.kind = kind
+
+
+class RetryBudgetExhausted(OverloadError):
+    def __init__(self, target, code):
+        super().__init__(
+            code, "retry budget exhausted for %s" % target
+        )
+        self.target = target
+
+
+# overload-class status codes a brownout may absorb: the transport is
+# down (UNAVAILABLE, incl. CircuitOpenError), the budget ran out
+# mid-storm (DEADLINE_EXCEEDED, incl. RetryBudgetExhausted), or the PS
+# pushed back and stayed overloaded (RESOURCE_EXHAUSTED). Anything
+# else (bad request, server logic error) must still raise.
+BROWNOUT_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+)
+
+
+def is_overload_failure(exc):
+    """True when a brownout path may absorb ``exc``: any OverloadError,
+    or a transport error carrying an overload-class status. The second
+    arm matters because a retry loop that exhausts its deadline budget
+    re-raises the last RAW RpcError — when the breaker's reset window
+    is shorter than the retry backoff, every retry lands in a half-open
+    probe window and no CircuitOpenError is ever minted."""
+    if isinstance(exc, OverloadError):
+        return True
+    code = getattr(exc, "code", None)
+    return callable(code) and code() in BROWNOUT_CODES
+
+
+# ---------------------------------------------------------------------------
+# deadline budget (thread-local remaining wall clock)
+
+
+class budget:
+    """``with budget(secs):`` — cap every nested RPC in this thread by
+    the remaining wall clock. Nested budgets tighten, never loosen: the
+    inner scope's deadline is min(outer remainder, secs). Re-entrant
+    and exception-safe; ``secs=None`` is a no-op scope (callers can
+    pass an optional knob straight through)."""
+
+    def __init__(self, secs):
+        self._secs = secs
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_state, "deadline", None)
+        if self._secs is not None:
+            deadline = time.monotonic() + float(self._secs)
+            if self._prev is not None:
+                deadline = min(deadline, self._prev)
+            _state.deadline = deadline
+        return self
+
+    def __exit__(self, *exc):
+        _state.deadline = self._prev
+        return False
+
+
+def remaining():
+    """Seconds left in this thread's active budget, or None when no
+    budget is open. Floored at 0.0 — an expired budget reads as zero,
+    and the caller (retry_call, the interceptor) decides what zero
+    means (fail, not block-forever)."""
+    deadline = getattr(_state, "deadline", None)
+    if deadline is None:
+        return None
+    return max(0.0, deadline - time.monotonic())
+
+
+def rpc_timeout(default):
+    """The timeout an RPC attempt should carry: the caller's default
+    capped by the thread's remaining budget. THE budget helper the
+    ``ft-deadline-no-propagation`` lint rule expects at stub call sites
+    on propagated paths — a fresh literal there silently forgets the
+    caller's remaining time."""
+    rem = remaining()
+    if rem is None:
+        return default
+    return min(float(default), rem) if default is not None else rem
+
+
+def bind_budget(fn):
+    """Capture this thread's budget (if any) and reinstate it around
+    ``fn`` in whatever thread runs it — for executor fan-outs, which
+    lose thread-locals (the ``trace.bind_context`` twin). Without this
+    a worker's per-shard push pool would mint fresh default deadlines
+    while the caller's budget is nearly gone."""
+    deadline = getattr(_state, "deadline", None)
+    if deadline is None:
+        return fn
+
+    def bound(*args, **kwargs):
+        prev = getattr(_state, "deadline", None)
+        _state.deadline = (
+            deadline if prev is None else min(deadline, prev)
+        )
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _state.deadline = prev
+
+    return bound
+
+
+def propagation_enabled():
+    return env_str(DEADLINE_BUDGET_ENV, "") != "0"
+
+
+class _CallDetails(
+    collections.namedtuple(
+        "_CallDetails",
+        ("method", "timeout", "metadata", "credentials",
+         "wait_for_ready", "compression"),
+    ),
+    grpc.ClientCallDetails,
+):
+    pass
+
+
+class DeadlineBudgetClientInterceptor(
+    grpc.UnaryUnaryClientInterceptor
+):
+    """Cap each outgoing attempt's timeout by the thread's remaining
+    budget and carry the remainder to the peer as metadata. No active
+    budget = untouched call details (zero added work beyond one
+    thread-local read)."""
+
+    def intercept_unary_unary(self, continuation, client_call_details,
+                              request):
+        rem = remaining()
+        if rem is None:
+            return continuation(client_call_details, request)
+        timeout = client_call_details.timeout
+        timeout = rem if timeout is None else min(float(timeout), rem)
+        metadata = list(client_call_details.metadata or ())
+        metadata.append((METADATA_KEY, "%.3f" % rem))
+        details = _CallDetails(
+            method=client_call_details.method,
+            timeout=timeout,
+            metadata=metadata,
+            credentials=getattr(client_call_details, "credentials", None),
+            wait_for_ready=getattr(
+                client_call_details, "wait_for_ready", None
+            ),
+            compression=getattr(client_call_details, "compression", None),
+        )
+        return continuation(details, request)
+
+
+def intercept_budget_channel(channel):
+    """``build_channel`` seam: wrap with the budget interceptor unless
+    EDL_DEADLINE_BUDGET=0 — then the exact input channel is returned
+    (identity, test-asserted)."""
+    if not propagation_enabled():
+        return channel
+    return grpc.intercept_channel(
+        channel, DeadlineBudgetClientInterceptor()
+    )
+
+
+class _BudgetServerInterceptor(grpc.ServerInterceptor):
+    """Adopt an incoming ``edl-deadline-budget`` header as the handler
+    thread's budget, so the server's own nested RPCs (PS fan-outs,
+    router forwards) inherit the CALLER's remaining time."""
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+        secs = None
+        for key, value in handler_call_details.invocation_metadata or ():
+            if key == METADATA_KEY:
+                try:
+                    secs = float(value)
+                except ValueError:
+                    secs = None
+                break
+        if secs is None:
+            return handler
+        inner = handler.unary_unary
+
+        def budgeted(request, context):
+            with budget(secs):
+                return inner(request, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            budgeted,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
+def server_budget_interceptors():
+    """``build_server`` seam: () unless propagation is on."""
+    if not propagation_enabled():
+        return ()
+    return (_BudgetServerInterceptor(),)
+
+
+# ---------------------------------------------------------------------------
+# server pushback
+
+
+def retry_after_hint(rpc_error):
+    """Seconds the server asked this client to wait before retrying
+    (the ``edl-retry-after-ms`` trailer on a RESOURCE_EXHAUSTED
+    pushback), or None when the error carries no hint."""
+    trailing = getattr(rpc_error, "trailing_metadata", None)
+    if trailing is None:
+        return None
+    try:
+        metadata = trailing() or ()
+    except Exception:  # edlint: disable=ft-swallowed-except
+        # a half-constructed RpcError (test doubles, client-side
+        # aborts) has no trailers — no hint, not an error
+        return None
+    for entry in metadata:
+        key = getattr(entry, "key", None) or entry[0]
+        value = getattr(entry, "value", None) or entry[1]
+        if key == RETRY_AFTER_KEY:
+            try:
+                return max(0.0, float(value) / 1000.0)
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# retry budget (per-target token bucket)
+
+
+class RetryBudget:
+    """Token bucket bounding retry amplification toward one target.
+
+    Starts full (``max_tokens``); every retry attempt spends 1.0, every
+    success earns ``ratio`` (capped at full). ``spend`` at zero returns
+    False — the caller fails fast instead of joining the storm. The
+    ~ratio asymptotics are the point: in steady overload the bucket
+    drains and at most ``ratio`` retries ride per unit of successful
+    traffic, so client-side amplification is bounded at 1+ratio no
+    matter how long the brownout lasts.
+    """
+
+    def __init__(self, max_tokens=None, ratio=None):
+        self.max_tokens = float(
+            max_tokens if max_tokens is not None
+            else env_int(RETRY_BUDGET_TOKENS_ENV, 100)
+        )
+        self.ratio = float(
+            ratio if ratio is not None
+            else env_float(RETRY_BUDGET_RATIO_ENV, 0.1)
+        )
+        self._tokens = self.max_tokens
+        self._lock = threading.Lock()
+        self.exhausted = 0  # cumulative fail-fast decisions
+
+    def record_success(self):
+        with self._lock:
+            self._tokens = min(
+                self.max_tokens, self._tokens + self.ratio
+            )
+
+    def spend(self):
+        """Take one retry token; False = exhausted, fail fast."""
+        with self._lock:
+            if self._tokens < 1.0:
+                self.exhausted += 1
+                return False
+            self._tokens -= 1.0
+            return True
+
+    def tokens(self):
+        with self._lock:
+            return self._tokens
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (per target+method-class)
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+_STATE_VALUE = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}
+
+
+class CircuitBreaker:
+    """Closed/open/half-open breaker for one (target, method class).
+
+    ``admit_delay()`` is the client-side gate: 0.0 = attempt now
+    (closed, or this caller won the half-open probe slot), else seconds
+    until the next probe window. Connection-shaped failures
+    (UNAVAILABLE / DEADLINE_EXCEEDED) count toward opening; server
+    pushback (RESOURCE_EXHAUSTED) deliberately does NOT — a pushing-
+    back server is alive and managing load, and opening on it would
+    turn graceful degradation into an outage.
+    """
+
+    def __init__(self, target, kind, failures=None, reset_secs=None):
+        self.target = target
+        self.kind = kind
+        self.failure_threshold = (
+            failures if failures is not None
+            else env_int(CIRCUIT_FAILURES_ENV, 5)
+        )
+        self.reset_secs = (
+            reset_secs if reset_secs is not None
+            else env_float(CIRCUIT_RESET_SECS_ENV, 5.0)
+        )
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self.open_count = 0  # cumulative closed/half_open -> open
+
+    def state(self):
+        with self._lock:
+            return self._state
+
+    def admit_delay(self, now=None):
+        """0.0 = go; > 0 = seconds until this caller may probe."""
+        now = time.monotonic() if now is None else now
+        transition = None
+        with self._lock:
+            if self._state == CLOSED:
+                return 0.0
+            wait = self._opened_at + self.reset_secs - now
+            if wait > 0:
+                return wait
+            # probe window: admit exactly one caller; the rest keep
+            # pacing on the window so a closed->open flap never
+            # releases a thundering herd
+            if self._probe_inflight:
+                delay = self.reset_secs
+            else:
+                transition = self._transition_locked(HALF_OPEN)
+                self._probe_inflight = True
+                delay = 0.0
+        self._journal(transition)
+        return delay
+
+    def record_success(self, now=None):
+        transition = None
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_inflight = False
+            if self._state != CLOSED:
+                transition = self._transition_locked(CLOSED)
+        self._journal(transition)
+
+    def record_failure(self, now=None):
+        now = time.monotonic() if now is None else now
+        transition = None
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN:
+                # failed probe: back to open, restart the window
+                self._probe_inflight = False
+                self._opened_at = now
+                transition = self._transition_locked(OPEN)
+            elif (
+                self._state == CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._opened_at = now
+                transition = self._transition_locked(OPEN)
+        self._journal(transition)
+
+    def _transition_locked(self, state):
+        """Flip the state under the lock; returns the (prev, new,
+        failures) tuple the caller journals AFTER releasing it (the
+        journal write is file IO — never under a lock the RPC path
+        contends on)."""
+        prev, self._state = self._state, state
+        if state == OPEN:
+            self.open_count += 1
+        return (prev, state, self._consecutive_failures)
+
+    def _journal(self, transition):
+        if transition is None:
+            return
+        prev, state, failures = transition
+        _m_circuit_transitions.labels(state=state).inc()
+        _m_circuit_state.labels(
+            target=self.target, kind=self.kind
+        ).set(_STATE_VALUE[state])
+        logger.warning(
+            "circuit %s -> %s for %s/%s (failures=%d)",
+            prev, state, self.target, self.kind, failures,
+        )
+        if events.enabled():
+            events.emit(
+                "circuit_%s" % state, target=self.target,
+                method_class=self.kind, previous=prev,
+                consecutive_failures=failures,
+                reset_secs=self.reset_secs,
+            )
+
+
+# hoisted instruments (obs-hot-path: construction is init-scope work)
+_m_circuit_transitions = obs_metrics.counter(
+    "edl_circuit_transitions_total",
+    "Circuit-breaker state transitions", ("state",),
+)
+_m_circuit_state = obs_metrics.gauge(
+    "edl_circuit_state",
+    "Breaker state per target/method-class "
+    "(0 closed, 1 open, 2 half-open)",
+    ("target", "kind"),
+)
+_m_retry_budget_exhausted = obs_metrics.counter(
+    "edl_retry_budget_exhausted_total",
+    "Retries refused because the per-target token bucket ran dry",
+    ("target",),
+)
+_m_pushback_waits = obs_metrics.counter(
+    "edl_retry_pushback_waits_total",
+    "Retries paced by a server edl-retry-after-ms hint", ("target",),
+)
+
+# process-wide registries: breakers/budgets are per-TARGET state shared
+# by every stub talking to that target, so they live here, not on the
+# client object (two PSClient instances to the same shard must share
+# one breaker)
+_breakers = {}
+_retry_budgets = {}
+
+# process-wide degraded-mode tallies (worker telemetry reads these)
+_counters = {
+    "degraded_pulls": 0,
+    "brownout_skipped_pushes": 0,
+    "pushback_waits": 0,
+}
+
+
+def breaker_for(target, kind):
+    key = (target, kind)
+    with _lock:
+        breaker = _breakers.get(key)
+        if breaker is None:
+            breaker = _breakers[key] = CircuitBreaker(target, kind)
+        return breaker
+
+
+def retry_budget_for(target):
+    with _lock:
+        bucket = _retry_budgets.get(target)
+        if bucket is None:
+            bucket = _retry_budgets[target] = RetryBudget()
+        return bucket
+
+
+def method_class(what):
+    """Breakers are per method CLASS, not per method: every pull
+    variant shares one read-path breaker (they fail together) while
+    the non-idempotent push path gets its own."""
+    lowered = (what or "").lower()
+    if "pull" in lowered or "get" in lowered or "info" in lowered:
+        return "read"
+    return "write"
+
+
+def note_degraded_pull(count=1):
+    with _lock:
+        _counters["degraded_pulls"] += int(count)
+
+
+def note_brownout_skip():
+    with _lock:
+        _counters["brownout_skipped_pushes"] += 1
+
+
+def note_pushback_wait(target):
+    _m_pushback_waits.labels(target=target).inc()
+    with _lock:
+        _counters["pushback_waits"] += 1
+
+
+def note_budget_exhausted(target):
+    _m_retry_budget_exhausted.labels(target=target).inc()
+
+
+def client_stats():
+    """Cumulative overload tallies for this process's client side —
+    the worker's telemetry blob and /statusz read these."""
+    with _lock:
+        stats = dict(_counters)
+        breakers = list(_breakers.items())
+        budgets = list(_retry_budgets.values())
+    stats["circuit_open_count"] = sum(
+        b.open_count for _, b in breakers
+    )
+    stats["retry_budget_exhausted"] = sum(
+        b.exhausted for b in budgets
+    )
+    stats["circuits_not_closed"] = sorted(
+        "%s/%s" % key for key, b in breakers if b.state() != CLOSED
+    )
+    return stats
+
+
+def _reset_for_tests():
+    with _lock:
+        _breakers.clear()
+        _retry_budgets.clear()
+        for key in _counters:
+            _counters[key] = 0

@@ -32,8 +32,10 @@ import time
 
 import numpy as np
 
+from elasticdl_tpu.common import overload
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import normalize_id_tables
+from elasticdl_tpu.observability import events
 
 logger = _logger_factory("elasticdl_tpu.embedding.client")
 
@@ -135,6 +137,26 @@ class HotRowCache:
                 return np.zeros(unique.shape, dtype=bool), None
             return fresh, rows[pos_clipped[fresh]]
 
+    def lookup_any(self, name, unique):
+        """Relaxed-horizon read for brownout pulls (ISSUE 19): like
+        ``split``, but ANY cached id qualifies regardless of staleness
+        — while the PS breaker is open, a stale row beats the zeros
+        row the caller would otherwise substitute. Hit/miss tallies
+        are untouched (this is degraded service, not cache traffic).
+
+        Returns (found_mask [n] bool, rows [hits, dim] or None)."""
+        with self._lock:
+            entry = self._tables.get(name)
+            if entry is None:
+                return np.zeros(unique.shape, dtype=bool), None
+            ids, rows, _stamps = entry
+            pos = np.searchsorted(ids, unique)
+            pos_clipped = np.minimum(pos, max(ids.size - 1, 0))
+            found = (pos < ids.size) & (ids[pos_clipped] == unique)
+            if not found.any():
+                return found, None
+            return found, rows[pos_clipped[found]]
+
     def clear(self):
         """Invalidate every cached row (e.g. the PS they were pulled
         from relaunched); hit/miss tallies are kept. Also bumps the
@@ -224,6 +246,10 @@ class EmbeddingClient:
         # pull; created only if that path ever runs
         self._table_pool = None
         self._pool_lock = threading.Lock()
+        # last observed row dim per table: a brownout pull (ISSUE 19)
+        # must build zero rows for ids the cache never held, and the
+        # dim is otherwise only knowable from a PS response
+        self._dims = {}
 
     @property
     def ps_num(self):
@@ -269,6 +295,7 @@ class EmbeddingClient:
             dim = cached_rows.shape[1]
         else:
             dim = np.asarray(fetched).shape[1]
+        self._dims[name] = dim
         rows = np.empty((unique.size, dim), dtype=np.float32)
         if cached_rows is not None:
             rows[cached_mask] = cached_rows
@@ -278,6 +305,53 @@ class EmbeddingClient:
             rows[~cached_mask] = fetched
             self._cache.put(name, missing, fetched,
                             if_generation=generation)
+        return rows
+
+    def _degraded_fill(self, name, unique, cached_mask, cached_rows,
+                       error):
+        """Brownout pull (ISSUE 19): the PS breaker is open (or the
+        retry budget is dry), so instead of surfacing the failure,
+        serve bounded-staleness rows — fresh cache hits as usual,
+        stale cached rows past the horizon, zeros (the cold-init
+        stand-in) for ids the cache never held. The degraded rows are
+        NOT put back into the cache: they must die with this pull, not
+        launder themselves into fresh-looking entries. Re-raises when
+        the row dim is unknowable (nothing ever pulled for this
+        table)."""
+        missing = unique[~cached_mask]
+        if not missing.size and cached_rows is not None:
+            # fully served from fresh cache — nothing degraded here
+            return np.asarray(cached_rows, dtype=np.float32)
+        found, stale_rows = self._cache.lookup_any(name, missing)
+        if stale_rows is not None:
+            dim = stale_rows.shape[1]
+        elif cached_rows is not None:
+            dim = cached_rows.shape[1]
+        else:
+            dim = self._dims.get(name)
+        if dim is None:
+            raise error
+        rows = np.zeros((unique.size, dim), dtype=np.float32)
+        if cached_rows is not None:
+            rows[cached_mask] = cached_rows
+        filled = np.zeros(unique.shape, dtype=bool)
+        filled[cached_mask] = True
+        if stale_rows is not None:
+            stale_full = np.zeros((missing.size, dim), dtype=np.float32)
+            stale_full[found] = stale_rows
+            rows[~cached_mask] = stale_full
+        n_stale = int(found.sum()) if stale_rows is not None else 0
+        n_cold = int(missing.size) - n_stale
+        overload.note_degraded_pull()
+        logger.warning(
+            "degraded pull for table %s: %d stale cached rows, %d "
+            "cold-init zeros (%s)", name, n_stale, n_cold, error,
+        )
+        if events.enabled():
+            events.emit(
+                "degraded_pull", table=name, ids=int(unique.size),
+                stale=n_stale, cold=n_cold,
+            )
         return rows
 
     def pull(self, name, unique):
@@ -291,7 +365,19 @@ class EmbeddingClient:
         missing = unique[~cached_mask]
         fetched = None
         if missing.size:
-            fetched = self._ps.pull_embedding_vectors(name, missing)
+            try:
+                fetched = self._ps.pull_embedding_vectors(name, missing)
+            except Exception as e:
+                # overload-class only — a retry loop that burns its
+                # whole deadline budget re-raises the last RAW
+                # RpcError, not an OverloadError (see
+                # overload.is_overload_failure)
+                if not (overload.brownout_enabled()
+                        and overload.is_overload_failure(e)):
+                    raise
+                return self._degraded_fill(
+                    name, unique, cached_mask, cached_rows, e
+                )
         return self._assemble(name, unique, cached_mask, cached_rows,
                               fetched, generation=generation)
 
@@ -341,7 +427,21 @@ class EmbeddingClient:
             missing = unique[~cached_mask]
             if missing.size:
                 to_pull[name] = missing
-        fetched = batch_pull(to_pull) if to_pull else {}
+        try:
+            fetched = batch_pull(to_pull) if to_pull else {}
+        except Exception as e:
+            # same overload-class gate as pull(): budget exhaustion
+            # surfaces as the last raw RpcError, not an OverloadError
+            if not (overload.brownout_enabled()
+                    and overload.is_overload_failure(e)):
+                raise
+            return {
+                name: self._degraded_fill(
+                    name, unique, cache_parts[name][0],
+                    cache_parts[name][1], e,
+                )
+                for name, unique in ids_by_table.items()
+            }
         out = {}
         for name, unique in ids_by_table.items():
             cached_mask, cached_rows = cache_parts[name]

@@ -91,6 +91,8 @@ ALERT_KINDS = (
     "nonfinite_loss", "loss_spike", "grad_explosion", "label_shift",
     # device runtime (ISSUE 18)
     "recompile_storm", "hbm_pressure",
+    # overload plane (ISSUE 19)
+    "ps_overload", "circuit_open",
 )
 
 # worker-health cumulative counters watched for recent movement:
@@ -99,6 +101,16 @@ _HEALTH_COUNTER_ALERTS = (
     ("health_nonfinite_batches", "nonfinite_loss"),
     ("health_loss_spikes", "loss_spike"),
     ("health_grad_explosions", "grad_explosion"),
+)
+
+# overload-plane cumulative counters (ISSUE 19), same recency-movement
+# contract: ps_overload fires while a PS shard's admission rejections
+# are moving, circuit_open while a worker's breakers keep tripping —
+# both clear on their own once the counters go quiet for the window,
+# which is exactly the raise-AND-clear the overload drill asserts
+_OVERLOAD_COUNTER_ALERTS = (
+    ("ps_overload_rejections", "ps_overload"),
+    ("circuit_open_count", "circuit_open"),
 )
 
 
@@ -376,12 +388,30 @@ class FleetMonitor:
                 "cost_step_bytes": float(blob.cost_step_bytes),
                 "h2d_bytes": int(blob.h2d_bytes),
                 "d2h_bytes": int(blob.d2h_bytes),
+                # overload plane (ISSUE 19): PS admission pushback plus
+                # the client-side resilience counters — what the
+                # ps_overload / circuit_open detectors and the /statusz
+                # overload section read
+                "ps_overload_rejections": int(
+                    blob.ps_overload_rejections
+                ),
+                "ps_pending_applies": int(blob.ps_pending_applies),
+                "circuit_open_count": int(blob.circuit_open_count),
+                "degraded_pulls": int(blob.degraded_pulls),
+                "brownout_skipped_pushes": int(
+                    blob.brownout_skipped_pushes
+                ),
+                "retry_budget_exhausted": int(
+                    blob.retry_budget_exhausted
+                ),
             }
             # recency bookkeeping for the health-counter detectors: a
             # cumulative counter that moved since the last sighting
             # stamps "now" (a restarted worker resetting its counters
             # reads as no movement — harmless)
-            for blob_key, _kind in _HEALTH_COUNTER_ALERTS:
+            for blob_key, _kind in (
+                _HEALTH_COUNTER_ALERTS + _OVERLOAD_COUNTER_ALERTS
+            ):
                 value = state.blob[blob_key]
                 prev = state.health_marks.get(blob_key)
                 if prev is None:
@@ -718,6 +748,38 @@ class FleetMonitor:
                         "max_fraction": self._hbm_pressure_max,
                         "tier_hbm_bytes": state.blob["tier_hbm_bytes"],
                     }
+                # overload-plane detectors (ISSUE 19): a cumulative
+                # counter fires while its last observed movement is
+                # inside the recency window and clears after — a PS
+                # that stopped rejecting (or a worker whose breakers
+                # re-closed) goes quiet and the alert self-clears
+                for blob_key, kind in _OVERLOAD_COUNTER_ALERTS:
+                    mark = state.health_marks.get(blob_key)
+                    if mark is None:
+                        continue
+                    count, moved_at = mark
+                    if not (
+                        moved_at > 0
+                        and now - moved_at <= self._health_alert_secs
+                    ):
+                        continue
+                    detail = {
+                        "since": now,
+                        "count": count,
+                        "window_secs": self._health_alert_secs,
+                    }
+                    if kind == "ps_overload":
+                        detail["pending_applies"] = state.blob.get(
+                            "ps_pending_applies", 0
+                        )
+                    else:  # circuit_open
+                        detail["degraded_pulls"] = state.blob.get(
+                            "degraded_pulls", 0
+                        )
+                        detail["brownout_skipped_pushes"] = (
+                            state.blob.get("brownout_skipped_pushes", 0)
+                        )
+                    desired[(kind, wid)] = detail
         # label_shift (ISSUE 15): the most recent out-of-band stream
         # window is inside the recency window
         shift_ts = self._stream_health["shift_ts"]
@@ -884,6 +946,35 @@ class FleetMonitor:
                         "h2d_bytes", "d2h_bytes",
                     )
                 }
+            # overload section (ISSUE 19): PS admission pressure next
+            # to the clients' resilience posture — "is the training
+            # plane shedding or degrading" is one /statusz read
+            overload_ps = {}
+            overload_clients = {}
+            for wid, state in self._roles.items():
+                if state.blob is None:
+                    continue
+                if wid < 0:
+                    overload_ps[state.role] = {
+                        key: state.blob[key]
+                        for key in (
+                            "ps_overload_rejections",
+                            "ps_pending_applies",
+                        )
+                    }
+                else:
+                    overload_clients[state.role] = {
+                        key: state.blob[key]
+                        for key in (
+                            "circuit_open_count", "degraded_pulls",
+                            "brownout_skipped_pushes",
+                            "retry_budget_exhausted",
+                        )
+                    }
+            overload_view = {
+                "ps": overload_ps,
+                "clients": overload_clients,
+            }
         body = {
             "ts": now,
             "job": _env_str(events.JOB_NAME_ENV, ""),
@@ -893,6 +984,7 @@ class FleetMonitor:
             "alerts": firing,
             "health": health,
             "device": device,
+            "overload": overload_view,
             "thresholds": {
                 "straggler_factor": self._straggler_factor,
                 "dead_air_secs": self._dead_air_secs,

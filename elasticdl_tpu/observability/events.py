@@ -158,6 +158,31 @@ EVENT_TYPES = frozenset({
                              #   rows beyond EDL_HEALTH_ROW_NORM_MAX
                              #   (+ ps, rows, tables, norm_max; edge-
                              #   journaled per scan transition)
+    # overload plane (ISSUE 19)
+    "ps_overload_enter",     # PS apply backlog crossed
+                             #   EDL_PS_MAX_PENDING_APPLIES; admission
+                             #   now answers RESOURCE_EXHAUSTED with a
+                             #   retry-after hint (+ ps_id, depth,
+                             #   max_pending, method; edge-journaled)
+    "ps_overload_clear",     # backlog drained below the limit
+                             #   (+ ps_id, depth)
+    "circuit_open",          # per-(target, method-class) breaker
+                             #   tripped (+ target, method_class,
+                             #   previous, consecutive_failures,
+                             #   reset_secs)
+    "circuit_half_open",     # probe window opened: one trial RPC
+                             #   admitted (+ target, method_class)
+    "circuit_closed",        # probe succeeded; normal pacing resumed
+                             #   (+ target, method_class)
+    "degraded_pull",         # brownout: pull served bounded-staleness
+                             #   cached/cold-init rows instead of the
+                             #   open-circuited PS (+ table, rows,
+                             #   cached, cold)
+    "brownout_skipped_push",  # trainer dropped a batch's push after
+                             #   EDL_BROWNOUT_SKIP_AFTER consecutive
+                             #   failures (+ skipped, version)
+    "brownout_recovered",    # pushes landing again after a brownout
+                             #   skip streak (+ skipped, version)
     # device-runtime observability (ISSUE 18)
     "xla_recompile",         # a wrapped step fn compiled AGAIN — a new
                              #   argument signature after warmup

@@ -375,20 +375,27 @@ class ParameterServer:
                 self.servicer.table_health_scan()
             self.servicer.finish_checkpoints()
             return 0
-        # polls missed before concluding the master is gone for good:
-        # must comfortably cover a master pod relaunch + state-journal
+        # Grace before concluding the master is gone for good: must
+        # comfortably cover a master pod relaunch + state-journal
         # replay (ISSUE 4) — the old 3-strike rule (15 s) made every
-        # recoverable master restart take the whole PS fleet with it
-        gone_polls = env_int("EDL_PS_MASTER_GONE_POLLS", 18)
-        misses = 0
+        # recoverable master restart take the whole PS fleet with it.
+        # Seconds-based (ISSUE 19) so the grace survives poll-interval
+        # tuning; an explicit EDL_PS_MASTER_GONE_POLLS still wins for
+        # back-compat, converted at this run's poll cadence.
+        gone_secs = env_float("EDL_PS_MASTER_GONE_SECS", 90.0)
+        legacy_polls = env_int("EDL_PS_MASTER_GONE_POLLS", 0)
+        if legacy_polls > 0:
+            gone_secs = legacy_polls * poll_secs
+        gone_since = None
         while True:
             time.sleep(poll_secs)
             if self._term_flag:
                 return self._finish_term()
             info = self._master_client.get_comm_info()
             if info.mesh_epoch < 0:  # RPC failure marker
-                misses += 1
-                if misses >= gone_polls:
+                if gone_since is None:
+                    gone_since = time.time()
+                if time.time() - gone_since >= gone_secs:
                     logger.info("Master gone; PS exiting")
                     self.server.stop(grace=1.0)
                     self._cleanup_uds()
@@ -400,7 +407,7 @@ class ParameterServer:
                     events.flush()
                     return 0
             else:
-                misses = 0
+                gone_since = None
                 if stream_ckpt_every > 0:
                     self.servicer.maybe_stream_checkpoint(
                         getattr(info, "stream_watermark", 0),

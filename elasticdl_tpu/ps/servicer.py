@@ -21,8 +21,10 @@ import sys
 import threading
 import time
 
+import grpc
 import numpy as np
 
+from elasticdl_tpu.common import overload
 from elasticdl_tpu.common.env_utils import env_float, env_int
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import (
@@ -37,6 +39,7 @@ from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.observability import trace
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.testing import faults
 from elasticdl_tpu.ps.embedding_store import (
     BLOB_DTYPE_CODES,
     BLOB_ITEMSIZE,
@@ -50,6 +53,10 @@ logger = _logger_factory("elasticdl_tpu.ps.servicer")
 # parallel; the numpy store holds one store-wide lock (and the GIL),
 # so >1 here is wasted threads, not wrong results.
 APPLY_THREADS_ENV = "EDL_PS_APPLY_THREADS"
+# Admission control (ISSUE 19): apply-backlog depth past which the PS
+# answers push/pull RPCs with RESOURCE_EXHAUSTED + a retry-after hint
+# instead of queueing more work. 0 disables.
+MAX_PENDING_APPLIES_ENV = "EDL_PS_MAX_PENDING_APPLIES"
 
 # packed-id blobs are little-endian; the native fast paths read them
 # as host int64, so they are only taken on LE hosts
@@ -156,6 +163,23 @@ class PserverServicer:
                 max_workers=apply_threads,
                 thread_name_prefix="ps-apply",
             )
+        # Admission control (ISSUE 19): in-flight push handlers are
+        # counted under a small dedicated lock; past the knob the RPC
+        # boundary answers RESOURCE_EXHAUSTED + edl-retry-after-ms and
+        # the clients' pushback pacing takes over. _overloaded tracks
+        # the enter/clear EDGE for journaling (per-reject events would
+        # flood the journal in the exact moment it matters most).
+        self._max_pending = env_int(MAX_PENDING_APPLIES_ENV, 64)
+        self._pending_lock = threading.Lock()
+        self._pending_applies = 0
+        self._t_overload_rejections = 0
+        self._overloaded = False
+        # EWMA of admitted apply wall seconds: the retry-after hint is
+        # calibrated from this, so pushed-back clients poll at the pace
+        # slots ACTUALLY free instead of a fixed guess (a hint far
+        # below the real drain time makes every waiter poll-and-miss
+        # several times per admission — measured amplification)
+        self._apply_ewma_secs = 0.0
         # checkpoint version this PS auto-restored at boot, stamped on
         # push/pull responses (wire encoding: version + 1, 0 = none) so
         # workers detecting a version regression know what state the
@@ -228,6 +252,17 @@ class PserverServicer:
             "edl_ps_push_rejected_total",
             "Pushes rejected as stale (sync mode version check)",
         )
+        self._m_overload_rejected = obs_metrics.counter(
+            "edl_ps_overload_rejected_total",
+            "RPCs rejected by admission control (RESOURCE_EXHAUSTED + "
+            "retry-after pushback) once the apply backlog crossed "
+            "EDL_PS_MAX_PENDING_APPLIES, by method", ("method",),
+        )
+        obs_metrics.gauge(
+            "edl_ps_pending_applies",
+            "Admission-control depth: in-flight push handlers plus "
+            "round-buffer entries beyond one full sync round",
+        ).set_function(self._pending_depth)
         self._m_push_dropped_dead = obs_metrics.counter(
             "edl_ps_push_dropped_dead_incarnation_total",
             "Pushes dropped as a dead incarnation's delayed delivery "
@@ -394,6 +429,11 @@ class PserverServicer:
             ps_row_norm_p99=self._t_row_norm_p99,
             ps_dead_row_fraction=self._t_dead_row_fraction,
             ps_exploding_rows=self._t_exploding_rows,
+            # overload plane (ISSUE 19): cumulative admission rejects
+            # + the live backlog depth they key off, so the fleet's
+            # ps_overload detector sees pushback without scraping
+            ps_overload_rejections=self._t_overload_rejections,
+            ps_pending_applies=self._pending_depth(),
         )
         # embedding lifecycle health (ISSUE 12): admission/eviction
         # tallies + the resident-row gauge the bounded-memory contract
@@ -542,6 +582,7 @@ class PserverServicer:
         return blob
 
     def pull_embedding_vectors(self, request, context=None):
+        self._admit_or_abort(context, "pull_embedding_vectors")
         ids = unpack_ids(request)
         self._t_pull_count += 1
         # a request carrying repeated ids (no packed blob) is from a
@@ -560,6 +601,7 @@ class PserverServicer:
         response: per-table row blobs aligned with the request's id
         order). The legacy per-table pull_embedding_vectors stays
         served for old peers."""
+        self._admit_or_abort(context, "pull_embedding_batch")
         response = pb.PullEmbeddingBatchResponse(
             restored_version=self._restored_wire
         )
@@ -584,7 +626,99 @@ class PserverServicer:
         if payload:
             self._m_push_bytes.labels(dtype=dtype).inc(payload)
 
+    def _pending_depth(self):
+        """Admission-control depth: in-flight push handlers plus the
+        round buffer's overflow beyond one full sync round (a buffer
+        holding more than grads_to_wait entries means rounds are
+        arriving faster than they apply)."""
+        with self._pending_lock:
+            depth = self._pending_applies
+        return depth + max(0, self._buffered_count() - self._grads_to_wait)
+
+    def _admit_or_abort(self, context, method):
+        """Admission control (ISSUE 19): once the apply backlog crosses
+        EDL_PS_MAX_PENDING_APPLIES, answer with RESOURCE_EXHAUSTED plus
+        an ``edl-retry-after-ms`` trailer instead of queueing more work
+        — the clients' pushback pacing (common/overload.py) then
+        spreads retries at the server's own hint, which is what caps
+        retry amplification fleet-wide. In-process calls
+        (context=None) are never rejected: admission protects the RPC
+        boundary, not local test plumbing."""
+        if context is None or self._max_pending <= 0:
+            return
+        depth = self._pending_depth()
+        if depth < self._max_pending:
+            if self._overloaded:
+                self._overloaded = False
+                logger.warning(
+                    "PS %d overload cleared (depth %d < %d)",
+                    self._ps_id, depth, self._max_pending,
+                )
+                if events.enabled():
+                    events.emit(
+                        "ps_overload_clear", ps_id=self._ps_id,
+                        depth=depth,
+                    )
+            return
+        self._t_overload_rejections += 1
+        self._m_overload_rejected.labels(method=method).inc()
+        # hint = (how far past the limit) x (observed seconds per
+        # apply): the time until this caller's turn actually comes up,
+        # so a paced retry usually lands instead of poll-and-missing
+        # several times per freed slot. Floor 50ms before any apply has
+        # been timed; clamped so a hint never parks a client longer
+        # than a couple of seconds.
+        excess = max(1, depth - self._max_pending + 1)
+        apply_secs = self._apply_ewma_secs
+        retry_ms = int(min(2000, max(50, 1000.0 * apply_secs * excess)))
+        if not self._overloaded:
+            self._overloaded = True
+            logger.warning(
+                "PS %d overloaded: apply backlog %d >= %d, pushing "
+                "back (retry-after %dms)",
+                self._ps_id, depth, self._max_pending, retry_ms,
+            )
+            if events.enabled():
+                events.emit(
+                    "ps_overload_enter", ps_id=self._ps_id,
+                    depth=depth, max_pending=self._max_pending,
+                    method=method,
+                )
+        context.set_trailing_metadata(
+            ((overload.RETRY_AFTER_KEY, str(retry_ms)),)
+        )
+        context.abort(
+            grpc.StatusCode.RESOURCE_EXHAUSTED,
+            "apply backlog %d >= %d on ps-%d; retry after %dms"
+            % (depth, self._max_pending, self._ps_id, retry_ms),
+        )
+
     def push_gradients(self, request, context=None):
+        self._admit_or_abort(context, "push_gradients")
+        with self._pending_lock:
+            self._pending_applies += 1
+        started = time.monotonic()
+        try:
+            # the overload fault (testing/faults.py) lands HERE, inside
+            # an occupied admission slot, so injected latency builds
+            # the same backlog real slow applies would (and is timed
+            # into the hint calibration like real slowness)
+            injected = faults.apply_delay("push_gradients")
+            if injected:
+                time.sleep(injected)
+            return self._push_gradients_admitted(request)
+        finally:
+            elapsed = time.monotonic() - started
+            with self._pending_lock:
+                self._pending_applies -= 1
+                if self._apply_ewma_secs:
+                    self._apply_ewma_secs += 0.2 * (
+                        elapsed - self._apply_ewma_secs
+                    )
+                else:
+                    self._apply_ewma_secs = elapsed
+
+    def _push_gradients_admitted(self, request):
         self._t_push_count += 1
         self._t_last_push_version = request.gradients.version
         self._m_push_requests.inc()

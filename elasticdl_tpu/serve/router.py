@@ -40,6 +40,7 @@ import numpy as np
 from elasticdl_tpu.common.env_utils import env_int
 from elasticdl_tpu.common.hash_utils import stable_u64
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.common import overload
 from elasticdl_tpu.common.tensor_utils import blob_to_ndarray
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.observability import trace
@@ -319,7 +320,9 @@ class RouterServicer:
             if stub is None:
                 continue
             try:
-                info = stub.model_info(pb.Empty(), timeout=5.0)
+                info = stub.model_info(
+                    pb.Empty(), timeout=overload.rpc_timeout(5.0)
+                )
             except grpc.RpcError:
                 continue
             if fleet_cap > 0:

@@ -22,6 +22,21 @@ whose firing schedule is a pure function of the spec:
     - ``nan-batch`` / ``shape-churn``: data-plane faults applied at
       ``maybe_poison_batch`` call sites on the train-batch path, not
       at the gRPC boundary (see that function's docstring).
+    - ``overload`` (ISSUE 19): server-side APPLY-PATH latency,
+      consulted by the PS inside its gradient-apply path via
+      ``apply_delay`` — NOT an interceptor fault. The request is
+      already admitted when the latency lands, so pending-apply depth
+      genuinely builds and the admission-control/pushback machinery is
+      exercised for real instead of being handed a synthetic status
+      code. ``rate`` = seconds per apply; the 5th field (normally the
+      seed, unused here) optionally bounds the fault to the first N
+      matching calls — the "slow window, then recovery" shape the
+      overload bench drives.
+    - ``flap`` (ISSUE 19): periodic UNAVAILABLE windows — calls fail
+      in alternating windows of ``int(rate)`` calls (first window
+      fails), forever. The repeating fail/pass cadence is what drives
+      a circuit breaker through full open -> half-open -> closed
+      cycles, where a one-shot burst only exercises open.
 - ``rate``   — for unavailable/deadline: values >= 1 are a
   deterministic BURST (the first ``int(rate)`` matching calls fail,
   later ones pass — the "PS comes back after N retries" shape);
@@ -54,7 +69,7 @@ FAULT_SPEC_ENV = "EDL_FAULT_SPEC"
 
 KINDS = (
     "unavailable", "deadline", "delay", "kill-once", "nan-batch",
-    "shape-churn",
+    "shape-churn", "overload", "flap",
 )
 
 _role = ""
@@ -135,6 +150,25 @@ class FaultSpec:
                 if calls == nth and not self._fired_kill:
                     self._fired_kill = True
                     return "poison"
+                return None
+            if self.kind == "overload":
+                # server-side apply-path latency (ISSUE 19): consumed
+                # only by apply_delay, never by the interceptors. The
+                # seed field, meaningless for a non-random schedule,
+                # doubles as an optional call-count bound so a bench
+                # can script "slow for the first N applies, then
+                # healthy again" in one spec.
+                if self.seed > 0 and calls > self.seed:
+                    return None
+                return ("overload", self.rate)
+            if self.kind == "flap":
+                # periodic UNAVAILABLE windows of int(rate) calls,
+                # first window failing: calls 1..N fail, N+1..2N pass,
+                # and so on — deterministic, so breaker-cycle tests
+                # can assert exact transition counts
+                period = max(1, int(self.rate))
+                if ((calls - 1) // period) % 2 == 0:
+                    return "unavailable"
                 return None
             if self.kind == "shape-churn":
                 # deterministic shape fault (ISSUE 18): the first
@@ -242,8 +276,13 @@ class _FaultServerInterceptor(grpc.ServerInterceptor):
         if handler is None or not handler.unary_unary:
             return handler
         method = _bare_method(handler_call_details.method)
+        # overload specs are consumed exclusively by apply_delay inside
+        # the PS apply path; matching them here too would double-advance
+        # their schedule (and sleep in the handler, where no backlog
+        # can build)
         specs = [
-            s for s in self._specs if s.matches(current_role(), method)
+            s for s in self._specs
+            if s.kind != "overload" and s.matches(current_role(), method)
         ]
         if not specs:
             return handler
@@ -281,6 +320,8 @@ class _FaultClientInterceptor(grpc.UnaryUnaryClientInterceptor):
                               request):
         method = _bare_method(client_call_details.method)
         for spec in self._specs:
+            if spec.kind == "overload":
+                continue  # server-apply-path only; see apply_delay
             if not spec.matches(current_role(), method):
                 continue
             action = spec.fire()
@@ -297,20 +338,51 @@ class _FaultClientInterceptor(grpc.UnaryUnaryClientInterceptor):
 
 def server_interceptors():
     """() when EDL_FAULT_SPEC is unset — build_server's call path is
-    then byte-identical to an uninstrumented server."""
-    specs = _specs()
+    then byte-identical to an uninstrumented server. Overload specs
+    are apply-path faults (consumed by ``apply_delay``, never by an
+    interceptor): a spec set that is ALL overload builds no
+    interceptor either."""
+    specs = [s for s in _specs() if s.kind != "overload"]
     if not specs:
         return ()
     return (_FaultServerInterceptor(specs),)
 
 
 def intercept_client_channel(channel):
-    """The channel itself when EDL_FAULT_SPEC is unset; a fault-
-    intercepted wrapper otherwise."""
-    specs = _specs()
+    """The channel itself when EDL_FAULT_SPEC is unset (or all specs
+    are apply-path overload kinds); a fault-intercepted wrapper
+    otherwise."""
+    specs = [s for s in _specs() if s.kind != "overload"]
     if not specs:
         return channel
     return grpc.intercept_channel(channel, _FaultClientInterceptor(specs))
+
+
+def apply_delay(method="push_gradients"):
+    """Seconds of injected apply-path latency for one call — the
+    server-side ``overload`` kind (ISSUE 19).
+
+    Consulted by the PS INSIDE its gradient-apply path, after the
+    request has been admitted, rather than at the interceptor: the
+    latency then occupies a real apply slot, so pending-apply depth
+    genuinely builds and admission control rejects for the same reason
+    it would in production — backlog — not because a status code was
+    conjured at the boundary.
+
+    Provably inert unset: one ``_specs()`` cache check, returns 0.0."""
+    specs = _specs()
+    if not specs:
+        return 0.0
+    delay = 0.0
+    for spec in specs:
+        if spec.kind != "overload":
+            continue
+        if not spec.matches(current_role(), method):
+            continue
+        action = spec.fire()
+        if isinstance(action, tuple) and action[0] == "overload":
+            delay = max(delay, action[1])
+    return delay
 
 
 def _churn_batch(batch, drop_rows):

@@ -17,16 +17,19 @@ capacity so XLA compiles the step once.
 
 import concurrent.futures
 
+import grpc
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticdl_tpu.common import overload
 from elasticdl_tpu.common.annotations import hot_path
 from elasticdl_tpu.common.env_utils import env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 from elasticdl_tpu.common.tensor_utils import deduplicate_indexed_slices
 from elasticdl_tpu.data.pipeline import MASK_KEY
 from elasticdl_tpu.observability import device as device_obs
+from elasticdl_tpu.observability import events
 from elasticdl_tpu.observability import trace
 # HotRowCache lives in the extracted embedding-client library (ISSUE 8)
 # so the serving tier shares the training pull/cache stack; re-exported
@@ -706,6 +709,12 @@ class SparseTrainer:
         # observability: total sync-PS version rejections this trainer
         # has retried through (tests assert the race really raced)
         self.push_rejections = 0
+        # Brownout (ISSUE 19): consecutive overload-class push failures
+        # absorbed so far, and the lifetime count of pushes dropped —
+        # EDL_BROWNOUT_SKIP_AFTER=0 (default) keeps this machinery
+        # entirely out of the push path
+        self._brownout_streak = 0
+        self.brownout_skipped_pushes = 0
         # Async double-buffered push (ASYNC_PUSH_ENV): at most ONE push
         # in flight; train_step joins step N-1's push before submitting
         # step N's, so gradients land at most one step late — inside
@@ -926,6 +935,79 @@ class SparseTrainer:
             # read stale spillover rows)
             self.device_tier.close()
 
+    # overload-class failures a brownout may absorb — shared with the
+    # pull-side degraded fills (overload.is_overload_failure)
+    _BROWNOUT_CODES = overload.BROWNOUT_CODES
+
+    def _push_with_brownout(self, row_grads, pull_info, **kwargs):
+        """Gradient push with brownout degradation (ISSUE 19).
+
+        Disabled (EDL_BROWNOUT_SKIP_AFTER=0, the default): a straight
+        ``preparer.push_gradients`` — pre-ISSUE-19 semantics exactly.
+
+        Enabled: an overload-class push failure is ABSORBED — the
+        batch's push is dropped (counted + journaled), reusing the
+        health sentinels' bit-exact skip contract (the PS simply never
+        sees this batch; no partial state). Once the failure streak
+        reaches the threshold the trainer stops paying the full retry
+        budget per batch: each further push runs under a deadline
+        budget of one breaker reset window, so a still-down PS costs
+        seconds per batch, and the capped attempt doubles as the
+        recovery probe — its first success resets the streak and
+        restores normal pacing within the breaker's half-open window."""
+        skip_after = overload.brownout_skip_after()
+        if skip_after <= 0:
+            return self.preparer.push_gradients(
+                row_grads, pull_info, **kwargs
+            )
+        degraded = self._brownout_streak >= skip_after
+        try:
+            if degraded:
+                with overload.budget(overload.circuit_reset_secs()):
+                    result = self.preparer.push_gradients(
+                        row_grads, pull_info, **kwargs
+                    )
+            else:
+                result = self.preparer.push_gradients(
+                    row_grads, pull_info, **kwargs
+                )
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code not in self._BROWNOUT_CODES:
+                raise
+            self._brownout_streak += 1
+            self.brownout_skipped_pushes += 1
+            overload.note_brownout_skip()
+            logger.warning(
+                "brownout: dropping this batch's push (overload-class "
+                "failure %s, streak %d%s)",
+                code, self._brownout_streak,
+                ", degraded pacing" if degraded else "",
+            )
+            if events.enabled():
+                events.emit(
+                    "brownout_skipped_push",
+                    streak=self._brownout_streak,
+                    degraded=degraded,
+                    code=str(code),
+                )
+            # accepted=True at the trainer's CURRENT version: the push
+            # was never sent, so there is nothing to retry and no
+            # version to adopt
+            return True, self._version, ()
+        if self._brownout_streak:
+            logger.warning(
+                "brownout recovered: push landed after %d dropped "
+                "pushes", self._brownout_streak,
+            )
+            if events.enabled():
+                events.emit(
+                    "brownout_recovered",
+                    skipped=self._brownout_streak,
+                )
+            self._brownout_streak = 0
+        return result
+
     def _dispatch_train_step(self, state, prepared):
         """Run the jitted step (health-injection hook included);
         returns (state, loss, row_grads, health_scalars|None)."""
@@ -989,7 +1071,7 @@ class SparseTrainer:
             # ps_push / RPC-attempt spans children of the step that
             # produced the gradients, not orphans (ISSUE 9)
             self._push_future = self._async_pool.submit(
-                trace.bind_context(self.preparer.push_gradients),
+                trace.bind_context(self._push_with_brownout),
                 row_grads,
                 pull_info,
                 model_version=self._version,
@@ -998,7 +1080,7 @@ class SparseTrainer:
             )
             return state, loss
         with self.timing.timeit("sparse_push"):
-            accepted, version, rejected = self.preparer.push_gradients(
+            accepted, version, rejected = self._push_with_brownout(
                 row_grads,
                 pull_info,
                 model_version=self._version,

@@ -15,6 +15,7 @@ from typing import NamedTuple, Tuple
 import grpc
 import numpy as np
 
+from elasticdl_tpu.common import overload
 from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.common.grpc_utils import build_channel, retry_call
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
@@ -46,7 +47,8 @@ logger = _logger_factory("elasticdl_tpu.worker.ps_client")
 PS_RETRY_BUDGET_SECS = 120.0
 
 
-def _call_with_retry(fn, what, budget_secs=None, channel=None):
+def _call_with_retry(fn, what, budget_secs=None, channel=None,
+                     target=None, fail_fast_when_open=False):
     return retry_call(
         fn,
         "PS %s" % what,
@@ -55,6 +57,10 @@ def _call_with_retry(fn, what, budget_secs=None, channel=None):
         # (grpc_utils._await_reconnect) — fail-fast retries alone never
         # re-dial a TRANSIENT_FAILURE channel
         channel=channel,
+        # target arms the overload machinery (ISSUE 19): per-shard
+        # circuit breaker + retry budget + pushback pacing
+        target=target,
+        fail_fast_when_open=fail_fast_when_open,
     )
 
 
@@ -80,6 +86,7 @@ class PSClient:
     def __init__(self, ps_addrs, worker_id=None, incarnation=None):
         if isinstance(ps_addrs, str):
             ps_addrs = [a for a in ps_addrs.split(",") if a]
+        self._addrs = list(ps_addrs)
         self._channels = [
             instrument_channel(build_channel(a)) for a in ps_addrs
         ]
@@ -180,12 +187,16 @@ class PSClient:
             self._pool.map(
                 lambda pair: _call_with_retry(
                     lambda stub=pair[0]: stub.push_embedding_table_infos(
-                        request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                        request,
+                        timeout=overload.rpc_timeout(
+                            GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                        ),
                     ),
                     "push_embedding_table_infos",
                     channel=pair[1],
+                    target=pair[2],
                 ),
-                zip(self._stubs, self._channels),
+                zip(self._stubs, self._channels, self._addrs),
             )
         )
 
@@ -256,10 +267,14 @@ class PSClient:
                 # a healthy shard would ignore it
                 _call_with_retry(
                     lambda: self._stubs[shard].push_model(
-                        request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                        request,
+                        timeout=overload.rpc_timeout(
+                            GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                        ),
                     ),
                     "push_model (resync)",
                     channel=self._channels[shard],
+                    target=self._addrs[shard],
                 )
             except grpc.RpcError:
                 logger.warning("dense re-init to PS %d failed", shard)
@@ -281,7 +296,10 @@ class PSClient:
         list(
             self._pool.map(
                 lambda stub: stub.push_model(
-                    request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                    request,
+                    timeout=overload.rpc_timeout(
+                        GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                    ),
                 ),
                 self._stubs,
             )
@@ -291,7 +309,7 @@ class PSClient:
         """Returns (initialized, version, params) from PS 0."""
         response = self._stubs[0].pull_dense_parameters(
             pb.PullDenseParametersRequest(version=version),
-            timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS,
+            timeout=overload.rpc_timeout(GRPC.DEFAULT_RPC_TIMEOUT_SECS),
         )
         params = {
             name: blob_to_ndarray(blob)
@@ -318,14 +336,20 @@ class PSClient:
         ids = np.asarray(ids, dtype=np.int64)
         if ids.size == 0:
             return np.empty((0, 0), dtype=np.float32)
+        fail_fast = overload.brownout_enabled()
         if self.ps_num == 1:
             request = self._pull_request(name, ids)
             blob = _call_with_retry(
                 lambda: self._stubs[0].pull_embedding_vectors(
-                    request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                    request,
+                    timeout=overload.rpc_timeout(
+                        GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                    ),
                 ),
                 "pull_embedding_vectors",
                 channel=self._channels[0],
+                target=self._addrs[0],
+                fail_fast_when_open=fail_fast,
             )
             return _rows_f32(blob_to_ndarray(blob))
         shard_of = ids % self.ps_num
@@ -333,8 +357,11 @@ class PSClient:
         positions = {}
         # bind_context: the per-shard futures run on pool threads; the
         # step's span context must ride along or the propagation
-        # interceptor has nothing to serialize (ISSUE 9)
-        call = trace.bind_context(_call_with_retry)
+        # interceptor has nothing to serialize (ISSUE 9). bind_budget
+        # (ISSUE 19): any caller deadline budget rides along the same
+        # way — the fan-out inherits the REMAINING budget instead of
+        # minting a fresh default timeout per shard.
+        call = overload.bind_budget(trace.bind_context(_call_with_retry))
         for shard in np.unique(shard_of):
             pos = np.nonzero(shard_of == shard)[0]
             positions[int(shard)] = pos
@@ -344,10 +371,15 @@ class PSClient:
                 call,
                 lambda stub=stub, request=request:
                     stub.pull_embedding_vectors(
-                        request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                        request,
+                        timeout=overload.rpc_timeout(
+                            GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                        ),
                     ),
                 "pull_embedding_vectors",
                 channel=self._channels[int(shard)],
+                target=self._addrs[int(shard)],
+                fail_fast_when_open=fail_fast,
             )
         dim = None
         rows = None
@@ -380,7 +412,12 @@ class PSClient:
                 max_workers=max(4, len(ids_by_table)),
                 thread_name_prefix="ps-table-pull",
             )
-        pull = trace.bind_context(self._pull_embedding_vectors)
+        # bind_budget: the legacy fallback is a NESTED fan-out (table
+        # tasks spawn per-shard tasks) — each layer must inherit the
+        # remaining caller budget, not restart it (ISSUE 19)
+        pull = overload.bind_budget(
+            trace.bind_context(self._pull_embedding_vectors)
+        )
         futures = {
             name: self._table_pool.submit(pull, name, ids)
             for name, ids in ids_by_table.items()
@@ -408,7 +445,8 @@ class PSClient:
                     ids[pos]
                 )
         futures = {}
-        call = trace.bind_context(_call_with_retry)
+        call = overload.bind_budget(trace.bind_context(_call_with_retry))
+        fail_fast = overload.brownout_enabled()
         for shard, request in enumerate(requests):
             if not request.tables:
                 continue
@@ -417,10 +455,15 @@ class PSClient:
                 call,
                 lambda stub=stub, request=request:
                     stub.pull_embedding_batch(
-                        request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                        request,
+                        timeout=overload.rpc_timeout(
+                            GRPC.DEFAULT_RPC_TIMEOUT_SECS
+                        ),
                     ),
                 "pull_embedding_batch",
                 channel=self._channels[shard],
+                target=self._addrs[shard],
+                fail_fast_when_open=fail_fast,
             )
         out = {}
         try:
@@ -491,7 +534,7 @@ class PSClient:
                     packed=not self._legacy_ids,
                 )
         futures = []
-        call = trace.bind_context(_call_with_retry)
+        call = overload.bind_budget(trace.bind_context(_call_with_retry))
         for shard, (stub, request) in enumerate(
             zip(self._stubs, requests)
         ):
@@ -501,10 +544,12 @@ class PSClient:
                 call,
                 lambda stub=stub, request=request:
                     stub.push_embedding_rows(
-                        request, timeout=PS_RETRY_BUDGET_SECS
+                        request,
+                        timeout=overload.rpc_timeout(PS_RETRY_BUDGET_SECS),
                     ),
                 "push_embedding_rows",
                 channel=self._channels[shard],
+                target=self._addrs[shard],
             )))
         for shard, future in futures:
             response = future.result()
@@ -597,7 +642,7 @@ class PSClient:
                     packed=not self._legacy_ids,
                 )
         futures = []
-        call = trace.bind_context(_call_with_retry)
+        call = overload.bind_budget(trace.bind_context(_call_with_retry))
         for shard, (stub, request) in enumerate(zip(self._stubs, per_ps)):
             if not request.gradients.embedding_tables and not force_empty:
                 continue
@@ -620,10 +665,14 @@ class PSClient:
                     call,
                     lambda stub=stub, request=request:
                         stub.push_gradients(
-                            request, timeout=PS_RETRY_BUDGET_SECS
+                            request,
+                            timeout=overload.rpc_timeout(
+                                PS_RETRY_BUDGET_SECS
+                            ),
                         ),
                     "push_gradients",
                     channel=self._channels[shard],
+                    target=self._addrs[shard],
                 ))
             )
         # empty push (e.g. fully masked batch): version must pass

@@ -17,6 +17,7 @@ import time
 
 import numpy as np
 
+from elasticdl_tpu.common import overload
 from elasticdl_tpu.common.constants import Mode
 from elasticdl_tpu.common.env_utils import env_float, env_str
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
@@ -463,6 +464,16 @@ class Worker:
             )
             if tier is not None:
                 blob.tier_hbm_bytes = tier.hbm_bytes()
+        # overload plane (ISSUE 19): this process's circuit-breaker /
+        # retry-budget / brownout tallies, feeding the master's
+        # circuit_open detector and the /statusz overload section
+        ostats = overload.client_stats()
+        blob.circuit_open_count = ostats["circuit_open_count"]
+        blob.degraded_pulls = ostats["degraded_pulls"]
+        blob.retry_budget_exhausted = ostats["retry_budget_exhausted"]
+        blob.brownout_skipped_pushes = getattr(
+            self.trainer, "brownout_skipped_pushes", 0
+        )
         return blob
 
     def _update_step_telemetry(self, real_count):

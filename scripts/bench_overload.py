@@ -1,0 +1,486 @@
+"""Overload-resilience drill for the training plane (ISSUE 19 gates).
+
+Three real PS processes (plus one flapping one) run the SAME seeded
+push workload; the drills measure what the overload machinery
+(common/overload.py + grpc_utils.retry_call + ps/servicer admission
+control) actually buys:
+
+- PROTECTED: workers push through ``retry_call(target=...)`` against a
+  PS whose applies are slow for the first ``--slow-secs`` (the
+  ``overload`` fault kind) and whose admission boundary pushes back at
+  ``--max-pending`` in-flight applies. Attempts per logical push
+  during the slow window is the ATTEMPT AMPLIFICATION; the hard gate
+  is ``<= --max-amplification`` (default 2x).
+- BASELINE: the same workload storms an identically-faulted PS with
+  the naive loop this layer replaces — retry immediately on any
+  failure, ignore the server's retry-after hint. Reported next to the
+  protected number; this is the amplification an unprotected fleet
+  would inflict.
+- CLEAN: the same workload against a fault-free PS. Because every
+  worker owns a disjoint id range (per-row update order is then
+  deterministic regardless of thread interleaving) and tables
+  zero-init, the protected PS's post-recovery state must be BIT-EQUAL
+  to this run's — the zero-lost-updates gate: admission rejects happen
+  before apply, so a retried push is never double-applied.
+- RECOVERY: pushes against a PS failing in call-count windows (the
+  ``flap`` fault kind) must open the circuit breaker and re-close it
+  via half-open probes; the gap between the last failed probe and the
+  first success must fit inside the journaled probe window
+  (``--reset-secs`` + ``--recovery-slack``).
+
+Prints ONE JSON line; exit 1 on any gate failure unless
+``--report-only``. PS startup dominates the short configurations — CI
+runs this report-only with reduced ``--slow-secs``.
+"""
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from elasticdl_tpu.common import overload  # noqa: E402
+from elasticdl_tpu.common.grpc_utils import (  # noqa: E402
+    build_channel,
+    find_free_port,
+    retry_call,
+)
+from elasticdl_tpu.common.tensor_utils import (  # noqa: E402
+    deduplicate_indexed_slices,
+    pack_ids,
+    serialize_indexed_slices,
+)
+from elasticdl_tpu.observability import events  # noqa: E402
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb  # noqa: E402
+from elasticdl_tpu.proto.services import PserverStub  # noqa: E402
+
+import grpc  # noqa: E402
+
+TABLE = "emb"
+CIRCUIT_FAILURES = 3
+FLAP_WINDOW_CALLS = 5   # calls 1-5 fail, 6-10 pass, ...
+FLAP_PUSHES = 4         # stays inside the first passing window
+
+_STORM_RETRY = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+)
+
+
+def start_ps(port, seed, extra_env):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **extra_env}
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "elasticdl_tpu.ps.server",
+            "--ps_id", "0", "--num_ps_pods", "1", "--port", str(port),
+            "--opt_type", "adam", "--opt_args", "lr=0.01",
+            "--use_async", "1", "--seed", str(seed),
+        ],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def wait_port(port, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port))
+            return
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    raise TimeoutError("ps on port %d never came up" % port)
+
+
+def make_workload(threads, pushes, rows, dim):
+    """Per-thread push sequences over DISJOINT id ranges: per-row
+    update order is then each owner thread's serial order, so the
+    final store state is independent of cross-thread interleaving —
+    the property the bit-equality gate rests on."""
+    work = []
+    for t in range(threads):
+        rng = np.random.RandomState(7000 + t)
+        base = t * 10_000_000
+        seq = []
+        for _ in range(pushes):
+            ids = base + rng.randint(0, 2048, size=rows).astype(np.int64)
+            grads = rng.randn(rows, dim).astype(np.float32)
+            values, ids = deduplicate_indexed_slices(grads, ids)
+            seq.append((ids, values))
+        work.append(seq)
+    return work
+
+
+def push_request(ids, values):
+    request = pb.PushGradientsRequest()
+    request.gradients.version = 0
+    serialize_indexed_slices(
+        values, ids, request.gradients.embedding_tables[TABLE],
+        packed=True,
+    )
+    return request
+
+
+def create_table(stub, dim):
+    request = pb.Model()
+    # zeros: row init must not depend on first-touch order (a
+    # sequential RNG stream would break cross-run bit-equality)
+    request.embedding_table_infos.add(
+        name=TABLE, dim=dim, initializer="zeros"
+    )
+    stub.push_embedding_table_infos(request, timeout=60)
+
+
+def run_protected(addr, work, dim, budget_secs=300.0):
+    channel = build_channel(addr)
+    stub = PserverStub(channel)
+    create_table(stub, dim)
+    records = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(len(work))
+
+    def runner(seq):
+        barrier.wait()
+        for ids, values in seq:
+            request = push_request(ids, values)
+            rec = {"start": time.monotonic(), "attempts": 0}
+
+            def attempt(request=request, rec=rec):
+                rec["attempts"] += 1
+                return stub.push_gradients(
+                    request, timeout=overload.rpc_timeout(60.0)
+                )
+
+            retry_call(
+                attempt, "bench push", budget_secs=budget_secs,
+                channel=channel, target=addr,
+            )
+            with lock:
+                records.append(rec)
+
+    threads = [
+        threading.Thread(target=runner, args=(seq,)) for seq in work
+    ]
+    start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return records, start, time.monotonic() - start, channel
+
+
+def run_baseline(addr, work, dim, window_secs):
+    """The unbounded-retry client the overload plane replaces: retry
+    every failure immediately-ish, ignore the server's pacing hint.
+    Runs for the slow window only — it measures amplification, not
+    completion."""
+    channel = build_channel(addr)
+    stub = PserverStub(channel)
+    create_table(stub, dim)
+    counts = {"attempts": 0, "successes": 0}
+    lock = threading.Lock()
+    barrier = threading.Barrier(len(work))
+    box = {}
+
+    def runner(seq):
+        barrier.wait()
+        stop_at = box["stop_at"]
+        i = 0
+        attempts = successes = 0
+        while time.monotonic() < stop_at:
+            ids, values = seq[i % len(seq)]
+            request = push_request(ids, values)
+            while time.monotonic() < stop_at:
+                attempts += 1
+                try:
+                    stub.push_gradients(request, timeout=60)
+                    successes += 1
+                    i += 1
+                    break
+                except grpc.RpcError as e:
+                    if e.code() not in _STORM_RETRY:
+                        raise
+                    time.sleep(0.01)
+        with lock:
+            counts["attempts"] += attempts
+            counts["successes"] += successes
+
+    threads = [
+        threading.Thread(target=runner, args=(seq,)) for seq in work
+    ]
+    box["stop_at"] = time.monotonic() + window_secs
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    channel.close()
+    return counts
+
+
+def run_recovery(addr, seq, dim, reset_secs):
+    """Serial pushes against the flapping PS: the first push rides
+    through breaker open -> half-open probes -> close; the follow-ups
+    land in the passing window."""
+    channel = build_channel(addr)
+    stub = PserverStub(channel)
+    create_table(stub, dim)
+    timeline = []
+
+    for ids, values in seq[:FLAP_PUSHES]:
+        request = push_request(ids, values)
+
+        def attempt(request=request):
+            try:
+                response = stub.push_gradients(
+                    request, timeout=overload.rpc_timeout(60.0)
+                )
+            except grpc.RpcError:
+                timeline.append((time.monotonic(), False))
+                raise
+            timeline.append((time.monotonic(), True))
+            return response
+
+        retry_call(
+            attempt, "bench push", budget_secs=60.0, channel=channel,
+            # keep jitter draws below the probe window so the measured
+            # recovery is the breaker's pacing, not backoff noise
+            base_delay=0.2, max_delay=0.25, target=addr,
+        )
+    channel.close()
+
+    failures = [t for t, ok in timeline if not ok]
+    successes = [t for t, ok in timeline if ok]
+    recovery = None
+    if failures:
+        after = [t for t in successes if t > failures[-1]]
+        if after:
+            recovery = after[0] - failures[-1]
+    breaker = overload.breaker_for(addr, "write")
+    return {
+        "attempts": len(timeline),
+        "failed_attempts": len(failures),
+        "recovery_secs": None if recovery is None else round(recovery, 3),
+        "breaker_open_count": breaker.open_count,
+        "breaker_final_state": breaker.state(),
+    }
+
+
+def pull_state(stub, work):
+    """Every pushed row, pulled per owner thread; returns the raw wire
+    bytes for bitwise comparison."""
+    blobs = []
+    for seq in work:
+        ids = np.unique(np.concatenate([ids for ids, _ in seq]))
+        request = pb.PullEmbeddingVectorsRequest(
+            name=TABLE, ids_blob=pack_ids(ids)
+        )
+        blob = stub.pull_embedding_vectors(request, timeout=120)
+        blobs.append((blob.dtype, blob.content))
+    return blobs
+
+
+def journal_counts(events_dir):
+    counts = {}
+    for fname in os.listdir(events_dir):
+        if not fname.endswith(".events.ndjson"):
+            continue
+        with open(os.path.join(events_dir, fname)) as f:
+            for line in f:
+                try:
+                    event = json.loads(line).get("event")
+                except ValueError:
+                    continue
+                counts[event] = counts.get(event, 0) + 1
+    return counts
+
+
+def main():
+    parser = argparse.ArgumentParser(__doc__)
+    parser.add_argument("--threads", type=int, default=6)
+    parser.add_argument("--pushes", type=int, default=20,
+                        help="logical pushes per thread")
+    parser.add_argument("--rows", type=int, default=256)
+    parser.add_argument("--dim", type=int, default=8)
+    parser.add_argument("--slow-secs", type=float, default=10.0,
+                        help="target wall length of the slow-apply "
+                             "window")
+    parser.add_argument("--apply-lat", type=float, default=0.5,
+                        help="injected seconds per apply in the window")
+    parser.add_argument("--max-pending", type=float, default=4,
+                        help="EDL_PS_MAX_PENDING_APPLIES on the "
+                             "faulted PS processes")
+    parser.add_argument("--reset-secs", type=float, default=1.0,
+                        help="EDL_CIRCUIT_RESET_SECS for the recovery "
+                             "drill")
+    parser.add_argument("--max-amplification", type=float, default=2.0,
+                        help="hard ceiling on protected attempts per "
+                             "push in the slow window (0 disables)")
+    parser.add_argument("--recovery-slack", type=float, default=1.0,
+                        help="allowed recovery beyond the probe window")
+    parser.add_argument("--report-only", action="store_true",
+                        help="print the report but never exit nonzero")
+    args = parser.parse_args()
+
+    max_pending = int(args.max_pending)
+    # client-side knobs, set before any breaker/bucket is built. The
+    # retry-token bucket is provisioned out of the way: pushback
+    # retries spend tokens, and THIS drill measures pacing and
+    # exactly-once, not budget exhaustion (tests/test_grpc_utils.py
+    # covers that edge directly).
+    os.environ["EDL_CIRCUIT_FAILURES"] = str(CIRCUIT_FAILURES)
+    os.environ["EDL_CIRCUIT_RESET_SECS"] = "%g" % args.reset_secs
+    os.environ["EDL_RETRY_BUDGET_TOKENS"] = "100000"
+    events_dir = tempfile.mkdtemp(prefix="bench_overload_events_")
+    os.environ["EDL_EVENTS_DIR"] = events_dir
+    events.configure("bench-overload")
+
+    # the slow window is expressed in admitted-apply counts: with
+    # max_pending applies in flight at apply_lat each, `bound` slow
+    # applies take ~slow_secs of wall clock under saturation
+    bound = max(1, int(args.slow_secs * max_pending / args.apply_lat))
+    overload_spec = "ps-0:push_gradients:overload:%g:%d" % (
+        args.apply_lat, bound
+    )
+    flap_spec = "ps-0:push_gradients:flap:%d" % FLAP_WINDOW_CALLS
+    faulted_env = {
+        "EDL_FAULT_SPEC": overload_spec,
+        "EDL_PS_MAX_PENDING_APPLIES": str(max_pending),
+    }
+    ports = {name: find_free_port() for name in
+             ("protected", "baseline", "clean", "flap")}
+    procs = {
+        "protected": start_ps(ports["protected"], 7, faulted_env),
+        "baseline": start_ps(ports["baseline"], 7, faulted_env),
+        "clean": start_ps(ports["clean"], 7, {
+            "EDL_FAULT_SPEC": "",
+            "EDL_PS_MAX_PENDING_APPLIES": str(max_pending),
+        }),
+        "flap": start_ps(ports["flap"], 7, {
+            "EDL_FAULT_SPEC": flap_spec,
+        }),
+    }
+    addr = {name: "localhost:%d" % port for name, port in ports.items()}
+
+    work = make_workload(args.threads, args.pushes, args.rows, args.dim)
+    try:
+        for port in ports.values():
+            wait_port(port)
+
+        stats_before = overload.client_stats()
+        records, start, protected_secs, protected_channel = run_protected(
+            addr["protected"], work, args.dim
+        )
+        stats_after = overload.client_stats()
+
+        window = [r for r in records
+                  if r["start"] - start < args.slow_secs] or records
+        window_attempts = sum(r["attempts"] for r in window)
+        window_amp = window_attempts / float(len(window))
+        overall_amp = (
+            sum(r["attempts"] for r in records) / float(len(records))
+        )
+
+        baseline = run_baseline(
+            addr["baseline"], work, args.dim, args.slow_secs
+        )
+        baseline_amp = (
+            baseline["attempts"] / float(baseline["successes"])
+            if baseline["successes"] else None
+        )
+
+        _, _, clean_secs, clean_channel = run_protected(
+            addr["clean"], work, args.dim
+        )
+        protected_state = pull_state(PserverStub(protected_channel), work)
+        clean_state = pull_state(PserverStub(clean_channel), work)
+        bit_equal = protected_state == clean_state
+        protected_channel.close()
+        clean_channel.close()
+
+        recovery = run_recovery(
+            addr["flap"], work[0], args.dim, args.reset_secs
+        )
+    finally:
+        for proc in procs.values():
+            proc.terminate()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    journal = journal_counts(events_dir)
+    gates = {
+        "attempt_amplification": (
+            args.max_amplification <= 0
+            or window_amp <= args.max_amplification
+        ),
+        "zero_lost_updates": bit_equal,
+        "recovery_in_probe_window": (
+            recovery["recovery_secs"] is not None
+            and recovery["recovery_secs"]
+            <= args.reset_secs + args.recovery_slack
+            and recovery["breaker_final_state"] == overload.CLOSED
+            and recovery["breaker_open_count"] >= 1
+        ),
+    }
+    out = {
+        "threads": args.threads,
+        "pushes_per_thread": args.pushes,
+        "rows": args.rows,
+        "dim": args.dim,
+        "slow_secs": args.slow_secs,
+        "apply_lat": args.apply_lat,
+        "max_pending": max_pending,
+        "protected": {
+            "elapsed_secs": round(protected_secs, 2),
+            "window_pushes": len(window),
+            "window_attempts": window_attempts,
+            "window_amplification": round(window_amp, 3),
+            "overall_amplification": round(overall_amp, 3),
+            "pushback_waits": (
+                stats_after["pushback_waits"]
+                - stats_before["pushback_waits"]
+            ),
+            "retry_budget_exhausted": (
+                stats_after["retry_budget_exhausted"]
+                - stats_before["retry_budget_exhausted"]
+            ),
+        },
+        "baseline": {
+            "window_attempts": baseline["attempts"],
+            "window_successes": baseline["successes"],
+            "amplification": (
+                None if baseline_amp is None else round(baseline_amp, 2)
+            ),
+        },
+        "clean_elapsed_secs": round(clean_secs, 2),
+        "state_bit_equal": bit_equal,
+        "recovery": dict(recovery, reset_secs=args.reset_secs),
+        "journal": {k: journal.get(k, 0) for k in (
+            "circuit_open", "circuit_half_open", "circuit_closed",
+            "ps_overload_enter", "ps_overload_clear",
+        )},
+        "gates": gates,
+    }
+    print(json.dumps(out))
+    if not all(gates.values()) and not args.report_only:
+        print("FAIL: gates %s" % {k: v for k, v in gates.items() if not v},
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

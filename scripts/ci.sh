@@ -803,6 +803,213 @@ grep -q "master_restarted" /tmp/_chaos_pm.out
 grep -q "task_dispatch" /tmp/_chaos_pm.out
 grep -q "worker_register" /tmp/_chaos_pm.out
 
+echo "== tier 1e (overload): PS pushback + breaker drill on live /alerts =="
+# ISSUE 19: a live master+PS+worker deepfm job while a noise-gradient
+# storm saturates the PS's single admission slot through a bounded
+# slow-apply window (the `overload` fault kind). The ps_overload and
+# circuit_open alerts must RAISE on the live /alerts while the storm
+# runs and CLEAR after it stops — with the job still running; the
+# worker-side breaker must open on an injected UNAVAILABLE burst and
+# end the run re-closed; pushback must show in the PS admission books
+# (/statusz overload section) and the client pacing books; and the job
+# itself must complete rc 0 — degraded, never failed.
+OVLD_DIR="$(mktemp -d)"
+export OVLD_DIR
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, os, socket, subprocess, sys, tempfile, threading, time, urllib.request
+sys.path.insert(0, "tests")
+events_dir = os.path.join(os.environ["OVLD_DIR"], "events")
+os.makedirs(events_dir)
+os.environ["EDL_EVENTS_DIR"] = events_dir
+# short recency window so raise AND clear both land inside one job
+os.environ["EDL_HEALTH_ALERT_SECS"] = "5"
+# a 2-failure breaker with a quick probe window
+os.environ["EDL_CIRCUIT_FAILURES"] = "2"
+os.environ["EDL_CIRCUIT_RESET_SECS"] = "0.5"
+# the drill measures alerts and pacing, not token accounting (that
+# edge is unit-tested): keep the bucket out of the way
+os.environ["EDL_RETRY_BUDGET_TOKENS"] = "1000"
+# client-side burst: the first 6 pushes out of this process fail
+# UNAVAILABLE — the breaker must open, probe, and re-close
+os.environ["EDL_FAULT_SPEC"] = "worker-0:push_gradients:unavailable:6"
+
+import numpy as np
+from test_utils import create_ctr_recordio
+from elasticdl_tpu.common import overload
+from elasticdl_tpu.common.grpc_utils import (
+    build_channel, find_free_port, retry_call,
+)
+from elasticdl_tpu.common.tensor_utils import serialize_indexed_slices
+from elasticdl_tpu.observability import events
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.services import PserverStub
+from elasticdl_tpu.testing import faults
+
+events.configure("worker-0")
+faults.set_role("worker-0")
+
+train = tempfile.mkdtemp()
+# enough tasks that the job comfortably outlives the storm — the
+# CLEAR half of the drill needs live /alerts after the storm ends
+create_ctr_recordio(train + "/f0.rec", num_records=8192, seed=0)
+mport, pport, statz = find_free_port(), find_free_port(), find_free_port()
+ps = subprocess.Popen([
+    sys.executable, "-m", "elasticdl_tpu.ps.server", "--ps_id", "0",
+    "--num_ps_pods", "1", "--port", str(pport),
+    "--master_addr", "localhost:%d" % mport,
+    "--opt_type", "adam", "--opt_args", "lr=0.01", "--use_async", "1",
+], env={**os.environ, "JAX_PLATFORMS": "cpu",
+        # TWO admission slots + a bounded slow-apply window: the
+        # storm's two pushers plus the worker exceed the slots and
+        # draw RESOURCE_EXHAUSTED with a retry-after hint calibrated
+        # from observed apply latency — but once the storm stops, a
+        # lone retrying push admits next to the worker's, so the
+        # rejection counters actually stop moving and the alert can
+        # clear (one slot would make the trailing storm push lose the
+        # slot race to the worker for tens of seconds)
+        "EDL_PS_MAX_PENDING_APPLIES": "2",
+        "EDL_FAULT_SPEC": "ps-0:push_gradients:overload:0.4:40"})
+
+def wait_port(port, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = socket.socket()
+        try:
+            s.connect(("127.0.0.1", port)); return
+        except OSError:
+            time.sleep(0.3)
+        finally:
+            s.close()
+    raise TimeoutError(port)
+
+wait_port(pport)
+from elasticdl_tpu.data.readers import RecordIODataReader
+from elasticdl_tpu.master.master import Master
+from elasticdl_tpu.worker.master_client import MasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+master = Master(
+    "elasticdl_tpu.models.deepfm", training_data=train,
+    records_per_task=64, num_epochs=1, port=mport, metrics_port=statz,
+)
+master.prepare()
+mc = MasterClient("localhost:%d" % mport, worker_id=0)
+mc.reset_worker()
+worker = Worker(
+    mc, "elasticdl_tpu.models.deepfm",
+    RecordIODataReader(data_dir=train), minibatch_size=32,
+    wait_sleep_secs=0.1, ps_addrs=["localhost:%d" % pport],
+)
+wt = threading.Thread(target=worker.run, daemon=True)
+wt.start()
+rc_box = {}
+mt = threading.Thread(
+    target=lambda: rc_box.update(
+        rc=master.run(poll_secs=0.2, timeout_secs=300)
+    ),
+    daemon=True,
+)
+mt.start()
+
+# the storm: two noise-table pushers contending for the PS's one
+# admission slot while applies run 0.4 s each — rejections are
+# structural, not timing luck. The noise table is disjoint from the
+# model's, so training state is untouched.
+addr = "localhost:%d" % pport
+storm_channel = build_channel(addr)
+storm_stub = PserverStub(storm_channel)
+info = pb.Model()
+info.embedding_table_infos.add(name="noise", dim=4, initializer="0.0")
+storm_stub.push_embedding_table_infos(info, timeout=30)
+
+storm_stop = threading.Event()
+
+def storm(seed):
+    rng = np.random.RandomState(seed)
+    # the storm runs until both alerts are observed (storm_stop), not
+    # for a fixed count: late storm pushes wait out doubled pushback
+    # hints (~5 s apiece), so a fixed-length storm would starve the
+    # clear window's runway. 40 is the never-raised backstop.
+    for _ in range(40):
+        if storm_stop.is_set():
+            return
+        request = pb.PushGradientsRequest()
+        request.gradients.version = 0
+        serialize_indexed_slices(
+            rng.randn(64, 4).astype(np.float32),
+            np.arange(64, dtype=np.int64),
+            request.gradients.embedding_tables["noise"], packed=True,
+        )
+        retry_call(
+            lambda r=request: storm_stub.push_gradients(r, timeout=30),
+            "storm push", budget_secs=120.0, target=addr,
+        )
+        time.sleep(0.1)
+
+storms = [threading.Thread(target=storm, args=(s,), daemon=True)
+          for s in (11, 12)]
+for s in storms:
+    s.start()
+
+def poll_alerts():
+    return json.load(urllib.request.urlopen(
+        "http://127.0.0.1:%d/alerts" % statz, timeout=5))
+
+raised = set()
+deadline = time.time() + 120
+while time.time() < deadline and mt.is_alive():
+    try:
+        alerts = poll_alerts()
+    except Exception:
+        time.sleep(0.5); continue
+    raised |= {a["alert"] for a in alerts
+               if a["alert"] in ("ps_overload", "circuit_open")}
+    if raised == {"ps_overload", "circuit_open"}:
+        break
+    time.sleep(0.5)
+assert raised == {"ps_overload", "circuit_open"}, raised
+# pushback visible in the live /statusz overload section
+statusz = json.load(urllib.request.urlopen(
+    "http://127.0.0.1:%d/statusz" % statz, timeout=5))
+ps_view = statusz["overload"]["ps"]
+assert any(v["ps_overload_rejections"] >= 1 for v in ps_view.values()), ps_view
+
+storm_stop.set()
+for s in storms:
+    s.join(timeout=180)
+# raise AND clear: the storm stopped and the slow window is spent, so
+# the rejection/open counters stop moving and both alerts must clear
+# within EDL_HEALTH_ALERT_SECS — while the job is still running
+cleared = False
+deadline = time.time() + 120
+while time.time() < deadline and mt.is_alive():
+    try:
+        alerts = poll_alerts()
+    except Exception:
+        time.sleep(0.5); continue
+    if not [a for a in alerts
+            if a["alert"] in ("ps_overload", "circuit_open")]:
+        cleared = True
+        break
+    time.sleep(0.5)
+assert cleared, "overload alerts never cleared while the job ran"
+mt.join(timeout=300)
+wt.join(timeout=60)
+ps.terminate(); ps.wait(timeout=30)
+assert rc_box.get("rc") == 0, rc_box
+stats = overload.client_stats()
+assert stats["pushback_waits"] >= 1, stats
+assert stats["circuit_open_count"] >= 1, stats
+assert stats["circuits_not_closed"] == [], stats
+print("overload drill OK: pushback waits %d, breaker opens %d, "
+      "alerts raised+cleared, rc 0"
+      % (stats["pushback_waits"], stats["circuit_open_count"]))
+PYEOF
+python scripts/postmortem.py "$OVLD_DIR/events" 2>/dev/null | tee /tmp/_ovld_pm.out | head -5 || true
+# the overload incident threads through the postmortem timeline
+grep -q "ps_overload_enter" /tmp/_ovld_pm.out
+grep -q "circuit_open" /tmp/_ovld_pm.out
+
 echo "== tier 1e+: scale-down under SIGTERM (graceful drain) =="
 # ISSUE 7: a live master + worker; the worker is SIGTERMed mid-job
 # (what a scale-down pod delete / spot preemption delivers). Its
@@ -1601,6 +1808,20 @@ printf '{"ts": "%s", "span_entropy": %s}\n' \
   "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_span_entropy.json)" \
   >> /tmp/ci_wire_micro.jsonl
 echo "span-entropy A/B journaled to /tmp/ci_wire_micro.jsonl"
+
+# Overload containment A/B (ISSUE 19): bounded-retry clients vs a
+# naive retry storm against the same saturated PS, plus a flap-window
+# breaker recovery drill. Hard gates (attempt amplification, bit-exact
+# zero-lost-updates, probe-window recovery) apply when the bench runs
+# directly; in CI it journals report-only so the trend watchdog tracks
+# the amplification ratio across runs. Reduced window keeps the lane
+# cheap.
+JAX_PLATFORMS=cpu python scripts/bench_overload.py \
+  --slow-secs 4 --pushes 8 --report-only | tee /tmp/_overload.json
+printf '{"ts": "%s", "overload": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_overload.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "overload containment A/B journaled to /tmp/ci_wire_micro.jsonl"
 
 # Bench-trend watchdog (ISSUE 14): folds the repo's BENCH_r*.json
 # series plus everything this run just journaled above into per-metric

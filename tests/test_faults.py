@@ -80,6 +80,48 @@ def test_delay_spec_returns_sleep_action():
     assert spec.fire() == ("delay", 0.25)
 
 
+def test_overload_spec_returns_apply_latency_action():
+    spec = faults.FaultSpec.parse("ps-0:push_gradients:overload:0.5")
+    # unbounded: every matching call is slow
+    assert [spec.fire() for _ in range(3)] == [("overload", 0.5)] * 3
+
+
+def test_overload_call_bound_limits_the_slow_window():
+    spec = faults.FaultSpec.parse("ps-0:push_gradients:overload:0.5:2")
+    # the 5th field bounds the fault to the first N matching calls —
+    # a "slow window then recovery" in one spec
+    assert [spec.fire() for _ in range(4)] == [
+        ("overload", 0.5), ("overload", 0.5), None, None
+    ]
+
+
+def test_flap_alternates_failing_and_passing_windows():
+    spec = faults.FaultSpec.parse("ps-0:*:flap:2")
+    assert [spec.fire() for _ in range(6)] == [
+        "unavailable", "unavailable", None, None,
+        "unavailable", "unavailable",
+    ]
+
+
+def test_apply_delay_consumes_overload_specs(monkeypatch):
+    monkeypatch.setenv(
+        faults.FAULT_SPEC_ENV, "ps-0:push_gradients:overload:0.25:1"
+    )
+    faults.set_role("ps-0")
+    # overload is an apply-path fault, NOT an interceptor fault: the
+    # interceptors must skip it entirely (no double schedule advance)
+    assert faults.server_interceptors() == ()
+    assert faults.apply_delay("push_gradients") == 0.25
+    # the call bound advanced on the consult above; window over
+    assert faults.apply_delay("push_gradients") == 0.0
+    # non-matching method never consults the spec
+    assert faults.apply_delay("pull_embedding_vectors") == 0.0
+
+
+def test_apply_delay_inert_when_env_unset():
+    assert faults.apply_delay("push_gradients") == 0.0
+
+
 def _serve_master(dispatcher):
     server = build_server()
     add_master_servicer_to_server(MasterServicer(dispatcher), server)

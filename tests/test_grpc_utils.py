@@ -1,0 +1,370 @@
+"""Direct retry_call edge coverage (common/grpc_utils + common/overload,
+ISSUE 19): deadline-budget arithmetic, budget exhaustion mid-backoff,
+the channel-ready reconnect path, circuit-breaker cycles, retry-budget
+exhaustion, and server-pushback pacing."""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from elasticdl_tpu.common import overload
+from elasticdl_tpu.common.grpc_utils import (
+    _await_reconnect,
+    build_server,
+    find_free_port,
+    retry_call,
+)
+
+
+class FakeRpcError(grpc.RpcError):
+    """A transport-shaped error with just the surface retry_call reads."""
+
+    def __init__(self, code, retry_after_ms=None):
+        super().__init__("fake %s" % code)
+        self._code = code
+        self._retry_after_ms = retry_after_ms
+
+    def code(self):
+        return self._code
+
+    def trailing_metadata(self):
+        if self._retry_after_ms is None:
+            return ()
+        return ((overload.RETRY_AFTER_KEY, str(self._retry_after_ms)),)
+
+
+class WorstCaseRng:
+    """uniform(a, b) -> b: every jitter draw is the full ceiling."""
+
+    def uniform(self, low, high):
+        return high
+
+
+class ZeroRng:
+    """uniform(a, b) -> a: every jitter draw is instant."""
+
+    def uniform(self, low, high):
+        return low
+
+
+def _failing(times, code=grpc.StatusCode.UNAVAILABLE, result="ok",
+             **error_kwargs):
+    """A callable failing ``times`` times, then returning ``result``;
+    .calls counts invocations."""
+    state = {"calls": 0}
+
+    def fn():
+        state["calls"] += 1
+        if state["calls"] <= times:
+            raise FakeRpcError(code, **error_kwargs)
+        return result
+
+    fn.state = state
+    return fn
+
+
+@pytest.fixture(autouse=True)
+def _clean_overload(monkeypatch):
+    for env in (
+        overload.DEADLINE_BUDGET_ENV,
+        overload.RETRY_BUDGET_TOKENS_ENV,
+        overload.RETRY_BUDGET_RATIO_ENV,
+        overload.CIRCUIT_FAILURES_ENV,
+        overload.CIRCUIT_RESET_SECS_ENV,
+        overload.BROWNOUT_SKIP_AFTER_ENV,
+    ):
+        monkeypatch.delenv(env, raising=False)
+    overload._reset_for_tests()
+    yield
+    overload._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# deadline-budget arithmetic
+
+
+def test_nested_budgets_tighten_never_loosen():
+    assert overload.remaining() is None
+    with overload.budget(5.0):
+        outer = overload.remaining()
+        assert 4.5 < outer <= 5.0
+        # a LOOSER inner scope is clamped to the outer remainder
+        with overload.budget(60.0):
+            assert overload.remaining() <= outer
+        # a TIGHTER inner scope binds ...
+        with overload.budget(0.5):
+            assert overload.remaining() <= 0.5
+        # ... and pops back to the outer remainder on exit
+        assert overload.remaining() > 0.5
+    assert overload.remaining() is None
+
+
+def test_budget_none_is_a_noop_scope():
+    with overload.budget(None):
+        assert overload.remaining() is None
+
+
+def test_rpc_timeout_caps_by_remainder():
+    assert overload.rpc_timeout(60.0) == 60.0  # no budget: the default
+    with overload.budget(1.0):
+        assert overload.rpc_timeout(60.0) <= 1.0
+        assert overload.rpc_timeout(0.2) <= 0.2  # tighter default wins
+        assert overload.rpc_timeout(None) <= 1.0  # no default: remainder
+
+
+def test_expired_budget_reads_zero_not_negative():
+    with overload.budget(0.0):
+        assert overload.remaining() == 0.0
+        assert overload.rpc_timeout(60.0) == 0.0
+
+
+def test_bind_budget_rehomes_into_another_thread():
+    seen = {}
+
+    def probe():
+        seen["remaining"] = overload.remaining()
+
+    with overload.budget(5.0):
+        bound = overload.bind_budget(probe)
+    thread = threading.Thread(target=bound)
+    thread.start()
+    thread.join()
+    assert seen["remaining"] is not None and seen["remaining"] <= 5.0
+    # without a budget open, bind_budget is the identity
+    assert overload.bind_budget(probe) is probe
+
+
+# ---------------------------------------------------------------------------
+# retry_call core paths
+
+
+def test_retry_call_returns_result_and_retries_unavailable():
+    fn = _failing(times=2)
+    result = retry_call(fn, "x", budget_secs=30, rng=ZeroRng())
+    assert result == "ok"
+    assert fn.state["calls"] == 3
+
+
+def test_retry_call_non_retryable_raises_immediately():
+    fn = _failing(times=5, code=grpc.StatusCode.INTERNAL)
+    with pytest.raises(FakeRpcError):
+        retry_call(fn, "x", budget_secs=30, rng=ZeroRng())
+    assert fn.state["calls"] == 1
+
+
+def test_retry_call_budget_exhaustion_mid_backoff_raises_original():
+    # the drawn backoff (worst case = the full 0.5 s ceiling) would
+    # cross the 0.2 s deadline: retry_call must raise the ORIGINAL
+    # error right away instead of sleeping through the budget
+    fn = _failing(times=10)
+    started = time.monotonic()
+    with pytest.raises(FakeRpcError):
+        retry_call(fn, "x", budget_secs=0.2, rng=WorstCaseRng())
+    assert time.monotonic() - started < 0.15
+    assert fn.state["calls"] == 1
+
+
+def test_retry_call_honors_callers_thread_budget():
+    # a generous budget_secs is capped by the thread's tighter budget
+    fn = _failing(times=10)
+    with overload.budget(0.2):
+        with pytest.raises(FakeRpcError):
+            retry_call(fn, "x", budget_secs=60, rng=WorstCaseRng())
+    assert fn.state["calls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# channel-ready reconnect path
+
+
+def test_await_reconnect_false_when_peer_never_comes_up():
+    channel = grpc.insecure_channel("localhost:1")
+    try:
+        started = time.monotonic()
+        assert _await_reconnect(channel, 0.2) is False
+        assert time.monotonic() - started < 2.0
+    finally:
+        channel.close()
+
+
+def test_retry_call_with_channel_beats_the_drawn_backoff():
+    # the peer is up, so channel_ready_future completes in ~ms and the
+    # retry fires after only the bounded residual jitter — NOT the full
+    # worst-case 5 s draw a sleep-only loop would burn
+    server = build_server(max_workers=2, instrument=False)
+    port = find_free_port()
+    server.add_insecure_port("localhost:%d" % port)
+    server.start()
+    channel = grpc.insecure_channel("localhost:%d" % port)
+    try:
+        fn = _failing(times=1)
+        started = time.monotonic()
+        result = retry_call(
+            fn, "x", budget_secs=30, base_delay=5.0,
+            rng=WorstCaseRng(), channel=channel,
+        )
+        elapsed = time.monotonic() - started
+        assert result == "ok"
+        assert fn.state["calls"] == 2
+        assert elapsed < 2.0, elapsed
+    finally:
+        channel.close()
+        server.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker via retry_call
+
+
+def test_breaker_opens_then_fail_fast_then_probe_recloses(monkeypatch):
+    monkeypatch.setenv(overload.CIRCUIT_FAILURES_ENV, "2")
+    monkeypatch.setenv(overload.CIRCUIT_RESET_SECS_ENV, "0.2")
+    fn = _failing(times=100)
+    with pytest.raises(grpc.RpcError):
+        retry_call(
+            fn, "push", budget_secs=0.25, rng=ZeroRng(), target="ps-0",
+        )
+    breaker = overload.breaker_for("ps-0", "write")
+    assert breaker.state() == overload.OPEN
+    assert breaker.open_count >= 1
+
+    # open circuit + fail_fast_when_open: no wire attempt at all
+    probe = _failing(times=0)
+    with pytest.raises(overload.CircuitOpenError) as excinfo:
+        retry_call(
+            probe, "push", budget_secs=5, rng=ZeroRng(), target="ps-0",
+            fail_fast_when_open=True,
+        )
+    assert probe.state["calls"] == 0
+    assert excinfo.value.code() == grpc.StatusCode.UNAVAILABLE
+
+    # after the reset window one probe is admitted; success re-closes
+    time.sleep(0.25)
+    healthy = _failing(times=0)
+    assert retry_call(
+        healthy, "push", budget_secs=5, rng=ZeroRng(), target="ps-0",
+    ) == "ok"
+    assert breaker.state() == overload.CLOSED
+    assert overload.client_stats()["circuits_not_closed"] == []
+
+
+def test_breaker_paces_within_budget_without_fail_fast(monkeypatch):
+    monkeypatch.setenv(overload.CIRCUIT_FAILURES_ENV, "1")
+    monkeypatch.setenv(overload.CIRCUIT_RESET_SECS_ENV, "0.1")
+    # trip the breaker
+    with pytest.raises(grpc.RpcError):
+        retry_call(
+            _failing(times=100), "push", budget_secs=0.05,
+            rng=ZeroRng(), target="ps-1",
+        )
+    assert overload.breaker_for("ps-1", "write").state() == overload.OPEN
+    # a patient caller (no fail-fast) waits out the probe window inside
+    # its budget and lands the probe
+    healthy = _failing(times=0)
+    started = time.monotonic()
+    assert retry_call(
+        healthy, "push", budget_secs=5, rng=ZeroRng(), target="ps-1",
+    ) == "ok"
+    assert healthy.state["calls"] == 1
+    assert time.monotonic() - started < 2.0
+
+
+# ---------------------------------------------------------------------------
+# retry budget
+
+
+def test_retry_budget_exhaustion_fails_fast(monkeypatch):
+    monkeypatch.setenv(overload.RETRY_BUDGET_TOKENS_ENV, "1")
+    fn = _failing(times=100)
+    with pytest.raises(overload.RetryBudgetExhausted) as excinfo:
+        retry_call(
+            fn, "push", budget_secs=30, rng=ZeroRng(), target="ps-2",
+        )
+    # one token = one funded retry: attempt 1 fails, retry (attempt 2)
+    # fails, the second retry finds the bucket dry
+    assert fn.state["calls"] == 2
+    assert excinfo.value.target == "ps-2"
+    assert overload.client_stats()["retry_budget_exhausted"] == 1
+
+
+def test_successes_refill_the_retry_budget(monkeypatch):
+    monkeypatch.setenv(overload.RETRY_BUDGET_TOKENS_ENV, "2")
+    monkeypatch.setenv(overload.RETRY_BUDGET_RATIO_ENV, "0.5")
+    bucket = overload.retry_budget_for("ps-3")
+    assert bucket.spend() and bucket.spend()
+    assert not bucket.spend()  # dry
+    for _ in range(2):
+        bucket.record_success()
+    assert bucket.spend()  # 2 successes x 0.5 = one funded retry
+
+
+# ---------------------------------------------------------------------------
+# server pushback
+
+
+def test_pushback_paces_at_hint_without_penalizing_breaker():
+    fn = _failing(
+        times=1, code=grpc.StatusCode.RESOURCE_EXHAUSTED,
+        retry_after_ms=50,
+    )
+    started = time.monotonic()
+    result = retry_call(
+        fn, "push", budget_secs=30, rng=WorstCaseRng(), target="ps-4",
+    )
+    elapsed = time.monotonic() - started
+    assert result == "ok"
+    # paced at the server's 50 ms hint, not the worst-case jitter draw
+    assert 0.05 <= elapsed < 1.0, elapsed
+    assert overload.client_stats()["pushback_waits"] == 1
+    # pushback is an ALIVE server managing load: never a breaker strike
+    assert overload.breaker_for("ps-4", "write").state() == overload.CLOSED
+
+
+def test_pushback_without_hint_is_not_retried():
+    # plain RESOURCE_EXHAUSTED (no hint trailer) is not retryable
+    fn = _failing(times=5, code=grpc.StatusCode.RESOURCE_EXHAUSTED)
+    with pytest.raises(FakeRpcError):
+        retry_call(fn, "push", budget_secs=30, rng=ZeroRng())
+    assert fn.state["calls"] == 1
+
+
+def test_retry_after_hint_parsing():
+    assert overload.retry_after_hint(
+        FakeRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                     retry_after_ms=250)
+    ) == 0.25
+    assert overload.retry_after_hint(
+        FakeRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED)
+    ) is None
+    assert overload.retry_after_hint(grpc.RpcError()) is None
+    junk = FakeRpcError(grpc.StatusCode.RESOURCE_EXHAUSTED)
+    junk._retry_after_ms = "not-a-number"
+    assert overload.retry_after_hint(junk) is None
+
+
+# ---------------------------------------------------------------------------
+# interceptor inertness + error surface
+
+
+def test_budget_interceptor_identity_when_disabled(monkeypatch):
+    monkeypatch.setenv(overload.DEADLINE_BUDGET_ENV, "0")
+    channel = grpc.insecure_channel("localhost:1")
+    try:
+        assert overload.intercept_budget_channel(channel) is channel
+    finally:
+        channel.close()
+    assert overload.server_budget_interceptors() == ()
+
+
+def test_overload_errors_walk_like_rpc_errors():
+    err = overload.CircuitOpenError("ps-0", "write")
+    assert isinstance(err, grpc.RpcError)
+    assert err.code() == grpc.StatusCode.UNAVAILABLE
+    assert "ps-0" in err.details()
+    budget_err = overload.RetryBudgetExhausted(
+        "ps-1", grpc.StatusCode.UNAVAILABLE
+    )
+    assert budget_err.code() == grpc.StatusCode.UNAVAILABLE
+    assert "ps-1" in budget_err.details()

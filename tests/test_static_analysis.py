@@ -371,6 +371,65 @@ def test_grpc_timeout_quiet_with_deadline_or_non_stub():
     """)
 
 
+def test_deadline_no_propagation_flags_literal_timeout_in_handler():
+    findings = findings_for("""
+        class RouterServicer:
+            def __init__(self, stub):
+                self._stub = stub
+
+            def model_info(self, request, context):
+                return self._stub.model_info(request, timeout=5.0)  # BUG
+    """, rules=["ft-deadline-no-propagation"])
+    assert rules_of(findings) == {"ft-deadline-no-propagation"}
+    assert findings[0].symbol == "RouterServicer.model_info"
+    assert "timeout=5.0" in findings[0].code
+
+
+def test_deadline_no_propagation_flags_default_const_in_thread_context():
+    findings = findings_for("""
+        from elasticdl_tpu.common.annotations import thread_context
+
+        class GRPC:
+            DEFAULT_RPC_TIMEOUT_SECS = 60.0
+
+        @thread_context("apply-pool")
+        def fan_out(stub, request):
+            return stub.push_model(
+                request, timeout=GRPC.DEFAULT_RPC_TIMEOUT_SECS  # BUG
+            )
+    """, rules=["ft-deadline-no-propagation"])
+    assert rules_of(findings) == {"ft-deadline-no-propagation"}
+
+
+def test_deadline_no_propagation_quiet_on_derived_or_client_paths():
+    assert not findings_for("""
+        from elasticdl_tpu.common import overload
+        from elasticdl_tpu.common.annotations import thread_context
+
+        class RouterServicer:
+            def __init__(self, stub):
+                self._stub = stub
+
+            def model_info(self, request, context):
+                # the budget helper caps by the caller's remainder
+                return self._stub.model_info(
+                    request, timeout=overload.rpc_timeout(5.0)
+                )
+
+            def predict(self, request, context, deadline):
+                # a Name is trusted as a derived deadline
+                return self._stub.predict(request, timeout=deadline)
+
+        @thread_context("apply-pool")
+        def local_fan_out(helper, request):
+            return helper.push_model(request, timeout=5.0)  # not a stub
+
+        def plain_client(stub, request):
+            # fresh deadline on a top-level client path is fine
+            return stub.get_task(request, timeout=60.0)
+    """, rules=["ft-deadline-no-propagation"])
+
+
 def test_retry_no_jitter_flags_deterministic_backoff_loop():
     findings = findings_for("""
         import time
@@ -747,6 +806,14 @@ _CLI_POSITIVE_FIXTURES = {
     "ft-grpc-timeout": ("bad_rpc.py", """
         def call(stub, request):
             return stub.get_task(request)
+    """),
+    "ft-deadline-no-propagation": ("bad_deadline.py", """
+        class EchoServicer:
+            def __init__(self, stub):
+                self._stub = stub
+
+            def echo(self, request, context):
+                return self._stub.echo(request, timeout=5.0)
     """),
     "ft-retry-no-jitter": ("bad_backoff.py", """
         import time

@@ -40,6 +40,7 @@ from elasticdl_tpu.common.grpc_utils import (
     find_free_port,
     retry_call,
 )
+from elasticdl_tpu.common import overload
 from elasticdl_tpu.observability import metrics as obs_metrics
 from elasticdl_tpu.observability import trace
 from elasticdl_tpu.observability.trace_propagation import (
@@ -134,15 +135,19 @@ def test_sample_zero_builds_uninstrumented_channel(
     traced, monkeypatch
 ):
     monkeypatch.setenv(trace.SAMPLE_ENV, "0")
+    # deadline-budget propagation (ISSUE 19) rides build_channel too
+    # and is on by default; with BOTH kill switches thrown the call
+    # path is byte-identical to a bare build (the ISSUE 9 overhead
+    # acceptance, extended to every propagation layer)
+    monkeypatch.setenv(overload.DEADLINE_BUDGET_ENV, "0")
     channel = build_channel("localhost:1")
-    # no interceptor wrapper at all: the call path is byte-identical
-    # to an untraced build (the ISSUE 9 overhead acceptance)
     assert "_interceptor" not in type(channel).__module__
     channel.close()
 
 
 def test_trace_disabled_builds_uninstrumented_channel(monkeypatch):
     monkeypatch.delenv(trace.TRACE_DIR_ENV, raising=False)
+    monkeypatch.setenv(overload.DEADLINE_BUDGET_ENV, "0")
     channel = build_channel("localhost:1")
     assert "_interceptor" not in type(channel).__module__
     channel.close()
