@@ -227,6 +227,7 @@ def _rules_by_name(names=None):
         obs_bare_jit,
         obs_hot_path,
         obs_span,
+        perf_collective,
         perf_gather,
         perf_gil,
         perf_io,
@@ -248,6 +249,7 @@ def _rules_by_name(names=None):
         "obs-span-no-context": obs_span.run,
         "obs-deterministic-tracer": deterministic_tracer.run,
         "num-silent-nonfinite": numerics.run,
+        "perf-bare-collective": perf_collective.run,
         "perf-varint-ids": perf_wire.run,
         "perf-host-gather": perf_gather.run,
         "perf-gil-held-apply": perf_gil.run,

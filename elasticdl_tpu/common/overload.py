@@ -527,23 +527,27 @@ class CircuitBreaker:
             )
 
 
-# hoisted instruments (obs-hot-path: construction is init-scope work)
-_m_circuit_transitions = obs_metrics.counter(
+# hoisted instruments (obs-hot-path: construction is init-scope work).
+# LAZY, not eager: this module reaches every role via common.grpc_utils
+# at import time, before main() publishes EDL_METRICS_PORT — an eager
+# counter() here would freeze the process registry disabled and blank
+# /metrics for the whole role.
+_m_circuit_transitions = obs_metrics.lazy_counter(
     "edl_circuit_transitions_total",
     "Circuit-breaker state transitions", ("state",),
 )
-_m_circuit_state = obs_metrics.gauge(
+_m_circuit_state = obs_metrics.lazy_gauge(
     "edl_circuit_state",
     "Breaker state per target/method-class "
     "(0 closed, 1 open, 2 half-open)",
     ("target", "kind"),
 )
-_m_retry_budget_exhausted = obs_metrics.counter(
+_m_retry_budget_exhausted = obs_metrics.lazy_counter(
     "edl_retry_budget_exhausted_total",
     "Retries refused because the per-target token bucket ran dry",
     ("target",),
 )
-_m_pushback_waits = obs_metrics.counter(
+_m_pushback_waits = obs_metrics.lazy_counter(
     "edl_retry_pushback_waits_total",
     "Retries paced by a server edl-retry-after-ms hint", ("target",),
 )

@@ -301,7 +301,7 @@ class InstanceManager:
                 if self._worker_pods_phase.get(name) == "Running"
             ]
         hosts = [addr for _, addr in sorted(alive)]
-        self._rendezvous.set_worker_hosts(hosts)
+        self._rendezvous.set_worker_hosts(hosts, reason="pod_watch")
 
     # -- parameter servers ---------------------------------------------
     def _ps_event(self, event_type, name, pod):

@@ -268,7 +268,9 @@ class DrainManager:
         if self._fleet is not None:
             self._fleet.mark_drained(worker_id, reason=request.reason)
         if self._rendezvous is not None and host:
-            self._rendezvous.remove_worker_host(host)
+            self._rendezvous.remove_worker_host(
+                host, reason=request.reason or "drain"
+            )
         self._m_drains.labels(outcome="ack").inc()
         logger.info(
             "worker %s drained cleanly (%s; pushes_joined=%s "

@@ -404,6 +404,18 @@ class FleetMonitor:
                 "retry_budget_exhausted": int(
                     blob.retry_budget_exhausted
                 ),
+                # dense data plane (ISSUE 20): the worker's GSPMD mesh
+                # topology, the rendezvous epoch it trains under, and
+                # the ICI traffic its dense step puts on the wire —
+                # the fleet-level proof the PS carries no dense bytes
+                "mesh_shape": str(blob.mesh_shape),
+                "mesh_epoch": int(blob.mesh_epoch),
+                "collective_bytes_per_step": float(
+                    blob.collective_bytes_per_step
+                ),
+                "dense_step_share": round(
+                    float(blob.dense_step_share), 4
+                ),
             }
             # recency bookkeeping for the health-counter detectors: a
             # cumulative counter that moved since the last sighting
@@ -975,6 +987,26 @@ class FleetMonitor:
                 "ps": overload_ps,
                 "clients": overload_clients,
             }
+            # dense data plane section (ISSUE 20): per-worker mesh
+            # shape, rendezvous epoch, and collective traffic — plus
+            # the dense-step share of batch time. A worker whose
+            # mesh_epoch trails its peers is mid-restart; a share well
+            # under 1.0 on a dense job means the PS crept back onto
+            # the hot path.
+            dense_plane = {}
+            for wid, state in self._roles.items():
+                if state.blob is None or wid < 0:
+                    continue
+                if not state.blob.get("mesh_shape"):
+                    continue
+                dense_plane[state.role] = {
+                    key: state.blob[key]
+                    for key in (
+                        "mesh_shape", "mesh_epoch",
+                        "collective_bytes_per_step",
+                        "dense_step_share",
+                    )
+                }
         body = {
             "ts": now,
             "job": _env_str(events.JOB_NAME_ENV, ""),
@@ -985,6 +1017,7 @@ class FleetMonitor:
             "health": health,
             "device": device,
             "overload": overload_view,
+            "dense_plane": dense_plane,
             "thresholds": {
                 "straggler_factor": self._straggler_factor,
                 "dead_air_secs": self._dead_air_secs,

@@ -14,6 +14,7 @@ import threading
 import time
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import events
 
 logger = _logger_factory("elasticdl_tpu.master.rendezvous")
 
@@ -32,48 +33,57 @@ class MeshRendezvous:
         # evicts members and the mesh epoch churns forever
         self._last_change = 0.0
 
-    def set_worker_hosts(self, hosts):
+    def _bump(self, old_world, reason):
+        """Epoch bump bookkeeping; caller holds the lock and has
+        already mutated ``self._hosts``. Journals the transition as
+        ``mesh_epoch_restart`` with the old/new mesh shapes — this is
+        the master-side record the postmortem elasticity story reads
+        (the exiting workers each journal their own restart line,
+        without shapes)."""
+        self._mesh_epoch += 1
+        self._last_change = time.time()
+        new_world = len(self._hosts)
+        logger.info(
+            "Mesh epoch -> %d (%s, %d -> %d hosts)",
+            self._mesh_epoch, reason, old_world, new_world,
+        )
+        events.emit(
+            "mesh_epoch_restart",
+            epoch=self._mesh_epoch,
+            old_mesh="dp=%d" % old_world if old_world else "",
+            new_mesh="dp=%d" % new_world if new_world else "",
+            old_world=old_world,
+            new_world=new_world,
+            reason=reason,
+        )
+
+    def set_worker_hosts(self, hosts, reason="set_hosts"):
         """Replace the alive-host list; bump the epoch if it changed."""
         hosts = list(hosts)
         with self._lock:
             if hosts == self._hosts:
                 return self._mesh_epoch
+            old_world = len(self._hosts)
             self._hosts = hosts
-            self._mesh_epoch += 1
-            self._last_change = time.time()
-            logger.info(
-                "Mesh epoch -> %d with %d hosts", self._mesh_epoch, len(hosts)
-            )
+            self._bump(old_world, reason)
             return self._mesh_epoch
 
-    def add_worker_host(self, host):
+    def add_worker_host(self, host, reason="worker_join"):
         with self._lock:
             if host in self._hosts:
                 return self._mesh_epoch
+            old_world = len(self._hosts)
             self._hosts.append(host)
-            self._mesh_epoch += 1
-            self._last_change = time.time()
-            logger.info(
-                "Mesh epoch -> %d (+%s, %d hosts)",
-                self._mesh_epoch,
-                host,
-                len(self._hosts),
-            )
+            self._bump(old_world, "%s:%s" % (reason, host))
             return self._mesh_epoch
 
-    def remove_worker_host(self, host):
+    def remove_worker_host(self, host, reason="worker_leave"):
         with self._lock:
             if host not in self._hosts:
                 return self._mesh_epoch
+            old_world = len(self._hosts)
             self._hosts.remove(host)
-            self._mesh_epoch += 1
-            self._last_change = time.time()
-            logger.info(
-                "Mesh epoch -> %d (-%s, %d hosts)",
-                self._mesh_epoch,
-                host,
-                len(self._hosts),
-            )
+            self._bump(old_world, "%s:%s" % (reason, host))
             return self._mesh_epoch
 
     def get_comm_info(self, host):

@@ -353,7 +353,9 @@ class MasterServicer:
             with self._lock:
                 self._worker_hosts[request.worker_id] = request.worker_host
             if self._auto_join_mesh:
-                self._rendezvous.add_worker_host(request.worker_host)
+                self._rendezvous.add_worker_host(
+                    request.worker_host, reason="worker_join"
+                )
         rank, size, epoch, coordinator = self._rendezvous.get_comm_info(
             request.worker_host
         )

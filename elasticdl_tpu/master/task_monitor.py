@@ -200,7 +200,7 @@ class TaskMonitor:
         if self._rendezvous is not None and host:
             # Membership change: surviving workers see a new mesh epoch on
             # their next get_comm_info and rebuild the SPMD mesh.
-            self._rendezvous.remove_worker_host(host)
+            self._rendezvous.remove_worker_host(host, reason="worker_death")
         if self._on_worker_dead is not None:
             try:
                 self._on_worker_dead(worker_id)

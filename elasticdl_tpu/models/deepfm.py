@@ -110,14 +110,26 @@ def sparse_embedding_specs(num_features=NUM_FIELDS, batch_size=64,
             capacity, batch_size * num_features, batch_size, num_features,
         )
     return [
+        # Small second-order init: an id the optimizer barely touched
+        # contributes ~nothing through the FM/deep towers instead of
+        # init-scale noise. On held-out CTR data most ids are rare, so
+        # eval AUC is dominated by exactly those rows — init 0.05 cost
+        # ~0.08 AUC on the planted-signal eval vs 0.001 (measured via
+        # the local-executor lane).
         SparseEmbeddingSpec(
             "deepfm_emb",
             EMBEDDING_DIM,
             feature_key="ids",
             capacity=capacity,
+            init_scale=0.001,
         ),
+        # Wide term starts at exactly no-op (standard wide&deep
+        # practice): a zero row is the correct prior for an unseen id,
+        # and the first gradient step writes the signal, not a
+        # correction of random noise.
         SparseEmbeddingSpec(
-            "deepfm_linear", 1, feature_key="ids", capacity=capacity
+            "deepfm_linear", 1, feature_key="ids", capacity=capacity,
+            initializer="zeros",
         ),
     ]
 

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from elasticdl_tpu.parallel.collectives import mesh_psum
 from elasticdl_tpu.parallel.pipeline import pipeline_apply
 from elasticdl_tpu.parallel.sharding import ShardingRules
 from elasticdl_tpu.train.losses import sparse_softmax_cross_entropy
@@ -29,7 +30,10 @@ def _make_stage_fn(use_tp):
         h = jnp.maximum(x @ p["W1"], 0.0)
         out = h @ p["W2"]
         if use_tp:
-            out = jax.lax.psum(out, "tp")
+            # mesh_psum, not lax.psum: the 1f1b schedule differentiates
+            # this stage fn INSIDE the shard_map body, where the pinned
+            # jax's psum transpose doubles tp-sharded grads
+            out = mesh_psum(out, "tp")
         return jnp.tanh(out + p["b"]) + x  # residual keeps depth trainable
 
     return layer_fn

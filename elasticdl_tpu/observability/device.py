@@ -87,43 +87,46 @@ _totals = {
 _hbm_peak = 0  # host-side watermark across memory_snapshot() polls
 
 # instruments hoisted to module scope (obs-hot-path discipline): the
-# registry returns NOOPs when metrics collection is off
-_m_compiles = obs_metrics.counter(
+# registry returns NOOPs when metrics collection is off. LAZY: the
+# trainers import this module before a role's main() publishes
+# EDL_METRICS_PORT; an eager counter() here would freeze the process
+# registry disabled and blank /metrics for the whole role.
+_m_compiles = obs_metrics.lazy_counter(
     "edl_xla_compiles_total",
     "XLA compiles (new argument signatures) per wrapped step fn",
     ("fn",),
 )
-_m_recompiles = obs_metrics.counter(
+_m_recompiles = obs_metrics.lazy_counter(
     "edl_xla_recompiles_total",
     "XLA compiles beyond each wrapped step fn's first",
     ("fn",),
 )
-_m_cache_hits = obs_metrics.counter(
+_m_cache_hits = obs_metrics.lazy_counter(
     "edl_xla_cache_hits_total",
     "Calls served by the jit executable cache per wrapped step fn",
     ("fn",),
 )
-_m_compile_secs = obs_metrics.histogram(
+_m_compile_secs = obs_metrics.lazy_histogram(
     "edl_xla_compile_seconds",
     "Wall seconds of calls that compiled (trace+compile+run)",
     buckets=(0.05, 0.25, 1.0, 5.0, 20.0, 60.0, 180.0),
 )
-_m_transfer_bytes = obs_metrics.counter(
+_m_transfer_bytes = obs_metrics.lazy_counter(
     "edl_device_transfer_bytes_total",
     "Host<->device transfer bytes attributed by direction",
     ("direction",),
 )
-_m_hbm_in_use = obs_metrics.gauge(
+_m_hbm_in_use = obs_metrics.lazy_gauge(
     "edl_device_hbm_bytes_in_use",
     "Device-memory bytes in use (allocator stats, or live-buffer "
     "fallback where the backend has no allocator)",
 )
-_m_hbm_peak = obs_metrics.gauge(
+_m_hbm_peak = obs_metrics.lazy_gauge(
     "edl_device_hbm_peak_bytes",
     "Peak device-memory bytes observed (allocator peak, or the "
     "process-lifetime watermark of the fallback)",
 )
-_m_live_buffers = obs_metrics.gauge(
+_m_live_buffers = obs_metrics.lazy_gauge(
     "edl_device_live_buffers",
     "Live device arrays held by this process",
 )

@@ -472,6 +472,69 @@ def histogram(name, help_text, labelnames=(),
     )
 
 
+class _LazyInstrument:
+    """Module-scope instrument declaration whose registry resolution is
+    deferred to the first recording call.
+
+    Library modules that role entry points import before ``main()``
+    publishes EDL_METRICS_PORT (common.overload via common.grpc_utils,
+    observability.device via the trainers) must not touch
+    ``default_registry()`` at import time: the registry snapshots
+    ``metrics_enabled()`` once, so an import-time construction freezes
+    the whole process's /metrics exposition disabled — every role's
+    scrape comes back empty. The proxy keeps the declaration at module
+    scope (obs-hot-path: no per-call construction) while resolving the
+    real instrument on first use, after the role has set its env."""
+
+    __slots__ = ("_factory", "_real")
+
+    def __init__(self, factory):
+        self._factory = factory
+        self._real = None
+
+    def _resolve(self):
+        real = self._real
+        if real is None:
+            real = self._real = self._factory()
+        return real
+
+    def labels(self, *values, **kv):
+        return self._resolve().labels(*values, **kv)
+
+    def inc(self, amount=1):
+        self._resolve().inc(amount)
+
+    def dec(self, amount=1):
+        self._resolve().dec(amount)
+
+    def set(self, value):
+        self._resolve().set(value)
+
+    def set_function(self, fn):
+        self._resolve().set_function(fn)
+
+    def observe(self, value):
+        self._resolve().observe(value)
+
+    def get(self, *labelvalues):
+        return self._resolve().get(*labelvalues)
+
+
+def lazy_counter(name, help_text, labelnames=()):
+    return _LazyInstrument(lambda: counter(name, help_text, labelnames))
+
+
+def lazy_gauge(name, help_text, labelnames=()):
+    return _LazyInstrument(lambda: gauge(name, help_text, labelnames))
+
+
+def lazy_histogram(name, help_text, labelnames=(),
+                   buckets=DEFAULT_LATENCY_BUCKETS):
+    return _LazyInstrument(
+        lambda: histogram(name, help_text, labelnames, buckets=buckets)
+    )
+
+
 def _logger():
     from elasticdl_tpu.common.log_utils import default_logger
 

@@ -27,6 +27,8 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from elasticdl_tpu.common import jax_compat
+
 NEG_INF = -1e30
 # Lane width of the m/l scratch rows (min f32 tile is (8, 128)).
 _STATS_LANES = 128
@@ -211,7 +213,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
         ],
         out_shape=out_shape,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jax_compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -418,7 +420,7 @@ def _bwd(
         out_specs=pl.BlockSpec((1, block_q, head_dim), q_idx),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jax_compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -453,7 +455,7 @@ def _bwd(
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jax_compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from elasticdl_tpu.common import jax_compat
 from elasticdl_tpu.parallel.mesh import DATA_AXES
 
 NEG_INF = -1e30
@@ -94,7 +95,7 @@ def _make_flash_ring(axis_name, sp_size, causal, sm_scale, spec_axes,
     from elasticdl_tpu.ops import flash_attention as F
 
     NEG = F.NEG_INF
-    vary = lambda x: jax.lax.pcast(x, spec_axes, to="varying")
+    vary = lambda x: jax_compat.pvary(x, spec_axes)
 
     def lse_w(lse_from, lse_to):
         # (bh, 1, S) log-weights -> (bh, S, 1) multiplicative weights
@@ -171,7 +172,13 @@ def _make_flash_ring(axis_name, sp_size, causal, sm_scale, spec_axes,
         return o
 
     def _fold_fwd(q_m, k_m, v_m):
-        my_idx = jax.lax.axis_index(axis_name)
+        # only the causal mask needs the device index; the non-causal
+        # fold ignores src/my_idx entirely, and leaving a dead
+        # axis_index in the program lowers to a PartitionId op the CPU
+        # SPMD partitioner rejects
+        my_idx = (
+            jax.lax.axis_index(axis_name) if causal else jnp.uint32(0)
+        )
         bh, seq, _ = q_m.shape
 
         def step(carry, t):
@@ -198,7 +205,9 @@ def _make_flash_ring(axis_name, sp_size, causal, sm_scale, spec_axes,
 
     def _fold_bwd(res, do_m):
         q_m, k_m, v_m, o_m, lse = res
-        my_idx = jax.lax.axis_index(axis_name)
+        my_idx = (
+            jax.lax.axis_index(axis_name) if causal else jnp.uint32(0)
+        )
 
         def step(carry, t):
             dq, k_blk, v_blk, dk_acc, dv_acc = carry
@@ -329,7 +338,7 @@ def ring_attention(
         # vma annotation, which the VMA checker rejects inside a
         # checked manual region; the specs here mirror the (long
         # VMA-checked) einsum path below
-        return jax.shard_map(
+        return jax_compat.shard_map(
             flash_local_fn,
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -391,8 +400,8 @@ def ring_attention(
                 spec_axes.extend(entry)
             else:
                 spec_axes.append(entry)
-        vary = lambda x: jax.lax.pcast(
-            x, tuple(spec_axes), to="varying"
+        vary = lambda x: jax_compat.pvary(
+            x, tuple(spec_axes)
         )
         init = (
             vary(jnp.full((batch, heads, seq_loc), NEG_INF, jnp.float32)),
@@ -411,7 +420,7 @@ def ring_attention(
         safe_l = jnp.where(l > 0.0, l, 1.0)
         return (acc / safe_l[..., None]).astype(q_loc.dtype)
 
-    return jax.shard_map(
+    return jax_compat.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -485,7 +494,7 @@ def ulysses_attention(
         )
         return heads_to_seq(out)
 
-    return jax.shard_map(
+    return jax_compat.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -17,6 +17,7 @@ from the latest checkpoint (broadcast-from-rank-0 has no analogue —
 state recovery is checkpoint-based, SURVEY.md §2.12/§5).
 """
 
+import os
 import threading
 import time
 
@@ -25,6 +26,13 @@ from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
 logger = _logger_factory("elasticdl_tpu.parallel.multihost")
 
 COORDINATOR_PORT = 51617
+
+# matches worker.worker.EPOCH_RESTART_EXIT_CODE (not imported: the
+# worker package pulls in the trainers, and this module must stay
+# importable before any jax backend work): the pod supervisor's
+# relaunch-and-rejoin exit, which is also the only possible recovery
+# from a join wedged inside an uninterruptible C++ call
+EPOCH_RESTART_EXIT_CODE = 3
 
 
 class MultiHostRuntime:
@@ -61,6 +69,44 @@ class MultiHostRuntime:
     def initialized(self):
         return self._epoch is not None
 
+    @staticmethod
+    def _maybe_enable_cpu_collectives():
+        """A multi-process CPU world needs an explicit cross-process
+        collectives implementation: without one, XLA:CPU rejects every
+        computation spanning processes ("Multiprocess computations
+        aren't implemented on the CPU backend") — including orbax's
+        directory-creation barrier, so even checkpointing dies. Gloo
+        ships in jaxlib; switch it on before the backend first
+        initializes. TPU/GPU worlds never reach this (their ICI/DCN
+        collectives are native to the platform)."""
+        import jax
+
+        platforms = getattr(jax.config, "jax_platforms", None) or ""
+        if "cpu" not in platforms.split(","):
+            return
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+        except (AttributeError, ValueError) as e:
+            # this jax spells the knob differently (or dropped it);
+            # single-host CPU still works, so warn rather than die
+            logger.warning("could not enable CPU gloo collectives: %s", e)
+
+    def _exit_wedged_join(self, rank, world, coordinator):
+        """Watchdog escape hatch for a join that neither returned nor
+        raised within twice its attempt timeout: the process is wedged
+        in native code and nothing in Python can unwind it, so exit
+        with the epoch-restart code — the supervisor relaunches this
+        worker, which rejoins with FRESH membership."""
+        logger.error(
+            "distributed join (rank %d/%d via %s) wedged past %ds — "
+            "membership likely dissolved mid-join; exiting for "
+            "relaunch-and-rejoin",
+            rank, world, coordinator, self._init_attempt_timeout * 2,
+        )
+        os._exit(EPOCH_RESTART_EXIT_CODE)
+
     def _wait_admitted(self, wait_sleep_secs, max_wait_secs, start):
         while True:
             info = self._mc.get_comm_info()
@@ -79,6 +125,7 @@ class MultiHostRuntime:
         and restore state from the latest checkpoint — False when the
         existing runtime is still current."""
         start = time.time()
+        self._maybe_enable_cpu_collectives()
         info = self._wait_admitted(wait_sleep_secs, max_wait_secs, start)
         if self._epoch == info.mesh_epoch:
             return False
@@ -117,6 +164,23 @@ class MultiHostRuntime:
                 coordinator = "%s:%d" % (
                     info.coordinator_addr.split(":")[0], self._port
                 )
+                # initialization_timeout bounds the common failure
+                # (peer slow/unreachable) but NOT every wedge: rank
+                # 0's client.connect() can block past it when the
+                # membership this join targets dissolves mid-join (a
+                # peer dies while the world re-forms, so the service
+                # was sized for a world that will never assemble) —
+                # observed on the CPU/gloo backend, and the blocked
+                # call is uninterruptible from Python. The watchdog
+                # turns that wedge into the standard epoch-restart
+                # exit the pod supervisor already relaunches.
+                watchdog = threading.Timer(
+                    self._init_attempt_timeout * 2 + 15.0,
+                    self._exit_wedged_join,
+                    args=(info.rank, info.world_size, coordinator),
+                )
+                watchdog.daemon = True
+                watchdog.start()
                 try:
                     self._distributed.initialize(
                         coordinator_address=coordinator,
@@ -124,8 +188,10 @@ class MultiHostRuntime:
                         process_id=info.rank,
                         initialization_timeout=self._init_attempt_timeout,
                     )
+                    watchdog.cancel()
                     break
                 except Exception as e:
+                    watchdog.cancel()
                     attempts += 1
                     if attempts >= self._max_init_attempts:
                         raise RuntimeError(

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from elasticdl_tpu.common import jax_compat
 from elasticdl_tpu.parallel.mesh import DATA_AXES
 
 
@@ -244,7 +245,7 @@ def _gpipe_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
         # Activation buffers derived from x_loc already vary over the
         # batch axes; each stage additionally computes different values,
         # so add ``pp`` to the varying set (shard_map VMA typing).
-        vary = lambda v: jax.lax.pcast(v, (axis,), to="varying")
+        vary = lambda v: jax_compat.pvary(v, (axis,))
         # Forward one hop toward the next stage; stage 0 receives zeros
         # (it reads fresh microbatches instead).
         perm = [(j, j + 1) for j in range(num_stages - 1)]
@@ -286,7 +287,7 @@ def _gpipe_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
         outputs = jax.lax.psum(outputs, axis)
         return outputs.reshape((batch_loc,) + x_loc.shape[1:])
 
-    return jax.shard_map(
+    return jax_compat.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(param_specs, spec),
@@ -382,8 +383,8 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
                 % (batch_loc, M)
             )
         x_mb = x_loc.reshape((M, batch_loc // M) + x_loc.shape[1:])
-        vary = lambda b: jax.lax.pcast(
-            b, (axis,) + _spec_axes(spec), to="varying"
+        vary = lambda b: jax_compat.pvary(
+            b, (axis,) + _spec_axes(spec)
         )
         perm_fwd = [(j, (j + 1) % S) for j in range(S)]
 
@@ -451,10 +452,29 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
         d = jax.lax.axis_index(axis)
         batch_loc = g_loc.shape[0]
         g_mb = g_loc.reshape((M, batch_loc // M) + g_loc.shape[1:])
-        vary = lambda b: jax.lax.pcast(
-            b, (axis,) + _spec_axes(spec), to="varying"
+        vary = lambda b: jax_compat.pvary(
+            b, (axis,) + _spec_axes(spec)
         )
         perm_bwd = [(j, (j - 1) % S) for j in range(S)]
+        # Axes the stage params vary over beyond the stage/batch axes
+        # (e.g. tp in a Megatron-style stage): the vjp's input
+        # cotangent is a per-shard PARTIAL over these — each shard saw
+        # only its slice of the in-stage matmuls — and must be summed
+        # to become the true dx. Contract: a stage that shards params
+        # over such an axis must consume its input through them (the
+        # Megatron layout does); purely-replicated side paths would
+        # make this sum an overcount.
+        _pspec_axes = set()
+        for s in jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda s: isinstance(s, P)
+        ):
+            _pspec_axes |= set(_spec_axes(s))
+        partial_axes = tuple(
+            a for a in mesh.axis_names
+            if a in _pspec_axes
+            and a != axis
+            and a not in _spec_axes(spec)
+        )
 
         def pick_chunk(v):
             # pcast to varying over the data axes BEFORE the vjp: with
@@ -463,12 +483,11 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
             # of the implicit pvary); varying params keep the cotangent
             # a per-shard partial, summed once outside the shard_map.
             return jax.tree_util.tree_map(
-                lambda leaf: jax.lax.pcast(
+                lambda leaf: jax_compat.pvary(
                     jax.lax.dynamic_index_in_dim(
                         leaf, v, 0, keepdims=False
                     ),
                     _spec_axes(spec),
-                    to="varying",
                 ),
                 params,
             )
@@ -496,6 +515,7 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
             chunk_params = pick_chunk(v)
             _, vjp = jax.vjp(stage_fn, chunk_params, inp)
             dp, dinp = vjp(g_in)
+            dinp = jax_compat.cotangent_psum(dinp, partial_axes)
             gate = jnp.where(active, 1.0, 0.0).astype(g_loc.dtype)
             dparams = jax.tree_util.tree_map(
                 lambda acc, g: jax.lax.dynamic_update_index_in_dim(
@@ -529,8 +549,8 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
             # accumulated cotangents additionally vary over the batch
             # axes they flow in from
             jax.tree_util.tree_map(
-                lambda leaf: jax.lax.pcast(
-                    jnp.zeros_like(leaf), _spec_axes(spec), to="varying"
+                lambda leaf: jax_compat.pvary(
+                    jnp.zeros_like(leaf), _spec_axes(spec)
                 ),
                 params,
             ),
@@ -549,6 +569,27 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
         dx = jax.lax.psum(
             dx_mb.reshape((batch_loc,) + g_loc.shape[1:]), axis
         )
+        # mesh axes the out_specs never mention (e.g. tp when a stage
+        # psums over it internally) must be provably replicated; anchor
+        # that for the 0.4.x checker, which cannot infer it through
+        # the scanned vjp (identity on new JAX, see jax_compat)
+        def _missing(spec_like, extra=()):
+            mentioned = set(_spec_axes(spec_like)) | set(extra)
+            return tuple(
+                a for a in mesh.axis_names if a not in mentioned
+            )
+
+        spec_leaves, treedef = jax.tree_util.tree_flatten(
+            param_specs, is_leaf=lambda s: isinstance(s, P)
+        )
+        grad_leaves = treedef.flatten_up_to(dparams)
+        dparams = treedef.unflatten([
+            jax_compat.anchor_replicated(
+                g, _missing(s, DATA_AXES + (axis,))
+            )
+            for g, s in zip(grad_leaves, spec_leaves)
+        ])
+        dx = jax_compat.anchor_replicated(dx, _missing(spec, (axis,)))
         return dparams, dx
 
     # params_layout="device": the caller's stack is already device-
@@ -564,7 +605,7 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
     )
 
     def _sharded_fwd(params, x):
-        return jax.shard_map(
+        return jax_compat.shard_map(
             fwd_local,
             mesh=mesh,
             in_specs=(param_specs, spec),
@@ -585,7 +626,7 @@ def _1f1b_apply(stage_fn, stacked_params, x, M, mesh, axis, spec,
         partial_specs = jax.tree_util.tree_map(
             lambda p: P(*((DATA_AXES,) + tuple(p))), param_specs
         )
-        dparams, dx = jax.shard_map(
+        dparams, dx = jax_compat.shard_map(
             bwd_local,
             mesh=mesh,
             in_specs=(param_specs, saved_spec, spec),

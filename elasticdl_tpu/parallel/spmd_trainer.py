@@ -17,6 +17,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from elasticdl_tpu.common.log_utils import default_logger as _logger_factory
+from elasticdl_tpu.observability import device as device_obs
+from elasticdl_tpu.parallel.dense_plane import plan_dense_plane
 from elasticdl_tpu.parallel.mesh import (
     MeshConfig,
     batch_sharding,
@@ -71,6 +73,10 @@ class SpmdTrainer:
         self._state_shardings = None
         self._train_step = None
         self._eval_step = None
+        # dense data plane: derived at create_state (needs the param
+        # tree); exported to the worker TelemetryBlob via the
+        # dense-plane properties below
+        self.dense_plan = None
         logger.info(
             "SPMD mesh %s (%d-way data parallel)",
             dict(self.mesh.shape),
@@ -93,6 +99,7 @@ class SpmdTrainer:
         self._state_shardings = infer_state_shardings(
             abstract, self.mesh, self._rules
         )
+        self._set_dense_plan(abstract.params)
         with self.mesh:
             state = jax.jit(
                 lambda rng, feats: create_train_state(
@@ -103,6 +110,23 @@ class SpmdTrainer:
         self._train_step = None
         self._eval_step = None
         return state
+
+    def _set_dense_plan(self, abstract_params):
+        self.dense_plan = plan_dense_plane(
+            abstract_params, self.mesh, self._rules
+        )
+        summary = self.dense_plan.summary()
+        logger.info(
+            "dense plane: mesh %s, %d reduce-scatter / %d psum / %d "
+            "local params, %.1f MB dense state, ~%.1f MB collective "
+            "traffic per step (PS carries none of it)",
+            summary["mesh_shape"],
+            summary["reduce_scatter_params"],
+            summary["psum_params"],
+            summary["local_params"],
+            summary["param_bytes"] / 1e6,
+            summary["collective_bytes_per_step"] / 1e6,
+        )
 
     def abstract_state(self, sample_features):
         """Shape/dtype skeleton of create_state without materializing any
@@ -117,6 +141,7 @@ class SpmdTrainer:
         self._state_shardings = infer_state_shardings(
             abstract, self.mesh, self._rules
         )
+        self._set_dense_plan(abstract.params)
         self._train_step = None
         self._eval_step = None
         return abstract
@@ -135,19 +160,55 @@ class SpmdTrainer:
         # shardings are per-leaf (rank-dependent) when a batch_spec is
         # set.
         replicated = NamedSharding(self.mesh, P())
-        self._train_step = jax.jit(
+        # recompile sentinels (ISSUE 18): the SPMD step carries the
+        # same instrumentation as the single-chip JaxTrainer — compile
+        # ledger, cost model, signature provenance — so the worker's
+        # telemetry and the recompile_storm detector see the dense
+        # plane exactly like any other step function
+        self._train_step = device_obs.instrumented_jit(
             self._train_step_fn,
+            name="spmd_train_step",
             in_shardings=(self._state_shardings, self._shard_tree(batch)),
             out_shardings=(self._state_shardings, replicated),
             donate_argnums=(0,),
         )
-        self._eval_step = jax.jit(
+        self._eval_step = device_obs.instrumented_jit(
             self._eval_step_fn,
+            name="spmd_eval_step",
             in_shardings=(
                 self._state_shardings,
                 self._shard_tree(batch["features"]),
             ),
             out_shardings=replicated,
+        )
+
+    @property
+    def cost_step_flops(self):
+        """XLA cost-model FLOPs of the last-compiled train step (0.0
+        before the first compile or with device obs off)."""
+        return float(getattr(self._train_step, "cost_flops", 0.0))
+
+    @property
+    def cost_step_bytes(self):
+        return float(getattr(self._train_step, "cost_bytes", 0.0))
+
+    # dense-plane telemetry (this PR): the worker folds these into the
+    # TelemetryBlob so FleetMonitor /statusz and postmortem timelines
+    # can show what the dense plane looks like per worker
+    @property
+    def mesh_shape_str(self):
+        return (
+            self.dense_plan.mesh_shape_str()
+            if self.dense_plan is not None
+            else ""
+        )
+
+    @property
+    def collective_bytes_per_step(self):
+        return float(
+            self.dense_plan.collective_bytes_per_step
+            if self.dense_plan is not None
+            else 0.0
         )
 
     @property

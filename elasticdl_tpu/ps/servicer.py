@@ -298,6 +298,20 @@ class PserverServicer:
             "Embedding-row payload bytes served, by wire dtype",
             ("dtype",),
         )
+        # Dense-plane contract (ISSUE 20): dense gradients reduce
+        # on-mesh and never ride the PS — this counter MUST stay 0
+        # under the GSPMD trainers. It exists so the contract is a
+        # scrapeable fact, not an absence of evidence: the dense-plane
+        # smoke (scripts/bench_dense_plane.py) fails if it moves.
+        self._m_push_dense_bytes = obs_metrics.counter(
+            "edl_ps_push_dense_bytes_total",
+            "Dense-gradient payload bytes received over push_gradients "
+            "(0 under the GSPMD dense data plane: only embedding rows "
+            "ride the PS)",
+        )
+        # touch the series so /metrics exposes an explicit 0: the
+        # contract is "provably zero", not "no evidence either way"
+        self._m_push_dense_bytes.inc(0)
         # device-tier writebacks (ISSUE 6): rows overwritten by
         # push_embedding_rows — eviction/flush traffic from workers'
         # HBM hot sets
@@ -348,6 +362,7 @@ class PserverServicer:
         self._t_push_count = 0
         self._t_pull_count = 0
         self._t_push_bytes = 0
+        self._t_push_dense_bytes = 0
         self._t_pull_bytes = 0
         self._t_last_push_version = 0
         self._t_ckpt_dirty_rows = 0
@@ -625,6 +640,16 @@ class PserverServicer:
         self._t_push_bytes += payload
         if payload:
             self._m_push_bytes.labels(dtype=dtype).inc(payload)
+        # dense grads on the wire violate the dense-plane contract
+        # (ISSUE 20); tally them separately so the violation is a
+        # nonzero counter, not traffic blended into the sparse series
+        dense_payload = sum(
+            len(blob.content)
+            for blob in request.gradients.dense_parameters.values()
+        )
+        if dense_payload:
+            self._t_push_dense_bytes += dense_payload
+            self._m_push_dense_bytes.inc(dense_payload)
 
     def _pending_depth(self):
         """Admission-control depth: in-flight push handlers plus the

@@ -60,6 +60,7 @@ from elasticdl_tpu.parallel.mesh import (
     build_mesh,
     data_parallel_size,
 )
+from elasticdl_tpu.parallel.dense_plane import plan_dense_plane
 from elasticdl_tpu.parallel.multihost_trainer import LockstepMixin
 from elasticdl_tpu.parallel.sharding import infer_state_shardings
 from elasticdl_tpu.train.sparse import (
@@ -104,6 +105,11 @@ class SparseSpmdTrainer(SparseTrainer):
         self._state_shardings = None
         self._batch_nd = batch_sharding(self.mesh)
         self._replicated_nd = NamedSharding(self.mesh, P())
+        # dense data plane (ISSUE 20): the sparse trainer's DENSE half
+        # is the same GSPMD plane SpmdTrainer runs — derive the same
+        # per-param reduction plan at create_state so mesh_shape /
+        # collective_bytes_per_step telemetry covers sparse jobs too
+        self.dense_plan = None
         super().__init__(
             model,
             loss_fn,
@@ -228,6 +234,7 @@ class SparseSpmdTrainer(SparseTrainer):
         self._state_shardings = infer_state_shardings(
             abstract, self.mesh, self._rules
         )
+        self._set_dense_plan(abstract.params)
         self._invalidate_compiled()
         with self.mesh:
             return device_obs.instrumented_jit(
@@ -280,8 +287,41 @@ class SparseSpmdTrainer(SparseTrainer):
         self._state_shardings = infer_state_shardings(
             abstract, self.mesh, self._rules
         )
+        self._set_dense_plan(abstract.params)
         self._invalidate_compiled()
         return abstract
+
+    def _set_dense_plan(self, abstract_params):
+        self.dense_plan = plan_dense_plane(
+            abstract_params, self.mesh, self._rules
+        )
+        summary = self.dense_plan.summary()
+        logger.info(
+            "sparse-SPMD dense plane: mesh %s, %d reduce-scatter / "
+            "%d psum / %d local params, ~%.2f MB collective traffic "
+            "per step (the PS carries embedding rows only)",
+            summary["mesh_shape"],
+            summary["reduce_scatter_params"],
+            summary["psum_params"],
+            summary["local_params"],
+            summary["collective_bytes_per_step"] / 1e6,
+        )
+
+    @property
+    def mesh_shape_str(self):
+        return (
+            self.dense_plan.mesh_shape_str()
+            if self.dense_plan is not None
+            else ""
+        )
+
+    @property
+    def collective_bytes_per_step(self):
+        return float(
+            self.dense_plan.collective_bytes_per_step
+            if self.dense_plan is not None
+            else 0.0
+        )
 
     @property
     def state_shardings(self):

@@ -379,6 +379,7 @@ class Worker:
         # opts out entirely.
         self._telemetry_on = env_str("EDL_TELEMETRY", "") != "0"
         self._step_ewma = 0.0
+        self._dense_share_ewma = 0.0
         self._last_examples_per_sec = 0.0
         self._prev_batch_end = 0.0
         self._telemetry_samples = 0
@@ -474,6 +475,25 @@ class Worker:
         blob.brownout_skipped_pushes = getattr(
             self.trainer, "brownout_skipped_pushes", 0
         )
+        # dense data plane (ISSUE 20): mesh topology + collective
+        # traffic of the GSPMD dense step, so /statusz and the
+        # postmortem timeline show which bytes ride the ICI instead of
+        # the PS. mesh_epoch is the rendezvous epoch this worker is
+        # training under (-1 until the first heartbeat lands); the
+        # share is the device-step fraction of batch wall time (1.0 on
+        # a pure-dense trainer — the PS carries nothing).
+        blob.mesh_shape = str(
+            getattr(self.trainer, "mesh_shape_str", "") or ""
+        )
+        blob.mesh_epoch = (
+            -1 if self._seen_mesh_epoch is None
+            else int(self._seen_mesh_epoch)
+        )
+        blob.collective_bytes_per_step = float(
+            getattr(self.trainer, "collective_bytes_per_step", 0.0)
+            or 0.0
+        )
+        blob.dense_step_share = self._dense_share_ewma
         return blob
 
     def _update_step_telemetry(self, real_count):
@@ -517,6 +537,26 @@ class Worker:
                 else 0.9 * self._step_ewma + 0.1 * step_secs
             )
         self._ewma_outlier_streak = 0
+        # dense-step share (ISSUE 20): fraction of the batch spent in
+        # the jitted device step. Sparse trainers time their device
+        # portion in their own Timing bridge ("batch_process" there
+        # excludes PS pull/push); a trainer without one (JaxTrainer,
+        # SpmdTrainer) IS the device step end-to-end, share 1.0.
+        trainer_timing = getattr(self.trainer, "timing", None)
+        dense_secs = (
+            trainer_timing.last_seconds.get("batch_process")
+            if trainer_timing is not None
+            else None
+        )
+        share = (
+            1.0 if dense_secs is None
+            else min(dense_secs / step_secs, 1.0)
+        )
+        self._dense_share_ewma = (
+            share
+            if self._dense_share_ewma == 0.0
+            else 0.9 * self._dense_share_ewma + 0.1 * share
+        )
         self._last_examples_per_sec = real_count / step_secs
 
     def _check_mesh_epoch(self):

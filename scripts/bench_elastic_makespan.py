@@ -28,6 +28,13 @@ workers as real OS processes occupying slots.
            SIGTERM -> finish current task -> join async push ->
            deregister. Every resize lands in the event journal as a
            scale_decision with the signals that fired.
+- mesh (--mode mesh, ISSUE 20): the multihost correctness gate. One
+           job whose workers form a ``jax.distributed`` mesh (the
+           GSPMD dense data plane) is grown dp=2 -> dp=3 and then
+           shrunk back mid-run; each resize is a mesh-epoch restart,
+           and the gate asserts zero lost/duplicated training steps
+           plus a ``mesh_epoch_restart`` journal entry with a reason
+           for every transition.
 
 Prints one JSON line: makespans, job-2 wait, and the speedup of the
 chosen elastic mode over gang. CPU backend; runs in ~4-8 min.
@@ -385,6 +392,256 @@ def run_autoscale(train1, train2, tmp, slots, **job_kw):
             job2.shutdown()
 
 
+def run_mesh_elastic(train, tmp, records, records_per_task, num_epochs,
+                     events_dir, deadline_secs=600.0):
+    """ISSUE 20: elasticity under the GSPMD dense data plane. The
+    scenarios above treat each worker as an independent consumer (its
+    own singleton mesh); the dense data plane makes the WORKER SET one
+    ``jax.distributed`` mesh, so a resize is a mesh-epoch restart —
+    checkpoint sharded dense state, re-form the world, resume. This
+    scenario drives one multihost job through a mid-run GROW (a third
+    worker joins: dp=2 -> dp=3) and a mid-run SHRINK (that worker is
+    SIGKILLed; the liveness monitor evicts it: dp=3 -> dp=2) and
+    asserts the elasticity contract mechanically:
+
+    - the job finishes with every training task completed EXACTLY once
+      across both restarts (lost work would stall ``finished()``;
+      duplicated work would over-count done tasks — the dispatcher
+      requeues in-flight tasks on an epoch change and drops stale
+      double-reports, and this is where that is proven end-to-end);
+    - every mesh transition lands in the event journal as
+      ``mesh_epoch_restart`` with old/new world sizes and a reason:
+      the run must contain the grow to world 3 (``worker_join:...``)
+      and the eviction shrink (``worker_death:...``).
+    """
+    import math
+
+    from elasticdl_tpu.common.grpc_utils import (
+        build_server, find_free_port,
+    )
+    from elasticdl_tpu.data.readers import RecordIODataReader
+    from elasticdl_tpu.master.fleet import FleetMonitor
+    from elasticdl_tpu.master.rendezvous import MeshRendezvous
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.master.task_monitor import TaskMonitor
+    from elasticdl_tpu.proto.services import (
+        add_master_servicer_to_server,
+    )
+    from scripts.bench_dense_plane import (
+        _spawn_worker as _spawn_mh_worker,
+    )
+    from scripts.convergence_elastic import _spawn_ps, _wait_port
+    from tests.test_utils import load_journal
+
+    t0 = time.time()
+    reader = RecordIODataReader(data_dir=train)
+    dispatcher = TaskDispatcher(
+        training_shards=reader.create_shards(),
+        records_per_task=records_per_task,
+        num_epochs=num_epochs,
+        seed=0,
+    )
+    fleet = FleetMonitor()
+    rendezvous = MeshRendezvous()
+    servicer = MasterServicer(
+        dispatcher, None, rendezvous=rendezvous, fleet_monitor=fleet
+    )
+    monitor = TaskMonitor(
+        dispatcher, servicer, rendezvous=rendezvous,
+        # restart-tolerant budgets (tests/test_multihost_e2e.py): the
+        # liveness timeout must exceed a worker's relaunch latency, and
+        # the grace window must cover the whole-world restart after
+        # each epoch bump, or eviction churn cascades
+        liveness_timeout_secs=30.0,
+        scan_interval_secs=0.5,
+        mesh_restart_grace_secs=25.0,
+    )
+    server = build_server()
+    add_master_servicer_to_server(servicer, server)
+    master_port = find_free_port()
+    server.add_insecure_port("localhost:%d" % master_port)
+    server.start()
+    monitor.start()
+    ports = [find_free_port() for _ in range(2)]
+    ps_procs = [_spawn_ps(i, 2, p, 0.01) for i, p in enumerate(ports)]
+    for p in ports:
+        _wait_port(p)
+    ps_addrs = ",".join("localhost:%d" % p for p in ports)
+    coordinator_port = find_free_port()
+    ckpt_dir = os.path.join(tmp, "mesh_ckpt")
+    logs = {i: os.path.join(tmp, "mesh_w%d.log" % i) for i in range(3)}
+    workers = {}
+    relaunches = {0: 0, 1: 0, 2: 0}
+    members = {0, 1}
+
+    def done_tasks():
+        return dispatcher.stats()["done"].get("training", 0)
+
+    def spawn(i):
+        workers[i] = _spawn_mh_worker(
+            i, master_port, coordinator_port, train, ps_addrs,
+            ckpt_dir, logs[i],
+        )
+
+    def supervise():
+        # pod-manager stand-in: every epoch bump makes the surviving
+        # workers exit for restart (worker/main.py EPOCH_RESTART_EXIT
+        # path), and late jax.distributed joiners can abort fatally —
+        # relaunch members until the run completes
+        for i in list(members):
+            proc = workers.get(i)
+            if proc is not None and proc.poll() is None:
+                continue
+            relaunches[i] += 1
+            if relaunches[i] >= 20:
+                raise SystemExit(
+                    "FAIL: mesh worker %d restart-looped; log tail:\n%s"
+                    % (i, open(logs[i]).read()[-2500:])
+                )
+            spawn(i)
+
+    max_world = 0
+    grown_done = shrunk_at = None
+    phase = "warmup"
+    try:
+        spawn(0)
+        spawn(1)
+        deadline = t0 + deadline_secs
+        while time.time() < deadline:
+            supervise()
+            world = len(rendezvous.hosts())
+            max_world = max(max_world, world)
+            done = done_tasks()
+            if phase == "warmup" and world == 2 and done >= 2:
+                # GROW mid-run: a new host registers; the rendezvous
+                # bumps the epoch and the live workers restart into
+                # the dp=3 world
+                members.add(2)
+                spawn(2)
+                phase = "growing"
+            elif phase == "growing":
+                # don't shrink until the dp=3 world has actually
+                # FORMED — a worker reporting mesh_shape=dp=3 has
+                # completed the jax.distributed join and rebuilt its
+                # trainer. Killing a member while the world is still
+                # re-forming is a different (supported, watchdogged)
+                # scenario, but this gate must exercise a clean
+                # grown-then-shrunk cycle to prove the step
+                # accounting, not a join race.
+                dp3 = any(
+                    entry.get("mesh_shape") == "dp=3"
+                    for entry in fleet.snapshot().get(
+                        "dense_plane", {}
+                    ).values()
+                )
+                if dp3:
+                    grown_done = done
+                    phase = "grown"
+            elif phase == "grown" and done >= grown_done + 2:
+                # SHRINK mid-run: hard-kill the third worker (no
+                # graceful leave) — the liveness monitor must evict it
+                # and bump the epoch back down to dp=2
+                members.discard(2)
+                proc = workers.get(2)
+                if proc is not None and proc.poll() is None:
+                    proc.send_signal(signal.SIGKILL)
+                    try:
+                        proc.wait(timeout=10)
+                    except Exception:
+                        pass
+                shrunk_at = done
+                phase = "shrunk"
+            if dispatcher.finished():
+                break
+            time.sleep(0.5)
+        elapsed = time.time() - t0
+        if not dispatcher.finished():
+            raise SystemExit(
+                "FAIL: mesh job never finished in %.0fs (phase %s); "
+                "worker log tail:\n%s"
+                % (deadline_secs, phase, open(logs[0]).read()[-2500:])
+            )
+        if dispatcher.job_failed():
+            raise SystemExit("FAIL: mesh job failed")
+        done = done_tasks()
+    finally:
+        for proc in workers.values():
+            if proc.poll() is None:
+                proc.kill()
+        for p in ps_procs:
+            p.terminate()
+        for p in ps_procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        monitor.stop()
+        server.stop(0)
+
+    expected = int(math.ceil(records / float(records_per_task))) \
+        * num_epochs
+    restarts = [
+        e for e in load_journal(events_dir)
+        if e.get("event") == "mesh_epoch_restart"
+    ]
+    grows = [
+        e for e in restarts
+        if e.get("new_world", 0) > e.get("old_world", 0)
+    ]
+    shrinks = [
+        e for e in restarts
+        if e.get("new_world", 0) < e.get("old_world", 0)
+    ]
+    result = {
+        "elapsed_s": round(elapsed, 1),
+        "expected_tasks": expected,
+        "done_tasks": done,
+        "max_world": max_world,
+        "mesh_epoch": rendezvous.mesh_epoch,
+        "epoch_restarts": len(restarts),
+        "grow_reasons": sorted({e.get("reason", "") for e in grows}),
+        "shrink_reasons": sorted(
+            {e.get("reason", "") for e in shrinks}
+        ),
+        "relaunches": dict(relaunches),
+    }
+    failures = []
+    if done != expected:
+        failures.append(
+            "%s steps: %d training tasks done, %d expected"
+            % ("LOST" if done < expected else "DUPLICATED",
+               done, expected)
+        )
+    if shrunk_at is None:
+        failures.append(
+            "job finished before the shrink was exercised (phase %s; "
+            "raise --records)" % phase
+        )
+    if not any(
+        e.get("new_world") == 3
+        and e.get("reason", "").startswith("worker_join")
+        for e in grows
+    ):
+        failures.append(
+            "no worker_join grow to world 3 journaled: %r" % restarts
+        )
+    if not any(
+        e.get("reason", "").startswith(("worker_death", "worker_leave"))
+        for e in shrinks
+    ):
+        failures.append(
+            "no worker_death/worker_leave shrink journaled: %r"
+            % restarts
+        )
+    if any(not e.get("reason") for e in restarts):
+        failures.append(
+            "mesh_epoch_restart journaled WITHOUT a reason: %r"
+            % restarts
+        )
+    return result, failures
+
+
 def _load_scale_decisions(events_dir):
     from tests.test_utils import load_journal
 
@@ -411,11 +668,14 @@ def main():
     parser.add_argument("--records_per_task", type=int, default=256)
     parser.add_argument("--num_epochs", type=int, default=2)
     parser.add_argument(
-        "--mode", choices=("both", "elastic", "autoscale", "all"),
+        "--mode",
+        choices=("both", "elastic", "autoscale", "mesh", "all"),
         default="both",
         help="both = gang + hardcoded elastic (the §B reproduction); "
         "autoscale = gang + the ISSUE-7 control loop making every "
-        "resize; all = the three-way comparison",
+        "resize; mesh = the ISSUE-20 multihost grow/shrink "
+        "correctness gate (no gang baseline — it asserts zero "
+        "lost/duplicated steps, not makespan); all = everything",
     )
     args = parser.parse_args()
 
@@ -437,11 +697,13 @@ def main():
     )
     want_elastic = args.mode in ("both", "elastic", "all")
     want_autoscale = args.mode in ("autoscale", "all")
+    want_mesh = args.mode in ("mesh", "all")
+    want_gang = want_elastic or want_autoscale
     events_dir = None
-    if want_autoscale:
+    if want_autoscale or want_mesh:
         # the acceptance contract: every resize must be explained by a
-        # scale_decision in the journal (workers journal their drain
-        # acks into the same dir)
+        # journal event — scale_decision for the autoscale lane,
+        # mesh_epoch_restart (with reasons) for the mesh lane
         events_dir = os.path.join(tmp, "events")
         os.makedirs(events_dir, exist_ok=True)
         # unconditional: an inherited EDL_EVENTS_DIR (e.g. ci.sh's
@@ -452,9 +714,12 @@ def main():
 
         events.configure("bench-master")
 
-    gang = run_gang(dirs[0], dirs[1], tmp, args.slots, **job_kw)
-    print("[gang]      %s" % gang, flush=True)
-    summary = {"slots": args.slots, "mode": args.mode, "gang": gang}
+    summary = {"slots": args.slots, "mode": args.mode}
+    gang = None
+    if want_gang:
+        gang = run_gang(dirs[0], dirs[1], tmp, args.slots, **job_kw)
+        print("[gang]      %s" % gang, flush=True)
+        summary["gang"] = gang
     if want_elastic:
         elastic = run_elastic(
             dirs[0], dirs[1], tmp, args.slots, **job_kw
@@ -482,8 +747,18 @@ def main():
         summary["beats_gang"] = (
             autoscale["makespan_s"] < gang["makespan_s"]
         )
+    mesh_failures = []
+    if want_mesh:
+        mesh, mesh_failures = run_mesh_elastic(
+            dirs[0], tmp, args.records, args.records_per_task,
+            args.num_epochs, events_dir,
+        )
+        print("[mesh]      %s" % mesh, flush=True)
+        summary["mesh"] = mesh
 
     print(json.dumps(summary))
+    if mesh_failures:
+        raise SystemExit("FAIL: " + "; ".join(mesh_failures))
     if want_autoscale:
         # the autoscaled run must beat the static gang baseline AND be
         # able to explain every resize — a silent scaler is a bug even

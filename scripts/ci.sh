@@ -1823,6 +1823,21 @@ printf '{"ts": "%s", "overload": %s}\n' \
   >> /tmp/ci_wire_micro.jsonl
 echo "overload containment A/B journaled to /tmp/ci_wire_micro.jsonl"
 
+echo "== tier 1g: dense data plane smoke (2-process CPU mesh, no PS on the dense path) =="
+# Dense-plane contract (ISSUE 20): a real 2-worker jax.distributed
+# deepfm job (dp=2 CPU mesh over gloo) against an in-process master
+# and a live PS subprocess. Hard gates: the PS's scraped byte counters
+# must show embedding-row pushes > 0 while
+# edl_ps_push_dense_bytes_total stays exactly 0 (dense gradients
+# reduce on-mesh, never over the PS), and both workers must report
+# mesh_shape=dp=2 dense-plane telemetry to the FleetMonitor. Timings
+# are report-only (journaled below).
+JAX_PLATFORMS=cpu python scripts/bench_dense_plane.py | tee /tmp/_dense_plane.json
+printf '{"ts": "%s", "dense_plane": %s}\n' \
+  "$(date -u +%Y-%m-%dT%H:%M:%SZ)" "$(cat /tmp/_dense_plane.json)" \
+  >> /tmp/ci_wire_micro.jsonl
+echo "dense-plane smoke journaled to /tmp/ci_wire_micro.jsonl"
+
 # Bench-trend watchdog (ISSUE 14): folds the repo's BENCH_r*.json
 # series plus everything this run just journaled above into per-metric
 # trajectories and flags any metric >20% worse than its best recorded

@@ -177,6 +177,15 @@ def summarize(timeline, dump_headers):
     device = {"recompiles": 0, "recompile_storms": 0,
               "hbm_pressure": 0, "compile_secs": 0.0}
     device_roles = {}  # role -> {"recompiles": n, "last_changed": [..]}
+    # dense data plane (ISSUE 20): every mesh-epoch restart the elastic
+    # controller (or a worker death) forced, with the old -> new mesh
+    # shapes kept verbatim — grow/shrink history is the elasticity
+    # story of the run
+    # "restarts" counts the master's authoritative epoch bumps (the
+    # events carrying old/new worlds); "worker_exits" counts the
+    # individual workers that journaled their restart-and-rejoin
+    mesh = {"restarts": 0, "grows": 0, "shrinks": 0, "worker_exits": 0}
+    mesh_transitions = []  # ordered "old -> new (reason)" strings
     job_failed = None
     for event in timeline:
         kind = event.get("event")
@@ -244,6 +253,26 @@ def summarize(timeline, dump_headers):
             health_roles.setdefault(
                 str(event.get("role", "?")), []
             ).append(kind)
+        elif kind == "mesh_epoch_restart":
+            if "new_world" not in event:
+                mesh["worker_exits"] += 1  # a worker's own exit record
+                continue
+            mesh["restarts"] += 1
+            old_world = int(event.get("old_world", 0))
+            new_world = int(event.get("new_world", 0))
+            if new_world > old_world:
+                mesh["grows"] += 1
+            elif new_world < old_world:
+                mesh["shrinks"] += 1
+            mesh_transitions.append(
+                "%s -> %s (epoch %s, %s)"
+                % (
+                    event.get("old_mesh", "?"),
+                    event.get("new_mesh", "?"),
+                    event.get("epoch", "?"),
+                    event.get("reason", "?"),
+                )
+            )
         elif kind == "xla_recompile":
             device["recompiles"] += 1
             device["compile_secs"] += float(event.get("seconds", 0.0))
@@ -274,6 +303,8 @@ def summarize(timeline, dump_headers):
         "health_roles": health_roles,
         "device": device,
         "device_roles": device_roles,
+        "mesh": mesh,
+        "mesh_transitions": mesh_transitions,
         "job_failed": job_failed,
     }
 
@@ -358,6 +389,16 @@ def render_text(timeline, summary, dump_headers, alert_counters):
                 % (role, entry["recompiles"], ",".join(entry["fns"]),
                    entry["last_changed"])
             )
+    mesh = summary.get("mesh", {})
+    if mesh.get("restarts") or mesh.get("worker_exits"):
+        lines.append(
+            "  mesh epochs: restarts=%d grows=%d shrinks=%d "
+            "worker_exits=%d"
+            % (mesh["restarts"], mesh["grows"], mesh["shrinks"],
+               mesh["worker_exits"])
+        )
+        for transition in summary.get("mesh_transitions", ()):
+            lines.append("    %s" % transition)
     if summary["job_failed"]:
         lines.append("  JOB FAILED: %r" % (summary["job_failed"],))
     return "\n".join(lines)

@@ -328,10 +328,16 @@ def test_bubble_fraction_interleaving_beats_gpipe():
 
 def _tp_stage_fn(p, x):
     """Megatron-style column+row parallel MLP: W1 sharded on its output
-    dim over tp, W2 on its input dim; one manual psum rejoins the
-    activation — tensor parallelism INSIDE a pipeline stage."""
+    dim over tp, W2 on its input dim; one manual all-reduce rejoins the
+    activation — tensor parallelism INSIDE a pipeline stage. Routed
+    through mesh_psum (not bare lax.psum): the schedule differentiates
+    the stage body inside the shard_map region, and mesh_psum is the
+    collective whose transpose is correct there on every jax version
+    (see parallel/collectives.py)."""
+    from elasticdl_tpu.parallel.collectives import mesh_psum
+
     h = jnp.maximum(x @ p["W1"], 0.0)
-    return jax.lax.psum(h @ p["W2"], "tp") + p["b"]
+    return mesh_psum(h @ p["W2"], "tp") + p["b"]
 
 
 def test_tp_inside_pp():

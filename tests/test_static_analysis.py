@@ -1607,6 +1607,80 @@ def test_obs_bare_jit_suppression_comment_works():
 
 
 # ---------------------------------------------------------------------------
+# perf-bare-collective (ISSUE 20)
+
+def test_perf_bare_collective_flags_raw_lax_in_model_scope():
+    findings = findings_for("""
+        import jax
+
+        def stage(p, x):
+            h = x @ p["W1"]
+            return jax.lax.psum(h @ p["W2"], "tp")  # BUG
+    """, path="elasticdl_tpu/models/fixture.py",
+       rules=["perf-bare-collective"])
+    assert len(findings) == 1, findings
+    assert findings[0].code == "lax.psum()"
+    assert "mesh_psum" in findings[0].message
+
+
+def test_perf_bare_collective_flags_bare_import_and_lax_prefix():
+    findings = findings_for("""
+        from jax.lax import psum
+        from jax import lax
+
+        def reduce_all(x, v):
+            a = psum(x, "dp")           # BUG (bare import)
+            b = lax.all_gather(v, "dp")  # BUG (lax prefix)
+            return a, b
+    """, path="elasticdl_tpu/train/fixture.py",
+       rules=["perf-bare-collective"])
+    assert sorted(f.code for f in findings) == [
+        "lax.all_gather()", "lax.psum()"
+    ]
+
+
+def test_perf_bare_collective_quiet_on_helpers_and_owned_scopes():
+    # the sanctioned helpers have different leaf names
+    assert not findings_for("""
+        from elasticdl_tpu.parallel.collectives import (
+            mesh_psum, mesh_reduce_scatter,
+        )
+
+        def stage(p, x):
+            g = mesh_reduce_scatter(x, "fsdp")
+            return mesh_psum(g @ p["W"], "tp")
+    """, path="elasticdl_tpu/models/fixture.py",
+       rules=["perf-bare-collective"])
+    # parallel/ and ops/ OWN communication; raw lax is their job
+    for owned in ("parallel", "ops"):
+        assert not findings_for("""
+            import jax
+
+            def helper(x):
+                return jax.lax.psum(x, "tp")
+        """, path="elasticdl_tpu/%s/fixture.py" % owned,
+           rules=["perf-bare-collective"])
+    # non-lax attributes sharing a collective's leaf name are not
+    # collectives
+    assert not findings_for("""
+        def pull(store, ids):
+            return store.all_gather(ids)
+    """, path="elasticdl_tpu/ps/fixture.py",
+       rules=["perf-bare-collective"])
+
+
+def test_perf_bare_collective_suppression_comment_works():
+    assert not findings_for("""
+        import jax
+
+        def compat_sum(x, axes):
+            # edlint: disable=perf-bare-collective
+            return jax.lax.psum(x, axes)
+    """, path="elasticdl_tpu/common/fixture.py",
+       rules=["perf-bare-collective"])
+
+
+# ---------------------------------------------------------------------------
 # the gate
 
 @pytest.mark.lint
